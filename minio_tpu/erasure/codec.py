@@ -1,0 +1,248 @@
+"""Erasure codec: the TPU-backed equivalent of the reference's `Erasure`
+value type (/root/reference/cmd/erasure-coding.go:34-149).
+
+Shard geometry (ShardSize/ShardFileSize/ShardFileOffset), split semantics,
+and the empty/all-zero early-outs reproduce the reference exactly; output
+bytes are bit-identical to klauspost/reedsolomon (validated against the
+golden xxhash64 vectors of erasureSelfTest, cmd/erasure-coding.go:157-215).
+
+The compute itself is redesigned for TPU: parity generation and
+reconstruction are GF(2) bit-matrix matmuls (ops/gf.py, ops/rs.py) that
+run on the MXU, batched over many 1 MiB blocks per dispatch instead of the
+reference's one-block-at-a-time goroutine fan-out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import gf, rs
+from ..utils import ceil_frac
+from ..utils.errors import (
+    ErrInvShardNum,
+    ErrMaxShardNum,
+    ErrReconstructRequired,
+    ErrShardSize,
+    ErrShortData,
+    ErrTooFewShards,
+)
+
+# Below this shard size the fixed JAX dispatch cost dominates; stay on the
+# host bit-matmul path. Above it, ship batches to the accelerator.
+_DEVICE_SHARD_THRESHOLD = 4096
+
+
+class Erasure:
+    """Erasure coding engine for one (data, parity, block_size) geometry."""
+
+    def __init__(self, data_blocks: int, parity_blocks: int, block_size: int):
+        # Parameter checks mirror NewErasure (cmd/erasure-coding.go:41-49).
+        if data_blocks <= 0 or parity_blocks <= 0:
+            raise ErrInvShardNum(
+                f"data={data_blocks} parity={parity_blocks} must be > 0"
+            )
+        if data_blocks + parity_blocks > gf.MAX_SHARDS:
+            raise ErrMaxShardNum(
+                f"data+parity={data_blocks + parity_blocks} exceeds 256"
+            )
+        self.data_blocks = data_blocks
+        self.parity_blocks = parity_blocks
+        self.block_size = block_size
+        self.total_shards = data_blocks + parity_blocks
+        # Host-side byte matrices (lru-cached module-level).
+        self.matrix = gf.rs_matrix(data_blocks, parity_blocks)
+        self._parity_bits_np = gf.bit_matrix(
+            gf.parity_matrix(data_blocks, parity_blocks)
+        )
+        self._parity_bits_dev = None  # lazily device_put on first large encode
+
+    # --- geometry (cmd/erasure-coding.go:120-149) ---
+
+    def shard_size(self) -> int:
+        """Actual shard size from the erasure blockSize."""
+        return ceil_frac(self.block_size, self.data_blocks)
+
+    def shard_file_size(self, total_length: int) -> int:
+        """Final erasure size on each disk from the original object size."""
+        if total_length == 0:
+            return 0
+        if total_length == -1:
+            return -1
+        num_shards = total_length // self.block_size
+        last_block_size = total_length % self.block_size
+        last_shard_size = ceil_frac(last_block_size, self.data_blocks)
+        return num_shards * self.shard_size() + last_shard_size
+
+    def shard_file_offset(self, start_offset: int, length: int, total_length: int) -> int:
+        """Effective per-shard offset where erasure reading ends."""
+        shard_size = self.shard_size()
+        shard_file_size = self.shard_file_size(total_length)
+        end_shard = (start_offset + length) // self.block_size
+        till_offset = end_shard * shard_size + shard_size
+        if till_offset > shard_file_size:
+            till_offset = shard_file_size
+        return till_offset
+
+    # --- device matrix helpers ---
+
+    def _parity_bitmat(self, on_device: bool):
+        if not on_device:
+            return self._parity_bits_np
+        if self._parity_bits_dev is None:
+            import jax
+
+            self._parity_bits_dev = jax.device_put(self._parity_bits_np)
+        return self._parity_bits_dev
+
+    def _apply(self, bitmat_np: np.ndarray, shards: np.ndarray,
+               dev_bitmat=None) -> np.ndarray:
+        """Apply an expanded GF(2) matrix to [.., K, S] shards, picking the
+        host or accelerator path by size. `dev_bitmat` supplies an
+        already-device-resident copy of the matrix to avoid re-uploading."""
+        if shards.shape[-1] >= _DEVICE_SHARD_THRESHOLD:
+            out = rs.apply_gf_matrix(
+                bitmat_np if dev_bitmat is None else dev_bitmat, shards
+            )
+            return np.asarray(out)
+        return rs.gf_matmul_shards_np(bitmat_np, shards)
+
+    def _apply_parity(self, shards: np.ndarray) -> np.ndarray:
+        on_device = shards.shape[-1] >= _DEVICE_SHARD_THRESHOLD
+        return self._apply(
+            self._parity_bits_np,
+            shards,
+            dev_bitmat=self._parity_bitmat(True) if on_device else None,
+        )
+
+    # --- split / encode (cmd/erasure-coding.go:76-90 + klauspost Split) ---
+
+    def split(self, data) -> list[np.ndarray]:
+        """Split data into k zero-padded data shards plus m empty parity
+        shard buffers, matching reedsolomon.Encoder.Split."""
+        data = np.frombuffer(memoryview(data), dtype=np.uint8)
+        if data.size == 0:
+            raise ErrShortData("cannot split empty data")
+        per_shard = ceil_frac(data.size, self.data_blocks)
+        padded = np.zeros(self.total_shards * per_shard, dtype=np.uint8)
+        padded[: data.size] = data
+        return list(padded.reshape(self.total_shards, per_shard))
+
+    def encode_data(self, data) -> list[np.ndarray]:
+        """Split + encode one block of bytes into k+m shards.
+
+        Empty input returns k+m empty shards (cmd/erasure-coding.go:77-79).
+        """
+        data = np.frombuffer(memoryview(data), dtype=np.uint8)
+        if data.size == 0:
+            return [np.zeros(0, dtype=np.uint8) for _ in range(self.total_shards)]
+        shards = self.split(data)
+        data_mat = np.stack(shards[: self.data_blocks])
+        parity = self._apply_parity(data_mat)
+        for i in range(self.parity_blocks):
+            shards[self.data_blocks + i] = parity[i]
+        return shards
+
+    def encode_batch(self, blocks: np.ndarray) -> np.ndarray:
+        """Batched encode: blocks [B, K, S] data shards -> [B, M, S] parity.
+
+        This is the TPU throughput path: many 1 MiB blocks per dispatch so
+        the MXU matmul amortizes transfers (unlike the reference's
+        block-at-a-time Encode loop, cmd/erasure-encode.go:80-108).
+        """
+        blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+        return self._apply_parity(blocks)
+
+    # --- reconstruct / decode (cmd/erasure-coding.go:95-118) ---
+
+    def decode_data_blocks(self, shards: list) -> list:
+        """Reconstruct ONLY missing data shards in-place; parity entries may
+        remain missing. Mirrors Erasure.DecodeDataBlocks semantics: if no
+        shard is missing — or every shard is missing (0-byte payload) — it
+        is a no-op."""
+        # Reference counts with an early break, so the all-missing early-out
+        # only triggers for a single-shard list; with >=1 missing shard in a
+        # normal k+m list, reconstruction runs (and raises ErrTooFewShards
+        # when everything is gone), cmd/erasure-coding.go:96-106.
+        is_zero = 0
+        for b in shards:
+            if b is None or len(b) == 0:
+                is_zero += 1
+                break
+        if is_zero == 0 or is_zero == len(shards):
+            return shards
+        return self._reconstruct(shards, data_only=True)
+
+    def decode_data_and_parity_blocks(self, shards: list) -> list:
+        """Reconstruct all missing shards (data and parity)."""
+        missing = [i for i, b in enumerate(shards) if b is None or len(b) == 0]
+        if not missing:
+            return shards
+        return self._reconstruct(shards, data_only=False)
+
+    def _reconstruct(self, shards: list, data_only: bool) -> list:
+        if len(shards) != self.total_shards:
+            raise ErrTooFewShards(
+                f"got {len(shards)} shards, want {self.total_shards}"
+            )
+        present = [i for i, b in enumerate(shards) if b is not None and len(b) > 0]
+        if len(present) < self.data_blocks:
+            raise ErrTooFewShards(
+                f"{len(present)} shards present, need {self.data_blocks}"
+            )
+        shard_len = len(shards[present[0]])
+        for i in present:
+            if len(shards[i]) != shard_len:
+                raise ErrShardSize("present shards differ in size")
+
+        missing = [i for i in range(self.total_shards) if i not in set(present)]
+        if data_only:
+            missing = [i for i in missing if i < self.data_blocks]
+        if not missing:
+            return shards
+
+        mat = gf.reconstruct_matrix(
+            self.data_blocks, self.parity_blocks, present, missing
+        )
+        src = np.stack(
+            [np.frombuffer(memoryview(shards[i]), dtype=np.uint8)
+             for i in present[: self.data_blocks]]
+        )
+        out = self._apply(gf.bit_matrix(mat), src)
+        for t_i, t in enumerate(missing):
+            shards[t] = out[t_i]
+        return shards
+
+    def reconstruct_targets(self, shards: list, targets: list[int]) -> list[np.ndarray]:
+        """Regenerate exactly `targets` shard indices from >=k present
+        shards without mutating the input list. Used by the heal engine
+        (equivalent of cmd/erasure-lowlevel-heal.go:28-48, where only the
+        stale disks receive writes)."""
+        present = [i for i, b in enumerate(shards) if b is not None and len(b) > 0]
+        if len(present) < self.data_blocks:
+            raise ErrTooFewShards(
+                f"{len(present)} shards present, need {self.data_blocks}"
+            )
+        mat = gf.reconstruct_matrix(
+            self.data_blocks, self.parity_blocks, present, targets
+        )
+        src = np.stack(
+            [np.frombuffer(memoryview(shards[i]), dtype=np.uint8)
+             for i in present[: self.data_blocks]]
+        )
+        out = self._apply(gf.bit_matrix(mat), src)
+        return [out[i] for i in range(len(targets))]
+
+    def join(self, shards: list, out_size: int) -> bytes:
+        """Concatenate data shards and trim padding (reedsolomon.Join)."""
+        if len(shards) < self.data_blocks:
+            raise ErrTooFewShards("not enough shards to join")
+        for i in range(self.data_blocks):
+            if shards[i] is None or len(shards[i]) == 0:
+                raise ErrReconstructRequired(f"data shard {i} missing")
+        data = np.concatenate(
+            [np.frombuffer(memoryview(shards[i]), dtype=np.uint8)
+             for i in range(self.data_blocks)]
+        )
+        if data.size < out_size:
+            raise ErrShortData("shards hold less data than requested")
+        return data[:out_size].tobytes()

@@ -1,0 +1,13 @@
+"""Shared utilities: errors, quorum reduction, hashing helpers."""
+
+from __future__ import annotations
+
+
+def ceil_frac(numerator: int, denominator: int) -> int:
+    """Ceiling division matching the reference's ceilFrac (cmd/utils.go)."""
+    if denominator == 0:
+        return 0
+    neg = (numerator < 0) != (denominator < 0)
+    numerator, denominator = abs(numerator), abs(denominator)
+    out = (numerator + denominator - 1) // denominator
+    return -out if neg else out

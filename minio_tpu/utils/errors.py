@@ -1,0 +1,221 @@
+"""Canonical error types for the TPU-native object store.
+
+Mirrors the error taxonomy of the reference implementation
+(/root/reference/cmd/typed-errors.go, cmd/storage-errors.go) so that quorum
+reduction and heal-trigger semantics can be expressed identically, while
+remaining idiomatic Python exceptions.
+"""
+
+from __future__ import annotations
+
+
+class StorageError(Exception):
+    """Base class for all storage-layer errors."""
+
+
+class ErrDiskNotFound(StorageError):
+    """Disk is offline / not found (ref: cmd/storage-errors.go errDiskNotFound)."""
+
+
+class ErrFileNotFound(StorageError):
+    """File not found on disk (ref: errFileNotFound) — triggers missing-part heal."""
+
+
+class ErrFileVersionNotFound(StorageError):
+    """Requested version not found (ref: errFileVersionNotFound)."""
+
+
+class ErrFileCorrupt(StorageError):
+    """Bitrot verification failed (ref: errFileCorrupt) — triggers bitrot heal."""
+
+
+class ErrFileAccessDenied(StorageError):
+    """Access denied on the path (ref: errFileAccessDenied)."""
+
+
+class ErrVolumeNotFound(StorageError):
+    """Volume (bucket dir) not found (ref: errVolumeNotFound)."""
+
+
+class ErrVolumeExists(StorageError):
+    """Volume already exists (ref: errVolumeExists)."""
+
+
+class ErrVolumeNotEmpty(StorageError):
+    """Volume not empty on delete (ref: errVolumeNotEmpty)."""
+
+
+class ErrDiskFull(StorageError):
+    """No space left (ref: errDiskFull)."""
+
+
+class ErrCorruptedFormat(StorageError):
+    """format.json unusable (ref: errCorruptedFormat)."""
+
+
+class ErrUnformattedDisk(StorageError):
+    """Fresh disk without format.json (ref: errUnformattedDisk)."""
+
+
+class ErrErasureReadQuorum(StorageError):
+    """Read quorum unavailable (ref: errErasureReadQuorum)."""
+
+
+class ErrErasureWriteQuorum(StorageError):
+    """Write quorum unavailable (ref: errErasureWriteQuorum)."""
+
+
+class ErrLessData(StorageError):
+    """Fewer bytes available than requested (ref: errLessData)."""
+
+
+class ErrMoreData(StorageError):
+    """More data was sent than advertised (ref: errMoreData)."""
+
+
+class ErrInvalidArgument(StorageError):
+    """Invalid arguments provided (ref: errInvalidArgument)."""
+
+
+class ErrMethodNotAllowed(StorageError):
+    """Operation not allowed (ref: errMethodNotAllowed)."""
+
+
+class ErrObjectNotFound(StorageError):
+    """Object does not exist (ref: cmd/object-api-errors.go ObjectNotFound)."""
+
+
+class ErrVersionNotFound(StorageError):
+    """Object version does not exist (ref: VersionNotFound)."""
+
+
+class ErrBucketNotFound(StorageError):
+    """Bucket does not exist (ref: BucketNotFound)."""
+
+
+class ErrBucketExists(StorageError):
+    """Bucket already owned/exists (ref: BucketAlreadyOwnedByYou)."""
+
+
+class ErrBucketNotEmpty(StorageError):
+    """Bucket not empty (ref: BucketNotEmpty)."""
+
+
+class ErrInvalidUploadID(StorageError):
+    """Multipart upload id not found (ref: InvalidUploadID)."""
+
+
+class ErrInvalidPart(StorageError):
+    """Multipart part missing/mismatched etag (ref: InvalidPart)."""
+
+
+class ErrObjectExistsAsDirectory(StorageError):
+    """Object name collides with a directory prefix (ref: ObjectExistsAsDirectory)."""
+
+
+# --- Reed-Solomon codec errors (mirror klauspost/reedsolomon, used by
+# --- cmd/erasure-coding.go:44-48) ---
+
+class RSError(Exception):
+    """Base class for Reed-Solomon codec errors."""
+
+
+class ErrInvShardNum(RSError):
+    """data/parity shard count <= 0."""
+
+
+class ErrMaxShardNum(RSError):
+    """data+parity > 256 shards."""
+
+
+class ErrShortData(RSError):
+    """Not enough data to fill the requested shards."""
+
+
+class ErrTooFewShards(RSError):
+    """Too few shards present to reconstruct."""
+
+
+class ErrShardSize(RSError):
+    """Shards are not identically sized."""
+
+
+class ErrReconstructRequired(RSError):
+    """A data shard is missing; reconstruction needed before join."""
+
+
+# Errors ignored during per-disk error reduction; the reference treats these
+# as "the disk is fine, the object simply isn't there"
+# (ref: cmd/object-api-utils.go objectOpIgnoredErrs = baseIgnoredErrs +
+#  errDiskAccessDenied + errUnformattedDisk).
+OBJECT_OP_IGNORED_ERRS = (
+    ErrDiskNotFound,
+    ErrUnformattedDisk,
+)
+
+
+def count_errs(errs, match: type | None) -> int:
+    """Count occurrences of error class `match` (None counts successes).
+
+    Ref: cmd/erasure-metadata-utils.go:25-37 countErrs.
+    """
+    n = 0
+    for e in errs:
+        if match is None:
+            n += e is None
+        else:
+            n += isinstance(e, match)
+    return n
+
+
+def reduce_errs(errs, ignored_errs=()):
+    """Return the maximally-occurring error (None = success counts too).
+
+    Ignored error types are normalized to ErrDiskNotFound, matching
+    cmd/erasure-metadata-utils.go:40-70 reduceErrs.
+    """
+    counts: dict[object, int] = {}
+    keys: dict[object, object] = {}
+    ignored = tuple(ignored_errs)
+
+    def normalize(e):
+        # Ignored error types are rewritten to ErrDiskNotFound before
+        # counting AND before being returned, exactly like the reference.
+        if e is not None and ignored and isinstance(e, ignored):
+            return ErrDiskNotFound()
+        return e
+
+    for e in errs:
+        e = normalize(e)
+        k = None if e is None else type(e)
+        counts[k] = counts.get(k, 0) + 1
+        keys.setdefault(k, e)
+
+    max_k, max_n = None, 0
+    for k, n in counts.items():
+        if n > max_n:
+            max_k, max_n = k, n
+    if max_k is None:
+        return max_n, None
+    return max_n, keys[max_k]
+
+
+def reduce_quorum_errs(errs, ignored_errs, quorum: int, quorum_err: StorageError):
+    """Return None if the max-occurring outcome reaches quorum, else an error.
+
+    Ref: cmd/erasure-metadata-utils.go:73-99 reduceQuorumErrs.
+    """
+    max_count, max_err = reduce_errs(errs, ignored_errs)
+    if max_count >= quorum:
+        return max_err
+    return quorum_err
+
+
+def reduce_read_quorum_errs(errs, ignored_errs, read_quorum: int):
+    """Ref: cmd/erasure-metadata-utils.go:73-78 reduceReadQuorumErrs."""
+    return reduce_quorum_errs(errs, ignored_errs, read_quorum, ErrErasureReadQuorum())
+
+
+def reduce_write_quorum_errs(errs, ignored_errs, write_quorum: int):
+    """Ref: cmd/erasure-metadata-utils.go:81-86 reduceWriteQuorumErrs."""
+    return reduce_quorum_errs(errs, ignored_errs, write_quorum, ErrErasureWriteQuorum())

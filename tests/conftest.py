@@ -1,0 +1,12 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so the
+multi-chip sharding paths (jax.sharding.Mesh over dp/sp axes) are exercised
+without TPU hardware. Must run before jax initializes a backend."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,236 @@
+"""Bitrot integrity framework: per-shard hashing in the streaming
+interleaved layout of the reference ([hash || chunk]* per shard file,
+/root/reference/cmd/bitrot-streaming.go) plus whole-file mode for the
+legacy algorithms (cmd/bitrot-whole.go).
+
+Four algorithms mirror cmd/bitrot.go:36-41 — SHA256, BLAKE2b-512,
+HighwayHash256 (whole), HighwayHash256S (streaming, the default). The
+HighwayHash implementation is our own bit-exact engine (ops/highwayhash.py)
+with a batched TPU variant used by the fused verify path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+from enum import Enum
+
+import numpy as np
+
+from ..ops import highwayhash
+from ..utils import ceil_frac
+from ..utils.errors import ErrFileCorrupt, ErrLessData
+
+
+class BitrotAlgorithm(Enum):
+    SHA256 = "sha256"
+    BLAKE2B512 = "blake2b"
+    HIGHWAYHASH256 = "highwayhash256"
+    HIGHWAYHASH256S = "highwayhash256S"
+
+    @classmethod
+    def default(cls) -> "BitrotAlgorithm":
+        # DefaultBitrotAlgorithm, cmd/bitrot.go (HighwayHash256S).
+        return cls.HIGHWAYHASH256S
+
+    @classmethod
+    def from_string(cls, s: str) -> "BitrotAlgorithm":
+        for a in cls:
+            if a.value == s:
+                return a
+        raise ValueError(f"unsupported bitrot algorithm {s!r}")
+
+    def new(self):
+        """hashlib-style digest for this algorithm (cmd/bitrot.go:44-61)."""
+        if self is BitrotAlgorithm.SHA256:
+            return hashlib.sha256()
+        if self is BitrotAlgorithm.BLAKE2B512:
+            return hashlib.blake2b(digest_size=64)
+        return highwayhash.HighwayHash256(highwayhash.MAGIC_KEY)
+
+    @property
+    def digest_size(self) -> int:
+        return 64 if self is BitrotAlgorithm.BLAKE2B512 else 32
+
+    @property
+    def streaming(self) -> bool:
+        return self is BitrotAlgorithm.HIGHWAYHASH256S
+
+
+def bitrot_shard_file_size(size: int, shard_size: int, algo: BitrotAlgorithm) -> int:
+    """On-disk size of a shard file with interleaved checksums
+    (cmd/bitrot.go:143-148)."""
+    if not algo.streaming:
+        return size
+    if size < 0:
+        return -1
+    return ceil_frac(size, shard_size) * algo.digest_size + size
+
+
+def bitrot_stream_offset(offset: int, shard_size: int, algo: BitrotAlgorithm) -> int:
+    """Translate a logical shard offset (multiple of shard_size) to the
+    physical offset in the interleaved stream
+    (cmd/bitrot-streaming.go:135)."""
+    return (offset // shard_size) * algo.digest_size + offset
+
+
+class StreamingBitrotWriter:
+    """Writes [H(chunk) || chunk] per chunk into an underlying byte sink.
+
+    The reference pipes this into disk.CreateFile asynchronously
+    (cmd/bitrot-streaming.go:83-99); here the sink is any .write()able.
+    """
+
+    def __init__(self, sink, algo: BitrotAlgorithm = BitrotAlgorithm.HIGHWAYHASH256S):
+        self._sink = sink
+        self._algo = algo
+        self._h = algo.new()
+        self.bytes_written = 0
+
+    def write(self, chunk) -> int:
+        chunk = bytes(chunk)
+        if not chunk:
+            return 0
+        h = self._algo.new()
+        h.update(chunk)
+        self._sink.write(h.digest())
+        self._sink.write(chunk)
+        self.bytes_written += len(chunk)
+        return len(chunk)
+
+    def close(self):
+        if hasattr(self._sink, "close"):
+            self._sink.close()
+
+
+class WholeBitrotWriter:
+    """Whole-file bitrot: plain passthrough writes, hash accumulated and
+    read out via sum() for xl.meta (cmd/bitrot-whole.go:37-60)."""
+
+    def __init__(self, sink, algo: BitrotAlgorithm):
+        self._sink = sink
+        self._h = algo.new()
+
+    def write(self, chunk) -> int:
+        chunk = bytes(chunk)
+        self._h.update(chunk)
+        self._sink.write(chunk)
+        return len(chunk)
+
+    def sum(self) -> bytes:
+        return self._h.digest()
+
+    def close(self):
+        if hasattr(self._sink, "close"):
+            self._sink.close()
+
+
+class StreamingBitrotReader:
+    """Sequential chunk-aligned read_at() with inline hash verification,
+    mirroring streamingBitrotReader (cmd/bitrot-streaming.go:102-168).
+
+    `open_stream(stream_offset, length)` is a callable returning a readable
+    for the physical byte range — the seam where a local file, an inline
+    xl.meta buffer, or a remote storage stream plugs in.
+    """
+
+    def __init__(self, open_stream, till_offset: int, shard_size: int,
+                 algo: BitrotAlgorithm = BitrotAlgorithm.HIGHWAYHASH256S):
+        self._open = open_stream
+        self._algo = algo
+        self._shard_size = shard_size
+        # Physical end offset incl. hash framing (cmd/bitrot-streaming.go:178)
+        self._till = ceil_frac(till_offset, shard_size) * algo.digest_size + till_offset
+        self._rc = None
+        self._curr = 0
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        if offset % self._shard_size != 0:
+            raise ValueError("offset must be shard-aligned")
+        if self._rc is None:
+            self._curr = offset
+            stream_off = bitrot_stream_offset(offset, self._shard_size, self._algo)
+            self._rc = self._open(stream_off, self._till - stream_off)
+        if offset != self._curr:
+            raise ValueError("non-sequential bitrot read")
+        hash_want = self._rc.read(self._algo.digest_size)
+        if len(hash_want) != self._algo.digest_size:
+            raise ErrFileCorrupt("short hash read")
+        buf = self._rc.read(length)
+        if len(buf) != length:
+            raise ErrFileCorrupt("short chunk read")
+        h = self._algo.new()
+        h.update(buf)
+        if h.digest() != hash_want:
+            raise ErrFileCorrupt(
+                f"content hash mismatch: want {hash_want.hex()}, got {h.digest().hex()}"
+            )
+        self._curr += length
+        return buf
+
+    def close(self):
+        if self._rc is not None and hasattr(self._rc, "close"):
+            self._rc.close()
+        self._rc = None
+
+
+def bitrot_verify(stream, want_size: int, part_size: int,
+                  algo: BitrotAlgorithm, want_sum: bytes, shard_size: int):
+    """Verify a whole shard stream (cmd/bitrot.go:151-199). Raises
+    ErrFileCorrupt on any mismatch."""
+    if not algo.streaming:
+        h = algo.new()
+        n = 0
+        while True:
+            buf = stream.read(1 << 20)
+            if not buf:
+                break
+            h.update(buf)
+            n += len(buf)
+        if n != want_size or h.digest() != want_sum:
+            raise ErrFileCorrupt("whole-file bitrot mismatch")
+        return
+
+    if want_size != bitrot_shard_file_size(part_size, shard_size, algo):
+        raise ErrFileCorrupt("bitrot file size mismatch")
+    left = want_size
+    chunk = shard_size
+    while left > 0:
+        hash_want = stream.read(algo.digest_size)
+        if len(hash_want) != algo.digest_size:
+            raise ErrLessData("short hash read")
+        left -= len(hash_want)
+        if left < chunk:
+            chunk = left
+        buf = stream.read(chunk)
+        if len(buf) != chunk:
+            raise ErrLessData("short chunk read")
+        left -= len(buf)
+        h = algo.new()
+        h.update(buf)
+        if h.digest() != hash_want:
+            raise ErrFileCorrupt("streaming bitrot mismatch")
+
+
+def hash_shard_chunks(shards: np.ndarray, shard_size: int) -> np.ndarray:
+    """Device-batched framing helper: hash every shard_size chunk of every
+    shard, matching the streaming writer's per-chunk hashes. shards
+    [..., S] uint8; returns hashes [..., n_chunks, 32] uint8.
+
+    The final partial chunk (if S % shard_size != 0) is hashed at its TRUE
+    length in a separate dispatch — the reference hashes the short tail
+    chunk as-is, never padded (cmd/bitrot-streaming.go:48-59)."""
+    from ..ops.highwayhash_jax import hash256_batch_jax
+
+    *lead, s = shards.shape
+    n_full = s // shard_size
+    tail = s - n_full * shard_size
+    out = np.empty((*lead, n_full + (1 if tail else 0), 32), dtype=np.uint8)
+    if n_full:
+        full = shards[..., : n_full * shard_size].reshape(*lead, n_full, shard_size)
+        out[..., :n_full, :] = np.asarray(hash256_batch_jax(full))
+    if tail:
+        out[..., n_full, :] = np.asarray(
+            hash256_batch_jax(shards[..., n_full * shard_size :])
+        )
+    return out

@@ -174,6 +174,10 @@ class Erasure:
 
     def decode_data_and_parity_blocks(self, shards: list) -> list:
         """Reconstruct all missing shards (data and parity)."""
+        if len(shards) != self.total_shards:
+            raise ErrTooFewShards(
+                f"got {len(shards)} shards, want {self.total_shards}"
+            )
         missing = [i for i, b in enumerate(shards) if b is None or len(b) == 0]
         if not missing:
             return shards
@@ -194,15 +198,21 @@ class Erasure:
             if len(shards[i]) != shard_len:
                 raise ErrShardSize("present shards differ in size")
 
-        missing = [i for i in range(self.total_shards) if i not in set(present)]
+        present_set = set(present)
+        missing = [i for i in range(self.total_shards) if i not in present_set]
         if data_only:
             missing = [i for i in missing if i < self.data_blocks]
         if not missing:
             return shards
 
-        mat = gf.reconstruct_matrix(
-            self.data_blocks, self.parity_blocks, present, missing
-        )
+        try:
+            mat = gf.reconstruct_matrix(
+                self.data_blocks, self.parity_blocks, present, missing
+            )
+        except ValueError as exc:
+            # Singular present-subset submatrix == not enough independent
+            # shards to reconstruct.
+            raise ErrTooFewShards(str(exc)) from exc
         src = np.stack(
             [np.frombuffer(memoryview(shards[i]), dtype=np.uint8)
              for i in present[: self.data_blocks]]
@@ -217,14 +227,25 @@ class Erasure:
         shards without mutating the input list. Used by the heal engine
         (equivalent of cmd/erasure-lowlevel-heal.go:28-48, where only the
         stale disks receive writes)."""
+        if len(shards) != self.total_shards:
+            raise ErrTooFewShards(
+                f"got {len(shards)} shards, want {self.total_shards}"
+            )
         present = [i for i, b in enumerate(shards) if b is not None and len(b) > 0]
         if len(present) < self.data_blocks:
             raise ErrTooFewShards(
                 f"{len(present)} shards present, need {self.data_blocks}"
             )
-        mat = gf.reconstruct_matrix(
-            self.data_blocks, self.parity_blocks, present, targets
-        )
+        shard_len = len(shards[present[0]])
+        for i in present:
+            if len(shards[i]) != shard_len:
+                raise ErrShardSize("present shards differ in size")
+        try:
+            mat = gf.reconstruct_matrix(
+                self.data_blocks, self.parity_blocks, present, targets
+            )
+        except ValueError as exc:
+            raise ErrTooFewShards(str(exc)) from exc
         src = np.stack(
             [np.frombuffer(memoryview(shards[i]), dtype=np.uint8)
              for i in present[: self.data_blocks]]

@@ -1,0 +1,248 @@
+"""HighwayHash-256: the default bitrot integrity hash of the reference
+(HighwayHash256/HighwayHash256S, /root/reference/cmd/bitrot.go:36-56, keyed
+with the magic pi-derived key at cmd/bitrot.go:34).
+
+This module is the host-side implementation: a vectorized numpy uint64
+engine that hashes BATCHES of equal-length chunks in lockstep (the packet
+chain within one chunk is inherently sequential, but every 128 KiB bitrot
+chunk is independent — cmd/bitrot-streaming.go:48-59 — so the batch axis is
+where the parallelism lives). A JAX/TPU variant sharing the same math via
+uint32 lane pairs lives in ops/highwayhash_jax.py.
+
+Validated bit-exactly against the reference self-test chain
+(bitrotSelfTest, cmd/bitrot.go:207-238).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Magic HH-256 key: HH-256 hash of the first 100 decimals of pi as utf-8
+# with a zero key (cmd/bitrot.go:34).
+MAGIC_KEY = bytes(
+    b"\x4b\xe7\x34\xfa\x8e\x23\x8a\xcd\x26\x3e\x83\xe6\xbb\x96\x85\x52"
+    b"\x04\x0f\x93\x5d\xa3\x9f\x44\x14\x97\xe0\x9d\x13\x22\xde\x36\xa0"
+)
+
+_INIT0 = np.array(
+    [0xDBE6D5D5FE4CCE2F, 0xA4093822299F31D0, 0x13198A2E03707344, 0x243F6A8885A308D3],
+    dtype=np.uint64,
+)
+_INIT1 = np.array(
+    [0x3BD39E10CB0EF593, 0xC0ACF169B5F18A8C, 0xBE5466CF34E90C6C, 0x452821E638D01377],
+    dtype=np.uint64,
+)
+
+_U = np.uint64
+_LOW32 = _U(0xFFFFFFFF)
+
+
+def _rot64_by_32(x):
+    return (x >> _U(32)) | (x << _U(32))
+
+
+def _key_lanes(key: bytes) -> np.ndarray:
+    if len(key) != 32:
+        raise ValueError("HighwayHash key must be 32 bytes")
+    return np.frombuffer(key, dtype="<u8").copy()
+
+
+class State:
+    """Hash state for a batch of independent streams: lanes [..., 4] u64."""
+
+    __slots__ = ("v0", "v1", "mul0", "mul1")
+
+    def __init__(self, key: bytes, batch_shape: tuple = ()):
+        k = _key_lanes(key)
+        shape = batch_shape + (4,)
+        self.mul0 = np.broadcast_to(_INIT0, shape).copy()
+        self.mul1 = np.broadcast_to(_INIT1, shape).copy()
+        self.v0 = self.mul0 ^ np.broadcast_to(k, shape)
+        self.v1 = self.mul1 ^ np.broadcast_to(_rot64_by_32(k), shape)
+
+    def copy(self) -> "State":
+        s = State.__new__(State)
+        s.v0, s.v1 = self.v0.copy(), self.v1.copy()
+        s.mul0, s.mul1 = self.mul0.copy(), self.mul1.copy()
+        return s
+
+
+def _mask_byte(v, b: int):
+    return v & _U(0xFF << (8 * b))
+
+
+def _zipper_pair(ve, vo):
+    """ZipperMergeAndAdd contributions for a lane pair (even, odd).
+
+    Mirrors the reference portable code: the function receives
+    (v1=odd lane, v0=even lane) and produces the additions for the
+    (even, odd) destination lanes. All byte fields are disjoint, so OR
+    equals the reference's additions.
+    """
+    add_even = (
+        ((_mask_byte(ve, 3) | _mask_byte(vo, 4)) >> _U(24))
+        | ((_mask_byte(ve, 5) | _mask_byte(vo, 6)) >> _U(16))
+        | _mask_byte(ve, 2)
+        | (_mask_byte(ve, 1) << _U(32))
+        | (_mask_byte(vo, 7) >> _U(8))
+        | (ve << _U(56))
+    )
+    add_odd = (
+        ((_mask_byte(vo, 3) | _mask_byte(ve, 4)) >> _U(24))
+        | _mask_byte(vo, 2)
+        | (_mask_byte(vo, 5) >> _U(16))
+        | (_mask_byte(vo, 1) << _U(24))
+        | (_mask_byte(ve, 6) >> _U(8))
+        | (_mask_byte(vo, 0) << _U(48))
+        | _mask_byte(ve, 7)
+    )
+    return add_even, add_odd
+
+
+def _zipper_add(dst, src):
+    """dst[lane] += zipper_merge(src lanes), for pairs (0,1) and (2,3)."""
+    ve, vo = src[..., 0::2], src[..., 1::2]
+    add_even, add_odd = _zipper_pair(ve, vo)
+    dst[..., 0::2] += add_even
+    dst[..., 1::2] += add_odd
+
+
+def _update(state: State, packet: np.ndarray):
+    """One 32-byte packet per stream; packet lanes [..., 4] u64 LE."""
+    state.v1 += state.mul0 + packet
+    state.mul0 ^= (state.v1 & _LOW32) * (state.v0 >> _U(32))
+    state.v0 += state.mul1
+    state.mul1 ^= (state.v0 & _LOW32) * (state.v1 >> _U(32))
+    _zipper_add(state.v0, state.v1)
+    _zipper_add(state.v1, state.v0)
+
+
+def _rotate32_by(count: int, lanes: np.ndarray) -> np.ndarray:
+    """Rotate each 32-bit half of each u64 lane left by `count`."""
+    if count == 0:
+        return lanes
+    c = _U(count)
+    inv = _U(32 - count)
+    lo = lanes & _LOW32
+    hi = lanes >> _U(32)
+    lo = ((lo << c) | (lo >> inv)) & _LOW32
+    hi = ((hi << c) | (hi >> inv)) & _LOW32
+    return (hi << _U(32)) | lo
+
+
+def _update_remainder(state: State, tail: np.ndarray):
+    """Final partial packet: tail [..., L] uint8 with 0 < L < 32.
+
+    Reproduces the reference's UpdateRemainder packet construction: the
+    4-aligned prefix is copied verbatim; with >=16 remainder bytes the last
+    4 bytes land at packet[28:32]; otherwise up to 3 trailing bytes are
+    spread at packet[16:19]."""
+    mod32 = tail.shape[-1]
+    mod4 = mod32 & 3
+    full4 = mod32 & ~3
+    state.v0 += _U((mod32 << 32) + mod32)
+    state.v1 = _rotate32_by(mod32, state.v1)
+    packet = np.zeros(tail.shape[:-1] + (32,), dtype=np.uint8)
+    packet[..., :full4] = tail[..., :full4]
+    if mod32 & 16:
+        packet[..., 28:32] = tail[..., mod32 - 4 : mod32]
+    elif mod4:
+        remainder = tail[..., full4:]
+        packet[..., 16] = remainder[..., 0]
+        packet[..., 17] = remainder[..., mod4 >> 1]
+        packet[..., 18] = remainder[..., mod4 - 1]
+    _update(state, packet.view("<u8").reshape(tail.shape[:-1] + (4,)))
+
+
+def _permute_and_update(state: State):
+    perm = _rot64_by_32(state.v0[..., [2, 3, 0, 1]])
+    _update(state, perm)
+
+
+def _modular_reduction(a3u, a2, a1, a0):
+    a3 = a3u & _U(0x3FFFFFFFFFFFFFFF)
+    m1 = a1 ^ ((a3 << _U(1)) | (a2 >> _U(63))) ^ ((a3 << _U(2)) | (a2 >> _U(62)))
+    m0 = a0 ^ (a2 << _U(1)) ^ (a2 << _U(2))
+    return m0, m1
+
+
+def _finalize256(state: State) -> np.ndarray:
+    """Returns digests [..., 32] uint8."""
+    for _ in range(10):
+        _permute_and_update(state)
+    v0, v1, mul0, mul1 = state.v0, state.v1, state.mul0, state.mul1
+    h0, h1 = _modular_reduction(
+        v1[..., 1] + mul1[..., 1], v1[..., 0] + mul1[..., 0],
+        v0[..., 1] + mul0[..., 1], v0[..., 0] + mul0[..., 0],
+    )
+    h2, h3 = _modular_reduction(
+        v1[..., 3] + mul1[..., 3], v1[..., 2] + mul1[..., 2],
+        v0[..., 3] + mul0[..., 3], v0[..., 2] + mul0[..., 2],
+    )
+    out = np.stack([h0, h1, h2, h3], axis=-1)
+    return np.ascontiguousarray(out).view(np.uint8).reshape(out.shape[:-1] + (32,))
+
+
+def hash256_batch(data: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
+    """Hash a batch of equal-length byte chunks: [..., L] uint8 -> [..., 32].
+
+    The batch axis is vectorized (all streams advance one packet per numpy
+    op); the packet chain within a chunk is sequential per the algorithm.
+    """
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    batch_shape = data.shape[:-1]
+    length = data.shape[-1]
+    state = State(key, batch_shape)
+    n_packets = length // 32
+    if n_packets:
+        packets = data[..., : n_packets * 32].view("<u8").reshape(
+            batch_shape + (n_packets, 4)
+        )
+        for p in range(n_packets):
+            _update(state, packets[..., p, :])
+    if length % 32:
+        _update_remainder(state, data[..., n_packets * 32 :])
+    return _finalize256(state)
+
+
+def hash256(data, key: bytes = MAGIC_KEY) -> bytes:
+    """One-shot HighwayHash-256 of a bytes-like object."""
+    arr = np.frombuffer(memoryview(data), dtype=np.uint8)
+    return hash256_batch(arr, key).tobytes()
+
+
+class HighwayHash256:
+    """Streaming hashlib-style digest, mirroring hash.Hash usage in the
+    reference bitrot writers (cmd/bitrot-streaming.go:48-60)."""
+
+    digest_size = 32
+    block_size = 32
+
+    def __init__(self, key: bytes = MAGIC_KEY):
+        self._key = key
+        self._state = State(key)
+        self._buf = bytearray()
+
+    def update(self, data):
+        self._buf += bytes(data)
+        n = (len(self._buf) // 32) * 32
+        if n:
+            packets = np.frombuffer(self._buf[:n], dtype="<u8").reshape(-1, 4)
+            for p in range(packets.shape[0]):
+                _update(self._state, packets[p])
+            del self._buf[:n]
+        return self
+
+    def digest(self) -> bytes:
+        s = self._state.copy()
+        if self._buf:
+            _update_remainder(s, np.frombuffer(bytes(self._buf), dtype=np.uint8))
+        return _finalize256(s).tobytes()
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+    def reset(self):
+        self._state = State(self._key)
+        self._buf.clear()
+        return self

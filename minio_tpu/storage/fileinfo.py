@@ -1,0 +1,221 @@
+"""FileInfo / ErasureInfo / part metadata — the per-disk object version
+descriptors exchanged between the object layer and the storage layer.
+
+Mirrors the reference's FileInfo (cmd/storage-datatypes.go:39-110) and
+ErasureInfo/ChecksumInfo (cmd/erasure-metadata.go:33-77) field-for-field
+where it matters for quorum and heal semantics.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+
+
+ERASURE_ALGORITHM = "rs-vandermonde"  # cmd/erasure-metadata.go erasureAlgorithm
+
+
+@dataclass
+class ObjectPartInfo:
+    """One multipart part (cmd/erasure-metadata.go ObjectPartInfo)."""
+
+    number: int
+    size: int
+    actual_size: int  # pre-compression/encryption size
+
+    def to_dict(self) -> dict:
+        return {"n": self.number, "s": self.size, "as": self.actual_size}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ObjectPartInfo":
+        return cls(number=d["n"], size=d["s"], actual_size=d["as"])
+
+
+@dataclass
+class ChecksumInfo:
+    """Per-part bitrot checksum (cmd/erasure-metadata.go ChecksumInfo).
+    Streaming algorithms interleave hashes in the shard file, so `hash`
+    stays empty for them, exactly like the reference."""
+
+    part_number: int
+    algorithm: str  # BitrotAlgorithm value string
+    hash: bytes = b""
+
+    def to_dict(self) -> dict:
+        return {"p": self.part_number, "a": self.algorithm, "h": self.hash}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChecksumInfo":
+        return cls(part_number=d["p"], algorithm=d["a"], hash=d["h"])
+
+
+@dataclass
+class ErasureInfo:
+    """Erasure geometry + this disk's shard index (cmd/erasure-metadata.go
+    ErasureInfo)."""
+
+    algorithm: str = ERASURE_ALGORITHM
+    data_blocks: int = 0
+    parity_blocks: int = 0
+    block_size: int = 0
+    index: int = 0  # 1-based position of this disk in `distribution`
+    distribution: list[int] = field(default_factory=list)
+    checksums: list[ChecksumInfo] = field(default_factory=list)
+
+    def shard_size(self) -> int:
+        from ..utils import ceil_frac
+
+        return ceil_frac(self.block_size, self.data_blocks)
+
+    def shard_file_size(self, total_length: int) -> int:
+        if total_length == 0:
+            return 0
+        if total_length == -1:
+            return -1
+        num = total_length // self.block_size
+        last = total_length % self.block_size
+        from ..utils import ceil_frac
+
+        return num * self.shard_size() + ceil_frac(last, self.data_blocks)
+
+    def get_checksum_info(self, part_number: int) -> ChecksumInfo:
+        for c in self.checksums:
+            if c.part_number == part_number:
+                return c
+        from .. import erasure
+
+        return ChecksumInfo(
+            part_number=part_number,
+            algorithm=erasure.bitrot.BitrotAlgorithm.default().value,
+        )
+
+    def equals(self, other: "ErasureInfo") -> bool:
+        return (
+            self.algorithm == other.algorithm
+            and self.data_blocks == other.data_blocks
+            and self.parity_blocks == other.parity_blocks
+            and self.block_size == other.block_size
+            and self.distribution == other.distribution
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "algo": self.algorithm,
+            "k": self.data_blocks,
+            "m": self.parity_blocks,
+            "bs": self.block_size,
+            "idx": self.index,
+            "dist": list(self.distribution),
+            "cs": [c.to_dict() for c in self.checksums],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ErasureInfo":
+        return cls(
+            algorithm=d["algo"],
+            data_blocks=d["k"],
+            parity_blocks=d["m"],
+            block_size=d["bs"],
+            index=d["idx"],
+            distribution=list(d["dist"]),
+            checksums=[ChecksumInfo.from_dict(c) for c in d["cs"]],
+        )
+
+
+@dataclass
+class FileInfo:
+    """Represents one version of one object on one disk
+    (cmd/storage-datatypes.go:39-110)."""
+
+    volume: str = ""
+    name: str = ""
+    version_id: str = ""  # "" == null version
+    is_latest: bool = True
+    deleted: bool = False  # delete marker
+    data_dir: str = ""  # uuid dir holding part files for this version
+    mod_time_ns: int = 0
+    size: int = 0
+    metadata: dict = field(default_factory=dict)  # user+sys metadata
+    parts: list[ObjectPartInfo] = field(default_factory=list)
+    erasure: ErasureInfo = field(default_factory=ErasureInfo)
+    # Inline small-object data (xl.meta v2 inline data, shard bytes for
+    # this disk keyed by part number), cmd/xl-storage-format-v2.go:242-570.
+    data: dict[int, bytes] = field(default_factory=dict)
+    fresh: bool = False
+    num_versions: int = 0
+    successor_mod_time_ns: int = 0
+
+    @classmethod
+    def new(cls, volume: str, name: str) -> "FileInfo":
+        return cls(volume=volume, name=name, mod_time_ns=time.time_ns())
+
+    def add_part(self, number: int, size: int, actual_size: int):
+        """Mirror FileInfo.AddObjectPart: replace or append + sort."""
+        info = ObjectPartInfo(number, size, actual_size)
+        for i, p in enumerate(self.parts):
+            if p.number == number:
+                self.parts[i] = info
+                break
+        else:
+            self.parts.append(info)
+        self.parts.sort(key=lambda p: p.number)
+
+    def to_object_part_index(self, offset: int) -> tuple[int, int]:
+        """(part index, offset within part) for a logical object offset
+        (cmd/erasure-metadata.go ObjectToPartOffset)."""
+        if offset == 0:
+            return 0, 0
+        remaining = offset
+        for i, part in enumerate(self.parts):
+            if remaining < part.size:
+                return i, remaining
+            remaining -= part.size
+        from ..utils.errors import ErrInvalidArgument
+
+        raise ErrInvalidArgument(f"offset {offset} beyond object size")
+
+    def write_quorum(self, default_parity: int | None = None) -> int:
+        """dataBlocks (+1 when data == parity), cmd/erasure-object.go:621-626."""
+        k, m = self.erasure.data_blocks, self.erasure.parity_blocks
+        return k + 1 if k == m else k
+
+    def read_quorum(self) -> int:
+        return self.erasure.data_blocks
+
+    def to_dict(self) -> dict:
+        return {
+            "v": self.volume,
+            "n": self.name,
+            "vid": self.version_id,
+            "lat": self.is_latest,
+            "del": self.deleted,
+            "dd": self.data_dir,
+            "mt": self.mod_time_ns,
+            "sz": self.size,
+            "meta": dict(self.metadata),
+            "parts": [p.to_dict() for p in self.parts],
+            "er": self.erasure.to_dict(),
+            "data": {int(k): bytes(v) for k, v in self.data.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileInfo":
+        return cls(
+            volume=d["v"],
+            name=d["n"],
+            version_id=d["vid"],
+            is_latest=d["lat"],
+            deleted=d["del"],
+            data_dir=d["dd"],
+            mod_time_ns=d["mt"],
+            size=d["sz"],
+            metadata=dict(d["meta"]),
+            parts=[ObjectPartInfo.from_dict(p) for p in d["parts"]],
+            erasure=ErasureInfo.from_dict(d["er"]),
+            data={int(k): bytes(v) for k, v in d.get("data", {}).items()},
+        )
+
+
+def new_uuid() -> str:
+    return str(uuid.uuid4())

@@ -1,0 +1,171 @@
+"""StorageAPI — the per-disk storage abstraction, identical for local
+disks and remote nodes (the seam for the distributed substrate).
+
+Mirrors the reference's 34-method StorageAPI
+(/root/reference/cmd/storage-interface.go:25-83). Methods are grouped the
+same way; a remote implementation (storage REST client over the node RPC
+plane) plugs in behind the same surface, exactly like
+cmd/storage-rest-client.go does.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+from .fileinfo import FileInfo
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created_ns: int
+
+
+@dataclass
+class DiskInfo:
+    """Subset of the reference DiskInfo (cmd/storage-datatypes.go)."""
+
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    used_inodes: int = 0
+    fs_type: str = ""
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    mount_path: str = ""
+    id: str = ""
+    error: str = ""
+
+
+@dataclass
+class FileInfoVersions:
+    """All versions of one object on one disk (storage-datatypes.go)."""
+
+    volume: str
+    name: str
+    versions: list[FileInfo] = field(default_factory=list)
+
+
+class StorageAPI(abc.ABC):
+    """Per-disk storage interface (ref cmd/storage-interface.go:25-83)."""
+
+    # --- identity / liveness ---
+
+    @abc.abstractmethod
+    def is_online(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_local(self) -> bool: ...
+
+    @abc.abstractmethod
+    def hostname(self) -> str: ...
+
+    @abc.abstractmethod
+    def endpoint(self) -> str: ...
+
+    @abc.abstractmethod
+    def get_disk_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def set_disk_id(self, disk_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    def close(self) -> None:
+        return None
+
+    # --- volume operations ---
+
+    @abc.abstractmethod
+    def make_vol(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def make_vol_bulk(self, *volumes: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_vols(self) -> list[VolInfo]: ...
+
+    @abc.abstractmethod
+    def stat_vol(self, volume: str) -> VolInfo: ...
+
+    @abc.abstractmethod
+    def delete_vol(self, volume: str, force_delete: bool = False) -> None: ...
+
+    # --- walk / listing ---
+
+    @abc.abstractmethod
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]: ...
+
+    @abc.abstractmethod
+    def walk_dir(self, volume: str, base_dir: str = "", recursive: bool = True,
+                 report_notfound: bool = False, forward_to: str = ""): ...
+
+    # --- metadata operations ---
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete_versions(self, volume: str, versions: list[FileInfo]) -> list: ...
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo: ...
+
+    @abc.abstractmethod
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    # --- file operations ---
+
+    @abc.abstractmethod
+    def list_versions(self, volume: str, path: str) -> FileInfoVersions: ...
+
+    @abc.abstractmethod
+    def read_file(self, volume: str, path: str, offset: int, length: int) -> bytes: ...
+
+    @abc.abstractmethod
+    def append_file(self, volume: str, path: str, buf: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, size: int, reader) -> None: ...
+
+    @abc.abstractmethod
+    def read_file_stream(self, volume: str, path: str, offset: int, length: int): ...
+
+    @abc.abstractmethod
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def check_file(self, volume: str, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def stat_info_file(self, volume: str, path: str): ...
+
+    # --- small-blob convenience (WriteAll/ReadAll) ---
+
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...

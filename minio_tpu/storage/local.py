@@ -1,0 +1,546 @@
+"""Local disk implementation of StorageAPI — the equivalent of the
+reference's xlStorage (/root/reference/cmd/xl-storage.go).
+
+On-disk layout per disk root (mirrors the reference's):
+
+    <root>/<volume>/<object...>/xl.meta          version journal
+    <root>/<volume>/<object...>/<dataDir>/part.N shard data (bitrot-framed)
+    <root>/.mtpu.sys/tmp/<uuid>                  staged writes
+    <root>/.mtpu.sys/format.json                 disk identity/format
+
+Writes are staged under tmp and committed with atomic rename
+(RenameData, ref cmd/xl-storage.go:1825); small objects inline their
+shard bytes in xl.meta instead of a part file (smallFileThreshold 128 KiB,
+ref cmd/xl-storage.go:66). Python's file IO replaces the reference's
+O_DIRECT/fdatasync tuning; durability points (fsync before rename-commit)
+are preserved behind the `fsync` flag.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import threading
+import time
+
+from ..utils.errors import (
+    ErrDiskNotFound,
+    ErrFileAccessDenied,
+    ErrFileCorrupt,
+    ErrFileNotFound,
+    ErrInvalidArgument,
+    ErrVolumeExists,
+    ErrVolumeNotEmpty,
+    ErrVolumeNotFound,
+)
+from ..erasure.bitrot import BitrotAlgorithm, bitrot_shard_file_size, bitrot_verify
+from .fileinfo import FileInfo
+from .interface import DiskInfo, FileInfoVersions, StorageAPI, VolInfo
+from .xlmeta import XLMeta
+
+# Reserved system volume (reference: .minio.sys, cmd/object-api-utils.go).
+SYSTEM_META_BUCKET = ".mtpu.sys"
+SYSTEM_TMP = SYSTEM_META_BUCKET + "/tmp"
+SYSTEM_MULTIPART = SYSTEM_META_BUCKET + "/multipart"
+XL_META_FILE = "xl.meta"
+
+# Shard files at or below this size are inlined into xl.meta
+# (smallFileThreshold, ref cmd/xl-storage.go:66).
+SMALL_FILE_THRESHOLD = 128 << 10
+
+
+def _check_path(p: str):
+    if p.startswith("/") or ".." in p.split("/"):
+        raise ErrInvalidArgument(f"unsafe path {p!r}")
+
+
+class LocalStorage(StorageAPI):
+    """POSIX StorageAPI over one directory tree ("disk")."""
+
+    def __init__(self, root: str, endpoint: str = "", fsync: bool = False):
+        self.root = os.path.abspath(root)
+        self._endpoint = endpoint or self.root
+        self._fsync = fsync
+        self._disk_id = ""
+        self._lock = threading.RLock()
+        self._online = True
+        os.makedirs(os.path.join(self.root, *SYSTEM_TMP.split("/")), exist_ok=True)
+
+    # --- helpers ---
+
+    def _vol_path(self, volume: str) -> str:
+        _check_path(volume)
+        return os.path.join(self.root, volume)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        _check_path(path)
+        return os.path.join(self._vol_path(volume), *path.split("/"))
+
+    def _require_online(self):
+        if not self._online:
+            raise ErrDiskNotFound(self._endpoint)
+
+    def set_online(self, online: bool):
+        """Test/fault-injection hook (stands in for network disconnect)."""
+        self._online = online
+
+    # --- identity ---
+
+    def is_online(self) -> bool:
+        return self._online
+
+    def is_local(self) -> bool:
+        return True
+
+    def hostname(self) -> str:
+        return ""
+
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    def get_disk_id(self) -> str:
+        self._require_online()
+        return self._disk_id
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+
+    def disk_info(self) -> DiskInfo:
+        self._require_online()
+        st = shutil.disk_usage(self.root)
+        return DiskInfo(
+            total=st.total, free=st.free, used=st.used,
+            endpoint=self._endpoint, mount_path=self.root, id=self._disk_id,
+        )
+
+    # --- volumes ---
+
+    def make_vol(self, volume: str) -> None:
+        self._require_online()
+        p = self._vol_path(volume)
+        if os.path.isdir(p):
+            raise ErrVolumeExists(volume)
+        os.makedirs(p, exist_ok=True)
+
+    def make_vol_bulk(self, *volumes: str) -> None:
+        for v in volumes:
+            try:
+                self.make_vol(v)
+            except ErrVolumeExists:
+                pass
+
+    def list_vols(self) -> list[VolInfo]:
+        self._require_online()
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            p = os.path.join(self.root, name)
+            if os.path.isdir(p):
+                out.append(VolInfo(name=name, created_ns=int(os.stat(p).st_ctime_ns)))
+        return out
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        self._require_online()
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise ErrVolumeNotFound(volume)
+        return VolInfo(name=volume, created_ns=int(os.stat(p).st_ctime_ns))
+
+    def delete_vol(self, volume: str, force_delete: bool = False) -> None:
+        self._require_online()
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise ErrVolumeNotFound(volume)
+        if force_delete:
+            shutil.rmtree(p)
+            return
+        try:
+            os.rmdir(p)
+        except OSError as exc:
+            raise ErrVolumeNotEmpty(volume) from exc
+
+    # --- listing ---
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        self._require_online()
+        p = self._file_path(volume, dir_path) if dir_path else self._vol_path(volume)
+        if not os.path.isdir(self._vol_path(volume)):
+            raise ErrVolumeNotFound(volume)
+        if not os.path.isdir(p):
+            raise ErrFileNotFound(dir_path)
+        entries = []
+        for name in sorted(os.listdir(p)):
+            full = os.path.join(p, name)
+            entries.append(name + "/" if os.path.isdir(full) else name)
+            if 0 < count <= len(entries):
+                break
+        return entries
+
+    def walk_dir(self, volume: str, base_dir: str = "", recursive: bool = True,
+                 report_notfound: bool = False, forward_to: str = ""):
+        """Yield (object_path, xl_meta_bytes) sorted lexically — the local
+        producer behind metacache listing (ref cmd/metacache-walk.go:333).
+        Directories containing xl.meta are objects; others recurse."""
+        self._require_online()
+        vol = self._vol_path(volume)
+        if not os.path.isdir(vol):
+            raise ErrVolumeNotFound(volume)
+
+        def walk(rel: str):
+            p = os.path.join(vol, *rel.split("/")) if rel else vol
+            try:
+                names = sorted(os.listdir(p))
+            except FileNotFoundError:
+                return
+            if XL_META_FILE in names:
+                with open(os.path.join(p, XL_META_FILE), "rb") as f:
+                    yield rel, f.read()
+                return
+            for name in names:
+                child = f"{rel}/{name}" if rel else name
+                if os.path.isdir(os.path.join(p, name)):
+                    if recursive:
+                        yield from walk(child)
+                    else:
+                        yield child + "/", b""
+
+        start = base_dir.strip("/")
+        for item in walk(start):
+            if forward_to and item[0] < forward_to:
+                continue
+            yield item
+
+    # --- metadata ---
+
+    def _read_meta(self, volume: str, path: str) -> XLMeta:
+        meta_path = os.path.join(self._file_path(volume, path), XL_META_FILE)
+        try:
+            with open(meta_path, "rb") as f:
+                return XLMeta.from_bytes(f.read())
+        except FileNotFoundError:
+            if not os.path.isdir(self._vol_path(volume)):
+                raise ErrVolumeNotFound(volume) from None
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+
+    def _write_meta(self, volume: str, path: str, meta: XLMeta):
+        obj_dir = self._file_path(volume, path)
+        os.makedirs(obj_dir, exist_ok=True)
+        tmp = os.path.join(obj_dir, f".xl.meta.tmp.{os.getpid()}.{time.monotonic_ns()}")
+        with open(tmp, "wb") as f:
+            f.write(meta.to_bytes())
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(obj_dir, XL_META_FILE))
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._require_online()
+        with self._lock:
+            try:
+                meta = self._read_meta(volume, path)
+            except ErrFileNotFound:
+                meta = XLMeta()
+            meta.add_version(fi)
+            self._write_meta(volume, path, meta)
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._require_online()
+        with self._lock:
+            meta = self._read_meta(volume, path)
+            meta.find_version(fi.version_id)  # must exist
+            meta.add_version(fi)
+            self._write_meta(volume, path, meta)
+
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        self._require_online()
+        meta = self._read_meta(volume, path)
+        fi = meta.to_file_info(volume, path, version_id)
+        if not read_data:
+            fi.data = {}
+        return fi
+
+    def list_versions(self, volume: str, path: str) -> FileInfoVersions:
+        self._require_online()
+        meta = self._read_meta(volume, path)
+        out = FileInfoVersions(volume=volume, name=path)
+        for v in meta.versions:
+            out.versions.append(meta.to_file_info(volume, path, v["vid"]))
+        return out
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None:
+        """Remove one version; drop xl.meta + dirs when journal empties
+        (ref cmd/xl-storage.go DeleteVersion)."""
+        self._require_online()
+        with self._lock:
+            meta = self._read_meta(volume, path)
+            data_dir = meta.delete_version(fi)
+            if data_dir:
+                shutil.rmtree(
+                    os.path.join(self._file_path(volume, path), data_dir),
+                    ignore_errors=True,
+                )
+            if meta.versions:
+                self._write_meta(volume, path, meta)
+            else:
+                obj_dir = self._file_path(volume, path)
+                try:
+                    os.remove(os.path.join(obj_dir, XL_META_FILE))
+                except FileNotFoundError:
+                    pass
+                self._cleanup_empty_dirs(volume, path)
+
+    def delete_versions(self, volume: str, versions: list[FileInfo]) -> list:
+        errs = []
+        for fi in versions:
+            try:
+                self.delete_version(volume, fi.name, fi)
+                errs.append(None)
+            except Exception as exc:  # noqa: BLE001 - collected per-version
+                errs.append(exc)
+        return errs
+
+    def _cleanup_empty_dirs(self, volume: str, path: str):
+        vol = self._vol_path(volume)
+        cur = self._file_path(volume, path)
+        while cur != vol and cur.startswith(vol):
+            try:
+                os.rmdir(cur)
+            except OSError:
+                break
+            cur = os.path.dirname(cur)
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomic commit: move staged data dir into place and journal the
+        version (ref cmd/xl-storage.go:1825 RenameData)."""
+        self._require_online()
+        with self._lock:
+            dst_dir = self._file_path(dst_volume, dst_path)
+            if fi.data_dir:
+                src_data = self._file_path(src_volume, src_path)
+                if not os.path.isdir(src_data):
+                    raise ErrFileNotFound(f"{src_volume}/{src_path}")
+                os.makedirs(dst_dir, exist_ok=True)
+                dst_data = os.path.join(dst_dir, fi.data_dir)
+                if os.path.isdir(dst_data):
+                    shutil.rmtree(dst_data)
+                os.replace(src_data, dst_data)
+            try:
+                meta = self._read_meta(dst_volume, dst_path)
+            except ErrFileNotFound:
+                meta = XLMeta()
+            meta.add_version(fi)
+            self._write_meta(dst_volume, dst_path, meta)
+
+    # --- files ---
+
+    def read_file(self, volume: str, path: str, offset: int, length: int) -> bytes:
+        self._require_online()
+        try:
+            with open(self._file_path(volume, path), "rb") as f:
+                f.seek(offset)
+                buf = f.read(length)
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise ErrFileAccessDenied(f"{volume}/{path}") from None
+        if len(buf) != length:
+            raise ErrFileCorrupt(f"short read {volume}/{path}")
+        return buf
+
+    def append_file(self, volume: str, path: str, buf: bytes) -> None:
+        self._require_online()
+        if not os.path.isdir(self._vol_path(volume)):
+            raise ErrVolumeNotFound(volume)
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "ab") as f:
+            f.write(buf)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+
+    def create_file(self, volume: str, path: str, size: int, reader) -> None:
+        """Stream-write a file of `size` bytes (-1 = unknown), ref
+        cmd/xl-storage.go:1487 CreateFile."""
+        self._require_online()
+        if not os.path.isdir(self._vol_path(volume)):
+            raise ErrVolumeNotFound(volume)
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        written = 0
+        with open(p, "wb") as f:
+            while True:
+                chunk = reader.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+                written += len(chunk)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if size >= 0 and written != size:
+            raise ErrLessDataOrMore(written, size)
+
+    def read_file_stream(self, volume: str, path: str, offset: int, length: int):
+        self._require_online()
+        try:
+            f = open(self._file_path(volume, path), "rb")
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise ErrFileAccessDenied(f"{volume}/{path}") from None
+        f.seek(offset)
+        return _LimitedReader(f, length)
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        self._require_online()
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        if not os.path.exists(src):
+            raise ErrFileNotFound(f"{src_volume}/{src_path}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Verify every part file exists with the right size
+        (ref cmd/xl-storage.go CheckParts)."""
+        self._require_online()
+        for part in fi.parts:
+            if part.number in fi.data:
+                continue  # inlined
+            p = os.path.join(
+                self._file_path(volume, path), fi.data_dir, f"part.{part.number}"
+            )
+            want = bitrot_shard_file_size(
+                fi.erasure.shard_file_size(part.size),
+                fi.erasure.shard_size(),
+                BitrotAlgorithm.from_string(
+                    fi.erasure.get_checksum_info(part.number).algorithm
+                ),
+            )
+            try:
+                st = os.stat(p)
+            except FileNotFoundError:
+                raise ErrFileNotFound(f"{volume}/{path} part.{part.number}") from None
+            if st.st_size != want:
+                raise ErrFileCorrupt(
+                    f"part.{part.number} size {st.st_size} != {want}"
+                )
+
+    def check_file(self, volume: str, path: str) -> None:
+        self._require_online()
+        meta = os.path.join(self._file_path(volume, path), XL_META_FILE)
+        if not os.path.isfile(meta):
+            raise ErrFileNotFound(f"{volume}/{path}")
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        self._require_online()
+        p = self._file_path(volume, path)
+        if not os.path.exists(p):
+            if not os.path.isdir(self._vol_path(volume)):
+                raise ErrVolumeNotFound(volume)
+            raise ErrFileNotFound(f"{volume}/{path}")
+        if os.path.isdir(p):
+            if recursive:
+                shutil.rmtree(p)
+            else:
+                try:
+                    os.rmdir(p)
+                except OSError as exc:
+                    raise ErrVolumeNotEmpty(f"{volume}/{path}") from exc
+        else:
+            os.remove(p)
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Deep bitrot scan of every part (ref cmd/xl-storage.go:2151)."""
+        self._require_online()
+        algo = BitrotAlgorithm.from_string(
+            fi.erasure.get_checksum_info(1).algorithm
+        )
+        for part in fi.parts:
+            shard_size = fi.erasure.shard_size()
+            part_size = fi.erasure.shard_file_size(part.size)
+            if part.number in fi.data:
+                stream = io.BytesIO(fi.data[part.number])
+                file_size = len(fi.data[part.number])
+            else:
+                p = os.path.join(
+                    self._file_path(volume, path), fi.data_dir, f"part.{part.number}"
+                )
+                try:
+                    stream = open(p, "rb")
+                    file_size = os.stat(p).st_size
+                except FileNotFoundError:
+                    raise ErrFileNotFound(
+                        f"{volume}/{path} part.{part.number}"
+                    ) from None
+            try:
+                ci = fi.erasure.get_checksum_info(part.number)
+                bitrot_verify(
+                    stream, file_size, part_size, algo, ci.hash, shard_size
+                )
+            finally:
+                stream.close()
+
+    def stat_info_file(self, volume: str, path: str):
+        self._require_online()
+        p = self._file_path(volume, path)
+        try:
+            return os.stat(p)
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+
+    # --- small blobs ---
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._require_online()
+        if not os.path.isdir(self._vol_path(volume)):
+            raise ErrVolumeNotFound(volume)
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        self._require_online()
+        try:
+            with open(self._file_path(volume, path), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            if not os.path.isdir(self._vol_path(volume)):
+                raise ErrVolumeNotFound(volume) from None
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+
+
+class _LimitedReader:
+    """Read at most `limit` bytes from an underlying file, then EOF."""
+
+    def __init__(self, f, limit: int):
+        self._f = f
+        self._left = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        if n is None or n < 0 or n > self._left:
+            n = self._left
+        buf = self._f.read(n)
+        self._left -= len(buf)
+        return buf
+
+    def close(self):
+        self._f.close()
+
+
+class ErrLessDataOrMore(ErrInvalidArgument):
+    def __init__(self, written: int, want: int):
+        super().__init__(f"wrote {written} bytes, expected {want}")

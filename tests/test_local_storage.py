@@ -1,0 +1,131 @@
+"""LocalStorage (xl-storage equivalent) behavior tests: volumes, blobs,
+version journal, rename-commit, walk, verify-file."""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu.storage.fileinfo import ErasureInfo, FileInfo, new_uuid
+from minio_tpu.storage.local import SYSTEM_TMP, LocalStorage
+from minio_tpu.utils.errors import (
+    ErrFileNotFound,
+    ErrFileVersionNotFound,
+    ErrVolumeExists,
+    ErrVolumeNotEmpty,
+    ErrVolumeNotFound,
+)
+
+
+@pytest.fixture
+def disk(tmp_path):
+    return LocalStorage(str(tmp_path / "disk0"), endpoint="test-disk-0")
+
+
+def test_volume_crud(disk):
+    disk.make_vol("bucket1")
+    with pytest.raises(ErrVolumeExists):
+        disk.make_vol("bucket1")
+    assert disk.stat_vol("bucket1").name == "bucket1"
+    names = [v.name for v in disk.list_vols()]
+    assert "bucket1" in names
+    with pytest.raises(ErrVolumeNotFound):
+        disk.stat_vol("nope")
+    disk.write_all("bucket1", "a/b", b"x")
+    with pytest.raises(ErrVolumeNotEmpty):
+        disk.delete_vol("bucket1")
+    disk.delete_vol("bucket1", force_delete=True)
+    with pytest.raises(ErrVolumeNotFound):
+        disk.stat_vol("bucket1")
+
+
+def test_blob_and_stream_io(disk):
+    disk.make_vol("b")
+    disk.write_all("b", "cfg/x.json", b"hello")
+    assert disk.read_all("b", "cfg/x.json") == b"hello"
+    with pytest.raises(ErrFileNotFound):
+        disk.read_all("b", "missing")
+    disk.create_file("b", "data/big", 5000, io.BytesIO(b"z" * 5000))
+    assert disk.read_file("b", "data/big", 100, 50) == b"z" * 50
+    r = disk.read_file_stream("b", "data/big", 4990, 10)
+    assert r.read() == b"z" * 10
+    r.close()
+
+
+def test_version_journal_and_rename_data(disk):
+    disk.make_vol("b")
+    fi = FileInfo.new("b", "obj1")
+    fi.version_id = new_uuid()
+    fi.size = 11
+    fi.data_dir = new_uuid()
+    fi.erasure = ErasureInfo(data_blocks=2, parity_blocks=2, block_size=1 << 20,
+                             index=1, distribution=[1, 2, 3, 4])
+    fi.add_part(1, 11, 11)
+
+    # Stage shard under tmp then commit, like putObject.
+    tmp_id = new_uuid()
+    disk.create_file(SYSTEM_TMP.split("/")[0], f"tmp/{tmp_id}/part.1", 5,
+                     io.BytesIO(b"shard"))
+    disk.rename_data(".mtpu.sys", f"tmp/{tmp_id}", fi, "b", "obj1")
+
+    got = disk.read_version("b", "obj1")
+    assert got.version_id == fi.version_id
+    assert got.size == 11
+    assert got.is_latest
+    part_path = f"obj1/{fi.data_dir}/part.1"
+    assert disk.read_file("b", part_path, 0, 5) == b"shard"
+
+    # Second version becomes latest.
+    fi2 = FileInfo.new("b", "obj1")
+    fi2.version_id = new_uuid()
+    fi2.size = 3
+    fi2.mod_time_ns = fi.mod_time_ns + 10
+    disk.write_metadata("b", "obj1", fi2)
+    assert disk.read_version("b", "obj1").version_id == fi2.version_id
+    assert disk.read_version("b", "obj1", fi.version_id).version_id == fi.version_id
+    assert len(disk.list_versions("b", "obj1").versions) == 2
+
+    # Delete latest; older becomes latest again.
+    disk.delete_version("b", "obj1", fi2)
+    assert disk.read_version("b", "obj1").version_id == fi.version_id
+    with pytest.raises(ErrFileVersionNotFound):
+        disk.read_version("b", "obj1", fi2.version_id)
+    # Deleting last version drops xl.meta entirely.
+    disk.delete_version("b", "obj1", fi)
+    with pytest.raises(ErrFileNotFound):
+        disk.read_version("b", "obj1")
+
+
+def test_inline_data_roundtrip(disk):
+    disk.make_vol("b")
+    fi = FileInfo.new("b", "small")
+    fi.version_id = new_uuid()
+    fi.size = 4
+    fi.data = {1: b"tiny"}
+    disk.write_metadata("b", "small", fi)
+    got = disk.read_version("b", "small", read_data=True)
+    assert got.data[1] == b"tiny"
+    got2 = disk.read_version("b", "small", read_data=False)
+    assert got2.data == {}
+
+
+def test_walk_dir(disk):
+    disk.make_vol("b")
+    for name in ["z/obj2", "a/obj1", "a/obj0", "top"]:
+        fi = FileInfo.new("b", name)
+        fi.version_id = new_uuid()
+        disk.write_metadata("b", name, fi)
+    entries = list(disk.walk_dir("b"))
+    assert [e[0] for e in entries] == ["a/obj0", "a/obj1", "top", "z/obj2"]
+    assert all(meta.startswith(b"XLT1") for _, meta in entries)
+    fwd = list(disk.walk_dir("b", forward_to="a/obj1"))
+    assert [e[0] for e in fwd] == ["a/obj1", "top", "z/obj2"]
+
+
+def test_offline_disk_raises(disk):
+    disk.make_vol("b")
+    disk.set_online(False)
+    from minio_tpu.utils.errors import ErrDiskNotFound
+    with pytest.raises(ErrDiskNotFound):
+        disk.read_all("b", "x")
+    disk.set_online(True)

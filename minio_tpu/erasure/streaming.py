@@ -43,7 +43,11 @@ class ParallelWriter:
     down to write_quorum (ref cmd/erasure-encode.go:29-70)."""
 
     def __init__(self, writers: list, write_quorum: int):
-        self.writers = list(writers)
+        # NOTE: the caller's list is mutated — failed writers are nil'd in
+        # place so upper layers (putObject commit, MRF) observe mid-stream
+        # failures, exactly like the reference's shared writers slice
+        # (cmd/erasure-encode.go:50, consumed at erasure-object.go:731+).
+        self.writers = writers
         self.write_quorum = write_quorum
         self.errs: list = [None] * len(writers)
 

@@ -1,0 +1,662 @@
+"""ErasureObjects — one erasure set: object CRUD over k+m disks with
+quorum semantics, the TPU-backed equivalent of the reference's
+erasureObjects (/root/reference/cmd/erasure.go:50-78 and
+cmd/erasure-object.go).
+
+Write path mirrors putObject (cmd/erasure-object.go:595-817): shuffle
+disks by the object's hash order, stage bitrot-framed shards under tmp,
+batch-encode on the MXU, then rename-commit under write quorum. Read path
+mirrors getObjectWithFileInfo (:236-356): quorum-pick xl.meta, k-of-n
+shard reads with reconstruct-on-miss, heal hints queued MRF-style.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..erasure.bitrot import (
+    BitrotAlgorithm,
+    StreamingBitrotReader,
+    StreamingBitrotWriter,
+)
+from ..erasure.codec import Erasure
+from ..erasure.streaming import decode_stream, encode_stream, heal_stream
+from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
+from ..storage.local import SMALL_FILE_THRESHOLD, SYSTEM_META_BUCKET
+from ..utils.errors import (
+    OBJECT_OP_IGNORED_ERRS,
+    ErrDiskNotFound,
+    ErrErasureReadQuorum,
+    ErrErasureWriteQuorum,
+    ErrFileNotFound,
+    ErrFileVersionNotFound,
+    ErrInvalidArgument,
+    ErrLessData,
+    ErrMethodNotAllowed,
+    ErrObjectNotFound,
+    ErrVersionNotFound,
+    ErrVolumeNotFound,
+    ErrBucketNotFound,
+    reduce_read_quorum_errs,
+    reduce_write_quorum_errs,
+)
+from .metadata import (
+    find_file_info_in_quorum,
+    common_mod_time,
+    hash_order,
+    object_quorum_from_meta,
+    read_all_file_info,
+    shuffle_disks,
+    shuffle_disks_and_parts_metadata,
+)
+from .types import ObjectInfo, ObjectOptions, TeeMD5Reader
+
+BLOCK_SIZE_V2 = 1 << 20  # erasure block size, ref cmd/object-api-common.go:39
+
+_obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
+
+
+from .multipart import MultipartMixin
+
+
+class ErasureObjects(MultipartMixin):
+    """One erasure set of len(disks) shards (4..16 in the reference)."""
+
+    def __init__(self, disks: list, default_parity: int | None = None,
+                 set_index: int = 0, pool_index: int = 0):
+        if len(disks) < 2:
+            raise ErrInvalidArgument("erasure set needs >= 2 disks")
+        self.disks = list(disks)
+        self.set_drive_count = len(disks)
+        self.default_parity = (
+            default_parity if default_parity is not None else len(disks) // 2
+        )
+        self.set_index = set_index
+        self.pool_index = pool_index
+        # MRF-style queue of (bucket, object, version_id) needing heal
+        # (ref mrfOpCh, cmd/erasure.go:75).
+        self._mrf: list[tuple[str, str, str]] = []
+        self._mrf_lock = threading.Lock()
+        # Namespace locks for this set (ref nsMutex, cmd/erasure.go:60).
+        from ..utils.nslock import NamespaceLock
+
+        self._ns_lock = NamespaceLock()
+
+    # ------------------------------------------------------------------
+    # helpers
+
+    def _object_erasure(self, k: int, m: int) -> Erasure:
+        return Erasure(k, m, BLOCK_SIZE_V2)
+
+    def _tmp_path(self, tmp_id: str) -> str:
+        return f"tmp/{tmp_id}"
+
+    def queue_mrf(self, bucket: str, object_: str, version_id: str = ""):
+        with self._mrf_lock:
+            self._mrf.append((bucket, object_, version_id))
+
+    def drain_mrf(self) -> list[tuple[str, str, str]]:
+        with self._mrf_lock:
+            out, self._mrf = self._mrf, []
+        return out
+
+    # ------------------------------------------------------------------
+    # bucket ops (ref cmd/erasure-bucket.go)
+
+    def make_bucket(self, bucket: str):
+        errs: list = [None] * len(self.disks)
+
+        def do(i):
+            try:
+                if self.disks[i] is None:
+                    raise ErrDiskNotFound(f"disk {i}")
+                self.disks[i].make_vol(bucket)
+            except Exception as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        list(_obj_pool.map(do, range(len(self.disks))))
+        write_quorum = len(self.disks) // 2 + 1
+        from ..utils.errors import ErrVolumeExists
+
+        real_errs = [None if isinstance(e, ErrVolumeExists) else e for e in errs]
+        err = reduce_write_quorum_errs(real_errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise err
+
+    def delete_bucket(self, bucket: str, force: bool = False):
+        errs: list = [None] * len(self.disks)
+
+        def do(i):
+            try:
+                if self.disks[i] is None:
+                    raise ErrDiskNotFound(f"disk {i}")
+                self.disks[i].delete_vol(bucket, force_delete=force)
+            except Exception as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        list(_obj_pool.map(do, range(len(self.disks))))
+        write_quorum = len(self.disks) // 2 + 1
+        real_errs = [None if isinstance(e, ErrVolumeNotFound) else e for e in errs]
+        err = reduce_write_quorum_errs(real_errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise err
+
+    def bucket_exists(self, bucket: str) -> bool:
+        ok = 0
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                d.stat_vol(bucket)
+                ok += 1
+            except Exception:  # noqa: BLE001
+                continue
+        return ok >= (len(self.disks) // 2)
+
+    # ------------------------------------------------------------------
+    # put (ref cmd/erasure-object.go:595-817)
+
+    def put_object(self, bucket: str, object_: str, reader, size: int,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        n = self.set_drive_count
+        parity = self.default_parity
+        data_blocks = n - parity
+        write_quorum = data_blocks + (1 if data_blocks == parity else 0)
+
+        erasure = self._object_erasure(data_blocks, parity)
+        distribution = hash_order(f"{bucket}/{object_}", n)
+        disks_by_shard = shuffle_disks(self.disks, distribution)
+
+        shard_file_size = erasure.shard_file_size(size) if size >= 0 else -1
+        inline = 0 <= shard_file_size <= SMALL_FILE_THRESHOLD
+
+        tmp_id = new_uuid()
+        data_dir = new_uuid()
+        tee = TeeMD5Reader(reader)
+
+        writers: list = [None] * n
+        sinks: list = [None] * n
+        for i, disk in enumerate(disks_by_shard):
+            if disk is None:
+                continue
+            try:
+                if inline:
+                    sinks[i] = io.BytesIO()
+                else:
+                    sinks[i] = disk.create_file_writer(
+                        SYSTEM_META_BUCKET, f"{self._tmp_path(tmp_id)}/part.1"
+                    )
+                writers[i] = StreamingBitrotWriter(
+                    sinks[i], BitrotAlgorithm.HIGHWAYHASH256S
+                )
+            except Exception:  # noqa: BLE001 - offline disk at open time
+                writers[i] = None
+
+        try:
+            total = encode_stream(erasure, tee, writers, write_quorum)
+        except Exception:
+            self._cleanup_tmp(disks_by_shard, tmp_id)
+            raise
+        if size >= 0 and total != size:
+            self._cleanup_tmp(disks_by_shard, tmp_id)
+            raise ErrLessData(f"read {total} bytes, expected {size}")
+        size = total
+
+        if not inline:
+            for s in sinks:
+                if s is not None:
+                    try:
+                        s.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        mod_time_ns = time.time_ns()
+        version_id = opts.version_id or (new_uuid() if opts.versioned else "")
+        etag = tee.md5_hex()
+
+        metadata = dict(opts.user_defined)
+        metadata["etag"] = etag
+        metadata.setdefault("content-type", "application/octet-stream")
+
+        # Commit: RenameData tmp -> final (or metadata-only for inline).
+        errs: list = [None] * n
+
+        def commit(i):
+            disk = disks_by_shard[i]
+            if disk is None or writers[i] is None:
+                errs[i] = ErrDiskNotFound(f"disk {i}")
+                return
+            fi = FileInfo(
+                volume=bucket,
+                name=object_,
+                version_id=version_id,
+                data_dir="" if inline else data_dir,
+                mod_time_ns=mod_time_ns,
+                size=size,
+                metadata=dict(metadata),
+                erasure=ErasureInfo(
+                    data_blocks=data_blocks,
+                    parity_blocks=parity,
+                    block_size=BLOCK_SIZE_V2,
+                    index=i + 1,
+                    distribution=list(distribution),
+                    checksums=[ChecksumInfo(1, BitrotAlgorithm.HIGHWAYHASH256S.value)],
+                ),
+            )
+            fi.add_part(1, size, size)
+            if inline:
+                fi.data = {1: sinks[i].getvalue()}
+            try:
+                disk.rename_data(
+                    SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi, bucket, object_
+                )
+            except Exception as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        list(_obj_pool.map(commit, range(n)))
+        err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            self._cleanup_tmp(disks_by_shard, tmp_id)
+            raise err
+        # Partial write (quorum met, some disks failed): queue MRF heal
+        # (ref cmd/erasure-object.go:798-804 addPartial).
+        if any(e is not None for e in errs):
+            self.queue_mrf(bucket, object_, version_id)
+
+        fi = FileInfo(
+            volume=bucket, name=object_, version_id=version_id,
+            mod_time_ns=mod_time_ns, size=size, metadata=metadata,
+            erasure=ErasureInfo(
+                data_blocks=data_blocks, parity_blocks=parity,
+                block_size=BLOCK_SIZE_V2, distribution=list(distribution),
+            ),
+        )
+        fi.num_versions = 1
+        return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
+
+    def _cleanup_tmp(self, disks: list, tmp_id: str):
+        for disk in disks:
+            if disk is None:
+                continue
+            try:
+                disk.delete(SYSTEM_META_BUCKET, self._tmp_path(tmp_id), recursive=True)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+    # ------------------------------------------------------------------
+    # get (ref cmd/erasure-object.go:135-356, :390-453)
+
+    def _read_quorum_file_info(self, bucket: str, object_: str, version_id: str,
+                               read_data: bool = False):
+        fis, errs = read_all_file_info(
+            self.disks, bucket, object_, version_id, read_data
+        )
+        if all(fi is None for fi in fis):
+            err = reduce_read_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, 1)
+            raise self._to_object_err(err, bucket, object_, version_id)
+        try:
+            read_quorum, _ = object_quorum_from_meta(fis, errs, self.default_parity)
+        except ErrErasureReadQuorum:
+            raise self._to_object_err(
+                ErrErasureReadQuorum(), bucket, object_, version_id
+            ) from None
+        err = reduce_read_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, read_quorum)
+        if err is not None:
+            raise self._to_object_err(err, bucket, object_, version_id)
+        mt, dd = common_mod_time(fis)
+        fi = find_file_info_in_quorum(fis, mt, dd, read_quorum)
+        return fi, fis, errs
+
+    @staticmethod
+    def _to_object_err(err, bucket, object_, version_id=""):
+        if isinstance(err, ErrFileNotFound):
+            return ErrObjectNotFound(f"{bucket}/{object_}")
+        if isinstance(err, ErrFileVersionNotFound):
+            return ErrVersionNotFound(f"{bucket}/{object_} ({version_id})")
+        if isinstance(err, ErrVolumeNotFound):
+            return ErrBucketNotFound(bucket)
+        return err if err is not None else ErrErasureReadQuorum()
+
+    def get_object_info(self, bucket: str, object_: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        fi, _, _ = self._read_quorum_file_info(bucket, object_, opts.version_id)
+        if fi.deleted:
+            if not opts.version_id:
+                raise ErrObjectNotFound(f"{bucket}/{object_}")
+            raise ErrMethodNotAllowed("delete marker")
+        return ObjectInfo.from_file_info(
+            fi, bucket, object_, opts.versioned or bool(opts.version_id)
+        )
+
+    def get_object(self, bucket: str, object_: str, writer,
+                   offset: int = 0, length: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        fi, fis, errs = self._read_quorum_file_info(
+            bucket, object_, opts.version_id, read_data=True
+        )
+        if fi.deleted:
+            if not opts.version_id:
+                raise ErrObjectNotFound(f"{bucket}/{object_}")
+            raise ErrMethodNotAllowed("delete marker")
+
+        total = fi.size
+        if length == -1:
+            length = total - offset
+        if offset < 0 or length < 0 or offset + length > total:
+            raise ErrInvalidArgument("invalid range")
+
+        erasure = self._object_erasure(
+            fi.erasure.data_blocks, fi.erasure.parity_blocks
+        )
+        disks_by_shard, metas_by_shard = shuffle_disks_and_parts_metadata(
+            self.disks, fis, fi
+        )
+
+        if length == 0 or not fi.parts:
+            return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
+
+        # Part loop (ref getObjectWithFileInfo :277-353).
+        part_index, part_offset = fi.to_object_part_index(offset)
+        remaining = length
+        heal_hint = None
+        for p in range(part_index, len(fi.parts)):
+            if remaining <= 0:
+                break
+            part = fi.parts[p]
+            part_length = min(part.size - part_offset, remaining)
+            till_offset = erasure.shard_file_offset(
+                part_offset, part_length, part.size
+            )
+            readers: list = [None] * len(disks_by_shard)
+            for i, disk in enumerate(disks_by_shard):
+                meta = metas_by_shard[i]
+                if disk is None or meta is None:
+                    continue
+                readers[i] = self._shard_reader(
+                    disk, meta, bucket, object_, fi, part.number,
+                    till_offset, erasure.shard_size(),
+                )
+            _, hint = decode_stream(
+                erasure, writer, readers, part_offset, part_length, part.size
+            )
+            if hint is not None and heal_hint is None:
+                heal_hint = hint
+            remaining -= part_length
+            part_offset = 0
+
+        if heal_hint is not None:
+            # On-read heal trigger (ref cmd/erasure-object.go:319-338).
+            self.queue_mrf(bucket, object_, fi.version_id)
+        return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
+
+    def _shard_reader(self, disk, meta: FileInfo, bucket: str, object_: str,
+                      fi: FileInfo, part_number: int, till_offset: int,
+                      shard_size: int):
+        inline = meta.data.get(part_number)
+        if inline is not None:
+            buf = inline
+
+            def open_inline(off, ln, b=buf):
+                return io.BytesIO(b[off : off + ln])
+
+            return StreamingBitrotReader(
+                open_inline, till_offset, shard_size
+            )
+        path = f"{object_}/{fi.data_dir}/part.{part_number}"
+
+        def open_stream(off, ln, d=disk, p=path):
+            return d.read_file_stream(bucket, p, off, ln)
+
+        return StreamingBitrotReader(open_stream, till_offset, shard_size)
+
+    # ------------------------------------------------------------------
+    # delete (ref cmd/erasure-object.go:901-1050 DeleteObject(s))
+
+    def delete_object(self, bucket: str, object_: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        n = self.set_drive_count
+        write_quorum = n // 2 + 1
+
+        if opts.versioned and not opts.version_id:
+            # Versioned delete without a version: write a delete marker.
+            marker = FileInfo(
+                volume=bucket, name=object_, version_id=new_uuid(),
+                deleted=True, mod_time_ns=time.time_ns(),
+            )
+            errs: list = [None] * n
+
+            def write_marker(i):
+                if self.disks[i] is None:
+                    errs[i] = ErrDiskNotFound(f"disk {i}")
+                    return
+                try:
+                    self.disks[i].write_metadata(bucket, object_, marker)
+                except Exception as exc:  # noqa: BLE001
+                    errs[i] = exc
+
+            list(_obj_pool.map(write_marker, range(n)))
+            err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
+            if err is not None:
+                raise err
+            oi = ObjectInfo(bucket=bucket, name=object_,
+                            version_id=marker.version_id, delete_marker=True)
+            return oi
+
+        fi = FileInfo(volume=bucket, name=object_,
+                      version_id=opts.version_id, deleted=False)
+        errs = [None] * n
+
+        def do(i):
+            if self.disks[i] is None:
+                errs[i] = ErrDiskNotFound(f"disk {i}")
+                return
+            try:
+                self.disks[i].delete_version(bucket, object_, fi)
+            except Exception as exc:  # noqa: BLE001
+                errs[i] = exc
+
+        list(_obj_pool.map(do, range(n)))
+        err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise self._to_object_err(err, bucket, object_, opts.version_id)
+        return ObjectInfo(bucket=bucket, name=object_, version_id=opts.version_id)
+
+    def delete_objects(self, bucket: str, objects: list[str],
+                       opts: ObjectOptions | None = None) -> list:
+        out = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o, opts)
+                out.append(None)
+            except Exception as exc:  # noqa: BLE001
+                out.append(exc)
+        return out
+
+    # ------------------------------------------------------------------
+    # listing (set-level raw walk merge; metacache layers on top)
+
+    def list_objects_raw(self, bucket: str, prefix: str = ""):
+        """Merged, de-duplicated sorted stream of (name, xl.meta bytes)
+        across this set's disks — the listPathRaw analog
+        (ref cmd/metacache-set.go:816-973). Streams a k-way merge of each
+        disk's sorted walk (prefix pushed down to the deepest directory),
+        so listing cost scales with entries consumed, not bucket size."""
+        import heapq
+
+        base_dir = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+
+        def disk_stream(disk):
+            try:
+                for name, meta in disk.walk_dir(bucket, base_dir=base_dir,
+                                                forward_to=prefix):
+                    if prefix and not name.startswith(prefix):
+                        if name > prefix:
+                            return  # sorted: nothing later can match
+                        continue
+                    yield name, meta
+            except Exception:  # noqa: BLE001 - tolerate offline disks
+                return
+
+        streams = [disk_stream(d) for d in self.disks if d is not None]
+        last = None
+        for name, meta in heapq.merge(*streams, key=lambda t: t[0]):
+            if name == last:
+                continue
+            last = name
+            yield name, meta
+
+    # ------------------------------------------------------------------
+    # heal (ref cmd/erasure-healing.go:234-519)
+
+    def heal_object(self, bucket: str, object_: str, version_id: str = "",
+                    remove_dangling: bool = False) -> dict:
+        fis, errs = read_all_file_info(
+            self.disks, bucket, object_, version_id, read_data=True
+        )
+        valid = [fi for fi in fis if fi is not None]
+        if not valid:
+            raise self._to_object_err(
+                reduce_read_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, 1),
+                bucket, object_, version_id,
+            )
+        mt, dd = common_mod_time(fis)
+        ref_fi = next(
+            fi for fi in valid if fi.mod_time_ns == mt and fi.data_dir == dd
+        )
+        data_blocks = ref_fi.erasure.data_blocks
+        parity = ref_fi.erasure.parity_blocks
+
+        # Classify disks (ref disksWithAllParts / shouldHealObjectOnDisk).
+        available = [False] * len(self.disks)
+        for i, fi in enumerate(fis):
+            if fi is None or self.disks[i] is None:
+                continue
+            if fi.mod_time_ns != mt or fi.data_dir != dd or fi.deleted != ref_fi.deleted:
+                continue
+            try:
+                if not fi.deleted:
+                    self.disks[i].check_parts(bucket, object_, fi)
+                available[i] = True
+            except Exception:  # noqa: BLE001 - part missing/corrupt
+                continue
+
+        n_avail = sum(available)
+        if n_avail < data_blocks and not ref_fi.deleted:
+            # Dangling object (ref isObjectDangling :776).
+            if remove_dangling:
+                try:
+                    self.delete_object(
+                        bucket, object_, ObjectOptions(version_id=version_id)
+                    )
+                except (ErrObjectNotFound, ErrVersionNotFound):
+                    pass  # already gone on most disks — purge complete
+                return {"healed": [], "dangling": True}
+            raise ErrErasureReadQuorum(
+                f"only {n_avail} of {data_blocks} shards available"
+            )
+
+        stale = [i for i, ok in enumerate(available)
+                 if not ok and self.disks[i] is not None]
+        if not stale:
+            return {"healed": [], "dangling": False}
+
+        distribution = ref_fi.erasure.distribution
+        disks_by_shard = shuffle_disks(self.disks, distribution)
+        avail_by_shard = shuffle_disks(
+            [self.disks[i] if available[i] else None for i in range(len(self.disks))],
+            distribution,
+        )
+        metas_by_shard = shuffle_disks(
+            [fis[i] if available[i] else None for i in range(len(self.disks))],
+            distribution,
+        )
+        # shard indices to regenerate = positions whose disk is stale.
+        stale_shards = [
+            s for s in range(len(disks_by_shard))
+            if avail_by_shard[s] is None and disks_by_shard[s] is not None
+        ]
+
+        erasure = self._object_erasure(data_blocks, parity)
+        tmp_id = new_uuid()
+        inline = bool(ref_fi.data)
+        healed_inline: dict[int, dict[int, bytes]] = {s: {} for s in stale_shards}
+
+        if not ref_fi.deleted:
+            for part in ref_fi.parts:
+                till = erasure.shard_file_offset(0, part.size, part.size)
+                readers: list = [None] * len(disks_by_shard)
+                for s in range(len(disks_by_shard)):
+                    if avail_by_shard[s] is None:
+                        continue
+                    readers[s] = self._shard_reader(
+                        avail_by_shard[s], metas_by_shard[s], bucket, object_,
+                        ref_fi, part.number, till, erasure.shard_size(),
+                    )
+                writers: list = [None] * len(disks_by_shard)
+                sinks: dict[int, object] = {}
+                for s in stale_shards:
+                    if inline:
+                        sinks[s] = io.BytesIO()
+                    else:
+                        sinks[s] = disks_by_shard[s].create_file_writer(
+                            SYSTEM_META_BUCKET,
+                            f"{self._tmp_path(tmp_id)}/part.{part.number}",
+                        )
+                    writers[s] = StreamingBitrotWriter(
+                        sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
+                    )
+                heal_stream(erasure, writers, readers, part.size)
+                for s in stale_shards:
+                    if inline:
+                        healed_inline[s][part.number] = sinks[s].getvalue()
+                    else:
+                        sinks[s].close()
+
+        # Commit healed shards + metadata on stale disks.
+        healed = []
+        for s in stale_shards:
+            disk = disks_by_shard[s]
+            fi = FileInfo.from_dict(ref_fi.to_dict())
+            fi.volume, fi.name = bucket, object_
+            fi.erasure.index = s + 1
+            if inline:
+                fi.data = healed_inline[s]
+            try:
+                if inline or ref_fi.deleted:
+                    disk.write_metadata(bucket, object_, fi)
+                else:
+                    fi.data = {}
+                    disk.rename_data(
+                        SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi,
+                        bucket, object_,
+                    )
+                healed.append(disk.endpoint())
+            except Exception:  # noqa: BLE001 - heal is best-effort per disk
+                continue
+        return {"healed": healed, "dangling": False}
+
+    def heal_bucket(self, bucket: str) -> dict:
+        """Recreate the bucket volume on disks missing it
+        (ref healBucket, cmd/erasure-healing.go:57)."""
+        healed = []
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                disk.stat_vol(bucket)
+            except ErrVolumeNotFound:
+                try:
+                    disk.make_vol(bucket)
+                    healed.append(disk.endpoint())
+                except Exception:  # noqa: BLE001
+                    continue
+            except Exception:  # noqa: BLE001
+                continue
+        return {"healed": healed}

@@ -1,0 +1,257 @@
+"""erasureServerPools — the top-level ObjectLayer: routes each object to a
+pool (most free space for new objects, existence for reads), merges
+listings and healing across pools.
+
+Mirrors /root/reference/cmd/erasure-server-pool.go (getPoolIdx :293,
+PutObject :731, GetObjectNInfo :593) plus the list_objects surface of the
+reference's ListObjects path, simplified to the set-level raw-walk merge.
+"""
+
+from __future__ import annotations
+
+import heapq
+import io
+
+from ..storage.xlmeta import XLMeta
+from ..utils.errors import (
+    ErrBucketNotFound,
+    ErrObjectNotFound,
+    ErrVersionNotFound,
+)
+from .sets import ErasureSets
+from .types import ListObjectsInfo, ObjectInfo, ObjectOptions
+
+
+class ErasureServerPools:
+    """ObjectLayer over one or more ErasureSets pools."""
+
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("need at least one pool")
+        self.pools = pools
+
+    # --- pool routing ---
+
+    def _pool_with_object(self, bucket: str, object_: str,
+                          opts: ObjectOptions | None) -> int | None:
+        for i, pool in enumerate(self.pools):
+            try:
+                pool.get_object_info(bucket, object_, opts)
+                return i
+            except (ErrObjectNotFound, ErrVersionNotFound):
+                continue
+        return None
+
+    def _pool_for_put(self, bucket: str, object_: str,
+                      opts: ObjectOptions | None) -> int:
+        """Existing object keeps its pool; new objects go to the pool with
+        the most free space (ref getPoolIdx, cmd/erasure-server-pool.go:293)."""
+        if len(self.pools) == 1:
+            return 0
+        existing = self._pool_with_object(bucket, object_, opts)
+        if existing is not None:
+            return existing
+        best, best_free = 0, -1
+        for i, pool in enumerate(self.pools):
+            free = 0
+            for disk in pool.disks:
+                if disk is None:
+                    continue
+                try:
+                    free += disk.disk_info().free
+                except Exception:  # noqa: BLE001
+                    continue
+            if free > best_free:
+                best, best_free = i, free
+        return best
+
+    # --- bucket ops ---
+
+    def make_bucket(self, bucket: str, opts: ObjectOptions | None = None):
+        for pool in self.pools:
+            pool.make_bucket(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False):
+        for pool in self.pools:
+            pool.delete_bucket(bucket, force=force)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return any(p.bucket_exists(bucket) for p in self.pools)
+
+    def get_bucket_info(self, bucket: str):
+        for pool in self.pools:
+            for b in pool.list_buckets():
+                if b.name == bucket:
+                    return b
+        raise ErrBucketNotFound(bucket)
+
+    def list_buckets(self):
+        seen = {}
+        for pool in self.pools:
+            for b in pool.list_buckets():
+                seen.setdefault(b.name, b)
+        return [seen[k] for k in sorted(seen)]
+
+    def _check_bucket(self, bucket: str):
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+
+    # --- object ops ---
+
+    def put_object(self, bucket, object_, reader, size, opts=None):
+        self._check_bucket(bucket)
+        idx = self._pool_for_put(bucket, object_, opts)
+        return self.pools[idx].put_object(bucket, object_, reader, size, opts)
+
+    def get_object(self, bucket, object_, writer, offset=0, length=-1, opts=None):
+        self._check_bucket(bucket)
+        last_exc = None
+        for pool in self.pools:
+            try:
+                return pool.get_object(bucket, object_, writer, offset, length, opts)
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
+
+    def get_object_bytes(self, bucket, object_, offset=0, length=-1, opts=None) -> bytes:
+        buf = io.BytesIO()
+        self.get_object(bucket, object_, buf, offset, length, opts)
+        return buf.getvalue()
+
+    def get_object_info(self, bucket, object_, opts=None) -> ObjectInfo:
+        self._check_bucket(bucket)
+        last_exc = None
+        for pool in self.pools:
+            try:
+                return pool.get_object_info(bucket, object_, opts)
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
+
+    def delete_object(self, bucket, object_, opts=None):
+        self._check_bucket(bucket)
+        last_exc = None
+        for pool in self.pools:
+            try:
+                return pool.delete_object(bucket, object_, opts)
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
+
+    def delete_objects(self, bucket, objects, opts=None):
+        return [self._del_one(bucket, o, opts) for o in objects]
+
+    def _del_one(self, bucket, o, opts):
+        try:
+            self.delete_object(bucket, o, opts)
+            return None
+        except Exception as exc:  # noqa: BLE001
+            return exc
+
+    # --- listing (merged raw walk; ref cmd/erasure-server-pool.go:876-1030) ---
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
+        self._check_bucket(bucket)
+        out = ListObjectsInfo()
+        prefixes: set[str] = set()
+        streams = [p.list_objects_raw(bucket, prefix) for p in self.pools]
+        merged = heapq.merge(*streams, key=lambda t: t[0])
+        last_name = None
+        for name, meta_blob in merged:
+            if name == last_name:
+                continue
+            last_name = name
+            if marker and name <= marker:
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    prefixes.add(prefix + rest.split(delimiter, 1)[0] + delimiter)
+                    continue
+            if len(out.objects) >= max_keys:
+                out.is_truncated = True
+                out.next_marker = out.objects[-1].name if out.objects else name
+                break
+            try:
+                meta = XLMeta.from_bytes(meta_blob)
+                fi = meta.to_file_info(bucket, name, None)
+            except Exception:  # noqa: BLE001 - skip unreadable entries
+                continue
+            if fi.deleted:
+                continue  # latest is a delete marker
+            out.objects.append(ObjectInfo.from_file_info(fi, bucket, name))
+        out.prefixes = sorted(prefixes)
+        return out
+
+    # --- multipart (single-pool routing for new uploads; existing uploads
+    # --- are found by id in whichever pool holds them) ---
+
+    def new_multipart_upload(self, bucket, object_, opts=None):
+        self._check_bucket(bucket)
+        idx = self._pool_for_put(bucket, object_, opts)
+        return self.pools[idx].new_multipart_upload(bucket, object_, opts)
+
+    def _pool_for_upload(self, bucket, object_, upload_id):
+        from ..utils.errors import ErrInvalidUploadID
+
+        for pool in self.pools:
+            try:
+                pool.get_hashed_set(object_)._upload_fi(bucket, object_, upload_id)
+                return pool
+            except ErrInvalidUploadID:
+                continue
+        raise ErrInvalidUploadID(upload_id)
+
+    def put_object_part(self, bucket, object_, upload_id, part_number, reader,
+                        size, opts=None):
+        pool = self._pool_for_upload(bucket, object_, upload_id)
+        return pool.put_object_part(
+            bucket, object_, upload_id, part_number, reader, size, opts
+        )
+
+    def list_object_parts(self, bucket, object_, upload_id, part_marker=0,
+                          max_parts=1000):
+        pool = self._pool_for_upload(bucket, object_, upload_id)
+        return pool.list_object_parts(
+            bucket, object_, upload_id, part_marker, max_parts
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for pool in self.pools:
+            out.extend(pool.list_multipart_uploads(bucket, prefix))
+        return out
+
+    def abort_multipart_upload(self, bucket, object_, upload_id):
+        pool = self._pool_for_upload(bucket, object_, upload_id)
+        return pool.abort_multipart_upload(bucket, object_, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts,
+                                  opts=None):
+        pool = self._pool_for_upload(bucket, object_, upload_id)
+        return pool.complete_multipart_upload(
+            bucket, object_, upload_id, parts, opts
+        )
+
+    # --- heal ---
+
+    def heal_object(self, bucket, object_, version_id="", remove_dangling=False):
+        results = []
+        for pool in self.pools:
+            try:
+                results.append(
+                    pool.heal_object(bucket, object_, version_id, remove_dangling)
+                )
+            except (ErrObjectNotFound, ErrVersionNotFound):
+                continue
+        if not results:
+            raise ErrObjectNotFound(f"{bucket}/{object_}")
+        return results[0] if len(results) == 1 else results
+
+    def heal_bucket(self, bucket):
+        return [p.heal_bucket(bucket) for p in self.pools]
+
+    def heal_format(self):
+        for pool in self.pools:
+            pool.init_format()

@@ -1,0 +1,253 @@
+"""erasureSets — one pool: N erasure sets of set_drive_count disks each,
+with deterministic object->set placement by sipHash(object) % N keyed on
+the deployment id, plus format.json identity management.
+
+Mirrors /root/reference/cmd/erasure-sets.go (placement :713-753) and
+cmd/format-erasure.go (formatErasureV3 :110-124) at the semantic level:
+every disk stores a format blob naming the deployment, its disk id, and
+the full set layout; quorum agreement on format decides fresh-vs-existing
+deployment.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..storage.local import SYSTEM_META_BUCKET
+from ..utils.errors import (
+    ErrCorruptedFormat,
+    ErrFileNotFound,
+    ErrUnformattedDisk,
+    ErrVolumeNotFound,
+)
+from ..storage.fileinfo import new_uuid
+from ..utils.siphash import crc_hash_mod, siphash_mod
+from .erasure_objects import ErasureObjects
+from .types import BucketInfo, ObjectOptions
+
+FORMAT_FILE = "format.json"
+
+# Distribution algo tags (ref cmd/format-erasure.go).
+DIST_ALGO_CRC = "CRCMOD"
+DIST_ALGO_SIPMOD = "SIPMOD+PARITY"
+
+
+def _format_path() -> str:
+    return FORMAT_FILE
+
+
+def write_format(disk, deployment_id: str, disk_id: str, this_set: int,
+                 this_disk: int, layout: list[list[str]],
+                 distribution_algo: str = DIST_ALGO_SIPMOD):
+    doc = {
+        "version": "1",
+        "format": "xl-tpu",
+        "id": deployment_id,
+        "xl": {
+            "version": "3",
+            "this": disk_id,
+            "sets": layout,
+            "distributionAlgo": distribution_algo,
+        },
+    }
+    disk.write_all(SYSTEM_META_BUCKET, _format_path(), json.dumps(doc).encode())
+
+
+def read_format(disk) -> dict:
+    try:
+        raw = disk.read_all(SYSTEM_META_BUCKET, _format_path())
+    except (ErrFileNotFound, ErrVolumeNotFound) as exc:
+        raise ErrUnformattedDisk(disk.endpoint()) from exc
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise ErrCorruptedFormat(disk.endpoint()) from exc
+    if doc.get("format") != "xl-tpu":
+        raise ErrCorruptedFormat(f"{disk.endpoint()}: bad format tag")
+    return doc
+
+
+class ErasureSets:
+    """One pool of set_count x set_drive_count disks."""
+
+    def __init__(self, disks: list, set_drive_count: int,
+                 deployment_id: str | None = None,
+                 default_parity: int | None = None, pool_index: int = 0):
+        if len(disks) % set_drive_count != 0:
+            raise ValueError("disk count must be a multiple of set_drive_count")
+        self.set_count = len(disks) // set_drive_count
+        self.set_drive_count = set_drive_count
+        self.disks = list(disks)
+        self.pool_index = pool_index
+        self.distribution_algo = DIST_ALGO_SIPMOD
+        self.deployment_id = deployment_id or new_uuid()
+        self.sets: list[ErasureObjects] = []
+        for s in range(self.set_count):
+            group = disks[s * set_drive_count : (s + 1) * set_drive_count]
+            self.sets.append(
+                ErasureObjects(group, default_parity=default_parity,
+                               set_index=s, pool_index=pool_index)
+            )
+
+    # --- format management (ref cmd/format-erasure.go, prepare-storage.go) ---
+
+    def init_format(self):
+        """Write fresh format.json to every disk (fresh deployment)."""
+        layout = [
+            [f"disk-{s}-{d}" for d in range(self.set_drive_count)]
+            for s in range(self.set_count)
+        ]
+        for s in range(self.set_count):
+            for d in range(self.set_drive_count):
+                disk = self.disks[s * self.set_drive_count + d]
+                if disk is None:
+                    continue
+                disk_id = layout[s][d]
+                write_format(disk, self.deployment_id, disk_id, s, d, layout,
+                             self.distribution_algo)
+                disk.set_disk_id(disk_id)
+
+    def load_format(self):
+        """Load format from disks, agree by quorum on deployment id
+        (ref waitForFormatErasure/quorum logic in prepare-storage.go)."""
+        ids: dict[str, int] = {}
+        algos: dict[str, int] = {}
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                doc = read_format(disk)
+            except (ErrUnformattedDisk, ErrCorruptedFormat):
+                continue
+            ids[doc["id"]] = ids.get(doc["id"], 0) + 1
+            algo = doc["xl"].get("distributionAlgo", DIST_ALGO_SIPMOD)
+            algos[algo] = algos.get(algo, 0) + 1
+            disk.set_disk_id(doc["xl"]["this"])
+        if not ids:
+            raise ErrUnformattedDisk("no formatted disks")
+        self.deployment_id = max(ids.items(), key=lambda kv: kv[1])[0]
+        self.distribution_algo = max(algos.items(), key=lambda kv: kv[1])[0]
+
+    @property
+    def deployment_id_bytes(self) -> bytes:
+        import uuid as _uuid
+
+        try:
+            return _uuid.UUID(self.deployment_id).bytes
+        except ValueError:
+            import hashlib
+
+            return hashlib.md5(self.deployment_id.encode()).digest()
+
+    # --- placement (ref cmd/erasure-sets.go:713-753) ---
+
+    def get_hashed_set_index(self, object_: str) -> int:
+        if self.distribution_algo == DIST_ALGO_CRC:
+            return crc_hash_mod(object_, self.set_count)
+        return siphash_mod(object_, self.set_count, self.deployment_id_bytes)
+
+    def get_hashed_set(self, object_: str) -> ErasureObjects:
+        return self.sets[self.get_hashed_set_index(object_)]
+
+    # --- ObjectLayer surface: route to the placed set ---
+
+    def make_bucket(self, bucket: str):
+        for s in self.sets:
+            s.make_bucket(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False):
+        for s in self.sets:
+            s.delete_bucket(bucket, force=force)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return all(s.bucket_exists(bucket) for s in self.sets)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        seen: dict[str, int] = {}
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                for v in disk.list_vols():
+                    if v.name.startswith("."):
+                        continue
+                    if v.name not in seen:
+                        seen[v.name] = v.created_ns
+            except Exception:  # noqa: BLE001 - offline disks tolerated
+                continue
+        return [BucketInfo(name=n, created_ns=c) for n, c in sorted(seen.items())]
+
+    def put_object(self, bucket, object_, reader, size, opts=None):
+        return self.get_hashed_set(object_).put_object(bucket, object_, reader, size, opts)
+
+    def get_object(self, bucket, object_, writer, offset=0, length=-1, opts=None):
+        return self.get_hashed_set(object_).get_object(
+            bucket, object_, writer, offset, length, opts
+        )
+
+    def get_object_info(self, bucket, object_, opts=None):
+        return self.get_hashed_set(object_).get_object_info(bucket, object_, opts)
+
+    def delete_object(self, bucket, object_, opts=None):
+        return self.get_hashed_set(object_).delete_object(bucket, object_, opts)
+
+    def delete_objects(self, bucket, objects, opts=None):
+        return [
+            self._delete_one(bucket, o, opts) for o in objects
+        ]
+
+    def _delete_one(self, bucket, object_, opts):
+        try:
+            self.get_hashed_set(object_).delete_object(bucket, object_, opts)
+            return None
+        except Exception as exc:  # noqa: BLE001
+            return exc
+
+    # --- multipart: routed to the placed set (ref cmd/erasure-sets.go) ---
+
+    def new_multipart_upload(self, bucket, object_, opts=None):
+        return self.get_hashed_set(object_).new_multipart_upload(bucket, object_, opts)
+
+    def put_object_part(self, bucket, object_, upload_id, part_number, reader,
+                        size, opts=None):
+        return self.get_hashed_set(object_).put_object_part(
+            bucket, object_, upload_id, part_number, reader, size, opts
+        )
+
+    def list_object_parts(self, bucket, object_, upload_id, part_marker=0,
+                          max_parts=1000):
+        return self.get_hashed_set(object_).list_object_parts(
+            bucket, object_, upload_id, part_marker, max_parts
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_multipart_uploads(bucket, prefix))
+        return out
+
+    def abort_multipart_upload(self, bucket, object_, upload_id):
+        return self.get_hashed_set(object_).abort_multipart_upload(
+            bucket, object_, upload_id
+        )
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts,
+                                  opts=None):
+        return self.get_hashed_set(object_).complete_multipart_upload(
+            bucket, object_, upload_id, parts, opts
+        )
+
+    def heal_object(self, bucket, object_, version_id="", remove_dangling=False):
+        return self.get_hashed_set(object_).heal_object(
+            bucket, object_, version_id, remove_dangling
+        )
+
+    def heal_bucket(self, bucket):
+        return [s.heal_bucket(bucket) for s in self.sets]
+
+    def list_objects_raw(self, bucket: str, prefix: str = ""):
+        """Merge the per-set sorted streams (k-way merge by name)."""
+        import heapq
+
+        iters = [s.list_objects_raw(bucket, prefix) for s in self.sets]
+        return heapq.merge(*iters, key=lambda t: t[0])

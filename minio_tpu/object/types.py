@@ -1,0 +1,138 @@
+"""Object-layer API types: ObjectInfo, options, list results — the Python
+equivalents of the reference's cmd/object-api-datatypes.go structures.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..storage.fileinfo import FileInfo
+
+
+@dataclass
+class ObjectOptions:
+    """Per-call options (ref cmd/object-api-interface.go:40-70)."""
+
+    version_id: str = ""
+    versioned: bool = False
+    version_suspended: bool = False
+    user_defined: dict = field(default_factory=dict)
+    delete_marker: bool = False
+    no_lock: bool = False
+    part_number: int = 0
+
+
+@dataclass
+class ObjectInfo:
+    """Externally visible object metadata
+    (ref cmd/object-api-datatypes.go ObjectInfo)."""
+
+    bucket: str = ""
+    name: str = ""
+    mod_time_ns: int = 0
+    size: int = 0
+    is_dir: bool = False
+    etag: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    content_type: str = ""
+    user_defined: dict = field(default_factory=dict)
+    parity_blocks: int = 0
+    data_blocks: int = 0
+    num_versions: int = 0
+    actual_size: int | None = None
+
+    @classmethod
+    def from_file_info(cls, fi: FileInfo, bucket: str, object_: str,
+                       versioned: bool = False) -> "ObjectInfo":
+        etag = fi.metadata.get("etag", "")
+        version_id = fi.version_id
+        if versioned and not version_id:
+            version_id = "null"
+        user_defined = {
+            k: v for k, v in fi.metadata.items()
+            if not k.startswith("x-mtpu-internal-") and k != "etag"
+        }
+        return cls(
+            bucket=bucket,
+            name=object_,
+            mod_time_ns=fi.mod_time_ns,
+            size=fi.size,
+            etag=etag,
+            version_id=version_id,
+            is_latest=fi.is_latest,
+            delete_marker=fi.deleted,
+            content_type=fi.metadata.get("content-type", ""),
+            user_defined=user_defined,
+            parity_blocks=fi.erasure.parity_blocks,
+            data_blocks=fi.erasure.data_blocks,
+            num_versions=fi.num_versions,
+        )
+
+
+@dataclass
+class ListObjectsInfo:
+    is_truncated: bool = False
+    next_marker: str = ""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class MultipartInfo:
+    bucket: str = ""
+    object: str = ""
+    upload_id: str = ""
+    user_defined: dict = field(default_factory=dict)
+
+
+@dataclass
+class PartInfo:
+    part_number: int = 0
+    etag: str = ""
+    size: int = 0
+    actual_size: int = 0
+    mod_time_ns: int = 0
+
+
+@dataclass
+class CompletePart:
+    part_number: int
+    etag: str
+
+
+@dataclass
+class BucketInfo:
+    name: str
+    created_ns: int
+
+
+def compute_etag(data_md5: bytes | None, parts: int = 0) -> str:
+    """S3-style ETag: hex md5, or multipart md5-of-md5s with -N suffix."""
+    if data_md5 is None:
+        return ""
+    if parts:
+        return data_md5.hex() + f"-{parts}"
+    return data_md5.hex()
+
+
+class TeeMD5Reader:
+    """Wrap a reader, computing md5/size as data flows through — a minimal
+    stand-in for the reference's pkg/hash.Reader."""
+
+    def __init__(self, src):
+        self._src = src
+        self._md5 = hashlib.md5()
+        self.bytes_read = 0
+
+    def read(self, n: int = -1) -> bytes:
+        buf = self._src.read(n)
+        if buf:
+            self._md5.update(buf)
+            self.bytes_read += len(buf)
+        return buf
+
+    def md5_hex(self) -> str:
+        return self._md5.hexdigest()

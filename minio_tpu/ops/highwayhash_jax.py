@@ -224,11 +224,6 @@ def _finalize256(state):
     return by.reshape(w.shape[:-1] + (32,)).astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("length",))
-def _hash256_fixed(data: jax.Array, key_arr_unused, length: int) -> jax.Array:
-    raise NotImplementedError  # placeholder; real entry below
-
-
 def _build_hash_fn(length: int, key: bytes):
     """Returns a jitted fn hashing [..., length] uint8 -> [..., 32] uint8."""
     n_packets = length // 32

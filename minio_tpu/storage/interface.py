@@ -144,6 +144,13 @@ class StorageAPI(abc.ABC):
     def read_file_stream(self, volume: str, path: str, offset: int, length: int): ...
 
     @abc.abstractmethod
+    def create_file_writer(self, volume: str, path: str):
+        """Open a writable sink for streaming shard writes — the Python
+        seam for the reference's pipe-into-CreateFile pattern
+        (cmd/bitrot-streaming.go:83-99). Caller must close()."""
+        ...
+
+    @abc.abstractmethod
     def rename_file(self, src_volume: str, src_path: str,
                     dst_volume: str, dst_path: str) -> None: ...
 

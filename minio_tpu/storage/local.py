@@ -384,6 +384,17 @@ class LocalStorage(StorageAPI):
         if size >= 0 and written != size:
             raise ErrLessDataOrMore(written, size)
 
+    def create_file_writer(self, volume: str, path: str):
+        self._require_online()
+        if not os.path.isdir(self._vol_path(volume)):
+            raise ErrVolumeNotFound(volume)
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        f = open(p, "wb")
+        if not self._fsync:
+            return f
+        return _FsyncOnClose(f)
+
     def read_file_stream(self, volume: str, path: str, offset: int, length: int):
         self._require_online()
         try:
@@ -519,6 +530,22 @@ class LocalStorage(StorageAPI):
             if not os.path.isdir(self._vol_path(volume)):
                 raise ErrVolumeNotFound(volume) from None
             raise ErrFileNotFound(f"{volume}/{path}") from None
+
+
+class _FsyncOnClose:
+    """File wrapper that fsyncs before close — keeps the fsync-before-
+    rename-commit durability point for streamed shard writes."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def write(self, b):
+        return self._f.write(b)
+
+    def close(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
 
 
 class _LimitedReader:

@@ -169,24 +169,18 @@ def count_errs(errs, match: type | None) -> int:
 
 
 def reduce_errs(errs, ignored_errs=()):
-    """Return the maximally-occurring error (None = success counts too).
-
-    Ignored error types are normalized to ErrDiskNotFound, matching
-    cmd/erasure-metadata-utils.go:40-70 reduceErrs.
+    """Return (count, err) for the maximally-occurring outcome (None =
+    success counts too); ignored error types are skipped entirely, and
+    ties prefer success. Mirrors reduceErrs,
+    cmd/erasure-metadata-utils.go:36-58.
     """
     counts: dict[object, int] = {}
     keys: dict[object, object] = {}
     ignored = tuple(ignored_errs)
 
-    def normalize(e):
-        # Ignored error types are rewritten to ErrDiskNotFound before
-        # counting AND before being returned, exactly like the reference.
-        if e is not None and ignored and isinstance(e, ignored):
-            return ErrDiskNotFound()
-        return e
-
     for e in errs:
-        e = normalize(e)
+        if e is not None and ignored and isinstance(e, ignored):
+            continue
         k = None if e is None else type(e)
         counts[k] = counts.get(k, 0) + 1
         keys.setdefault(k, e)
@@ -195,9 +189,10 @@ def reduce_errs(errs, ignored_errs=()):
     for k, n in counts.items():
         if n > max_n:
             max_k, max_n = k, n
-    if max_k is None:
-        return max_n, None
-    return max_n, keys[max_k]
+        elif n == max_n and k is None:
+            # Prefer nil over errors with the same count.
+            max_k = k
+    return max_n, keys.get(max_k)
 
 
 def reduce_quorum_errs(errs, ignored_errs, quorum: int, quorum_err: StorageError):

@@ -1,0 +1,81 @@
+"""Headline benchmark: Reed-Solomon 12+4 erasure-encode throughput at
+1 MiB blocks (the reference's BenchmarkErasureEncode grid,
+/root/reference/cmd/erasure-encode_test.go:210-253, and BASELINE.json
+north-star config).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
+
+`vs_baseline` compares against AVX2 klauspost/reedsolomon on the
+reference host. The reference publishes no absolute numbers
+(BASELINE.md), and no Go toolchain exists in this image to measure it,
+so the denominator is a documented estimate: ~6 GB/s for 12+4 encode
+with AVX2 auto-goroutines on a modern server core-group (klauspost/
+reedsolomon README-class numbers). Replace with a measured value when a
+reference host is available.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+AVX2_BASELINE_GBPS = 6.0
+
+K, M = 12, 4
+BLOCK = 1 << 20
+BATCH = 64  # 64 MiB of object data per dispatch
+ITERS = 20
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import gf
+    from minio_tpu.ops.rs import apply_gf_matrix
+    from minio_tpu.utils import ceil_frac
+
+    shard = ceil_frac(BLOCK, K)
+    bitmat = jnp.asarray(gf.bit_matrix(gf.parity_matrix(K, M)), dtype=jnp.int8)
+    rng = np.random.default_rng(0)
+    blocks_np = rng.integers(0, 256, size=(BATCH, K, shard), dtype=np.uint8)
+    blocks = jax.device_put(blocks_np)
+
+    fn = jax.jit(apply_gf_matrix)
+    fn(bitmat, blocks).block_until_ready()  # compile + warm
+
+    # Device-resident steady state (the pipelined path keeps batches on
+    # device; H2D overlap is the streaming layer's job).
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = fn(bitmat, blocks)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    data_bytes = BATCH * K * shard * ITERS
+    gbps = data_bytes / dt / 1e9
+
+    # End-to-end including H2D transfer of the data shards.
+    t0 = time.perf_counter()
+    for _ in range(4):
+        out = fn(bitmat, jax.device_put(blocks_np))
+    out.block_until_ready()
+    e2e_gbps = (BATCH * K * shard * 4) / (time.perf_counter() - t0) / 1e9
+
+    print(json.dumps({
+        "metric": f"erasure encode {K}+{M} @1MiB blocks, device-resident",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / AVX2_BASELINE_GBPS, 3),
+        "e2e_h2d_gbps": round(e2e_gbps, 3),
+        "batch_blocks": BATCH,
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,6 @@
+"""Multi-chip SPMD erasure data-plane: device meshes, lane-sharded
+stripes, XLA-collective reconstruction. See `sharded.py`."""
 
+from .sharded import Mesh, ShardedErasure, full_put_get_step, make_mesh
+
+__all__ = ["Mesh", "ShardedErasure", "full_put_get_step", "make_mesh"]

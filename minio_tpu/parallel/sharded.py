@@ -1,0 +1,214 @@
+"""Multi-chip SPMD erasure pipeline over a `jax.sharding.Mesh`.
+
+This is the TPU-native replacement for the reference's distributed data
+plane (shard fan-out over goroutines + storage-REST,
+/root/reference/cmd/erasure-encode.go:29-70 parallelWriter,
+cmd/erasure-decode.go:30-201 parallelReader): instead of one goroutine and
+one TCP stream per disk, the erasure stripe lives sharded across a device
+mesh and XLA collectives move shards over ICI/DCN.
+
+Axis mapping (the storage analog of dp/tp/sp):
+
+- ``dp``   — block-batch axis. Independent erasure blocks (different
+  objects, or successive 1 MiB blocks of one large object) are
+  embarrassingly parallel, exactly like the reference's per-object
+  goroutines and sipHash set placement (cmd/erasure-sets.go:713). Pure
+  data parallelism; no collectives.
+- ``lane`` — shard-lane axis. The k+m shards of one stripe; one lane ==
+  one "disk" of the erasure set. This is the tensor/sequence-parallel
+  analog: a single logical blob is striped across devices
+  (SURVEY.md §5.7). Encode needs no cross-lane traffic (parity is a
+  matmul against replicated data); degraded reads all-gather the k
+  surviving lanes over ICI and reconstruct locally.
+
+All device code is shape-static and jit-compiled once per (geometry,
+survivor-set); the host picks the reconstruction matrix for whichever
+disks are dead — the compiled step itself has no data-dependent control
+flow (parallelReader's "read k, escalate on error" loop becomes a host
+-level retry with a different static survivor tuple).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gf
+from ..ops.rs import apply_gf_matrix
+from ..utils import ceil_frac
+
+
+def make_mesh(n_devices: int | None = None, lanes: int | None = None) -> Mesh:
+    """Build a 2D ('dp', 'lane') mesh over the first `n_devices` devices.
+
+    `lanes` must divide n_devices; default picks the largest power-of-two
+    lane group <= min(n_devices, 8) so a 4..16-wide erasure set maps onto
+    it evenly (set sizes are 4/8/16 in practice, docs/distributed/DESIGN.md).
+    """
+    all_devs = jax.devices()
+    if n_devices is not None and len(all_devs) < n_devices:
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(all_devs)} available"
+        )
+    devs = all_devs if n_devices is None else all_devs[:n_devices]
+    n = len(devs)
+    if lanes is None:
+        lanes = 1
+        while lanes * 2 <= min(n, 8) and n % (lanes * 2) == 0:
+            lanes *= 2
+    if n % lanes != 0:
+        raise ValueError(f"lanes={lanes} must divide n_devices={n}")
+    arr = np.asarray(devs).reshape(n // lanes, lanes)
+    return Mesh(arr, ("dp", "lane"))
+
+
+class ShardedErasure:
+    """One erasure geometry (k data + m parity) laid out on a device mesh.
+
+    Device layout: stripes are uint8 tensors [B, k+m, S] sharded
+    P('dp', 'lane', None) — batch over dp, shard lanes over lane (each
+    mesh column is one group of "disks").
+    """
+
+    def __init__(self, mesh: Mesh, data_blocks: int, parity_blocks: int,
+                 block_size: int = 1 << 20):
+        self.mesh = mesh
+        self.k = data_blocks
+        self.m = parity_blocks
+        self.n = data_blocks + parity_blocks
+        self.block_size = block_size
+        self.shard_size = ceil_frac(block_size, data_blocks)
+        lanes = mesh.shape["lane"]
+        if self.n % lanes != 0:
+            raise ValueError(
+                f"k+m={self.n} must be divisible by mesh lane dim {lanes}"
+            )
+        self._parity_bits = jnp.asarray(
+            gf.bit_matrix(gf.parity_matrix(self.k, self.m)), dtype=jnp.int8
+        )
+        self._decode_cache: dict = {}
+        self.data_spec = NamedSharding(mesh, P("dp", None, None))
+        self.stripe_spec = NamedSharding(mesh, P("dp", "lane", None))
+        self.replicated = NamedSharding(mesh, P())
+
+    # --- encode (put path) ---
+
+    @functools.cached_property
+    def _encode_fn(self):
+        def encode(parity_bits, data):
+            # data [B, k, S] dp-sharded; parity matmul is lane-local after
+            # XLA scatters the concat output over 'lane'.
+            parity = apply_gf_matrix(parity_bits, data)
+            stripe = jnp.concatenate([data, parity], axis=1)
+            return jax.lax.with_sharding_constraint(stripe, self.stripe_spec)
+
+        return jax.jit(
+            encode,
+            in_shardings=(self.replicated, self.data_spec),
+            out_shardings=self.stripe_spec,
+        )
+
+    def encode(self, blocks: np.ndarray) -> jax.Array:
+        """blocks uint8 [B, k, S] -> device stripes [B, k+m, S], lane-sharded.
+
+        B must be divisible by the dp mesh dim.
+        """
+        if blocks.ndim != 3 or blocks.shape[1] != self.k:
+            raise ValueError(f"blocks must be [B, {self.k}, S], got {blocks.shape}")
+        if blocks.shape[2] != self.shard_size:
+            raise ValueError(
+                f"shard width {blocks.shape[2]} != shard_size {self.shard_size} "
+                f"for block_size={self.block_size}"
+            )
+        data = jax.device_put(
+            np.ascontiguousarray(blocks, dtype=np.uint8), self.data_spec
+        )
+        return self._encode_fn(self._parity_bits, data)
+
+    # --- degraded read / heal (get path) ---
+
+    def _decode_fn(self, survivors: tuple, targets: tuple):
+        cached = self._decode_cache.get((survivors, targets))
+        if cached is not None:
+            return cached
+        recon_np = gf.bit_matrix(
+            gf.reconstruct_matrix(self.k, self.m, list(survivors), list(targets))
+        )
+        recon = jnp.asarray(recon_np, dtype=jnp.int8)
+        surv_idx = jnp.asarray(survivors[: self.k], dtype=jnp.int32)
+
+        def decode(stripe):
+            # Gathering k survivor lanes from a lane-sharded stripe is the
+            # all-gather over ICI (parallelReader analog, reference
+            # cmd/erasure-decode.go:133-188 without the dynamic escalation).
+            surv = jnp.take(stripe, surv_idx, axis=1)
+            surv = jax.lax.with_sharding_constraint(
+                surv, NamedSharding(self.mesh, P("dp", None, None))
+            )
+            return apply_gf_matrix(recon, surv)
+
+        fn = jax.jit(
+            decode,
+            in_shardings=(self.stripe_spec,),
+            out_shardings=self.data_spec,
+        )
+        self._decode_cache[(survivors, targets)] = fn
+        return fn
+
+    def reconstruct(self, stripe: jax.Array, dead: tuple[int, ...],
+                    targets: tuple[int, ...] | None = None) -> jax.Array:
+        """Regenerate `targets` shard lanes (default: all dead lanes) from
+        the first k surviving lanes. `dead` and `targets` are static: the
+        host compiles one program per failure pattern, like the reference
+        building one reconstruction matrix per missing-shard set."""
+        dead_set = set(dead)
+        if any(i < 0 or i >= self.n for i in dead_set):
+            raise ValueError(f"dead lane index out of range [0, {self.n}): {dead}")
+        survivors = tuple(i for i in range(self.n) if i not in dead_set)[: self.k]
+        if len(survivors) < self.k:
+            raise ValueError(
+                f"only {len(survivors)} survivors, need {self.k}"
+            )
+        if targets is None:
+            targets = tuple(sorted(dead_set))
+        return self._decode_fn(survivors, tuple(targets))(stripe)
+
+    def decode_data(self, stripe: jax.Array, dead: tuple[int, ...]) -> jax.Array:
+        """Recover the k data shards [B, k, S] under `dead` lanes."""
+        dead_set = set(dead)
+        if any(i < 0 or i >= self.n for i in dead_set):
+            raise ValueError(f"dead lane index out of range [0, {self.n}): {dead}")
+        missing_data = tuple(i for i in range(self.k) if i in dead_set)
+        if not missing_data:
+            out = stripe[:, : self.k, :]
+            return jax.device_put(out, self.data_spec)
+        survivors = tuple(i for i in range(self.n) if i not in dead_set)[: self.k]
+        if len(survivors) < self.k:
+            raise ValueError(f"only {len(survivors)} survivors, need {self.k}")
+        rec = self._decode_fn(survivors, missing_data)(stripe)
+        # Merge reconstructed shards back into data positions host-free.
+        parts = []
+        ri = 0
+        for i in range(self.k):
+            if i in dead_set:
+                parts.append(rec[:, ri : ri + 1, :])
+                ri += 1
+            else:
+                parts.append(stripe[:, i : i + 1, :])
+        return jnp.concatenate(parts, axis=1)
+
+
+def full_put_get_step(se: ShardedErasure, blocks: np.ndarray,
+                      dead: tuple[int, ...]):
+    """The complete device data-plane step: encode a batch of blocks into
+    lane-sharded stripes, fail `dead` lanes, reconstruct, and return
+    (stripe, recovered_blocks). This is what `__graft_entry__.
+    dryrun_multichip` drives — put + degraded get + heal reconstruction in
+    one SPMD program pair."""
+    stripe = se.encode(blocks)
+    recovered = se.decode_data(stripe, dead)
+    return stripe, recovered

@@ -1,0 +1,80 @@
+"""Multi-chip sharded erasure pipeline tests on the 8-device CPU mesh
+(conftest forces xla_force_host_platform_device_count=8). Validates that
+the SPMD lane-sharded encode/reconstruct matches the host codec
+bit-exactly and that the driver entry points run."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.codec import Erasure
+from minio_tpu.parallel import ShardedErasure, full_put_get_step, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _random_blocks(batch, k, shard, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(batch, k, shard), dtype=np.uint8
+    )
+
+
+def test_mesh_shape(mesh):
+    assert mesh.shape["dp"] * mesh.shape["lane"] == 8
+    assert mesh.shape["lane"] in (2, 4, 8)
+
+
+def test_sharded_encode_matches_host_codec(mesh):
+    k, m, shard = 4, 4, 512
+    se = ShardedErasure(mesh, k, m, block_size=k * shard)
+    blocks = _random_blocks(mesh.shape["dp"] * 2, k, shard)
+    stripe = np.asarray(se.encode(blocks))
+    host = Erasure(k, m, k * shard)
+    for b in range(blocks.shape[0]):
+        exp = host.encode_batch(blocks[b : b + 1])[0]
+        np.testing.assert_array_equal(stripe[b, k:], exp)
+        np.testing.assert_array_equal(stripe[b, :k], blocks[b])
+
+
+@pytest.mark.parametrize("dead", [(0,), (1, 5), (0, 2, 4, 6)])
+def test_sharded_degraded_read_roundtrip(mesh, dead):
+    k, m, shard = 4, 4, 384
+    se = ShardedErasure(mesh, k, m, block_size=k * shard)
+    blocks = _random_blocks(mesh.shape["dp"], k, shard, seed=3)
+    stripe = se.encode(blocks)
+    rec = np.asarray(se.decode_data(stripe, dead))
+    np.testing.assert_array_equal(rec, blocks)
+
+
+def test_sharded_reconstruct_targets_parity(mesh):
+    k, m, shard = 4, 4, 256
+    se = ShardedErasure(mesh, k, m, block_size=k * shard)
+    blocks = _random_blocks(mesh.shape["dp"], k, shard, seed=5)
+    stripe = se.encode(blocks)
+    stripe_np = np.asarray(stripe)
+    # Regenerate parity lane k+1 from a degraded stripe.
+    dead = (0, k + 1)
+    rec = np.asarray(se.reconstruct(stripe, dead))
+    np.testing.assert_array_equal(rec[:, 0], blocks[:, 0])
+    np.testing.assert_array_equal(rec[:, 1], stripe_np[:, k + 1])
+
+
+def test_full_put_get_step(mesh):
+    k, m, shard = 4, 4, 256
+    se = ShardedErasure(mesh, k, m, block_size=k * shard)
+    blocks = _random_blocks(mesh.shape["dp"] * 2, k, shard, seed=9)
+    stripe, recovered = full_put_get_step(se, blocks, dead=(2, 3, 4, 5))
+    np.testing.assert_array_equal(np.asarray(recovered), blocks)
+    assert stripe.shape == (blocks.shape[0], k + m, shard)
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as ge
+    import jax
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[1] == 4  # parity shards of 12+4
+    ge.dryrun_multichip(8)

@@ -1,1 +1,9 @@
+"""S3-compatible HTTP API plane: signatures (SigV4/V2/streaming),
+request auth, route dispatch, bucket/object/multipart handlers
+(reference: cmd/api-router.go, cmd/object-handlers.go,
+cmd/auth-handler.go, cmd/signature-v4.go)."""
 
+from .errors import API_ERRORS, S3Error
+from .server import S3Server
+
+__all__ = ["API_ERRORS", "S3Error", "S3Server"]

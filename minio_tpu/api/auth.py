@@ -1,0 +1,129 @@
+"""Request authentication & authorization: classify the auth type
+(anonymous / SigV2 / SigV4 / presigned / streaming), verify the
+signature against IAM credentials, and evaluate the action against IAM +
+bucket policies — behavioral parity with the reference's
+cmd/auth-handler.go (checkRequestAuthType) without its Go structure.
+"""
+
+from __future__ import annotations
+
+from ..iam import Args, IAMSys
+from . import sign
+from .errors import S3Error
+
+AUTH_ANONYMOUS = "anonymous"
+AUTH_SIGNED_V4 = "signed-v4"
+AUTH_SIGNED_V2 = "signed-v2"
+AUTH_PRESIGNED_V4 = "presigned-v4"
+AUTH_PRESIGNED_V2 = "presigned-v2"
+AUTH_STREAMING = "streaming-v4"
+AUTH_JWT = "jwt"
+
+
+def auth_type(headers: dict, query: dict) -> str:
+    """Classify the request auth mechanism (ref cmd/auth-handler.go:66)."""
+    auth = headers.get("Authorization", headers.get("authorization", ""))
+    sha = headers.get(
+        "X-Amz-Content-Sha256", headers.get("x-amz-content-sha256", "")
+    )
+    if auth.startswith(sign.SIGN_V4_ALGORITHM):
+        if sha == sign.STREAMING_CONTENT_SHA256:
+            return AUTH_STREAMING
+        return AUTH_SIGNED_V4
+    if auth.startswith("AWS "):
+        return AUTH_SIGNED_V2
+    if auth.startswith("Bearer "):
+        return AUTH_JWT
+    if "X-Amz-Credential" in query:
+        return AUTH_PRESIGNED_V4
+    if "AWSAccessKeyId" in query:
+        return AUTH_PRESIGNED_V2
+    return AUTH_ANONYMOUS
+
+
+class AuthResult:
+    def __init__(self, access_key: str = "", auth: str = AUTH_ANONYMOUS,
+                 cred=None):
+        self.access_key = access_key
+        self.auth = auth
+        self.cred = cred
+
+    @property
+    def is_anonymous(self) -> bool:
+        return self.auth == AUTH_ANONYMOUS
+
+
+def authenticate(iam: IAMSys, method: str, path: str,
+                 query: list[tuple[str, str]], headers: dict) -> AuthResult:
+    """Verify the request signature. Raises S3Error on failure."""
+    qdict = dict(query)
+    at = auth_type(headers, qdict)
+    if at == AUTH_ANONYMOUS:
+        return AuthResult()
+    if at == AUTH_JWT:
+        raise S3Error("AccessDenied", "JWT auth is for the admin/web plane")
+
+    def secret_for(access_key: str) -> str:
+        cred = iam.get_credentials(access_key)
+        if cred is None:
+            raise S3Error("InvalidAccessKeyId", access_key)
+        return cred.secret_key
+
+    try:
+        if at in (AUTH_SIGNED_V4, AUTH_STREAMING):
+            auth_hdr = headers.get(
+                "Authorization", headers.get("authorization", "")
+            )
+            cred_scope, _, _ = sign.parse_v4_auth_header(auth_hdr)
+            secret = secret_for(cred_scope.access_key)
+            sign.verify_v4_header(secret, method, path, query, headers)
+            return AuthResult(
+                cred_scope.access_key, at,
+                iam.get_credentials(cred_scope.access_key),
+            )
+        if at == AUTH_PRESIGNED_V4:
+            cred_scope = sign.V4Credential(qdict.get("X-Amz-Credential", ""))
+            secret = secret_for(cred_scope.access_key)
+            sign.verify_v4_presigned(secret, method, path, query, headers)
+            return AuthResult(
+                cred_scope.access_key, at,
+                iam.get_credentials(cred_scope.access_key),
+            )
+        if at == AUTH_SIGNED_V2:
+            auth_hdr = headers.get(
+                "Authorization", headers.get("authorization", "")
+            )
+            access_key = auth_hdr[4:].split(":", 1)[0]
+            secret = secret_for(access_key)
+            sign.verify_v2_header(secret, method, path, query, headers)
+            return AuthResult(access_key, at, iam.get_credentials(access_key))
+        if at == AUTH_PRESIGNED_V2:
+            raise S3Error("NotImplemented", "presigned V2")
+    except sign.SignError as exc:
+        raise S3Error(exc.code, str(exc)) from exc
+    raise S3Error("SignatureVersionNotSupported")
+
+
+def authorize(iam: IAMSys, bucket_policy, result: AuthResult, action: str,
+              bucket: str, object_: str = "",
+              conditions: dict | None = None) -> None:
+    """Allow/deny the S3 action; anonymous requests fall back to the
+    bucket policy (ref cmd/auth-handler.go isPutActionAllowed /
+    checkRequestAuthTypeCredential)."""
+    conditions = conditions or {}
+    if result.is_anonymous:
+        if bucket_policy is not None and bucket_policy.is_allowed(Args(
+            account="", action=action, bucket=bucket, object=object_,
+            conditions=conditions,
+        )):
+            return
+        raise S3Error("AccessDenied", f"anonymous {action}")
+    args = Args(
+        account=result.access_key, action=action, bucket=bucket,
+        object=object_, conditions=conditions,
+    )
+    if iam.is_allowed(args):
+        return
+    if bucket_policy is not None and bucket_policy.is_allowed(args):
+        return
+    raise S3Error("AccessDenied", f"{result.access_key} {action}")

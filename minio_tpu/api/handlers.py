@@ -1,0 +1,818 @@
+"""S3 API handlers: bucket + object + multipart endpoints over the
+ObjectLayer — behavioral parity with the reference's
+cmd/object-handlers.go (4007 LoC), cmd/bucket-handlers.go,
+cmd/bucket-listobjects-handlers.go, re-designed as plain request->
+response functions (no Go middleware plumbing).
+
+Each handler receives a RequestContext (parsed request) and returns a
+Response; signature/authz has already run in server.py dispatch.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import io
+import urllib.parse
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from ..object.types import CompletePart, ObjectOptions
+from ..utils.errors import StorageError
+from .errors import S3Error, from_object_error
+
+MAX_OBJECT_SIZE = 5 * 1024 ** 4         # 5 TiB
+MAX_PART_SIZE = 5 * 1024 ** 3           # 5 GiB
+MAX_PARTS = 10000
+MAX_DELETE_OBJECTS = 1000
+MAX_KEY_LENGTH = 1024
+
+
+def iso8601(ns: int) -> str:
+    dt = datetime.datetime.fromtimestamp(ns / 1e9, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def http_date(ns: int) -> str:
+    dt = datetime.datetime.fromtimestamp(ns / 1e9, datetime.timezone.utc)
+    return dt.strftime("%a, %d %b %Y %H:%M:%S GMT")
+
+
+@dataclass
+class Response:
+    status: int = 200
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+
+    @classmethod
+    def xml(cls, root: ET.Element, status: int = 200,
+            headers: dict | None = None) -> "Response":
+        body = (
+            b'<?xml version="1.0" encoding="UTF-8"?>\n'
+            + ET.tostring(root, encoding="unicode").encode()
+        )
+        h = {"Content-Type": "application/xml"}
+        h.update(headers or {})
+        return cls(status, h, body)
+
+
+def _xml_root(tag: str) -> ET.Element:
+    root = ET.Element(tag)
+    root.set("xmlns", "http://s3.amazonaws.com/doc/2006-03-01/")
+    return root
+
+
+def valid_bucket_name(bucket: str) -> bool:
+    """S3 DNS-compatible bucket naming rules."""
+    if not (3 <= len(bucket) <= 63):
+        return False
+    if bucket.startswith((".", "-")) or bucket.endswith((".", "-")):
+        return False
+    if ".." in bucket or ".-" in bucket or "-." in bucket:
+        return False
+    return all(c.islower() or c.isdigit() or c in ".-" for c in bucket)
+
+
+def valid_object_name(obj: str) -> bool:
+    if not obj or len(obj) > MAX_KEY_LENGTH:
+        return False
+    if obj.startswith("/"):
+        return False
+    for seg in obj.split("/"):
+        if seg in (".", ".."):
+            return False
+    return True
+
+
+def parse_range(header: str, size: int) -> tuple[int, int] | None:
+    """Parse 'bytes=a-b' into (offset, length); None = whole object
+    (ref cmd/httprange.go)."""
+    if not header:
+        return None
+    if not header.startswith("bytes="):
+        raise S3Error("InvalidRange", header)
+    spec = header[len("bytes="):]
+    if "," in spec:
+        raise S3Error("NotImplemented", "multiple ranges")
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if start_s == "":
+            # suffix range: last N bytes
+            n = int(end_s)
+            if n <= 0:
+                raise S3Error("InvalidRange", header)
+            off = max(0, size - n)
+            return off, size - off
+        start = int(start_s)
+        if end_s == "":
+            if start >= size:
+                raise S3Error("InvalidRange", header)
+            return start, size - start
+        end = int(end_s)
+        if start > end or start >= size:
+            raise S3Error("InvalidRange", header)
+        end = min(end, size - 1)
+        return start, end - start + 1
+    except ValueError as exc:
+        raise S3Error("InvalidRange", header) from exc
+
+
+_RESPONSE_OVERRIDES = {
+    "response-content-type": "Content-Type",
+    "response-content-language": "Content-Language",
+    "response-expires": "Expires",
+    "response-cache-control": "Cache-Control",
+    "response-content-disposition": "Content-Disposition",
+    "response-content-encoding": "Content-Encoding",
+}
+
+_REMEMBERED_HEADERS = (
+    "content-type", "cache-control", "content-disposition",
+    "content-encoding", "content-language", "expires",
+)
+
+
+def extract_user_metadata(headers: dict) -> dict:
+    """x-amz-meta-* + standard content headers -> stored metadata
+    (ref cmd/utils.go extractMetadata)."""
+    meta = {}
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk.startswith("x-amz-meta-"):
+            meta[lk] = v
+        elif lk in _REMEMBERED_HEADERS:
+            meta[lk] = v
+        elif lk.startswith("x-amz-storage-class"):
+            meta["x-amz-storage-class"] = v
+    return meta
+
+
+class S3ApiHandlers:
+    """All S3 endpoints bound to an ObjectLayer + subsystems."""
+
+    def __init__(self, object_layer, bucket_meta, iam, notify=None):
+        self.ol = object_layer
+        self.bm = bucket_meta
+        self.iam = iam
+        self.notify = notify
+
+    def _opts_for(self, bucket: str, query: dict,
+                  headers: dict | None = None) -> ObjectOptions:
+        bmeta = self.bm.get(bucket)
+        return ObjectOptions(
+            version_id=query.get("versionId", ""),
+            versioned=bmeta.versioning_enabled,
+            version_suspended=bmeta.versioning_suspended,
+        )
+
+    def _event(self, name: str, bucket: str, oi=None, key: str = ""):
+        if self.notify is not None:
+            self.notify.send(name, bucket, oi=oi, key=key)
+
+    # ---------- service ----------
+
+    def list_buckets(self, ctx) -> Response:
+        root = _xml_root("ListAllMyBucketsResult")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "minio-tpu"
+        ET.SubElement(owner, "DisplayName").text = "minio-tpu"
+        buckets = ET.SubElement(root, "Buckets")
+        for b in self.ol.list_buckets():
+            if b.name.startswith("."):  # hide .minio.sys
+                continue
+            be = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(be, "Name").text = b.name
+            ET.SubElement(be, "CreationDate").text = iso8601(b.created_ns)
+        return Response.xml(root)
+
+    # ---------- bucket ----------
+
+    def make_bucket(self, ctx) -> Response:
+        if not valid_bucket_name(ctx.bucket):
+            raise S3Error("InvalidBucketName", ctx.bucket)
+        try:
+            self.ol.make_bucket(ctx.bucket)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        self._event("s3:BucketCreated:*", ctx.bucket)
+        return Response(200, {"Location": "/" + ctx.bucket})
+
+    def head_bucket(self, ctx) -> Response:
+        if not self.ol.bucket_exists(ctx.bucket):
+            raise S3Error("NoSuchBucket", ctx.bucket)
+        return Response(200)
+
+    def delete_bucket(self, ctx) -> Response:
+        force = ctx.headers.get("x-minio-force-delete", "") == "true"
+        try:
+            self.ol.delete_bucket(ctx.bucket, force=force)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        self.bm.delete(ctx.bucket)
+        self._event("s3:BucketRemoved:*", ctx.bucket)
+        return Response(204)
+
+    def get_bucket_location(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        root = _xml_root("LocationConstraint")
+        root.text = ""  # us-east-1 == empty
+        return Response.xml(root)
+
+    def _check_bucket(self, bucket: str):
+        if not self.ol.bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+
+    # --- listing ---
+
+    def list_objects_v1(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        q = ctx.qdict
+        prefix = q.get("prefix", "")
+        marker = q.get("marker", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "max-keys negative")
+        try:
+            res = self.ol.list_objects(
+                ctx.bucket, prefix=prefix, marker=marker,
+                delimiter=delimiter, max_keys=max_keys,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("ListBucketResult")
+        ET.SubElement(root, "Name").text = ctx.bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Marker").text = marker
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if res.is_truncated else "false"
+        )
+        if res.is_truncated and res.next_marker:
+            ET.SubElement(root, "NextMarker").text = res.next_marker
+        self._fill_entries(root, res)
+        return Response.xml(root)
+
+    def list_objects_v2(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        q = ctx.qdict
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        token = q.get("continuation-token", "")
+        start_after = q.get("start-after", "")
+        fetch_owner = q.get("fetch-owner", "") == "true"
+        marker = token or start_after
+        if token:
+            import base64
+
+            try:
+                marker = base64.b64decode(token).decode()
+            except Exception as exc:
+                raise S3Error(
+                    "InvalidArgument", "bad continuation-token"
+                ) from exc
+        try:
+            res = self.ol.list_objects(
+                ctx.bucket, prefix=prefix, marker=marker,
+                delimiter=delimiter, max_keys=max_keys,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("ListBucketResult")
+        ET.SubElement(root, "Name").text = ctx.bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        ET.SubElement(root, "KeyCount").text = str(
+            len(res.objects) + len(res.prefixes)
+        )
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if res.is_truncated else "false"
+        )
+        if token:
+            ET.SubElement(root, "ContinuationToken").text = token
+        if res.is_truncated and res.next_marker:
+            import base64
+
+            ET.SubElement(root, "NextContinuationToken").text = (
+                base64.b64encode(res.next_marker.encode()).decode()
+            )
+        self._fill_entries(root, res, owner=fetch_owner)
+        return Response.xml(root)
+
+    def _fill_entries(self, root, res, owner: bool = True):
+        for oi in res.objects:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = oi.name
+            ET.SubElement(c, "LastModified").text = iso8601(oi.mod_time_ns)
+            ET.SubElement(c, "ETag").text = f'"{oi.etag}"'
+            ET.SubElement(c, "Size").text = str(oi.size)
+            ET.SubElement(c, "StorageClass").text = "STANDARD"
+            if owner:
+                o = ET.SubElement(c, "Owner")
+                ET.SubElement(o, "ID").text = "minio-tpu"
+                ET.SubElement(o, "DisplayName").text = "minio-tpu"
+        for p in res.prefixes:
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+
+    def delete_multiple_objects(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        try:
+            req = ET.fromstring(ctx.body)
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        objects = []
+        quiet = False
+        for el in req:
+            tag = el.tag.removeprefix(ns)
+            if tag == "Quiet":
+                quiet = (el.text or "").strip() == "true"
+            elif tag == "Object":
+                key = ""
+                vid = ""
+                for sub in el:
+                    st = sub.tag.removeprefix(ns)
+                    if st == "Key":
+                        key = sub.text or ""
+                    elif st == "VersionId":
+                        vid = sub.text or ""
+                if key:
+                    objects.append((key, vid))
+        if len(objects) > MAX_DELETE_OBJECTS:
+            raise S3Error("InvalidRequest", "too many objects")
+        root = _xml_root("DeleteResult")
+        for key, vid in objects:
+            try:
+                opts = self._opts_for(ctx.bucket, {"versionId": vid})
+                self.ol.delete_object(ctx.bucket, key, opts)
+                if not quiet:
+                    d = ET.SubElement(root, "Deleted")
+                    ET.SubElement(d, "Key").text = key
+                    if vid:
+                        ET.SubElement(d, "VersionId").text = vid
+                self._event("s3:ObjectRemoved:Delete", ctx.bucket, key=key)
+            except StorageError as exc:
+                api = from_object_error(exc)
+                if api.api.code in ("NoSuchKey", "NoSuchVersion"):
+                    if not quiet:
+                        d = ET.SubElement(root, "Deleted")
+                        ET.SubElement(d, "Key").text = key
+                    continue
+                e = ET.SubElement(root, "Error")
+                ET.SubElement(e, "Key").text = key
+                ET.SubElement(e, "Code").text = api.api.code
+                ET.SubElement(e, "Message").text = api.detail
+        return Response.xml(root)
+
+    # --- bucket config subresources ---
+
+    def put_bucket_policy(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        from ..iam.policy import Policy
+
+        try:
+            Policy.parse(ctx.body)
+        except (ValueError, KeyError) as exc:
+            raise S3Error("MalformedXML", f"bad policy: {exc}") from exc
+        self.bm.update(ctx.bucket, "policy_json", ctx.body.decode())
+        return Response(204)
+
+    def get_bucket_policy(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        bm = self.bm.get(ctx.bucket)
+        if not bm.policy_json:
+            raise S3Error("NoSuchBucketPolicy", ctx.bucket)
+        return Response(
+            200, {"Content-Type": "application/json"},
+            bm.policy_json.encode(),
+        )
+
+    def delete_bucket_policy(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        self.bm.update(ctx.bucket, "policy_json", "")
+        return Response(204)
+
+    def _xml_subresource(self, ctx, fld: str, missing_code: str,
+                         root_tag: str | None = None):
+        """GET/PUT/DELETE for the XML-blob bucket subresources."""
+        self._check_bucket(ctx.bucket)
+        if ctx.method == "GET":
+            bm = self.bm.get(ctx.bucket)
+            val = getattr(bm, fld)
+            if not val:
+                raise S3Error(missing_code, ctx.bucket)
+            return Response(200, {"Content-Type": "application/xml"},
+                            val.encode())
+        if ctx.method == "PUT":
+            try:
+                ET.fromstring(ctx.body)
+            except ET.ParseError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            self.bm.update(ctx.bucket, fld, ctx.body.decode())
+            return Response(200)
+        self.bm.update(ctx.bucket, fld, "")
+        return Response(204)
+
+    def bucket_versioning(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        if ctx.method == "PUT":
+            try:
+                ET.fromstring(ctx.body)
+            except ET.ParseError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            self.bm.update(ctx.bucket, "versioning_xml", ctx.body.decode())
+            return Response(200)
+        bm = self.bm.get(ctx.bucket)
+        if bm.versioning_xml:
+            return Response(200, {"Content-Type": "application/xml"},
+                            bm.versioning_xml.encode())
+        root = _xml_root("VersioningConfiguration")
+        return Response.xml(root)
+
+    def bucket_tagging(self, ctx) -> Response:
+        return self._xml_subresource(ctx, "tagging_xml", "NoSuchTagSet")
+
+    def bucket_lifecycle(self, ctx) -> Response:
+        return self._xml_subresource(
+            ctx, "lifecycle_xml", "NoSuchLifecycleConfiguration"
+        )
+
+    def bucket_encryption(self, ctx) -> Response:
+        return self._xml_subresource(
+            ctx, "sse_xml", "ServerSideEncryptionConfigurationNotFoundError"
+        )
+
+    def bucket_object_lock(self, ctx) -> Response:
+        return self._xml_subresource(
+            ctx, "object_lock_xml", "ObjectLockConfigurationNotFoundError"
+        )
+
+    def bucket_replication(self, ctx) -> Response:
+        return self._xml_subresource(
+            ctx, "replication_xml", "ReplicationConfigurationNotFoundError"
+        )
+
+    def bucket_notification(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        if ctx.method == "PUT":
+            try:
+                ET.fromstring(ctx.body)
+            except ET.ParseError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            self.bm.update(ctx.bucket, "notification_xml", ctx.body.decode())
+            if self.notify is not None:
+                self.notify.load_bucket_rules(ctx.bucket)
+            return Response(200)
+        bm = self.bm.get(ctx.bucket)
+        if bm.notification_xml:
+            return Response(200, {"Content-Type": "application/xml"},
+                            bm.notification_xml.encode())
+        root = _xml_root("NotificationConfiguration")
+        return Response.xml(root)
+
+    # ---------- object ----------
+
+    def put_object(self, ctx) -> Response:
+        if not valid_object_name(ctx.object):
+            raise S3Error("InvalidArgument", f"bad object name {ctx.object!r}")
+        self._check_bucket(ctx.bucket)
+        copy_source = ctx.headers.get("x-amz-copy-source", "")
+        if copy_source:
+            return self._copy_object(ctx, copy_source)
+        size = ctx.content_length
+        if size is None:
+            raise S3Error("MissingContentLength")
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        opts.user_defined = extract_user_metadata(ctx.headers)
+        try:
+            oi = self.ol.put_object(
+                ctx.bucket, ctx.object, ctx.body_reader, size, opts
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        md5_hdr = ctx.headers.get("content-md5", "")
+        if md5_hdr:
+            import base64
+
+            want = base64.b64decode(md5_hdr).hex()
+            if want != oi.etag:
+                # best-effort: object already committed in layer; the
+                # reference validates inline via hash.Reader
+                raise S3Error("BadDigest")
+        headers = {"ETag": f'"{oi.etag}"'}
+        if oi.version_id and oi.version_id != "null":
+            headers["x-amz-version-id"] = oi.version_id
+        self._event("s3:ObjectCreated:Put", ctx.bucket, oi=oi)
+        return Response(200, headers)
+
+    def _copy_object(self, ctx, copy_source: str) -> Response:
+        src = urllib.parse.unquote(copy_source)
+        if src.startswith("/"):
+            src = src[1:]
+        vid = ""
+        if "?versionId=" in src:
+            src, _, vid = src.partition("?versionId=")
+        if "/" not in src:
+            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+        sbucket, _, sobject = src.partition("/")
+        try:
+            src_opts = self._opts_for(sbucket, {"versionId": vid})
+            data = self.ol.get_object_bytes(sbucket, sobject, opts=src_opts)
+            src_info = self.ol.get_object_info(sbucket, sobject, src_opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        directive = ctx.headers.get("x-amz-metadata-directive", "COPY")
+        if directive == "REPLACE":
+            opts.user_defined = extract_user_metadata(ctx.headers)
+        else:
+            opts.user_defined = dict(src_info.user_defined)
+        try:
+            oi = self.ol.put_object(
+                ctx.bucket, ctx.object, io.BytesIO(data), len(data), opts
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("CopyObjectResult")
+        ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
+        ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
+        self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
+        headers = {}
+        if oi.version_id and oi.version_id != "null":
+            headers["x-amz-version-id"] = oi.version_id
+        return Response.xml(root, headers=headers)
+
+    def _conditional_headers(self, ctx, oi):
+        """If-Match / If-None-Match / If-(Un)Modified-Since
+        (ref cmd/object-handlers-common.go checkPreconditions)."""
+        inm = ctx.headers.get("if-none-match", "")
+        im = ctx.headers.get("if-match", "")
+        etag = f'"{oi.etag}"'
+        if im and im not in (etag, oi.etag, "*"):
+            raise S3Error("PreconditionFailed", "If-Match")
+        if inm and (inm in (etag, oi.etag) or inm == "*"):
+            return Response(304, {"ETag": etag})
+        ims = ctx.headers.get("if-modified-since", "")
+        if ims:
+            try:
+                t = datetime.datetime.strptime(
+                    ims, "%a, %d %b %Y %H:%M:%S GMT"
+                ).replace(tzinfo=datetime.timezone.utc)
+                if oi.mod_time_ns // 10 ** 9 <= int(t.timestamp()):
+                    return Response(304, {"ETag": etag})
+            except ValueError:
+                pass
+        ius = ctx.headers.get("if-unmodified-since", "")
+        if ius:
+            try:
+                t = datetime.datetime.strptime(
+                    ius, "%a, %d %b %Y %H:%M:%S GMT"
+                ).replace(tzinfo=datetime.timezone.utc)
+                if oi.mod_time_ns // 10 ** 9 > int(t.timestamp()):
+                    raise S3Error("PreconditionFailed", "If-Unmodified-Since")
+            except ValueError:
+                pass
+        return None
+
+    def _object_headers(self, ctx, oi) -> dict:
+        headers = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": http_date(oi.mod_time_ns),
+            "Content-Type": oi.content_type or "application/octet-stream",
+            "Accept-Ranges": "bytes",
+        }
+        if oi.version_id and oi.version_id != "null":
+            headers["x-amz-version-id"] = oi.version_id
+        for k, v in oi.user_defined.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+            elif k in _REMEMBERED_HEADERS and k != "content-type":
+                headers[k.title()] = v
+        for qk, hk in _RESPONSE_OVERRIDES.items():
+            if qk in ctx.qdict:
+                headers[hk] = ctx.qdict[qk]
+        return headers
+
+    def get_object(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            oi = self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        if oi.delete_marker:
+            raise S3Error("NoSuchKey", ctx.object)
+        early = self._conditional_headers(ctx, oi)
+        if early is not None:
+            return early
+        rng = parse_range(ctx.headers.get("range", ""), oi.size)
+        offset, length = (rng if rng else (0, oi.size))
+        try:
+            data = self.ol.get_object_bytes(
+                ctx.bucket, ctx.object, offset=offset, length=length,
+                opts=opts,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        headers = self._object_headers(ctx, oi)
+        headers["Content-Length"] = str(len(data))
+        self._event("s3:ObjectAccessed:Get", ctx.bucket, oi=oi)
+        if rng:
+            headers["Content-Range"] = (
+                f"bytes {offset}-{offset + length - 1}/{oi.size}"
+            )
+            return Response(206, headers, data)
+        return Response(200, headers, data)
+
+    def head_object(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            oi = self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        if oi.delete_marker:
+            raise S3Error("NoSuchKey", ctx.object)
+        early = self._conditional_headers(ctx, oi)
+        if early is not None:
+            return early
+        headers = self._object_headers(ctx, oi)
+        headers["Content-Length"] = str(oi.size)
+        self._event("s3:ObjectAccessed:Head", ctx.bucket, oi=oi)
+        return Response(200, headers)
+
+    def delete_object(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        headers = {}
+        try:
+            oi = self.ol.delete_object(ctx.bucket, ctx.object, opts)
+            if oi is not None and getattr(oi, "delete_marker", False):
+                headers["x-amz-delete-marker"] = "true"
+                if oi.version_id and oi.version_id != "null":
+                    headers["x-amz-version-id"] = oi.version_id
+        except StorageError as exc:
+            api = from_object_error(exc)
+            if api.api.code not in ("NoSuchKey", "NoSuchVersion"):
+                raise api from exc
+        self._event("s3:ObjectRemoved:Delete", ctx.bucket, key=ctx.object)
+        return Response(204, headers)
+
+    # ---------- multipart ----------
+
+    def new_multipart_upload(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        if not valid_object_name(ctx.object):
+            raise S3Error("InvalidArgument", ctx.object)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        opts.user_defined = extract_user_metadata(ctx.headers)
+        try:
+            upload_id = self.ol.new_multipart_upload(
+                ctx.bucket, ctx.object, opts
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("InitiateMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = ctx.bucket
+        ET.SubElement(root, "Key").text = ctx.object
+        ET.SubElement(root, "UploadId").text = upload_id
+        return Response.xml(root)
+
+    def put_object_part(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        q = ctx.qdict
+        upload_id = q.get("uploadId", "")
+        try:
+            part_number = int(q.get("partNumber", "0"))
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", "partNumber") from exc
+        if not 1 <= part_number <= MAX_PARTS:
+            raise S3Error("InvalidArgument", f"partNumber {part_number}")
+        size = ctx.content_length
+        if size is None:
+            raise S3Error("MissingContentLength")
+        if size > MAX_PART_SIZE:
+            raise S3Error("EntityTooLarge")
+        try:
+            pi = self.ol.put_object_part(
+                ctx.bucket, ctx.object, upload_id, part_number,
+                ctx.body_reader, size,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(200, {"ETag": f'"{pi.etag}"'})
+
+    def complete_multipart_upload(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        upload_id = ctx.qdict.get("uploadId", "")
+        try:
+            req = ET.fromstring(ctx.body)
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        parts = []
+        for el in req:
+            if el.tag.removeprefix(ns) != "Part":
+                continue
+            pn, etag = 0, ""
+            for sub in el:
+                t = sub.tag.removeprefix(ns)
+                if t == "PartNumber":
+                    pn = int(sub.text or "0")
+                elif t == "ETag":
+                    etag = (sub.text or "").strip('"')
+            parts.append(CompletePart(pn, etag))
+        if not parts:
+            raise S3Error("MalformedXML", "no parts")
+        if parts != sorted(parts, key=lambda p: p.part_number):
+            raise S3Error("InvalidPartOrder")
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            oi = self.ol.complete_multipart_upload(
+                ctx.bucket, ctx.object, upload_id, parts, opts
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("CompleteMultipartUploadResult")
+        ET.SubElement(root, "Location").text = (
+            f"/{ctx.bucket}/{ctx.object}"
+        )
+        ET.SubElement(root, "Bucket").text = ctx.bucket
+        ET.SubElement(root, "Key").text = ctx.object
+        ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
+        headers = {}
+        if oi.version_id and oi.version_id != "null":
+            headers["x-amz-version-id"] = oi.version_id
+        self._event(
+            "s3:ObjectCreated:CompleteMultipartUpload", ctx.bucket, oi=oi
+        )
+        return Response.xml(root, headers=headers)
+
+    def abort_multipart_upload(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        upload_id = ctx.qdict.get("uploadId", "")
+        try:
+            self.ol.abort_multipart_upload(ctx.bucket, ctx.object, upload_id)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(204)
+
+    def list_object_parts(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        q = ctx.qdict
+        upload_id = q.get("uploadId", "")
+        part_marker = int(q.get("part-number-marker", "0") or "0")
+        max_parts = min(int(q.get("max-parts", "1000") or "1000"), 1000)
+        try:
+            parts = self.ol.list_object_parts(
+                ctx.bucket, ctx.object, upload_id, part_marker, max_parts
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("ListPartsResult")
+        ET.SubElement(root, "Bucket").text = ctx.bucket
+        ET.SubElement(root, "Key").text = ctx.object
+        ET.SubElement(root, "UploadId").text = upload_id
+        ET.SubElement(root, "PartNumberMarker").text = str(part_marker)
+        ET.SubElement(root, "MaxParts").text = str(max_parts)
+        truncated = len(parts) > max_parts
+        parts = parts[:max_parts]
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if truncated else "false"
+        )
+        if truncated and parts:
+            ET.SubElement(root, "NextPartNumberMarker").text = str(
+                parts[-1].part_number
+            )
+        for p in parts:
+            pe = ET.SubElement(root, "Part")
+            ET.SubElement(pe, "PartNumber").text = str(p.part_number)
+            ET.SubElement(pe, "LastModified").text = iso8601(p.mod_time_ns)
+            ET.SubElement(pe, "ETag").text = f'"{p.etag}"'
+            ET.SubElement(pe, "Size").text = str(p.size)
+        return Response.xml(root)
+
+    def list_multipart_uploads(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        prefix = ctx.qdict.get("prefix", "")
+        try:
+            uploads = self.ol.list_multipart_uploads(ctx.bucket, prefix)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("ListMultipartUploadsResult")
+        ET.SubElement(root, "Bucket").text = ctx.bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "IsTruncated").text = "false"
+        for mp in uploads:
+            u = ET.SubElement(root, "Upload")
+            ET.SubElement(u, "Key").text = mp.object
+            ET.SubElement(u, "UploadId").text = mp.upload_id
+        return Response.xml(root)

@@ -1,0 +1,351 @@
+"""The S3 HTTP front-end: threading HTTP server, middleware checks,
+route dispatch, auth enforcement — the equivalents of the reference's
+cmd/http/server.go, cmd/routers.go (16-filter globalHandlers chain),
+cmd/api-router.go (registerAPIRouter) re-designed as a single dispatch
+pipeline.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..iam import IAMSys
+from . import sign
+from .auth import AUTH_STREAMING, authenticate, authorize
+from .errors import API_ERRORS, S3Error, error_xml
+from .handlers import Response, S3ApiHandlers
+
+# S3 action names per route (subset of pkg/iam/policy/action.go).
+_ACTIONS = {
+    "list_buckets": "s3:ListAllMyBuckets",
+    "make_bucket": "s3:CreateBucket",
+    "head_bucket": "s3:ListBucket",
+    "delete_bucket": "s3:DeleteBucket",
+    "get_bucket_location": "s3:GetBucketLocation",
+    "list_objects_v1": "s3:ListBucket",
+    "list_objects_v2": "s3:ListBucket",
+    "delete_multiple_objects": "s3:DeleteObject",
+    "put_bucket_policy": "s3:PutBucketPolicy",
+    "get_bucket_policy": "s3:GetBucketPolicy",
+    "delete_bucket_policy": "s3:DeleteBucketPolicy",
+    "bucket_versioning": "s3:GetBucketVersioning",
+    "bucket_tagging": "s3:GetBucketTagging",
+    "bucket_lifecycle": "s3:GetLifecycleConfiguration",
+    "bucket_encryption": "s3:GetEncryptionConfiguration",
+    "bucket_object_lock": "s3:GetBucketObjectLockConfiguration",
+    "bucket_replication": "s3:GetReplicationConfiguration",
+    "bucket_notification": "s3:GetBucketNotification",
+    "put_object": "s3:PutObject",
+    "get_object": "s3:GetObject",
+    "head_object": "s3:GetObject",
+    "delete_object": "s3:DeleteObject",
+    "new_multipart_upload": "s3:PutObject",
+    "put_object_part": "s3:PutObject",
+    "complete_multipart_upload": "s3:PutObject",
+    "abort_multipart_upload": "s3:AbortMultipartUpload",
+    "list_object_parts": "s3:ListMultipartUploadParts",
+    "list_multipart_uploads": "s3:ListBucketMultipartUploads",
+}
+
+_MUTATING_SUBRESOURCE_ACTIONS = {
+    "bucket_versioning": "s3:PutBucketVersioning",
+    "bucket_tagging": "s3:PutBucketTagging",
+    "bucket_lifecycle": "s3:PutLifecycleConfiguration",
+    "bucket_encryption": "s3:PutEncryptionConfiguration",
+    "bucket_object_lock": "s3:PutBucketObjectLockConfiguration",
+    "bucket_replication": "s3:PutReplicationConfiguration",
+    "bucket_notification": "s3:PutBucketNotification",
+}
+
+
+class LimitedReader:
+    """Cap reads at Content-Length: a raw socket file stays open after the
+    body, so an unbounded read(block_size) would hang the connection."""
+
+    def __init__(self, raw, limit: int):
+        self._raw = raw
+        self._left = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        if n is None or n < 0 or n > self._left:
+            n = self._left
+        buf = self._raw.read(n)
+        self._left -= len(buf)
+        return buf
+
+
+class RequestContext:
+    """Parsed request handed to handlers."""
+
+    def __init__(self, method: str, path: str,
+                 query: list[tuple[str, str]], headers: dict,
+                 body_reader, content_length: int | None):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.qdict = dict(query)
+        self.headers = {k.lower(): v for k, v in headers.items()}
+        self.raw_headers = dict(headers)
+        self.body_reader = body_reader
+        self.content_length = content_length
+        self._body: bytes | None = None
+        self.request_id = uuid.uuid4().hex[:16].upper()
+        parts = path.lstrip("/").split("/", 1)
+        self.bucket = parts[0] if parts[0] else ""
+        self.object = parts[1] if len(parts) > 1 else ""
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            n = self.content_length if self.content_length is not None else -1
+            self._body = self.body_reader.read(n) if n != 0 else b""
+        return self._body
+
+
+def route(ctx: RequestContext) -> str:
+    """Resolve (method, bucket/object, query) -> handler name; the
+    gorilla/mux table of cmd/api-router.go:143-455 as one decision tree."""
+    m, q = ctx.method, ctx.qdict
+    if not ctx.bucket:
+        if m == "GET":
+            return "list_buckets"
+        raise S3Error("MethodNotAllowed", "service endpoint")
+    if not ctx.object:
+        if m == "GET":
+            if "location" in q:
+                return "get_bucket_location"
+            if "policy" in q:
+                return "get_bucket_policy"
+            if "versioning" in q:
+                return "bucket_versioning"
+            if "tagging" in q:
+                return "bucket_tagging"
+            if "lifecycle" in q:
+                return "bucket_lifecycle"
+            if "encryption" in q:
+                return "bucket_encryption"
+            if "object-lock" in q:
+                return "bucket_object_lock"
+            if "replication" in q:
+                return "bucket_replication"
+            if "notification" in q:
+                return "bucket_notification"
+            if "uploads" in q:
+                return "list_multipart_uploads"
+            if q.get("list-type") == "2":
+                return "list_objects_v2"
+            return "list_objects_v1"
+        if m == "PUT":
+            if "policy" in q:
+                return "put_bucket_policy"
+            for sub in ("versioning", "tagging", "lifecycle", "encryption",
+                        "object-lock", "replication", "notification"):
+                if sub in q:
+                    return f"bucket_{sub.replace('-', '_')}"
+            return "make_bucket"
+        if m == "HEAD":
+            return "head_bucket"
+        if m == "DELETE":
+            if "policy" in q:
+                return "delete_bucket_policy"
+            for sub in ("tagging", "lifecycle", "encryption", "replication"):
+                if sub in q:
+                    return f"bucket_{sub.replace('-', '_')}"
+            return "delete_bucket"
+        if m == "POST":
+            if "delete" in q:
+                return "delete_multiple_objects"
+        raise S3Error("MethodNotAllowed", f"{m} bucket")
+    # object routes
+    if m == "GET":
+        if "uploadId" in q:
+            return "list_object_parts"
+        return "get_object"
+    if m == "HEAD":
+        return "head_object"
+    if m == "PUT":
+        if "partNumber" in q and "uploadId" in q:
+            return "put_object_part"
+        return "put_object"
+    if m == "POST":
+        if "uploads" in q:
+            return "new_multipart_upload"
+        if "uploadId" in q:
+            return "complete_multipart_upload"
+        raise S3Error("MethodNotAllowed", f"POST {ctx.object}")
+    if m == "DELETE":
+        if "uploadId" in q:
+            return "abort_multipart_upload"
+        return "delete_object"
+    raise S3Error("MethodNotAllowed", m)
+
+
+def _reserved_metadata_check(ctx: RequestContext):
+    """Reject client-supplied internal metadata (ref
+    cmd/generic-handlers.go ReservedMetadataPrefix filter)."""
+    for k in ctx.headers:
+        if k.startswith("x-mtpu-internal-") or k.startswith("x-minio-internal-"):
+            raise S3Error("AccessDenied", "reserved metadata prefix")
+
+
+class S3Server:
+    """Bind an ObjectLayer + subsystems to a listening HTTP server."""
+
+    def __init__(self, object_layer, iam: IAMSys, bucket_meta,
+                 notify=None, region: str = "us-east-1",
+                 host: str = "127.0.0.1", port: int = 0, metrics=None,
+                 trace=None):
+        self.handlers = S3ApiHandlers(object_layer, bucket_meta, iam, notify)
+        self.iam = iam
+        self.region = region
+        self.metrics = metrics
+        self.trace = trace
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self):
+                outer._handle(self)
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ---
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # --- request pipeline ---
+
+    def _handle(self, h: BaseHTTPRequestHandler):
+        parsed = urllib.parse.urlsplit(h.path)
+        query = urllib.parse.parse_qsl(
+            parsed.query, keep_blank_values=True
+        )
+        cl_hdr = h.headers.get("Content-Length")
+        content_length = int(cl_hdr) if cl_hdr is not None else None
+        body_reader = (
+            LimitedReader(h.rfile, content_length)
+            if content_length is not None else io.BytesIO(b"")
+        )
+        ctx = RequestContext(
+            h.command, urllib.parse.unquote(parsed.path), query,
+            dict(h.headers), body_reader, content_length,
+        )
+        try:
+            resp = self._process(ctx)
+        except S3Error as exc:
+            resp = Response(
+                exc.api.status,
+                {"Content-Type": "application/xml"},
+                error_xml(exc.api, ctx.path, ctx.request_id, exc.detail),
+            )
+        except Exception as exc:  # noqa: BLE001 — render as InternalError
+            api = API_ERRORS["InternalError"]
+            resp = Response(
+                api.status, {"Content-Type": "application/xml"},
+                error_xml(api, ctx.path, ctx.request_id, str(exc)),
+            )
+        self._write(h, ctx, resp)
+
+    def _process(self, ctx: RequestContext) -> Response:
+        _reserved_metadata_check(ctx)
+        name = route(ctx)
+        if self.metrics is not None:
+            self.metrics.inc("s3_requests_total", api=name)
+        auth_result = authenticate(
+            self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+        )
+        action = _ACTIONS.get(name, "s3:*")
+        if ctx.method in ("PUT", "POST", "DELETE"):
+            action = _MUTATING_SUBRESOURCE_ACTIONS.get(name, action)
+        bucket_policy = None
+        if ctx.bucket:
+            bucket_policy = self.handlers.bm.get(ctx.bucket).policy()
+        authorize(
+            self.iam, bucket_policy, auth_result, action,
+            ctx.bucket, ctx.object,
+        )
+        if auth_result.auth == AUTH_STREAMING:
+            self._wrap_streaming_body(ctx, auth_result)
+        if self.trace is not None:
+            self.trace.publish({
+                "api": name, "method": ctx.method, "path": ctx.path,
+                "request_id": ctx.request_id,
+            })
+        handler = getattr(self.handlers, name)
+        resp = handler(ctx)
+        if self.metrics is not None:
+            self.metrics.inc(
+                "s3_responses_total", api=name, status=str(resp.status)
+            )
+        return resp
+
+    def _wrap_streaming_body(self, ctx: RequestContext, auth_result):
+        """Replace the body reader with the verifying aws-chunked decoder;
+        the decoded length comes from x-amz-decoded-content-length."""
+        auth_hdr = ctx.headers.get("authorization", "")
+        cred_scope, _, seed_sig = sign.parse_v4_auth_header(auth_hdr)
+        secret = self.iam.get_credentials(cred_scope.access_key).secret_key
+        amz_date = ctx.headers.get("x-amz-date", "")
+        decoded_len = ctx.headers.get("x-amz-decoded-content-length")
+        if decoded_len is None:
+            raise S3Error("MissingContentLength", "x-amz-decoded-content-length")
+        ctx.body_reader = sign.ChunkedReader(
+            ctx.body_reader, secret, cred_scope, amz_date, seed_sig
+        )
+        ctx.content_length = int(decoded_len)
+
+    def _write(self, h: BaseHTTPRequestHandler, ctx: RequestContext,
+               resp: Response):
+        try:
+            h.send_response(resp.status)
+            headers = dict(resp.headers)
+            # Security headers (ref cmd/generic-handlers.go
+            # addSecurityHeaders) + request id.
+            headers.setdefault("X-Content-Type-Options", "nosniff")
+            headers.setdefault("X-Xss-Protection", "1; mode=block")
+            headers.setdefault("Server", "MinIO-TPU")
+            headers["x-amz-request-id"] = ctx.request_id
+            body = resp.body if ctx.method != "HEAD" else b""
+            if "Content-Length" not in headers or ctx.method == "HEAD":
+                headers["Content-Length"] = headers.get(
+                    "Content-Length", str(len(resp.body))
+                )
+            if ctx.method == "HEAD":
+                headers["Content-Length"] = headers.get("Content-Length", "0")
+            for k, v in headers.items():
+                h.send_header(k, v)
+            h.end_headers()
+            if body:
+                h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass

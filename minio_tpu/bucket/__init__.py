@@ -1,0 +1,6 @@
+"""Per-bucket configuration subsystems (metadata, policy, versioning,
+lifecycle, quota — reference: cmd/bucket-metadata-sys.go, pkg/bucket/*)."""
+
+from .metadata import BucketMetadata, BucketMetadataSys
+
+__all__ = ["BucketMetadata", "BucketMetadataSys"]

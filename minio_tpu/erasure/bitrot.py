@@ -46,6 +46,13 @@ class BitrotAlgorithm(Enum):
             return hashlib.sha256()
         if self is BitrotAlgorithm.BLAKE2B512:
             return hashlib.blake2b(digest_size=64)
+        # HighwayHash: native C engine when available (the reference uses
+        # Go assembly here), numpy engine as fallback.
+        from .. import native
+
+        h = native.new_highwayhash256(highwayhash.MAGIC_KEY)
+        if h is not None:
+            return h
         return highwayhash.HighwayHash256(highwayhash.MAGIC_KEY)
 
     @property

@@ -1,0 +1,325 @@
+"""IAMSys: users, groups, named policies, service accounts, temporary
+(STS) credentials — behavioral parity with the reference's cmd/iam.go +
+cmd/iam-object-store.go, persisted as JSON blobs under
+`.minio.sys/config/iam/` in the object layer (or any mapping-like store).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .policy import CANNED_POLICIES, Args, Policy
+
+IAM_PREFIX = "config/iam"
+
+
+@dataclass
+class Credentials:
+    access_key: str
+    secret_key: str
+    session_token: str = ""
+    status: str = "on"  # "on" | "off"
+    expiration_ns: int = 0  # 0 = never
+    parent_user: str = ""   # set for service accounts / STS creds
+    groups: list = field(default_factory=list)
+
+    def is_expired(self) -> bool:
+        return self.expiration_ns > 0 and time.time_ns() > self.expiration_ns
+
+    def is_temp(self) -> bool:
+        return bool(self.session_token) and self.expiration_ns > 0
+
+    def is_service_account(self) -> bool:
+        return bool(self.parent_user) and not self.session_token
+
+    def to_dict(self) -> dict:
+        return {
+            "accessKey": self.access_key,
+            "secretKey": self.secret_key,
+            "sessionToken": self.session_token,
+            "status": self.status,
+            "expirationNs": self.expiration_ns,
+            "parentUser": self.parent_user,
+            "groups": self.groups,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Credentials":
+        return cls(
+            access_key=d["accessKey"], secret_key=d["secretKey"],
+            session_token=d.get("sessionToken", ""),
+            status=d.get("status", "on"),
+            expiration_ns=d.get("expirationNs", 0),
+            parent_user=d.get("parentUser", ""),
+            groups=d.get("groups", []),
+        )
+
+
+def generate_credentials() -> tuple[str, str]:
+    """Random access/secret pair (ref pkg/auth GetNewCredentials)."""
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    access = "".join(secrets.choice(alphabet) for _ in range(20))
+    secret = secrets.token_urlsafe(30)[:40]
+    return access, secret
+
+
+class IAMStore:
+    """Persistence adapter. Default: a dict (tests); `ObjectStoreBackend`
+    persists into the object layer like iam-object-store.go."""
+
+    def __init__(self):
+        self._items: dict[str, bytes] = {}
+
+    def save(self, path: str, data: bytes):
+        self._items[path] = data
+
+    def load(self, path: str) -> bytes | None:
+        return self._items.get(path)
+
+    def delete(self, path: str):
+        self._items.pop(path, None)
+
+    def list(self, prefix: str) -> list[str]:
+        return sorted(k for k in self._items if k.startswith(prefix))
+
+
+class ObjectStoreBackend(IAMStore):
+    """IAM storage over the object layer, blobs under
+    `.minio.sys/config/iam/...` (ref cmd/iam-object-store.go:535)."""
+
+    META_BUCKET = ".minio.sys"
+
+    def __init__(self, object_layer):
+        super().__init__()
+        self._ol = object_layer
+
+    def save(self, path: str, data: bytes):
+        import io
+
+        self._ol.put_object(
+            self.META_BUCKET, f"{IAM_PREFIX}/{path}", io.BytesIO(data),
+            len(data),
+        )
+
+    def load(self, path: str) -> bytes | None:
+        from ..utils.errors import StorageError
+
+        try:
+            return self._ol.get_object_bytes(
+                self.META_BUCKET, f"{IAM_PREFIX}/{path}"
+            )
+        except StorageError:
+            return None
+
+    def delete(self, path: str):
+        from ..utils.errors import StorageError
+
+        try:
+            self._ol.delete_object(self.META_BUCKET, f"{IAM_PREFIX}/{path}")
+        except StorageError:
+            pass
+
+    def list(self, prefix: str) -> list[str]:
+        from ..utils.errors import StorageError
+
+        try:
+            res = self._ol.list_objects(
+                self.META_BUCKET, prefix=f"{IAM_PREFIX}/{prefix}",
+                max_keys=10000,
+            )
+        except StorageError:
+            return []
+        plen = len(IAM_PREFIX) + 1
+        return [o.name[plen:] for o in res.objects]
+
+
+class IAMSys:
+    """The identity/authorization system singleton (ref cmd/iam.go:204)."""
+
+    def __init__(self, root_access: str, root_secret: str,
+                 store: IAMStore | None = None):
+        self.root = Credentials(root_access, root_secret)
+        self.store = store or IAMStore()
+        self._lock = threading.RLock()
+        self.users: dict[str, Credentials] = {}
+        self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
+        self.user_policy: dict[str, list[str]] = {}   # user -> policy names
+        self.group_policy: dict[str, list[str]] = {}
+        self.group_members: dict[str, list[str]] = {}
+        self.sts: dict[str, Credentials] = {}
+
+    # --- load/persist ---
+
+    def load(self):
+        with self._lock:
+            for path in self.store.list("users/"):
+                raw = self.store.load(path)
+                if raw:
+                    c = Credentials.from_dict(json.loads(raw))
+                    self.users[c.access_key] = c
+            for path in self.store.list("policies/"):
+                raw = self.store.load(path)
+                if raw:
+                    name = path.split("/", 1)[1].removesuffix(".json")
+                    self.policies[name] = Policy.parse(raw)
+            raw = self.store.load("policy-mappings.json")
+            if raw:
+                d = json.loads(raw)
+                self.user_policy = d.get("users", {})
+                self.group_policy = d.get("groups", {})
+                self.group_members = d.get("members", {})
+
+    def _persist_mappings(self):
+        self.store.save("policy-mappings.json", json.dumps({
+            "users": self.user_policy,
+            "groups": self.group_policy,
+            "members": self.group_members,
+        }).encode())
+
+    # --- user management (ref cmd/admin-handlers-users.go surface) ---
+
+    def add_user(self, access_key: str, secret_key: str,
+                 status: str = "on") -> Credentials:
+        with self._lock:
+            c = Credentials(access_key, secret_key, status=status)
+            self.users[access_key] = c
+            self.store.save(
+                f"users/{access_key}.json", json.dumps(c.to_dict()).encode()
+            )
+            return c
+
+    def delete_user(self, access_key: str):
+        with self._lock:
+            self.users.pop(access_key, None)
+            self.user_policy.pop(access_key, None)
+            self.store.delete(f"users/{access_key}.json")
+            self._persist_mappings()
+
+    def set_user_status(self, access_key: str, status: str):
+        with self._lock:
+            c = self.users.get(access_key)
+            if c is None:
+                raise KeyError(access_key)
+            c.status = status
+            self.store.save(
+                f"users/{access_key}.json", json.dumps(c.to_dict()).encode()
+            )
+
+    def list_users(self) -> dict[str, Credentials]:
+        with self._lock:
+            return dict(self.users)
+
+    # --- service accounts / STS ---
+
+    def new_service_account(self, parent_user: str) -> Credentials:
+        with self._lock:
+            access, secret = generate_credentials()
+            c = Credentials(access, secret, parent_user=parent_user)
+            self.users[access] = c
+            self.store.save(
+                f"users/{access}.json", json.dumps(c.to_dict()).encode()
+            )
+            return c
+
+    def new_sts_credentials(self, parent_user: str, duration_s: int = 3600,
+                            session_policy: Policy | None = None) -> Credentials:
+        with self._lock:
+            access, secret = generate_credentials()
+            token = secrets.token_urlsafe(32)
+            c = Credentials(
+                access, secret, session_token=token,
+                expiration_ns=time.time_ns() + duration_s * 10 ** 9,
+                parent_user=parent_user,
+            )
+            self.sts[access] = c
+            if session_policy is not None:
+                self.policies[f"sts-{access}"] = session_policy
+                self.user_policy[access] = [f"sts-{access}"]
+            return c
+
+    # --- groups ---
+
+    def add_group_members(self, group: str, members: list[str]):
+        with self._lock:
+            cur = set(self.group_members.get(group, []))
+            cur.update(members)
+            self.group_members[group] = sorted(cur)
+            self._persist_mappings()
+
+    def remove_group_members(self, group: str, members: list[str]):
+        with self._lock:
+            cur = set(self.group_members.get(group, []))
+            cur -= set(members)
+            if cur:
+                self.group_members[group] = sorted(cur)
+            else:
+                self.group_members.pop(group, None)
+                self.group_policy.pop(group, None)
+            self._persist_mappings()
+
+    def groups_of(self, user: str) -> list[str]:
+        with self._lock:
+            return [
+                g for g, members in self.group_members.items()
+                if user in members
+            ]
+
+    # --- policies ---
+
+    def set_policy(self, name: str, policy: Policy):
+        with self._lock:
+            self.policies[name] = policy
+            self.store.save(
+                f"policies/{name}.json",
+                json.dumps(policy.to_dict()).encode(),
+            )
+
+    def delete_policy(self, name: str):
+        with self._lock:
+            self.policies.pop(name, None)
+            self.store.delete(f"policies/{name}.json")
+
+    def attach_policy(self, user_or_group: str, names: list[str],
+                      is_group: bool = False):
+        with self._lock:
+            target = self.group_policy if is_group else self.user_policy
+            target[user_or_group] = names
+            self._persist_mappings()
+
+    # --- lookup + authorization ---
+
+    def get_credentials(self, access_key: str) -> Credentials | None:
+        with self._lock:
+            if access_key == self.root.access_key:
+                return self.root
+            c = self.users.get(access_key) or self.sts.get(access_key)
+            if c is None or c.is_expired() or c.status != "on":
+                return None
+            return c
+
+    def effective_policy(self, access_key: str) -> Policy:
+        with self._lock:
+            names: list[str] = list(self.user_policy.get(access_key, []))
+            cred = self.users.get(access_key) or self.sts.get(access_key)
+            if cred is not None and cred.parent_user:
+                names += self.user_policy.get(cred.parent_user, [])
+            user_for_groups = cred.parent_user if cred and cred.parent_user else access_key
+            for g in self.groups_of(user_for_groups):
+                names += self.group_policy.get(g, [])
+            merged = Policy([])
+            for n in names:
+                p = self.policies.get(n)
+                if p is not None:
+                    merged = merged.merge(p)
+            return merged
+
+    def is_allowed(self, args: Args) -> bool:
+        """Root always allowed; others evaluated against their policy set
+        (ref cmd/iam.go IsAllowed)."""
+        if args.account == self.root.access_key:
+            return True
+        return self.effective_policy(args.account).is_allowed(args)

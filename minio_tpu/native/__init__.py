@@ -1,0 +1,154 @@
+"""Native (C) runtime components, built on demand with the system
+compiler and loaded via ctypes — the counterpart of the reference's
+assembly-accelerated Go deps (SURVEY.md §2.9). Python fallbacks exist for
+every entry point; set MTPU_NO_NATIVE=1 to force them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SOURCES = ["highwayhash.c"]
+_LIB_NAME = "libmtpu_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _needs_rebuild(so_path: str) -> bool:
+    if not os.path.exists(so_path):
+        return True
+    so_mtime = os.path.getmtime(so_path)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, src)) > so_mtime
+        for src in _SOURCES
+    )
+
+
+def _build() -> str | None:
+    so_path = os.path.join(_BUILD_DIR, _LIB_NAME)
+    if not _needs_rebuild(so_path):
+        return so_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, so_path)
+        return so_path
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load() -> ctypes.CDLL | None:
+    """Build (if stale) and load the native library; None on failure."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    if os.environ.get("MTPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so_path = _build()
+        if so_path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.hh256_init.argtypes = [ctypes.c_char_p, u64p]
+        lib.hh256_update.argtypes = [u64p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.hh256_final.argtypes = [
+            u64p, ctypes.c_char_p, ctypes.c_size_t, u8p,
+        ]
+        lib.hh256_hash.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, u8p,
+        ]
+        lib.hh256_hash_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+class NativeHighwayHash256:
+    """hashlib-style streaming digest over the C engine."""
+
+    digest_size = 32
+    block_size = 32
+
+    def __init__(self, key: bytes, lib: ctypes.CDLL):
+        self._lib = lib
+        self._key = key
+        self._state = (ctypes.c_uint64 * 16)()
+        self._buf = bytearray()
+        lib.hh256_init(key, self._state)
+
+    def update(self, data):
+        data = bytes(data)
+        if not self._buf:
+            # Fast path (one big chunk per hasher in the bitrot writers):
+            # feed the aligned prefix straight to C, buffer only the tail.
+            n = len(data) // 32
+            if n:
+                self._lib.hh256_update(self._state, data, n)
+            self._buf += data[n * 32:]
+            return self
+        self._buf += data
+        n = len(self._buf) // 32
+        if n:
+            chunk = bytes(self._buf[: n * 32])
+            self._lib.hh256_update(self._state, chunk, n)
+            del self._buf[: n * 32]
+        return self
+
+    def digest(self) -> bytes:
+        out = (ctypes.c_uint8 * 32)()
+        tail = bytes(self._buf)
+        self._lib.hh256_final(self._state, tail, len(tail), out)
+        return bytes(out)
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+    def reset(self):
+        self._lib.hh256_init(self._key, self._state)
+        self._buf.clear()
+        return self
+
+
+def new_highwayhash256(key: bytes):
+    """Native digest when available, else None (caller falls back)."""
+    lib = load()
+    if lib is None:
+        return None
+    return NativeHighwayHash256(key, lib)
+
+
+def hash256(data: bytes, key: bytes):
+    """One-shot native hash; None when the native lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 32)()
+    buf = bytes(data)
+    lib.hh256_hash(key, buf, len(buf), out)
+    return bytes(out)

@@ -1,0 +1,170 @@
+/* HighwayHash-256 native engine for the host-side bitrot path.
+ *
+ * The reference's default bitrot hash is HighwayHash256S computed by
+ * Go assembly (minio/highwayhash, used at cmd/bitrot.go:36-56). Here the
+ * portable math is transcribed from this repo's bit-exact numpy engine
+ * (minio_tpu/ops/highwayhash.py, validated against the reference
+ * bitrotSelfTest chain) into C for the streaming writers/readers; the
+ * batched TPU variant lives in ops/highwayhash_jax.py.
+ *
+ * Build: cc -O3 -shared -fPIC (see minio_tpu/native/__init__.py).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    uint64_t v0[4], v1[4], mul0[4], mul1[4];
+} hh_state;
+
+static const uint64_t INIT0[4] = {
+    0xDBE6D5D5FE4CCE2Full, 0xA4093822299F31D0ull,
+    0x13198A2E03707344ull, 0x243F6A8885A308D3ull,
+};
+static const uint64_t INIT1[4] = {
+    0x3BD39E10CB0EF593ull, 0xC0ACF169B5F18A8Cull,
+    0xBE5466CF34E90C6Cull, 0x452821E638D01377ull,
+};
+
+static inline uint64_t rot64_32(uint64_t x) { return (x >> 32) | (x << 32); }
+static inline uint64_t mb(uint64_t v, int b) {
+    return v & (0xFFull << (8 * b));
+}
+
+static inline void zipper_pair(uint64_t ve, uint64_t vo,
+                               uint64_t *add_e, uint64_t *add_o) {
+    *add_e = ((mb(ve, 3) | mb(vo, 4)) >> 24) |
+             ((mb(ve, 5) | mb(vo, 6)) >> 16) |
+             mb(ve, 2) | (mb(ve, 1) << 32) | (mb(vo, 7) >> 8) | (ve << 56);
+    *add_o = ((mb(vo, 3) | mb(ve, 4)) >> 24) |
+             mb(vo, 2) | (mb(vo, 5) >> 16) | (mb(vo, 1) << 24) |
+             (mb(ve, 6) >> 8) | (mb(vo, 0) << 48) | mb(ve, 7);
+}
+
+static inline void zipper_add(uint64_t *dst, const uint64_t *src) {
+    uint64_t ae, ao;
+    zipper_pair(src[0], src[1], &ae, &ao);
+    dst[0] += ae;
+    dst[1] += ao;
+    zipper_pair(src[2], src[3], &ae, &ao);
+    dst[2] += ae;
+    dst[3] += ao;
+}
+
+static inline void update(hh_state *s, const uint64_t p[4]) {
+    for (int i = 0; i < 4; i++) {
+        s->v1[i] += s->mul0[i] + p[i];
+        s->mul0[i] ^= (s->v1[i] & 0xFFFFFFFFull) * (s->v0[i] >> 32);
+        s->v0[i] += s->mul1[i];
+        s->mul1[i] ^= (s->v0[i] & 0xFFFFFFFFull) * (s->v1[i] >> 32);
+    }
+    zipper_add(s->v0, s->v1);
+    zipper_add(s->v1, s->v0);
+}
+
+static void update_packets(hh_state *s, const uint8_t *data, size_t n) {
+    uint64_t p[4];
+    for (size_t i = 0; i < n; i++) {
+        memcpy(p, data + 32 * i, 32);
+        update(s, p);
+    }
+}
+
+static void update_remainder(hh_state *s, const uint8_t *tail, size_t mod32) {
+    size_t mod4 = mod32 & 3, full4 = mod32 & ~(size_t)3;
+    uint64_t inc = ((uint64_t)mod32 << 32) + (uint64_t)mod32;
+    for (int i = 0; i < 4; i++) s->v0[i] += inc;
+    int c = (int)(mod32 & 31);
+    for (int i = 0; i < 4; i++) {
+        uint32_t lo = (uint32_t)s->v1[i], hi = (uint32_t)(s->v1[i] >> 32);
+        if (c) {
+            lo = (lo << c) | (lo >> (32 - c));
+            hi = (hi << c) | (hi >> (32 - c));
+        }
+        s->v1[i] = ((uint64_t)hi << 32) | lo;
+    }
+    uint8_t packet[32];
+    memset(packet, 0, 32);
+    memcpy(packet, tail, full4);
+    if (mod32 & 16) {
+        memcpy(packet + 28, tail + mod32 - 4, 4);
+    } else if (mod4) {
+        packet[16] = tail[full4];
+        packet[17] = tail[full4 + (mod4 >> 1)];
+        packet[18] = tail[full4 + mod4 - 1];
+    }
+    uint64_t p[4];
+    memcpy(p, packet, 32);
+    update(s, p);
+}
+
+static void permute_and_update(hh_state *s) {
+    uint64_t perm[4] = {
+        rot64_32(s->v0[2]), rot64_32(s->v0[3]),
+        rot64_32(s->v0[0]), rot64_32(s->v0[1]),
+    };
+    update(s, perm);
+}
+
+static void mod_red(uint64_t a3u, uint64_t a2, uint64_t a1, uint64_t a0,
+                    uint64_t *m0, uint64_t *m1) {
+    uint64_t a3 = a3u & 0x3FFFFFFFFFFFFFFFull;
+    *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+    *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+static void finalize256(const hh_state *st, uint8_t *out) {
+    hh_state s = *st;
+    for (int i = 0; i < 10; i++) permute_and_update(&s);
+    uint64_t h[4];
+    mod_red(s.v1[1] + s.mul1[1], s.v1[0] + s.mul1[0],
+            s.v0[1] + s.mul0[1], s.v0[0] + s.mul0[0], &h[0], &h[1]);
+    mod_red(s.v1[3] + s.mul1[3], s.v1[2] + s.mul1[2],
+            s.v0[3] + s.mul0[3], s.v0[2] + s.mul0[2], &h[2], &h[3]);
+    memcpy(out, h, 32);
+}
+
+/* ---- exported API (ctypes) ---- */
+
+void hh256_init(const uint8_t *key32, uint64_t *state) {
+    hh_state *s = (hh_state *)state;
+    uint64_t k[4];
+    memcpy(k, key32, 32);
+    for (int i = 0; i < 4; i++) {
+        s->mul0[i] = INIT0[i];
+        s->mul1[i] = INIT1[i];
+        s->v0[i] = INIT0[i] ^ k[i];
+        s->v1[i] = INIT1[i] ^ rot64_32(k[i]);
+    }
+}
+
+void hh256_update(uint64_t *state, const uint8_t *data, size_t n_packets) {
+    update_packets((hh_state *)state, data, n_packets);
+}
+
+void hh256_final(const uint64_t *state, const uint8_t *tail, size_t tail_len,
+                 uint8_t *out32) {
+    hh_state s = *(const hh_state *)state;
+    if (tail_len) update_remainder(&s, tail, tail_len);
+    finalize256(&s, out32);
+}
+
+void hh256_hash(const uint8_t *key32, const uint8_t *data, size_t len,
+                uint8_t *out32) {
+    hh_state s;
+    hh256_init(key32, (uint64_t *)&s);
+    size_t n = len / 32;
+    update_packets(&s, data, n);
+    if (len % 32) {
+        update_remainder(&s, data + n * 32, len % 32);
+    }
+    finalize256(&s, out32);
+}
+
+void hh256_hash_batch(const uint8_t *key32, const uint8_t *data, size_t n,
+                      size_t len, uint8_t *out) {
+    for (size_t i = 0; i < n; i++) {
+        hh256_hash(key32, data + i * len, len, out + i * 32);
+    }
+}

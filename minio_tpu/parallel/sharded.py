@@ -124,6 +124,11 @@ class ShardedErasure:
                 f"shard width {blocks.shape[2]} != shard_size {self.shard_size} "
                 f"for block_size={self.block_size}"
             )
+        dp = self.mesh.shape["dp"]
+        if blocks.shape[0] % dp != 0:
+            raise ValueError(
+                f"batch {blocks.shape[0]} must be divisible by dp={dp}"
+            )
         data = jax.device_put(
             np.ascontiguousarray(blocks, dtype=np.uint8), self.data_spec
         )
@@ -166,29 +171,30 @@ class ShardedErasure:
         host compiles one program per failure pattern, like the reference
         building one reconstruction matrix per missing-shard set."""
         dead_set = set(dead)
-        if any(i < 0 or i >= self.n for i in dead_set):
-            raise ValueError(f"dead lane index out of range [0, {self.n}): {dead}")
-        survivors = tuple(i for i in range(self.n) if i not in dead_set)[: self.k]
-        if len(survivors) < self.k:
-            raise ValueError(
-                f"only {len(survivors)} survivors, need {self.k}"
-            )
+        survivors = self._survivors(dead_set)
         if targets is None:
             targets = tuple(sorted(dead_set))
         return self._decode_fn(survivors, tuple(targets))(stripe)
 
+    def _survivors(self, dead_set: set) -> tuple:
+        """First k live lanes, validating the dead set."""
+        if any(i < 0 or i >= self.n for i in dead_set):
+            raise ValueError(
+                f"dead lane index out of range [0, {self.n}): {sorted(dead_set)}"
+            )
+        survivors = tuple(i for i in range(self.n) if i not in dead_set)[: self.k]
+        if len(survivors) < self.k:
+            raise ValueError(f"only {len(survivors)} survivors, need {self.k}")
+        return survivors
+
     def decode_data(self, stripe: jax.Array, dead: tuple[int, ...]) -> jax.Array:
         """Recover the k data shards [B, k, S] under `dead` lanes."""
         dead_set = set(dead)
-        if any(i < 0 or i >= self.n for i in dead_set):
-            raise ValueError(f"dead lane index out of range [0, {self.n}): {dead}")
+        survivors = self._survivors(dead_set)
         missing_data = tuple(i for i in range(self.k) if i in dead_set)
         if not missing_data:
             out = stripe[:, : self.k, :]
             return jax.device_put(out, self.data_spec)
-        survivors = tuple(i for i in range(self.n) if i not in dead_set)[: self.k]
-        if len(survivors) < self.k:
-            raise ValueError(f"only {len(survivors)} survivors, need {self.k}")
         rec = self._decode_fn(survivors, missing_data)(stripe)
         # Merge reconstructed shards back into data positions host-free.
         parts = []

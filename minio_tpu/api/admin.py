@@ -1,0 +1,366 @@
+"""Admin API: cluster info, storage info, config KV, user/policy
+management, heal triggering, lock inspection, trace polling — behavioral
+parity with the reference's `/minio/admin/v3/*` plane
+(cmd/admin-router.go:38-185, cmd/admin-handlers.go,
+cmd/admin-handlers-users.go, cmd/admin-handlers-config-kv.go), served
+through the same dispatch pipeline as the S3 routes.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import time
+
+from ..iam import Args, Policy
+from .errors import S3Error
+from .handlers import Response
+
+ADMIN_PREFIX = "/minio/admin/v3"
+
+
+class AdminHandlers:
+    def __init__(self, object_layer, iam, config_sys=None, metrics=None,
+                 trace=None, notification=None, lockers=None):
+        self.ol = object_layer
+        self.iam = iam
+        self.config_sys = config_sys
+        self.metrics = metrics
+        self.trace = trace
+        self.notification = notification
+        self.lockers = lockers
+        self.started = time.time()
+
+    # --- routing ---
+
+    def route(self, ctx) -> str:
+        rest = ctx.path[len(ADMIN_PREFIX):].strip("/")
+        head = rest.split("/", 1)[0]
+        m = ctx.method
+        table = {
+            ("GET", "info"): "server_info",
+            ("GET", "storageinfo"): "storage_info",
+            ("GET", "datausage"): "data_usage_info",
+            ("GET", "metrics"): "metrics_snapshot",
+            ("GET", "get-config-kv"): "get_config_kv",
+            ("PUT", "set-config-kv"): "set_config_kv",
+            ("DELETE", "del-config-kv"): "del_config_kv",
+            ("GET", "help-config-kv"): "help_config_kv",
+            ("GET", "list-users"): "list_users",
+            ("PUT", "add-user"): "add_user",
+            ("DELETE", "remove-user"): "remove_user",
+            ("PUT", "set-user-status"): "set_user_status",
+            ("GET", "list-canned-policies"): "list_policies",
+            ("PUT", "add-canned-policy"): "add_policy",
+            ("DELETE", "remove-canned-policy"): "remove_policy",
+            ("PUT", "set-user-or-group-policy"): "set_policy_mapping",
+            ("POST", "heal"): "heal",
+            ("GET", "top"): "top_locks",
+            ("GET", "trace"): "trace_poll",
+            ("POST", "service"): "service_action",
+            ("GET", "accountinfo"): "account_info",
+        }
+        name = table.get((m, head))
+        if name is None:
+            raise S3Error("MethodNotAllowed", f"admin {m} /{rest}")
+        return name
+
+    # Action names per handler for IAM admin-policy checks
+    ACTIONS = {
+        "server_info": "admin:ServerInfo",
+        "storage_info": "admin:StorageInfo",
+        "data_usage_info": "admin:DataUsageInfo",
+        "metrics_snapshot": "admin:Prometheus",
+        "get_config_kv": "admin:ConfigUpdate",
+        "set_config_kv": "admin:ConfigUpdate",
+        "del_config_kv": "admin:ConfigUpdate",
+        "help_config_kv": "admin:ConfigUpdate",
+        "list_users": "admin:ListUsers",
+        "add_user": "admin:CreateUser",
+        "remove_user": "admin:DeleteUser",
+        "set_user_status": "admin:EnableUser",
+        "list_policies": "admin:ListUserPolicies",
+        "add_policy": "admin:CreatePolicy",
+        "remove_policy": "admin:DeletePolicy",
+        "set_policy_mapping": "admin:AttachUserOrGroupPolicy",
+        "heal": "admin:Heal",
+        "top_locks": "admin:TopLocksInfo",
+        "trace_poll": "admin:ServerTrace",
+        "service_action": "admin:ServiceRestart",
+        "account_info": "admin:AccountInfo",
+    }
+
+    def authorize(self, auth_result, name: str):
+        if auth_result.is_anonymous:
+            raise S3Error("AccessDenied", "admin API requires signature")
+        action = self.ACTIONS.get(name, "admin:*")
+        if not self.iam.is_allowed(Args(
+            account=auth_result.access_key, action=action,
+        )):
+            raise S3Error("AccessDenied", f"{auth_result.access_key} {action}")
+
+    # --- handlers (JSON responses, like madmin) ---
+
+    def _json(self, obj, status: int = 200) -> Response:
+        return Response(
+            status, {"Content-Type": "application/json"},
+            json.dumps(obj).encode(),
+        )
+
+    def server_info(self, ctx) -> Response:
+        buckets = [
+            b for b in self.ol.list_buckets() if not b.name.startswith(".")
+        ]
+        servers = (
+            self.notification.server_info() if self.notification else []
+        )
+        return self._json({
+            "mode": "online",
+            "deploymentID": getattr(
+                self.ol.pools[0], "deployment_id", ""
+            ) if getattr(self.ol, "pools", None) else "",
+            "buckets": {"count": len(buckets)},
+            "servers": servers,
+            "uptime_s": time.time() - self.started,
+            "version": "minio-tpu/0.1",
+        })
+
+    def storage_info(self, ctx) -> Response:
+        disks = []
+        for pool in getattr(self.ol, "pools", []):
+            for d in pool.disks:
+                if d is None:
+                    disks.append({"state": "offline"})
+                    continue
+                try:
+                    di = d.disk_info()
+                    disks.append({
+                        "endpoint": di.endpoint,
+                        "state": "ok",
+                        "totalspace": di.total,
+                        "availspace": di.free,
+                        "usedspace": di.used,
+                    })
+                except Exception as exc:  # noqa: BLE001 per-disk state
+                    disks.append({
+                        "endpoint": d.endpoint(), "state": "offline",
+                        "error": str(exc),
+                    })
+        return self._json({"disks": disks})
+
+    def data_usage_info(self, ctx) -> Response:
+        usage = {"bucketsUsage": {}, "objectsTotalCount": 0,
+                 "objectsTotalSize": 0}
+        for b in self.ol.list_buckets():
+            if b.name.startswith("."):
+                continue
+            count = size = 0
+            marker = ""
+            while True:
+                res = self.ol.list_objects(
+                    b.name, marker=marker, max_keys=1000
+                )
+                for oi in res.objects:
+                    count += 1
+                    size += oi.size
+                if not res.is_truncated:
+                    break
+                marker = res.next_marker
+            usage["bucketsUsage"][b.name] = {
+                "objectsCount": count, "size": size,
+            }
+            usage["objectsTotalCount"] += count
+            usage["objectsTotalSize"] += size
+        usage["lastUpdate"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        return self._json(usage)
+
+    def metrics_snapshot(self, ctx) -> Response:
+        if self.metrics is None:
+            return Response(200, {"Content-Type": "text/plain"}, b"")
+        return Response(
+            200, {"Content-Type": "text/plain; version=0.0.4"},
+            self.metrics.render_prometheus().encode(),
+        )
+
+    # --- config KV ---
+
+    def get_config_kv(self, ctx) -> Response:
+        if self.config_sys is None:
+            raise S3Error("NotImplemented", "config system not wired")
+        key = ctx.qdict.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument", "key required")
+        try:
+            kvs = self.config_sys.config.get(key)
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        return self._json({key: dict(kvs)})
+
+    def set_config_kv(self, ctx) -> Response:
+        if self.config_sys is None:
+            raise S3Error("NotImplemented", "config system not wired")
+        # body: "subsys[:target] k=v k2=v2" (mc admin config set syntax)
+        try:
+            text = ctx.body.decode()
+            parts = text.split()
+            target = parts[0]
+            kv = dict(p.split("=", 1) for p in parts[1:])
+            self.config_sys.config.set_kv(target, **kv)
+        except (ValueError, IndexError) as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        self.config_sys.save()
+        return self._json({"restart": False})
+
+    def del_config_kv(self, ctx) -> Response:
+        if self.config_sys is None:
+            raise S3Error("NotImplemented", "config system not wired")
+        self.config_sys.config.del_target(ctx.body.decode().strip())
+        self.config_sys.save()
+        return self._json({})
+
+    def help_config_kv(self, ctx) -> Response:
+        from ..config import HELP
+
+        return self._json(HELP)
+
+    # --- users / policies ---
+
+    def list_users(self, ctx) -> Response:
+        return self._json({
+            ak: {"status": c.status, "policyName": ",".join(
+                self.iam.user_policy.get(ak, [])
+            )}
+            for ak, c in self.iam.list_users().items()
+        })
+
+    def add_user(self, ctx) -> Response:
+        ak = ctx.qdict.get("accessKey", "")
+        if not ak:
+            raise S3Error("InvalidArgument", "accessKey required")
+        body = json.loads(ctx.body or b"{}")
+        self.iam.add_user(
+            ak, body.get("secretKey", ""), body.get("status", "on")
+        )
+        return self._json({})
+
+    def remove_user(self, ctx) -> Response:
+        self.iam.delete_user(ctx.qdict.get("accessKey", ""))
+        return self._json({})
+
+    def set_user_status(self, ctx) -> Response:
+        try:
+            self.iam.set_user_status(
+                ctx.qdict.get("accessKey", ""),
+                ctx.qdict.get("status", "on"),
+            )
+        except KeyError as exc:
+            raise S3Error("InvalidArgument", f"no such user {exc}") from exc
+        return self._json({})
+
+    def list_policies(self, ctx) -> Response:
+        return self._json({
+            name: p.to_dict() for name, p in self.iam.policies.items()
+        })
+
+    def add_policy(self, ctx) -> Response:
+        name = ctx.qdict.get("name", "")
+        if not name:
+            raise S3Error("InvalidArgument", "name required")
+        try:
+            self.iam.set_policy(name, Policy.parse(ctx.body))
+        except (ValueError, KeyError) as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        return self._json({})
+
+    def remove_policy(self, ctx) -> Response:
+        self.iam.delete_policy(ctx.qdict.get("name", ""))
+        return self._json({})
+
+    def set_policy_mapping(self, ctx) -> Response:
+        user_or_group = ctx.qdict.get("userOrGroup", "")
+        policy_name = ctx.qdict.get("policyName", "")
+        is_group = ctx.qdict.get("isGroup", "false") == "true"
+        if not user_or_group:
+            raise S3Error("InvalidArgument", "userOrGroup required")
+        names = [p for p in policy_name.split(",") if p]
+        self.iam.attach_policy(user_or_group, names, is_group)
+        return self._json({})
+
+    # --- heal / locks / trace / service ---
+
+    def heal(self, ctx) -> Response:
+        # POST /minio/admin/v3/heal/<bucket>/<prefix>
+        rest = ctx.path[len(ADMIN_PREFIX) + len("/heal"):].strip("/")
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            # cluster-wide: heal format/buckets
+            result = self.ol.heal_format() if hasattr(
+                self.ol, "heal_format"
+            ) else {}
+            return self._json({"healSequence": "format", "result": result})
+        healed = []
+        failed = []
+        marker = ""
+        while True:
+            res = self.ol.list_objects(
+                bucket, prefix=prefix, marker=marker, max_keys=1000
+            )
+            for oi in res.objects:
+                try:
+                    self.ol.heal_object(bucket, oi.name)
+                    healed.append(oi.name)
+                except Exception as exc:  # noqa: BLE001 per-object status
+                    failed.append({"object": oi.name, "error": str(exc)})
+            if not res.is_truncated:
+                break
+            marker = res.next_marker
+        return self._json({
+            "healSequence": f"{bucket}/{prefix}",
+            "healed": healed, "failed": failed,
+        })
+
+    def top_locks(self, ctx) -> Response:
+        if self.notification is not None:
+            return self._json({"peers": self.notification.get_locks()})
+        if self.lockers is not None:
+            return self._json({"locks": {
+                res: self.lockers.held(res)
+                for res in list(getattr(self.lockers, "_map", {}))
+            }})
+        return self._json({"locks": {}})
+
+    def trace_poll(self, ctx) -> Response:
+        """Bounded poll of the trace bus (the reference streams chunked
+        JSON; a poll window keeps the HTTP layer simple)."""
+        if self.trace is None:
+            return self._json([])
+        wait_s = min(float(ctx.qdict.get("wait", "2")), 10.0)
+        q = self.trace.subscribe()
+        out = []
+        deadline = time.time() + wait_s
+        try:
+            while time.time() < deadline and len(out) < 1000:
+                try:
+                    out.append(q.get(timeout=max(0.05, deadline - time.time())))
+                except queue.Empty:
+                    break
+        finally:
+            self.trace.unsubscribe(q)
+        return self._json(out)
+
+    def service_action(self, ctx) -> Response:
+        action = ctx.qdict.get("action", "")
+        if action not in ("restart", "stop"):
+            raise S3Error("InvalidArgument", f"action {action!r}")
+        # Signal recorded; process supervision is the operator's domain.
+        return self._json({"action": action, "accepted": True})
+
+    def account_info(self, ctx) -> Response:
+        buckets = []
+        for b in self.ol.list_buckets():
+            if b.name.startswith("."):
+                continue
+            buckets.append({"name": b.name, "createdNs": b.created_ns})
+        return self._json({"accountName": "minio-tpu", "buckets": buckets})

@@ -63,7 +63,11 @@ def _xml_root(tag: str) -> ET.Element:
 
 
 def valid_bucket_name(bucket: str) -> bool:
-    """S3 DNS-compatible bucket naming rules."""
+    """S3 DNS-compatible bucket naming rules; 'minio' is reserved for the
+    health/metrics/admin route namespace (ref cmd/generic-handlers.go
+    minioReservedBucket)."""
+    if bucket == "minio":
+        return False
     if not (3 <= len(bucket) <= 63):
         return False
     if bucket.startswith((".", "-")) or bucket.endswith((".", "-")):

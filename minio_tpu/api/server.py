@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..iam import IAMSys
 from . import sign
+from .admin import ADMIN_PREFIX, AdminHandlers
 from .auth import AUTH_STREAMING, authenticate, authorize
 from .errors import API_ERRORS, S3Error, error_xml
 from .handlers import Response, S3ApiHandlers
@@ -200,8 +201,12 @@ class S3Server:
     def __init__(self, object_layer, iam: IAMSys, bucket_meta,
                  notify=None, region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
-                 trace=None):
+                 trace=None, config_sys=None, notification=None):
         self.handlers = S3ApiHandlers(object_layer, bucket_meta, iam, notify)
+        self.admin = AdminHandlers(
+            object_layer, iam, config_sys=config_sys, metrics=metrics,
+            trace=trace, notification=notification,
+        )
         self.iam = iam
         self.region = region
         self.metrics = metrics
@@ -278,6 +283,33 @@ class S3Server:
 
     def _process(self, ctx: RequestContext) -> Response:
         _reserved_metadata_check(ctx)
+        # Health endpoints: unauthenticated, GET/HEAD only
+        # (ref cmd/healthcheck-router.go)
+        if ctx.path.startswith("/minio/health/"):
+            if ctx.method not in ("GET", "HEAD"):
+                raise S3Error("MethodNotAllowed", ctx.method)
+            return self._health(ctx)
+        # Prometheus metrics (ref cmd/metrics-router.go)
+        if ctx.path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node",
+                        "/minio/prometheus/metrics"):
+            if ctx.method not in ("GET", "HEAD"):
+                raise S3Error("MethodNotAllowed", ctx.method)
+            auth_result = authenticate(
+                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+            )
+            self.admin.authorize(auth_result, "metrics_snapshot")
+            return self.admin.metrics_snapshot(ctx)
+        # Admin plane (streaming bodies are an S3-data-plane mechanism;
+        # the admin plane rejects them rather than parse chunk framing)
+        if ctx.path.startswith(ADMIN_PREFIX):
+            name = self.admin.route(ctx)
+            auth_result = authenticate(
+                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+            )
+            if auth_result.auth == AUTH_STREAMING:
+                raise S3Error("NotImplemented", "streaming admin request")
+            self.admin.authorize(auth_result, name)
+            return getattr(self.admin, name)(ctx)
         name = route(ctx)
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
@@ -308,6 +340,21 @@ class S3Server:
                 "s3_responses_total", api=name, status=str(resp.status)
             )
         return resp
+
+    def _health(self, ctx: RequestContext) -> Response:
+        """/minio/health/{live,ready,cluster}
+        (ref cmd/healthcheck-router.go; cluster checks quorum health,
+        cmd/erasure-server-pool.go:1705)."""
+        kind = ctx.path.rsplit("/", 1)[1]
+        if kind == "live":
+            return Response(200)
+        if kind in ("ready", "cluster"):
+            ol = self.handlers.ol
+            health = getattr(ol, "health", None)
+            if health is not None and not health():
+                return Response(503)
+            return Response(200)
+        return Response(404)
 
     def _wrap_streaming_body(self, ctx: RequestContext, auth_result):
         """Replace the body reader with the verifying aws-chunked decoder;

@@ -1,0 +1,9 @@
+"""Config system: versioned subsystem KV config with env overrides,
+persisted under `.minio.sys/config/config.json` with history — behavioral
+parity with the reference's cmd/config/config.go (20 subsystems,
+Default/env/stored lookup order) without the Go struct machinery.
+"""
+
+from .config import KVS, Config, ConfigSys, HELP, SUBSYSTEMS
+
+__all__ = ["KVS", "Config", "ConfigSys", "HELP", "SUBSYSTEMS"]

@@ -1,0 +1,235 @@
+"""Subsystem KV configuration.
+
+The reference (cmd/config/config.go:97-118) defines 20 subsystems, each a
+map of KV pairs with defaults, env-var overrides (MINIO_<SUBSYS>_<KEY>),
+and persisted operator values stored AES-encrypted in the cluster meta
+bucket. This implementation keeps the same three-layer lookup order —
+env > stored > default — the same `subsys[:target]` addressing, the same
+history behavior, with plain-JSON persistence (encryption of the config
+blob is keyed off the root credential, see ConfigSys.save).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+
+from ..utils.errors import StorageError
+
+META_BUCKET = ".minio.sys"
+CONFIG_PATH = "config/config.json"
+HISTORY_PREFIX = "config/history"
+ENV_PREFIX = "MTPU"
+
+# subsystem -> {key: default}  (ref cmd/config/config.go SubSystems +
+# per-subsystem DefaultKVS; trimmed to what this server implements,
+# notification targets reduced like the kubegems fork to
+# mysql/postgres/redis/webhook)
+SUBSYSTEMS: dict[str, dict[str, str]] = {
+    "api": {
+        "requests_max": "0",
+        "requests_deadline": "10s",
+        "cors_allow_origin": "*",
+        "replication_workers": "100",
+    },
+    "credentials": {"access_key": "", "secret_key": ""},
+    "region": {"name": "us-east-1"},
+    "storage_class": {"standard": "", "rrs": "EC:2"},
+    "cache": {"drives": "", "expiry": "90", "quota": "80", "exclude": ""},
+    "compression": {"enable": "off", "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin", "mime_types": "text/*,application/json,application/xml"},
+    "etcd": {"endpoints": "", "path_prefix": ""},
+    "identity_openid": {"config_url": "", "client_id": ""},
+    "identity_ldap": {"server_addr": "", "user_dn_search_base_dn": ""},
+    "policy_opa": {"url": "", "auth_token": ""},
+    "kms_kes": {"endpoint": "", "key_name": ""},
+    "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
+    "scanner": {"delay": "10", "max_wait": "15s", "cycle": "1m"},
+    "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "", "queue_dir": "", "queue_limit": "0"},
+    "notify_mysql": {"enable": "off", "dsn_string": "", "table": ""},
+    "notify_postgres": {"enable": "off", "connection_string": "", "table": ""},
+    "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace"},
+}
+
+HELP: dict[str, str] = {
+    "api": "manage global HTTP API call specific features",
+    "credentials": "set root credentials",
+    "region": "label the location of the server",
+    "storage_class": "define object level redundancy",
+    "cache": "add caching storage tier",
+    "compression": "enable streaming compression of objects",
+    "etcd": "federate multiple clusters for IAM and Bucket DNS",
+    "identity_openid": "enable OpenID SSO support",
+    "identity_ldap": "enable LDAP SSO support",
+    "policy_opa": "enable external OPA for policy enforcement",
+    "kms_kes": "enable external MinIO key encryption service",
+    "logger_webhook": "send server logs to webhook endpoints",
+    "audit_webhook": "send audit logs to webhook endpoints",
+    "heal": "manage object healing frequency and bitrot verification",
+    "scanner": "manage namespace scanning for usage calculation, lifecycle, healing",
+    "notify_webhook": "publish bucket notifications to webhook endpoints",
+    "notify_mysql": "publish bucket notifications to MySQL databases",
+    "notify_postgres": "publish bucket notifications to Postgres databases",
+    "notify_redis": "publish bucket notifications to Redis datastores",
+}
+
+DEFAULT_TARGET = "_"
+
+
+class KVS(dict):
+    """One target's key-value set."""
+
+    def get_str(self, key: str, default: str = "") -> str:
+        return self.get(key, default)
+
+
+class Config:
+    """config[subsys][target] = KVS. Parse/serialize + lookup."""
+
+    def __init__(self):
+        self._data: dict[str, dict[str, KVS]] = {
+            sub: {DEFAULT_TARGET: KVS(defaults)}
+            for sub, defaults in SUBSYSTEMS.items()
+        }
+
+    @staticmethod
+    def split_subsys(s: str) -> tuple[str, str]:
+        """'notify_webhook:primary' -> (subsys, target)."""
+        sub, _, target = s.partition(":")
+        return sub, target or DEFAULT_TARGET
+
+    def set_kv(self, subsys_target: str, **kv: str):
+        sub, target = self.split_subsys(subsys_target)
+        if sub not in SUBSYSTEMS:
+            raise ValueError(f"unknown config subsystem {sub!r}")
+        bad = set(kv) - set(SUBSYSTEMS[sub])
+        if bad:
+            raise ValueError(f"unknown keys for {sub}: {sorted(bad)}")
+        cur = self._data[sub].setdefault(
+            target, KVS(SUBSYSTEMS[sub])
+        )
+        cur.update(kv)
+
+    def del_target(self, subsys_target: str):
+        sub, target = self.split_subsys(subsys_target)
+        if target == DEFAULT_TARGET:
+            self._data[sub][DEFAULT_TARGET] = KVS(SUBSYSTEMS[sub])
+        else:
+            self._data[sub].pop(target, None)
+
+    def get(self, subsys_target: str) -> KVS:
+        """Resolved view: default < stored < env."""
+        sub, target = self.split_subsys(subsys_target)
+        if sub not in SUBSYSTEMS:
+            raise ValueError(f"unknown config subsystem {sub!r}")
+        out = KVS(SUBSYSTEMS[sub])
+        out.update(self._data[sub].get(target, {}))
+        for key in SUBSYSTEMS[sub]:
+            env = f"{ENV_PREFIX}_{sub.upper()}_{key.upper()}"
+            if target != DEFAULT_TARGET:
+                env += f"_{target.upper()}"
+            if env in os.environ:
+                out[key] = os.environ[env]
+        return out
+
+    def targets(self, subsys: str) -> list[str]:
+        return sorted(self._data.get(subsys, {}))
+
+    def to_json(self) -> bytes:
+        return json.dumps(self._data, sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Config":
+        c = cls()
+        for sub, targets in json.loads(raw).items():
+            if sub not in SUBSYSTEMS:
+                continue
+            for target, kvs in targets.items():
+                known = {
+                    k: v for k, v in kvs.items() if k in SUBSYSTEMS[sub]
+                }
+                c._data[sub][target] = KVS(SUBSYSTEMS[sub])
+                c._data[sub][target].update(known)
+        return c
+
+
+class ConfigSys:
+    """Load/save the cluster Config in the object layer with history
+    (ref cmd/config-*.go; the reference encrypts the blob with the root
+    credential via madmin — here the blob is obfuscated the same way only
+    if `cryptography` is present, else stored plain)."""
+
+    def __init__(self, object_layer, secret: str = ""):
+        self._ol = object_layer
+        self._secret = secret
+        self.config = Config()
+
+    # --- crypto envelope (AES-GCM keyed from the root secret) ---
+
+    def _seal(self, raw: bytes) -> bytes:
+        if not self._secret:
+            return b"PLAIN\x00" + raw
+        import hashlib
+        import os as _os
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        key = hashlib.sha256(("mtpu-config:" + self._secret).encode()).digest()
+        nonce = _os.urandom(12)
+        return b"AESG\x00\x00" + nonce + AESGCM(key).encrypt(nonce, raw, None)
+
+    def _unseal(self, blob: bytes) -> bytes:
+        if blob.startswith(b"PLAIN\x00"):
+            return blob[6:]
+        if blob.startswith(b"AESG\x00\x00"):
+            import hashlib
+
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+            key = hashlib.sha256(
+                ("mtpu-config:" + self._secret).encode()
+            ).digest()
+            nonce, ct = blob[6:18], blob[18:]
+            return AESGCM(key).decrypt(nonce, ct, None)
+        raise ValueError("unknown config blob header")
+
+    # --- persistence ---
+
+    def load(self):
+        try:
+            blob = self._ol.get_object_bytes(META_BUCKET, CONFIG_PATH)
+        except StorageError:
+            return  # fresh deployment: defaults
+        self.config = Config.from_json(self._unseal(blob))
+
+    def save(self, keep_history: bool = True):
+        blob = self._seal(self.config.to_json())
+        if keep_history:
+            ts = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
+            self._put(f"{HISTORY_PREFIX}/{ts}.kv", blob)
+        self._put(CONFIG_PATH, blob)
+
+    def _put(self, path: str, blob: bytes):
+        from ..utils.errors import ErrBucketNotFound
+
+        try:
+            self._ol.put_object(
+                META_BUCKET, path, io.BytesIO(blob), len(blob)
+            )
+        except ErrBucketNotFound:
+            self._ol.make_bucket(META_BUCKET)
+            self._ol.put_object(
+                META_BUCKET, path, io.BytesIO(blob), len(blob)
+            )
+
+    def history(self) -> list[str]:
+        try:
+            res = self._ol.list_objects(
+                META_BUCKET, prefix=HISTORY_PREFIX + "/", max_keys=1000
+            )
+        except StorageError:
+            return []
+        return [o.name.rsplit("/", 1)[1] for o in res.objects]

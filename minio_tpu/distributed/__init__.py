@@ -1,0 +1,28 @@
+"""Distributed substrate: the node-to-node RPC planes (storage, lock,
+peer-control, bootstrap) that make multi-node erasure pools work —
+reference: cmd/storage-rest-*.go, pkg/dsync, cmd/peer-rest-*.go,
+cmd/bootstrap-peer-server.go."""
+
+from .dsync import (
+    DRWMutex,
+    Dsync,
+    LocalLocker,
+    LockRESTServer,
+)
+from .peer import (
+    BootstrapServer,
+    NotificationSys,
+    PeerClient,
+    PeerRESTServer,
+    verify_cluster_config,
+)
+from .rest import RPCClient, RPCError, RPCServer, make_token, verify_token
+from .storage_rest import RemoteStorage, StorageRESTServer
+
+__all__ = [
+    "DRWMutex", "Dsync", "LocalLocker", "LockRESTServer",
+    "BootstrapServer", "NotificationSys", "PeerClient", "PeerRESTServer",
+    "verify_cluster_config",
+    "RPCClient", "RPCError", "RPCServer", "make_token", "verify_token",
+    "RemoteStorage", "StorageRESTServer",
+]

@@ -1,0 +1,289 @@
+"""dsync: distributed read/write locks with quorum — behavioral parity
+with the reference's pkg/dsync (DRWMutex quorum algorithm
+pkg/dsync/drwmutex.go:347-464, auto-refresh :251, server-side expiry)
+plus the lock RPC plane (cmd/lock-rest-server.go:93-232,
+cmd/local-locker.go).
+
+Algorithm: a lock is held when a majority (writes: tolerance = n//2,
+quorum = n - tolerance; reads: quorum = n//2 + 1 when n even... the
+reference uses tolerance = n/2 and for writes requires quorum+1 when
+n == 2*tolerance) of lockers granted it. Partial grants are rolled back.
+Holders refresh periodically; lockers expire stale entries so crashed
+holders release automatically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from .rest import RPCClient, RPCError, RPCServer
+
+LOCK_PREFIX = "/mtpu/lock/v1"
+DEFAULT_EXPIRY_S = 30.0
+REFRESH_INTERVAL_S = 10.0
+
+
+class LocalLocker:
+    """In-process lock table for one node (ref cmd/local-locker.go).
+
+    Entries: resource -> list of grants {uid, owner, writer, ts}.
+    """
+
+    def __init__(self, expiry_s: float = DEFAULT_EXPIRY_S):
+        self._mu = threading.Lock()
+        self._map: dict[str, list[dict]] = {}
+        self.expiry_s = expiry_s
+
+    def _expire(self, now: float):
+        for res in list(self._map):
+            grants = [
+                g for g in self._map[res]
+                if now - g["ts"] < self.expiry_s
+            ]
+            if grants:
+                self._map[res] = grants
+            else:
+                del self._map[res]
+
+    def lock(self, resource: str, uid: str, owner: str) -> bool:
+        now = time.time()
+        with self._mu:
+            self._expire(now)
+            if resource in self._map:
+                return False
+            self._map[resource] = [
+                {"uid": uid, "owner": owner, "writer": True, "ts": now}
+            ]
+            return True
+
+    def rlock(self, resource: str, uid: str, owner: str) -> bool:
+        now = time.time()
+        with self._mu:
+            self._expire(now)
+            grants = self._map.get(resource, [])
+            if any(g["writer"] for g in grants):
+                return False
+            grants.append(
+                {"uid": uid, "owner": owner, "writer": False, "ts": now}
+            )
+            self._map[resource] = grants
+            return True
+
+    def unlock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            grants = self._map.get(resource)
+            if not grants:
+                return False
+            kept = [g for g in grants if g["uid"] != uid]
+            if len(kept) == len(grants):
+                return False
+            if kept:
+                self._map[resource] = kept
+            else:
+                del self._map[resource]
+            return True
+
+    def refresh(self, resource: str, uid: str) -> bool:
+        now = time.time()
+        with self._mu:
+            self._expire(now)
+            for g in self._map.get(resource, []):
+                if g["uid"] == uid:
+                    g["ts"] = now
+                    return True
+            return False
+
+    def force_unlock(self, resource: str) -> bool:
+        with self._mu:
+            return self._map.pop(resource, None) is not None
+
+    def held(self, resource: str) -> list[dict]:
+        with self._mu:
+            self._expire(time.time())
+            return list(self._map.get(resource, []))
+
+
+class LockRESTServer:
+    """Expose a LocalLocker on the lock RPC plane."""
+
+    def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0,
+                 expiry_s: float = DEFAULT_EXPIRY_S):
+        self.locker = LocalLocker(expiry_s)
+        self.rpc = RPCServer(LOCK_PREFIX, secret, host, port)
+        for name in ("ping", "lock", "rlock", "unlock", "refresh",
+                     "force_unlock"):
+            self.rpc.register(name, getattr(self, f"_h_{name}"))
+
+    def start(self):
+        self.rpc.start()
+        return self
+
+    def stop(self):
+        self.rpc.stop()
+
+    @property
+    def endpoint(self) -> str:
+        return self.rpc.endpoint
+
+    def _h_ping(self, args, body):
+        return {"ok": True}
+
+    def _h_lock(self, args, body):
+        return {"ok": self.locker.lock(
+            args["resource"], args["uid"], args.get("owner", "")
+        )}
+
+    def _h_rlock(self, args, body):
+        return {"ok": self.locker.rlock(
+            args["resource"], args["uid"], args.get("owner", "")
+        )}
+
+    def _h_unlock(self, args, body):
+        return {"ok": self.locker.unlock(args["resource"], args["uid"])}
+
+    def _h_refresh(self, args, body):
+        return {"ok": self.locker.refresh(args["resource"], args["uid"])}
+
+    def _h_force_unlock(self, args, body):
+        return {"ok": self.locker.force_unlock(args["resource"])}
+
+
+class _LockerClient:
+    """One locker endpoint: either in-process (LocalLocker) or remote."""
+
+    def __init__(self, local: LocalLocker | None = None,
+                 endpoint: str = "", secret: str = ""):
+        self._local = local
+        self._client = (
+            None if local is not None
+            else RPCClient(endpoint, LOCK_PREFIX, secret, timeout=5.0)
+        )
+
+    def call(self, method: str, resource: str, uid: str, owner: str) -> bool:
+        if self._local is not None:
+            fn = getattr(self._local, method)
+            if method == "force_unlock":
+                return fn(resource)
+            if method in ("unlock", "refresh"):
+                return fn(resource, uid)
+            return fn(resource, uid, owner)
+        try:
+            return bool(self._client.call(method, {
+                "resource": resource, "uid": uid, "owner": owner,
+            })["ok"])
+        except RPCError:
+            return False
+
+
+class DRWMutex:
+    """Distributed RW mutex over N lockers with quorum + refresh
+    (ref pkg/dsync/drwmutex.go:56)."""
+
+    def __init__(self, lockers: list[_LockerClient], resource: str,
+                 owner: str = "", refresh_interval: float = REFRESH_INTERVAL_S):
+        self.lockers = lockers
+        self.resource = resource
+        self.owner = owner or str(uuid.uuid4())
+        self.uid = ""
+        self._writer = False
+        self._refresh_interval = refresh_interval
+        self._stop_refresh: threading.Event | None = None
+        self.lost = threading.Event()  # set when refresh quorum is lost
+
+    def _quorum(self, writer: bool) -> int:
+        n = len(self.lockers)
+        tolerance = n // 2
+        quorum = n - tolerance
+        if writer and quorum == tolerance:
+            quorum += 1  # ref drwmutex.go:130-138
+        return quorum
+
+    def _acquire(self, writer: bool, timeout: float) -> bool:
+        method = "lock" if writer else "rlock"
+        quorum = self._quorum(writer)
+        deadline = time.time() + timeout
+        while True:
+            uid = str(uuid.uuid4())
+            granted = [
+                loc.call(method, self.resource, uid, self.owner)
+                for loc in self.lockers
+            ]
+            if sum(granted) >= quorum:
+                self.uid = uid
+                self._writer = writer
+                self._start_refresh()
+                return True
+            # roll back partial grants (ref releaseAll :504)
+            for i, ok in enumerate(granted):
+                if ok:
+                    self.lockers[i].call(
+                        "unlock", self.resource, uid, self.owner
+                    )
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.01 + 0.04 * (time.time() % 1))  # jittered retry
+
+    def lock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(True, timeout)
+
+    def rlock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(False, timeout)
+
+    def unlock(self):
+        self._stop_refresh_loop()
+        for loc in self.lockers:
+            loc.call("unlock", self.resource, self.uid, self.owner)
+        self.uid = ""
+
+    def force_unlock(self):
+        self._stop_refresh_loop()
+        for loc in self.lockers:
+            loc.call("force_unlock", self.resource, "", self.owner)
+
+    # --- refresh loop (ref drwmutex.go:214-345) ---
+
+    def _start_refresh(self):
+        self.lost.clear()
+        stop = threading.Event()
+        self._stop_refresh = stop
+        uid = self.uid
+
+        def loop():
+            while not stop.wait(self._refresh_interval):
+                ok = sum(
+                    loc.call("refresh", self.resource, uid, self.owner)
+                    for loc in self.lockers
+                )
+                if ok < self._quorum(self._writer):
+                    # Lost the lock (e.g. lockers restarted / expired):
+                    # signal the owner to cancel its operation.
+                    self.lost.set()
+                    return
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+
+    def _stop_refresh_loop(self):
+        if self._stop_refresh is not None:
+            self._stop_refresh.set()
+            self._stop_refresh = None
+
+
+class Dsync:
+    """Factory bundling the cluster's locker endpoints
+    (ref pkg/dsync/dsync.go)."""
+
+    def __init__(self, local: LocalLocker | None = None,
+                 remote_endpoints: list[str] | None = None,
+                 secret: str = ""):
+        self.lockers: list[_LockerClient] = []
+        if local is not None:
+            self.lockers.append(_LockerClient(local=local))
+        for ep in remote_endpoints or []:
+            self.lockers.append(_LockerClient(endpoint=ep, secret=secret))
+
+    def new_mutex(self, resource: str, owner: str = "",
+                  refresh_interval: float = REFRESH_INTERVAL_S) -> DRWMutex:
+        return DRWMutex(self.lockers, resource, owner, refresh_interval)

@@ -1,0 +1,225 @@
+"""Peer control plane + bootstrap: the node mesh used for cache
+invalidation, cluster info collection, and the startup config-consistency
+handshake — behavioral parity with the reference's cmd/peer-rest-server.go
+/ cmd/peer-rest-client.go / cmd/notification.go (hub) and
+cmd/bootstrap-peer-server.go (verifyServerSystemConfig).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from .rest import RPCClient, RPCError, RPCServer
+
+PEER_PREFIX = "/mtpu/peer/v1"
+BOOTSTRAP_PREFIX = "/mtpu/bootstrap/v1"
+
+
+class PeerRESTServer:
+    """Serve this node's control-plane methods to the mesh."""
+
+    def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0,
+                 bucket_meta=None, iam=None, object_layer=None,
+                 lockers=None, trace=None):
+        self.bucket_meta = bucket_meta
+        self.iam = iam
+        self.object_layer = object_layer
+        self.lockers = lockers
+        self.trace = trace
+        self.started_ns = time.time_ns()
+        self.rpc = RPCServer(PEER_PREFIX, secret, host, port)
+        for name in ("ping", "load_bucket_metadata", "delete_bucket_metadata",
+                     "load_user", "load_policy", "server_info",
+                     "local_storage_info", "get_locks", "signal_service"):
+            self.rpc.register(name, getattr(self, f"_h_{name}"))
+
+    def start(self):
+        self.rpc.start()
+        return self
+
+    def stop(self):
+        self.rpc.stop()
+
+    @property
+    def endpoint(self) -> str:
+        return self.rpc.endpoint
+
+    # --- handlers ---
+
+    def _h_ping(self, args, body):
+        return {"ok": True}
+
+    def _h_load_bucket_metadata(self, args, body):
+        if self.bucket_meta is not None:
+            self.bucket_meta.invalidate(args["bucket"])
+        return {}
+
+    def _h_delete_bucket_metadata(self, args, body):
+        if self.bucket_meta is not None:
+            self.bucket_meta.invalidate(args["bucket"])
+        return {}
+
+    def _h_load_user(self, args, body):
+        if self.iam is not None:
+            self.iam.load()
+        return {}
+
+    def _h_load_policy(self, args, body):
+        if self.iam is not None:
+            self.iam.load()
+        return {}
+
+    def _h_server_info(self, args, body):
+        return {
+            "endpoint": self.endpoint,
+            "uptime_ns": time.time_ns() - self.started_ns,
+            "version": "minio-tpu/0.1",
+            "pid": os.getpid(),
+        }
+
+    def _h_local_storage_info(self, args, body):
+        if self.object_layer is None:
+            return {"disks": []}
+        disks = []
+        for pool in getattr(self.object_layer, "pools", []):
+            for d in pool.disks:
+                if d is None:
+                    continue
+                try:
+                    di = d.disk_info()
+                    disks.append({
+                        "endpoint": di.endpoint, "total": di.total,
+                        "free": di.free, "used": di.used, "error": "",
+                    })
+                except Exception as exc:  # noqa: BLE001 - per-disk status
+                    disks.append({"endpoint": d.endpoint(), "error": str(exc)})
+        return {"disks": disks}
+
+    def _h_get_locks(self, args, body):
+        if self.lockers is None:
+            return {"locks": {}}
+        return {"locks": {
+            res: [
+                {"owner": g["owner"], "writer": g["writer"], "ts": g["ts"]}
+                for g in self.lockers.held(res)
+            ]
+            for res in list(self.lockers._map)
+        }}
+
+    def _h_signal_service(self, args, body):
+        # restart/stop signaling is a host-process concern; recorded only.
+        return {"signal": args.get("signal", ""), "accepted": True}
+
+
+class PeerClient:
+    """RPC client for one peer (ref cmd/peer-rest-client.go)."""
+
+    def __init__(self, endpoint: str, secret: str):
+        self.endpoint = endpoint
+        self._c = RPCClient(endpoint, PEER_PREFIX, secret, timeout=10.0)
+
+    def call(self, method: str, args: dict | None = None):
+        return self._c.call(method, args)
+
+    @property
+    def online(self) -> bool:
+        return self._c.online
+
+
+class NotificationSys:
+    """Fan-out hub over all peers (ref cmd/notification.go:1556 — the
+    name is historical; it is the peer-broadcast mechanism)."""
+
+    def __init__(self, peers: list[PeerClient]):
+        self.peers = peers
+
+    def _broadcast(self, method: str, args: dict | None = None) -> list:
+        out = []
+        for p in self.peers:
+            try:
+                out.append(p.call(method, args))
+            except RPCError as exc:
+                out.append(exc)
+        return out
+
+    def load_bucket_metadata(self, bucket: str):
+        self._broadcast("load_bucket_metadata", {"bucket": bucket})
+
+    def delete_bucket_metadata(self, bucket: str):
+        self._broadcast("delete_bucket_metadata", {"bucket": bucket})
+
+    def load_user(self):
+        self._broadcast("load_user")
+
+    def server_info(self) -> list[dict]:
+        return [
+            r for r in self._broadcast("server_info")
+            if not isinstance(r, Exception)
+        ]
+
+    def storage_info(self) -> list[dict]:
+        return [
+            r for r in self._broadcast("local_storage_info")
+            if not isinstance(r, Exception)
+        ]
+
+    def get_locks(self) -> list[dict]:
+        return [
+            r for r in self._broadcast("get_locks")
+            if not isinstance(r, Exception)
+        ]
+
+
+class BootstrapServer:
+    """Startup config handshake endpoint
+    (ref cmd/bootstrap-peer-server.go:37 /verify)."""
+
+    def __init__(self, secret: str, config: dict,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.rpc = RPCServer(BOOTSTRAP_PREFIX, secret, host, port)
+        self.rpc.register("verify", self._h_verify)
+
+    def start(self):
+        self.rpc.start()
+        return self
+
+    def stop(self):
+        self.rpc.stop()
+
+    @property
+    def endpoint(self) -> str:
+        return self.rpc.endpoint
+
+    def _h_verify(self, args, body):
+        return dict(self.config)
+
+
+def verify_cluster_config(local_config: dict, peer_endpoints: list[str],
+                          secret: str, retries: int = 30,
+                          delay_s: float = 0.2) -> None:
+    """Loop until every peer reports an identical config fingerprint
+    (ref cmd/server-main.go:446-460 verifyServerSystemConfig loop).
+    Raises RuntimeError on persistent mismatch/unreachable peers."""
+    last_err = None
+    for _ in range(retries):
+        ok = True
+        for ep in peer_endpoints:
+            client = RPCClient(ep, BOOTSTRAP_PREFIX, secret, timeout=5.0)
+            try:
+                remote = client.call("verify")
+            except RPCError as exc:
+                ok = False
+                last_err = f"{ep} unreachable: {exc}"
+                break
+            if remote != local_config:
+                ok = False
+                last_err = (
+                    f"{ep} config mismatch: {remote} != {local_config}"
+                )
+                break
+        if ok:
+            return
+        time.sleep(delay_s)
+    raise RuntimeError(f"cluster config verification failed: {last_err}")

@@ -1,0 +1,244 @@
+"""Generic node-to-node RPC: authed POST endpoints with msgpack bodies,
+connection pooling, health checking — the equivalent of the reference's
+cmd/rest/client.go (bearer-JWT authed per-method POSTs) re-designed on
+Python http primitives with HMAC tokens.
+
+All three distributed planes (storage, lock, peer-control) ride on this.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import msgpack
+
+TOKEN_VALIDITY_S = 15 * 60
+
+
+class RPCError(Exception):
+    """Remote call failed; carries the remote error type name for
+    re-raising typed storage errors client-side."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.message = message
+
+
+def make_token(secret: str, now: float | None = None) -> str:
+    """HMAC cluster token: base64(payload).hexsig (the reference uses
+    JWT with the root credential as signing key, cmd/rest/client.go:128)."""
+    payload = json.dumps({
+        "exp": (now or time.time()) + TOKEN_VALIDITY_S,
+    }).encode()
+    b64 = base64.urlsafe_b64encode(payload).decode()
+    sig = hmac.new(secret.encode(), b64.encode(), hashlib.sha256).hexdigest()
+    return f"{b64}.{sig}"
+
+
+def verify_token(secret: str, token: str) -> bool:
+    try:
+        b64, sig = token.split(".", 1)
+    except ValueError:
+        return False
+    want = hmac.new(secret.encode(), b64.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, sig):
+        return False
+    try:
+        payload = json.loads(base64.urlsafe_b64decode(b64))
+    except Exception:
+        return False
+    return payload.get("exp", 0) > time.time()
+
+
+class RPCServer:
+    """HTTP server exposing named methods under a version prefix.
+
+    Handlers: fn(args: dict, body: bytes) -> (result, stream) where
+    result is msgpack-encoded and stream (optional file-like) is sent as
+    the raw response body after the msgpack frame length header.
+    """
+
+    def __init__(self, prefix: str, secret: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.prefix = prefix.rstrip("/")
+        self.secret = secret
+        self._methods: dict = {}
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                outer._handle(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    def register(self, name: str, fn):
+        self._methods[name] = fn
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _handle(self, h: BaseHTTPRequestHandler):
+        parsed = urllib.parse.urlsplit(h.path)
+        if not parsed.path.startswith(self.prefix + "/"):
+            self._reply_error(h, 404, "NotFound", parsed.path)
+            return
+        token = h.headers.get("Authorization", "").removeprefix("Bearer ")
+        if not verify_token(self.secret, token):
+            self._reply_error(h, 403, "AccessDenied", "bad cluster token")
+            return
+        method = parsed.path[len(self.prefix) + 1:]
+        fn = self._methods.get(method)
+        if fn is None:
+            self._reply_error(h, 404, "UnknownMethod", method)
+            return
+        args = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        clen = int(h.headers.get("Content-Length", "0") or "0")
+        body = h.rfile.read(clen) if clen else b""
+        try:
+            out = fn(args, body)
+        except Exception as exc:  # noqa: BLE001 - typed error to client
+            self._reply_error(h, 500, type(exc).__name__, str(exc))
+            return
+        result, stream = out if isinstance(out, tuple) else (out, None)
+        frame = msgpack.packb(result, use_bin_type=True)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/x-msgpack")
+            h.send_header("X-Frame-Length", str(len(frame)))
+            if stream is None:
+                h.send_header("Content-Length", str(len(frame)))
+                h.end_headers()
+                h.wfile.write(frame)
+            else:
+                data = stream.read() if hasattr(stream, "read") else bytes(stream)
+                h.send_header("Content-Length", str(len(frame) + len(data)))
+                h.end_headers()
+                h.wfile.write(frame)
+                h.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _reply_error(self, h, status: int, kind: str, message: str):
+        try:
+            body = msgpack.packb(
+                {"__error__": kind, "message": message}, use_bin_type=True
+            )
+            h.send_response(status)
+            h.send_header("Content-Type", "application/x-msgpack")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class RPCClient:
+    """Pooled, health-checked client for one peer's RPC plane
+    (ref cmd/rest/client.go:120-188 Call + health check loop)."""
+
+    def __init__(self, endpoint: str, prefix: str, secret: str,
+                 timeout: float = 30.0):
+        self.endpoint_str = endpoint
+        self.prefix = prefix.rstrip("/")
+        self.secret = secret
+        self.timeout = timeout
+        self._online = True
+        self._last_check = 0.0
+        self._lock = threading.Lock()
+        self._pool: list[http.client.HTTPConnection] = []
+
+    # --- connection pool ---
+
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(
+            self.endpoint_str, timeout=self.timeout
+        )
+
+    def _put_conn(self, conn):
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    # --- health ---
+
+    @property
+    def online(self) -> bool:
+        if not self._online and time.time() - self._last_check > 1.0:
+            # lazy reconnect probe (ref: HealthCheckFn + 1s backoff)
+            self._last_check = time.time()
+            try:
+                self.call("ping")
+                self._online = True
+            except Exception:
+                pass
+        return self._online
+
+    def mark_offline(self):
+        self._online = False
+        self._last_check = time.time()
+
+    # --- calls ---
+
+    def call(self, method: str, args: dict | None = None,
+             body: bytes = b"", want_stream: bool = False):
+        """POST one method. Returns the msgpack result, or
+        (result, raw_rest_of_body) when want_stream."""
+        qs = urllib.parse.urlencode(args or {})
+        url = f"{self.prefix}/{method}" + (f"?{qs}" if qs else "")
+        headers = {
+            "Authorization": f"Bearer {make_token(self.secret)}",
+            "Content-Length": str(len(body)),
+        }
+        conn = self._get_conn()
+        try:
+            conn.request("POST", url, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            self._put_conn(conn)
+        except (OSError, http.client.HTTPException) as exc:
+            conn.close()
+            self.mark_offline()
+            raise RPCError("Unreachable", str(exc)) from exc
+        frame_len = int(resp.headers.get("X-Frame-Length", len(raw)))
+        result = msgpack.unpackb(raw[:frame_len], raw=False)
+        if isinstance(result, dict) and "__error__" in result:
+            raise RPCError(result["__error__"], result.get("message", ""))
+        if want_stream:
+            return result, raw[frame_len:]
+        return result

@@ -1,0 +1,521 @@
+"""Storage plane: every node serves its local disks to peers over RPC,
+and RemoteStorage makes a peer disk look like a local StorageAPI —
+behavioral parity with the reference's cmd/storage-rest-server.go /
+cmd/storage-rest-client.go (34 StorageAPI methods over per-method
+endpoints, msgpack args, streamed file bodies).
+"""
+
+from __future__ import annotations
+
+import io
+
+from ..storage.fileinfo import FileInfo
+from ..storage.interface import DiskInfo, FileInfoVersions, StorageAPI, VolInfo
+from ..utils import errors as oe
+from .rest import RPCClient, RPCError, RPCServer
+
+STORAGE_PREFIX = "/mtpu/storage/v1"
+
+# Typed errors cross the wire by class name (the reference ships error
+# strings and rehydrates with toStorageErr, cmd/storage-rest-client.go).
+_ERR_TYPES = {
+    cls.__name__: cls
+    for cls in vars(oe).values()
+    if isinstance(cls, type) and issubclass(cls, Exception)
+}
+
+
+def _rehydrate(exc: RPCError) -> Exception:
+    cls = _ERR_TYPES.get(exc.kind)
+    if cls is not None:
+        return cls(exc.message)
+    if exc.kind == "Unreachable":
+        return oe.ErrDiskNotFound(exc.message)
+    return exc
+
+
+def _fi_pack(fi: FileInfo) -> dict:
+    return fi.to_dict()
+
+
+class StorageRESTServer:
+    """Expose a set of local disks at /mtpu/storage/v1/<method>?disk=N."""
+
+    def __init__(self, disks: list, secret: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.disks = {d.endpoint(): d for d in disks}
+        self.rpc = RPCServer(STORAGE_PREFIX, secret, host, port)
+        for name in (
+            "ping", "disk_info", "get_disk_id", "set_disk_id", "make_vol",
+            "make_vol_bulk", "list_vols", "stat_vol", "delete_vol",
+            "list_dir", "walk_dir", "delete_version", "delete_versions",
+            "write_metadata", "update_metadata", "read_version",
+            "rename_data", "list_versions", "read_file", "append_file",
+            "create_file", "read_file_stream", "rename_file", "check_parts",
+            "check_file", "delete", "verify_file", "write_all", "read_all",
+        ):
+            self.rpc.register(name, getattr(self, f"_h_{name}"))
+
+    def start(self):
+        self.rpc.start()
+        return self
+
+    def stop(self):
+        self.rpc.stop()
+
+    @property
+    def endpoint(self) -> str:
+        return self.rpc.endpoint
+
+    def _disk(self, args: dict):
+        d = self.disks.get(args.get("disk", ""))
+        if d is None:
+            raise oe.ErrDiskNotFound(args.get("disk", ""))
+        return d
+
+    # --- handlers ---
+
+    def _h_ping(self, args, body):
+        return {"ok": True}
+
+    def _h_disk_info(self, args, body):
+        di = self._disk(args).disk_info()
+        return {
+            "total": di.total, "free": di.free, "used": di.used,
+            "fs_type": di.fs_type, "endpoint": di.endpoint,
+            "mount_path": di.mount_path, "id": di.id, "error": di.error,
+            "healing": di.healing,
+        }
+
+    def _h_get_disk_id(self, args, body):
+        return {"id": self._disk(args).get_disk_id()}
+
+    def _h_set_disk_id(self, args, body):
+        self._disk(args).set_disk_id(args["id"])
+        return {}
+
+    def _h_make_vol(self, args, body):
+        self._disk(args).make_vol(args["volume"])
+        return {}
+
+    def _h_make_vol_bulk(self, args, body):
+        import msgpack
+
+        self._disk(args).make_vol_bulk(*msgpack.unpackb(body, raw=False))
+        return {}
+
+    def _h_list_vols(self, args, body):
+        return {
+            "vols": [
+                {"name": v.name, "created_ns": v.created_ns}
+                for v in self._disk(args).list_vols()
+            ]
+        }
+
+    def _h_stat_vol(self, args, body):
+        v = self._disk(args).stat_vol(args["volume"])
+        return {"name": v.name, "created_ns": v.created_ns}
+
+    def _h_delete_vol(self, args, body):
+        self._disk(args).delete_vol(
+            args["volume"], args.get("force") == "1"
+        )
+        return {}
+
+    def _h_list_dir(self, args, body):
+        return {
+            "entries": self._disk(args).list_dir(
+                args["volume"], args.get("dir", ""),
+                int(args.get("count", "-1")),
+            )
+        }
+
+    def _h_walk_dir(self, args, body):
+        entries = list(self._disk(args).walk_dir(
+            args["volume"], args.get("base", ""),
+            args.get("recursive", "1") == "1",
+        ))
+        return {"entries": entries}
+
+    def _h_delete_version(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).delete_version(
+            args["volume"], args["path"], fi,
+            args.get("force_del_marker") == "1",
+        )
+        return {}
+
+    def _h_delete_versions(self, args, body):
+        import msgpack
+
+        fis = [
+            FileInfo.from_dict(d)
+            for d in msgpack.unpackb(body, raw=False)
+        ]
+        errs = self._disk(args).delete_versions(args["volume"], fis)
+        return {
+            "errors": [
+                None if e is None else {
+                    "kind": type(e).__name__, "message": str(e)
+                }
+                for e in errs
+            ]
+        }
+
+    def _h_write_metadata(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).write_metadata(args["volume"], args["path"], fi)
+        return {}
+
+    def _h_update_metadata(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).update_metadata(args["volume"], args["path"], fi)
+        return {}
+
+    def _h_read_version(self, args, body):
+        fi = self._disk(args).read_version(
+            args["volume"], args["path"], args.get("version_id", ""),
+            args.get("read_data") == "1",
+        )
+        return _fi_pack(fi)
+
+    def _h_rename_data(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).rename_data(
+            args["src_volume"], args["src_path"], fi,
+            args["dst_volume"], args["dst_path"],
+        )
+        return {}
+
+    def _h_list_versions(self, args, body):
+        fv = self._disk(args).list_versions(args["volume"], args["path"])
+        return {
+            "volume": fv.volume, "name": fv.name,
+            "versions": [_fi_pack(f) for f in fv.versions],
+        }
+
+    def _h_read_file(self, args, body):
+        data = self._disk(args).read_file(
+            args["volume"], args["path"],
+            int(args["offset"]), int(args["length"]),
+        )
+        return {"n": len(data)}, io.BytesIO(data)
+
+    def _h_append_file(self, args, body):
+        self._disk(args).append_file(args["volume"], args["path"], body)
+        return {}
+
+    def _h_create_file(self, args, body):
+        self._disk(args).create_file(
+            args["volume"], args["path"], int(args["size"]),
+            io.BytesIO(body),
+        )
+        return {}
+
+    def _h_read_file_stream(self, args, body):
+        stream = self._disk(args).read_file_stream(
+            args["volume"], args["path"],
+            int(args["offset"]), int(args["length"]),
+        )
+        try:
+            data = stream.read()
+        finally:
+            close = getattr(stream, "close", None)
+            if close:
+                close()
+        return {"n": len(data)}, io.BytesIO(data)
+
+    def _h_rename_file(self, args, body):
+        self._disk(args).rename_file(
+            args["src_volume"], args["src_path"],
+            args["dst_volume"], args["dst_path"],
+        )
+        return {}
+
+    def _h_check_parts(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).check_parts(args["volume"], args["path"], fi)
+        return {}
+
+    def _h_check_file(self, args, body):
+        self._disk(args).check_file(args["volume"], args["path"])
+        return {}
+
+    def _h_delete(self, args, body):
+        self._disk(args).delete(
+            args["volume"], args["path"], args.get("recursive") == "1"
+        )
+        return {}
+
+    def _h_verify_file(self, args, body):
+        import msgpack
+
+        fi = FileInfo.from_dict(msgpack.unpackb(body, raw=False))
+        self._disk(args).verify_file(args["volume"], args["path"], fi)
+        return {}
+
+    def _h_write_all(self, args, body):
+        self._disk(args).write_all(args["volume"], args["path"], body)
+        return {}
+
+    def _h_read_all(self, args, body):
+        data = self._disk(args).read_all(args["volume"], args["path"])
+        return {"n": len(data)}, io.BytesIO(data)
+
+
+class _RemoteWriter:
+    """Buffering writable sink for create_file_writer over the wire. The
+    reference streams via io.Pipe into CreateFile's request body
+    (cmd/bitrot-streaming.go:89-97); shard files are ≤ a few MiB per part
+    so a buffered single POST keeps the wire protocol simple."""
+
+    def __init__(self, client: "RemoteStorage", volume: str, path: str):
+        self._c = client
+        self._volume = volume
+        self._path = path
+        self._buf = bytearray()
+        self.closed = False
+
+    def write(self, data) -> int:
+        self._buf += bytes(data)
+        return len(data)
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        self._c.create_file(
+            self._volume, self._path, len(self._buf), io.BytesIO(bytes(self._buf))
+        )
+
+
+class RemoteStorage(StorageAPI):
+    """StorageAPI over the storage REST plane (one peer disk)."""
+
+    def __init__(self, node_endpoint: str, disk_endpoint: str, secret: str,
+                 timeout: float = 30.0):
+        self._node = node_endpoint
+        self._disk_ep = disk_endpoint
+        self._client = RPCClient(
+            node_endpoint, STORAGE_PREFIX, secret, timeout
+        )
+
+    def _call(self, method: str, args: dict | None = None,
+              body: bytes = b"", want_stream: bool = False):
+        a = {"disk": self._disk_ep}
+        a.update(args or {})
+        try:
+            return self._client.call(method, a, body, want_stream)
+        except RPCError as exc:
+            raise _rehydrate(exc) from exc
+
+    # --- identity ---
+
+    def is_online(self) -> bool:
+        return self._client.online
+
+    def is_local(self) -> bool:
+        return False
+
+    def hostname(self) -> str:
+        return self._node
+
+    def endpoint(self) -> str:
+        return f"{self._node}/{self._disk_ep}"
+
+    def get_disk_id(self) -> str:
+        return self._call("get_disk_id")["id"]
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._call("set_disk_id", {"id": disk_id})
+
+    def disk_info(self) -> DiskInfo:
+        d = self._call("disk_info")
+        return DiskInfo(
+            total=d["total"], free=d["free"], used=d["used"],
+            fs_type=d["fs_type"], endpoint=self.endpoint(),
+            mount_path=d["mount_path"], id=d["id"], error=d["error"],
+            healing=d["healing"],
+        )
+
+    # --- volumes ---
+
+    def make_vol(self, volume: str) -> None:
+        self._call("make_vol", {"volume": volume})
+
+    def make_vol_bulk(self, *volumes: str) -> None:
+        import msgpack
+
+        self._call("make_vol_bulk", body=msgpack.packb(list(volumes)))
+
+    def list_vols(self) -> list[VolInfo]:
+        return [
+            VolInfo(v["name"], v["created_ns"])
+            for v in self._call("list_vols")["vols"]
+        ]
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        v = self._call("stat_vol", {"volume": volume})
+        return VolInfo(v["name"], v["created_ns"])
+
+    def delete_vol(self, volume: str, force_delete: bool = False) -> None:
+        self._call("delete_vol", {
+            "volume": volume, "force": "1" if force_delete else "0",
+        })
+
+    # --- listing ---
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        return self._call("list_dir", {
+            "volume": volume, "dir": dir_path, "count": str(count),
+        })["entries"]
+
+    def walk_dir(self, volume: str, base_dir: str = "", recursive: bool = True,
+                 report_notfound: bool = False, forward_to: str = ""):
+        for e in self._call("walk_dir", {
+            "volume": volume, "base": base_dir,
+            "recursive": "1" if recursive else "0",
+        })["entries"]:
+            yield tuple(e)  # msgpack turns (path, meta_bytes) into a list
+
+    # --- metadata ---
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None:
+        import msgpack
+
+        self._call("delete_version", {
+            "volume": volume, "path": path,
+            "force_del_marker": "1" if force_del_marker else "0",
+        }, msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    def delete_versions(self, volume: str, versions: list[FileInfo]) -> list:
+        import msgpack
+
+        res = self._call(
+            "delete_versions", {"volume": volume},
+            msgpack.packb([_fi_pack(f) for f in versions], use_bin_type=True),
+        )
+        out = []
+        for e in res["errors"]:
+            if e is None:
+                out.append(None)
+            else:
+                cls = _ERR_TYPES.get(e["kind"], oe.StorageError)
+                out.append(cls(e["message"]))
+        return out
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        import msgpack
+
+        self._call("write_metadata", {"volume": volume, "path": path},
+                   msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        import msgpack
+
+        self._call("update_metadata", {"volume": volume, "path": path},
+                   msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        d = self._call("read_version", {
+            "volume": volume, "path": path, "version_id": version_id,
+            "read_data": "1" if read_data else "0",
+        })
+        return FileInfo.from_dict(d)
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        import msgpack
+
+        self._call("rename_data", {
+            "src_volume": src_volume, "src_path": src_path,
+            "dst_volume": dst_volume, "dst_path": dst_path,
+        }, msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    # --- files ---
+
+    def list_versions(self, volume: str, path: str) -> FileInfoVersions:
+        d = self._call("list_versions", {"volume": volume, "path": path})
+        return FileInfoVersions(
+            volume=d["volume"], name=d["name"],
+            versions=[FileInfo.from_dict(v) for v in d["versions"]],
+        )
+
+    def read_file(self, volume: str, path: str, offset: int,
+                  length: int) -> bytes:
+        _, data = self._call("read_file", {
+            "volume": volume, "path": path,
+            "offset": str(offset), "length": str(length),
+        }, want_stream=True)
+        return data
+
+    def append_file(self, volume: str, path: str, buf: bytes) -> None:
+        self._call("append_file", {"volume": volume, "path": path}, bytes(buf))
+
+    def create_file(self, volume: str, path: str, size: int, reader) -> None:
+        data = reader.read() if hasattr(reader, "read") else bytes(reader)
+        self._call("create_file", {
+            "volume": volume, "path": path, "size": str(size),
+        }, data)
+
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int):
+        _, data = self._call("read_file_stream", {
+            "volume": volume, "path": path,
+            "offset": str(offset), "length": str(length),
+        }, want_stream=True)
+        return io.BytesIO(data)
+
+    def create_file_writer(self, volume: str, path: str):
+        return _RemoteWriter(self, volume, path)
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        self._call("rename_file", {
+            "src_volume": src_volume, "src_path": src_path,
+            "dst_volume": dst_volume, "dst_path": dst_path,
+        })
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        import msgpack
+
+        self._call("check_parts", {"volume": volume, "path": path},
+                   msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    def check_file(self, volume: str, path: str) -> None:
+        self._call("check_file", {"volume": volume, "path": path})
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        self._call("delete", {
+            "volume": volume, "path": path,
+            "recursive": "1" if recursive else "0",
+        })
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        import msgpack
+
+        self._call("verify_file", {"volume": volume, "path": path},
+                   msgpack.packb(_fi_pack(fi), use_bin_type=True))
+
+    def stat_info_file(self, volume: str, path: str):
+        raise NotImplementedError
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._call("write_all", {"volume": volume, "path": path}, bytes(data))
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        _, data = self._call("read_all", {"volume": volume, "path": path},
+                             want_stream=True)
+        return data

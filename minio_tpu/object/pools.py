@@ -252,6 +252,29 @@ class ErasureServerPools:
     def heal_bucket(self, bucket):
         return [p.heal_bucket(bucket) for p in self.pools]
 
+    def health(self) -> bool:
+        """Cluster can serve writes: every erasure set in every pool has at
+        least write-quorum online disks (ref cmd/erasure-server-pool.go:
+        1705-1786 Health maintenance check, simplified to the quorum
+        predicate)."""
+        for pool in self.pools:
+            for es in pool.sets:
+                online = 0
+                for d in es.disks:
+                    if d is None:
+                        continue
+                    try:
+                        if d.is_online():
+                            online += 1
+                    except Exception:  # noqa: BLE001 - offline disk probe
+                        continue
+                write_quorum = len(es.disks) - es.default_parity
+                if es.default_parity == len(es.disks) - es.default_parity:
+                    write_quorum += 1
+                if online < write_quorum:
+                    return False
+        return True
+
     def heal_format(self):
         for pool in self.pools:
             pool.init_format()

@@ -1,0 +1,9 @@
+"""Observability: metrics registry with Prometheus exposition, in-proc
+pub/sub, HTTP call tracing, structured logging (reference:
+cmd/metrics-v2.go, pkg/pubsub, cmd/http-tracer.go, cmd/logger)."""
+
+from .metrics import Metrics
+from .pubsub import PubSub
+from .trace import Logger, TraceHub
+
+__all__ = ["Logger", "Metrics", "PubSub", "TraceHub"]

@@ -1,0 +1,131 @@
+"""Metrics registry: counters/gauges/histograms with label sets and
+Prometheus text exposition — the equivalent of the reference's typed
+metric descriptors + /minio/v2/metrics/{cluster,node} endpoints
+(cmd/metrics-v2.go, cmd/metrics-router.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Metrics:
+    """Thread-safe registry. Metric names follow prometheus conventions
+    with the `mtpu_` namespace."""
+
+    HISTOGRAM_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+    )
+
+    def __init__(self, namespace: str = "mtpu"):
+        self.namespace = namespace
+        self._mu = threading.Lock()
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
+        self._hists: dict[str, dict[tuple, list]] = {}
+        self._help: dict[str, str] = {}
+        self.started = time.time()
+
+    def describe(self, name: str, help_text: str):
+        self._help[name] = help_text
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        with self._mu:
+            series = self._counters.setdefault(name, {})
+            key = _label_key(labels)
+            series[key] = series.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._mu:
+            self._gauges.setdefault(name, {})[_label_key(labels)] = value
+
+    def observe(self, name: str, value: float, **labels):
+        with self._mu:
+            series = self._hists.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = [0] * (len(self.HISTOGRAM_BUCKETS) + 1) + [0.0, 0]
+            h = series[key]
+            for i, b in enumerate(self.HISTOGRAM_BUCKETS):
+                if value <= b:
+                    h[i] += 1
+                    break
+            else:
+                h[len(self.HISTOGRAM_BUCKETS)] += 1
+            h[-2] += value  # sum
+            h[-1] += 1      # count
+
+    def time(self, name: str, **labels):
+        """Context manager observing elapsed seconds into a histogram."""
+        metrics = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metrics.observe(
+                    name, time.perf_counter() - self.t0, **labels
+                )
+                return False
+
+        return _Timer()
+
+    # --- snapshot / exposition ---
+
+    def counter_value(self, name: str, **labels) -> float:
+        with self._mu:
+            return self._counters.get(name, {}).get(_label_key(labels), 0.0)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text format v0.0.4."""
+        ns = self.namespace
+        out: list[str] = []
+
+        def fmt_labels(key: tuple, extra: dict | None = None) -> str:
+            items = list(key) + sorted((extra or {}).items())
+            if not items:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in items)
+            return "{" + inner + "}"
+
+        with self._mu:
+            for name, series in sorted(self._counters.items()):
+                full = f"{ns}_{name}"
+                if name in self._help:
+                    out.append(f"# HELP {full} {self._help[name]}")
+                out.append(f"# TYPE {full} counter")
+                for key, v in sorted(series.items()):
+                    out.append(f"{full}{fmt_labels(key)} {v}")
+            for name, series in sorted(self._gauges.items()):
+                full = f"{ns}_{name}"
+                if name in self._help:
+                    out.append(f"# HELP {full} {self._help[name]}")
+                out.append(f"# TYPE {full} gauge")
+                for key, v in sorted(series.items()):
+                    out.append(f"{full}{fmt_labels(key)} {v}")
+            for name, series in sorted(self._hists.items()):
+                full = f"{ns}_{name}"
+                out.append(f"# TYPE {full} histogram")
+                for key, h in sorted(series.items()):
+                    cum = 0
+                    for i, b in enumerate(self.HISTOGRAM_BUCKETS):
+                        cum += h[i]
+                        out.append(
+                            f"{full}_bucket{fmt_labels(key, {'le': b})} {cum}"
+                        )
+                    cum += h[len(self.HISTOGRAM_BUCKETS)]
+                    out.append(
+                        f"{full}_bucket{fmt_labels(key, {'le': '+Inf'})} {cum}"
+                    )
+                    out.append(f"{full}_sum{fmt_labels(key)} {h[-2]}")
+                    out.append(f"{full}_count{fmt_labels(key)} {h[-1]}")
+            out.append(f"# TYPE {ns}_uptime_seconds gauge")
+            out.append(f"{ns}_uptime_seconds {time.time() - self.started}")
+        return "\n".join(out) + "\n"

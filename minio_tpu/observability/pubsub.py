@@ -1,0 +1,41 @@
+"""In-process pub/sub bus (ref pkg/pubsub/pubsub.go): bounded
+subscriber queues, non-blocking publish (slow subscribers drop)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class PubSub:
+    def __init__(self, max_queue: int = 1000):
+        self._mu = threading.Lock()
+        self._subs: list[queue.Queue] = []
+        self._max_queue = max_queue
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(self._max_queue)
+        with self._mu:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue):
+        with self._mu:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
+    def publish(self, item):
+        with self._mu:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                pass  # drop for slow subscribers (ref pubsub.go Publish)
+
+    @property
+    def num_subscribers(self) -> int:
+        with self._mu:
+            return len(self._subs)

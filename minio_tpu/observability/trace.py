@@ -1,0 +1,73 @@
+"""HTTP call tracing: every API call publishes a trace.Info-shaped dict
+to the trace bus; `mc admin trace`-style consumers subscribe (reference:
+cmd/http-tracer.go:182-257, pkg/trace). Also a structured logger with a
+deduplicating LogIf (cmd/logger/logonce.go)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+from .pubsub import PubSub
+
+
+class TraceHub:
+    """Trace bus. publish() takes a dict with at least api/method/path."""
+
+    def __init__(self):
+        self.bus = PubSub()
+
+    def publish(self, info: dict):
+        if self.bus.num_subscribers == 0:
+            return  # tracing is free when nobody listens (ref Trace())
+        info = dict(info)
+        info.setdefault("time_ns", time.time_ns())
+        self.bus.publish(info)
+
+    def subscribe(self):
+        return self.bus.subscribe()
+
+    def unsubscribe(self, q):
+        self.bus.unsubscribe(q)
+
+
+class Logger:
+    """Structured JSON logger with once-per-error dedup
+    (ref cmd/logger LogIf + logonce.go)."""
+
+    def __init__(self, stream=None):
+        self._stream = stream or sys.stderr
+        self._mu = threading.Lock()
+        self._seen: dict[str, float] = {}
+
+    def log(self, level: str, message: str, **fields):
+        entry = {
+            "level": level,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "message": message,
+        }
+        entry.update(fields)
+        with self._mu:
+            self._stream.write(json.dumps(entry) + "\n")
+
+    def info(self, message: str, **fields):
+        self.log("INFO", message, **fields)
+
+    def error(self, message: str, **fields):
+        self.log("ERROR", message, **fields)
+
+    def log_once_if(self, err: Exception | None, context: str = "",
+                    interval_s: float = 30.0):
+        """Log an error at most once per interval per (type, context)."""
+        if err is None:
+            return
+        key = f"{type(err).__name__}:{context}"
+        now = time.time()
+        with self._mu:
+            last = self._seen.get(key, 0.0)
+            if now - last < interval_s:
+                return
+            self._seen[key] = now
+        self.error(str(err), context=context, error=type(err).__name__)

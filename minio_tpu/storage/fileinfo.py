@@ -196,7 +196,8 @@ class FileInfo:
             "meta": dict(self.metadata),
             "parts": [p.to_dict() for p in self.parts],
             "er": self.erasure.to_dict(),
-            "data": {int(k): bytes(v) for k, v in self.data.items()},
+            # str keys: msgpack (strict_map_key) and json both reject ints
+            "data": {str(k): bytes(v) for k, v in self.data.items()},
         }
 
     @classmethod

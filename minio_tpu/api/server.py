@@ -299,6 +299,17 @@ class S3Server:
             )
             self.admin.authorize(auth_result, "metrics_snapshot")
             return self.admin.metrics_snapshot(ctx)
+        # STS plane: POST / with form-encoded AssumeRole
+        # (ref cmd/sts-handlers.go:71 registerSTSRouter)
+        from .sts import handle_sts, is_sts_request
+
+        if is_sts_request(ctx):
+            auth_result = authenticate(
+                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+            )
+            if auth_result.is_anonymous:
+                raise S3Error("AccessDenied", "STS requires signature")
+            return handle_sts(ctx, self.iam, auth_result.access_key)
         # Admin plane (streaming bodies are an S3-data-plane mechanism;
         # the admin plane rejects them rather than parse chunk framing)
         if ctx.path.startswith(ADMIN_PREFIX):

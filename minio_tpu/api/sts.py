@@ -1,0 +1,65 @@
+"""STS: temporary credentials via AssumeRole — behavioral parity with
+the reference's cmd/sts-handlers.go:149 (AssumeRole with SigV4-signed
+POST form body, optional inline session Policy, DurationSeconds), minus
+the OIDC/LDAP federation flows (identity_openid / identity_ldap config
+gates exist; their token exchanges need an external IdP).
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..iam import IAMSys, Policy
+from .errors import S3Error
+from .handlers import Response, iso8601
+
+STS_VERSION = "2011-06-15"
+MIN_DURATION_S = 900
+MAX_DURATION_S = 7 * 24 * 3600
+
+
+def is_sts_request(ctx) -> bool:
+    """POST / with a form body carrying Action=AssumeRole*."""
+    if ctx.method != "POST" or ctx.bucket:
+        return False
+    ctype = ctx.headers.get("content-type", "")
+    return "x-www-form-urlencoded" in ctype
+
+
+def handle_sts(ctx, iam: IAMSys, access_key: str) -> Response:
+    form = dict(urllib.parse.parse_qsl(ctx.body.decode()))
+    action = form.get("Action", "")
+    if action != "AssumeRole":
+        raise S3Error("NotImplemented", f"STS action {action!r}")
+    if form.get("Version") != STS_VERSION:
+        raise S3Error("InvalidArgument", "missing STS Version")
+    try:
+        duration = int(form.get("DurationSeconds", "3600"))
+    except ValueError as exc:
+        raise S3Error("InvalidArgument", "DurationSeconds") from exc
+    if not MIN_DURATION_S <= duration <= MAX_DURATION_S:
+        raise S3Error("InvalidArgument", f"DurationSeconds {duration}")
+    session_policy = None
+    if form.get("Policy"):
+        try:
+            session_policy = Policy.parse(form["Policy"])
+        except (ValueError, KeyError) as exc:
+            raise S3Error("MalformedXML", f"session policy: {exc}") from exc
+        if len(form["Policy"]) > 2048:
+            raise S3Error("InvalidArgument", "session policy too large")
+    cred = iam.new_sts_credentials(
+        parent_user=access_key, duration_s=duration,
+        session_policy=session_policy,
+    )
+    root = ET.Element("AssumeRoleResponse")
+    root.set("xmlns", "https://sts.amazonaws.com/doc/2011-06-15/")
+    result = ET.SubElement(root, "AssumeRoleResult")
+    creds = ET.SubElement(result, "Credentials")
+    ET.SubElement(creds, "AccessKeyId").text = cred.access_key
+    ET.SubElement(creds, "SecretAccessKey").text = cred.secret_key
+    ET.SubElement(creds, "SessionToken").text = cred.session_token
+    ET.SubElement(creds, "Expiration").text = iso8601(cred.expiration_ns)
+    meta = ET.SubElement(root, "ResponseMetadata")
+    ET.SubElement(meta, "RequestId").text = ctx.request_id
+    return Response.xml(root)

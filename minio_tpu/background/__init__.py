@@ -1,0 +1,17 @@
+"""Background services: data scanner + usage accounting, MRF drain,
+admin heal sequences, erasure-set sweeps, stale upload cleanup
+(reference: cmd/data-scanner.go, cmd/background-heal-ops.go,
+cmd/global-heal.go, cmd/admin-heal-ops.go)."""
+
+from .heal import HealSequence, HealState, MRFHealer, heal_erasure_set
+from .scanner import (
+    DataScanner,
+    DataUsageInfo,
+    DynamicSleeper,
+    parse_lifecycle,
+)
+
+__all__ = [
+    "DataScanner", "DataUsageInfo", "DynamicSleeper", "parse_lifecycle",
+    "HealSequence", "HealState", "MRFHealer", "heal_erasure_set",
+]

@@ -49,9 +49,9 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
     "scanner": {"delay": "10", "max_wait": "15s", "cycle": "1m"},
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "", "queue_dir": "", "queue_limit": "0"},
-    "notify_mysql": {"enable": "off", "dsn_string": "", "table": ""},
-    "notify_postgres": {"enable": "off", "connection_string": "", "table": ""},
-    "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace"},
+    "notify_mysql": {"enable": "off", "dsn_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
+    "notify_postgres": {"enable": "off", "connection_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
+    "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace", "queue_dir": "", "queue_limit": "0"},
 }
 
 HELP: dict[str, str] = {

@@ -1,0 +1,21 @@
+"""Bucket event notification: rules engine, targets (webhook + DB
+config surface), durable queue store, event record production
+(reference: pkg/event, pkg/event/target, cmd/event-notification.go)."""
+
+from .rules import TargetRule, expand_name, match_rules, parse_notification_config
+from .system import EventNotifier, make_event_record
+from .targets import (
+    MySQLTarget,
+    PostgresTarget,
+    QueueStore,
+    RedisTarget,
+    WebhookTarget,
+    targets_from_config,
+)
+
+__all__ = [
+    "TargetRule", "expand_name", "match_rules", "parse_notification_config",
+    "EventNotifier", "make_event_record",
+    "MySQLTarget", "PostgresTarget", "QueueStore", "RedisTarget",
+    "WebhookTarget", "targets_from_config",
+]

@@ -1,0 +1,100 @@
+"""Bucket notification rules: parse NotificationConfiguration XML and
+match event name + object key against per-target filter rules —
+behavioral parity with the reference's pkg/event rules
+(pkg/event/rules.go, name.go Expand, config.go) built from the S3
+notification schema.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+# Event name expansion (ref pkg/event/name.go Expand): a wildcard name
+# covers its concrete members.
+_EXPANSIONS = {
+    "s3:ObjectCreated:*": [
+        "s3:ObjectCreated:Put", "s3:ObjectCreated:Post",
+        "s3:ObjectCreated:Copy",
+        "s3:ObjectCreated:CompleteMultipartUpload",
+        "s3:ObjectCreated:PutRetention",
+        "s3:ObjectCreated:PutLegalHold",
+    ],
+    "s3:ObjectRemoved:*": [
+        "s3:ObjectRemoved:Delete",
+        "s3:ObjectRemoved:DeleteMarkerCreated",
+    ],
+    "s3:ObjectAccessed:*": [
+        "s3:ObjectAccessed:Get", "s3:ObjectAccessed:Head",
+    ],
+    "s3:Replication:*": [
+        "s3:Replication:OperationFailedReplication",
+        "s3:Replication:OperationCompletedReplication",
+    ],
+}
+
+
+def expand_name(name: str) -> list[str]:
+    return _EXPANSIONS.get(name, [name])
+
+
+@dataclass
+class TargetRule:
+    """One Queue/Topic/CloudFunction configuration entry."""
+
+    arn: str
+    events: list[str] = field(default_factory=list)
+    prefix: str = ""
+    suffix: str = ""
+
+    def matches(self, event_name: str, key: str) -> bool:
+        if event_name not in self.events:
+            return False
+        if self.prefix and not key.startswith(self.prefix):
+            return False
+        if self.suffix and not key.endswith(self.suffix):
+            return False
+        return True
+
+
+def parse_notification_config(xml_text: str) -> list[TargetRule]:
+    """NotificationConfiguration -> TargetRules. Unknown elements are
+    ignored; bad XML yields no rules."""
+    if not xml_text:
+        return []
+    try:
+        root = ET.fromstring(xml_text)
+    except ET.ParseError:
+        return []
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag[: root.tag.index("}") + 1]
+    rules: list[TargetRule] = []
+    for kind, arn_tag in (
+        ("QueueConfiguration", "Queue"),
+        ("TopicConfiguration", "Topic"),
+        ("CloudFunctionConfiguration", "CloudFunction"),
+    ):
+        for cfg in root.iter(f"{ns}{kind}"):
+            arn = cfg.findtext(f"{ns}{arn_tag}", "")
+            events: list[str] = []
+            for ev in cfg.findall(f"{ns}Event"):
+                events.extend(expand_name((ev.text or "").strip()))
+            prefix = suffix = ""
+            for fr in cfg.iter(f"{ns}FilterRule"):
+                fr_name = fr.findtext(f"{ns}Name", "").lower()
+                fr_value = fr.findtext(f"{ns}Value", "")
+                if fr_name == "prefix":
+                    prefix = fr_value
+                elif fr_name == "suffix":
+                    suffix = fr_value
+            if arn and events:
+                rules.append(TargetRule(arn, events, prefix, suffix))
+    return rules
+
+
+def match_rules(rules: list[TargetRule], event_name: str,
+                key: str) -> set[str]:
+    """ARNs whose rules match this event."""
+    return {r.arn for r in rules if r.matches(event_name, key)}

@@ -1,0 +1,151 @@
+"""EventNotifier: builds S3 event records from object operations and
+routes them to matching bucket-rule targets via a worker queue —
+behavioral parity with the reference's sendEvent path
+(cmd/notification.go:1439, cmd/event-notification.go) with per-target
+queue stores for durability.
+"""
+
+from __future__ import annotations
+
+import datetime
+import queue
+import threading
+import urllib.parse
+
+from .rules import TargetRule, match_rules, parse_notification_config
+from .targets import Target
+
+
+def make_event_record(event_name: str, bucket: str, key: str = "",
+                      size: int = 0, etag: str = "", version_id: str = "",
+                      region: str = "us-east-1",
+                      user_identity: str = "minio-tpu") -> dict:
+    """S3 event record v2.0 (ref pkg/event/event.go Event)."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return {
+        "eventVersion": "2.0",
+        "eventSource": "minio:s3",
+        "awsRegion": region,
+        "eventTime": now.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+        "eventName": event_name.removeprefix("s3:"),
+        "userIdentity": {"principalId": user_identity},
+        "requestParameters": {},
+        "responseElements": {},
+        "s3": {
+            "s3SchemaVersion": "1.0",
+            "configurationId": "Config",
+            "bucket": {
+                "name": bucket,
+                "ownerIdentity": {"principalId": user_identity},
+                "arn": f"arn:aws:s3:::{bucket}",
+            },
+            "object": {
+                "key": urllib.parse.quote(key),
+                "size": size,
+                "eTag": etag,
+                "versionId": version_id,
+                "sequencer": f"{int(now.timestamp() * 1e6):016X}",
+            },
+        },
+    }
+
+
+class EventNotifier:
+    """Holds per-bucket rules + the target registry; send() is the hook
+    the API handlers call (S3ApiHandlers._event)."""
+
+    def __init__(self, bucket_meta=None, targets: dict[str, Target] | None = None,
+                 region: str = "us-east-1", metrics=None, logger=None):
+        self.bm = bucket_meta
+        self.targets = targets or {}
+        self.region = region
+        self.metrics = metrics
+        self.logger = logger
+        self._rules: dict[str, list[TargetRule]] = {}
+        self._mu = threading.Lock()
+        self._q: queue.Queue = queue.Queue(10000)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    # --- rules ---
+
+    def load_bucket_rules(self, bucket: str):
+        xml_text = ""
+        if self.bm is not None:
+            xml_text = self.bm.get(bucket).notification_xml
+        with self._mu:
+            self._rules[bucket] = parse_notification_config(xml_text)
+
+    def rules_for(self, bucket: str) -> list[TargetRule]:
+        with self._mu:
+            if bucket not in self._rules:
+                pass
+            else:
+                return self._rules[bucket]
+        self.load_bucket_rules(bucket)
+        with self._mu:
+            return self._rules.get(bucket, [])
+
+    # --- send path ---
+
+    def send(self, event_name: str, bucket: str, oi=None, key: str = ""):
+        """Non-blocking: match rules, enqueue for the worker."""
+        if oi is not None:
+            key = oi.name
+        arns = match_rules(self.rules_for(bucket), event_name, key)
+        if not arns:
+            return
+        record = make_event_record(
+            event_name, bucket, key,
+            size=getattr(oi, "size", 0),
+            etag=getattr(oi, "etag", ""),
+            version_id=getattr(oi, "version_id", "") or "",
+            region=self.region,
+        )
+        payload = {"EventName": event_name, "Key": f"{bucket}/{key}",
+                   "Records": [record]}
+        try:
+            self._q.put_nowait((arns, payload))
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.inc("events_dropped_total")
+
+    def _drain(self):
+        while not self._stop.is_set():
+            try:
+                arns, payload = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            for arn in arns:
+                target = self.targets.get(arn)
+                if target is None:
+                    continue
+                try:
+                    target.save(payload)
+                    if self.metrics is not None:
+                        self.metrics.inc("events_sent_total", arn=arn)
+                except Exception as exc:  # noqa: BLE001 - per-target
+                    if self.metrics is not None:
+                        self.metrics.inc("events_errors_total", arn=arn)
+                    if self.logger is not None:
+                        self.logger.log_once_if(exc, f"notify:{arn}")
+
+    def flush(self, timeout: float = 5.0):
+        """Wait for the in-memory queue to drain (tests)."""
+        import time
+
+        deadline = time.time() + timeout
+        while not self._q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+    def retry_stores(self) -> int:
+        """Drain every target's persistent queue store."""
+        total = 0
+        for t in self.targets.values():
+            total += t.drain()
+        return total
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=2)

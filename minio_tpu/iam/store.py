@@ -237,8 +237,9 @@ class IAMSys:
             )
             self.sts[access] = c
             if session_policy is not None:
+                # Session policies RESTRICT (intersect with) the parent's
+                # permissions; is_allowed requires parent AND session.
                 self.policies[f"sts-{access}"] = session_policy
-                self.user_policy[access] = [f"sts-{access}"]
             return c
 
     # --- groups ---
@@ -302,13 +303,12 @@ class IAMSys:
             return c
 
     def effective_policy(self, access_key: str) -> Policy:
+        """Merged view of the policies directly attached to a user (plus
+        group attachments). Does NOT resolve parent/session semantics —
+        use is_allowed for authorization decisions."""
         with self._lock:
             names: list[str] = list(self.user_policy.get(access_key, []))
-            cred = self.users.get(access_key) or self.sts.get(access_key)
-            if cred is not None and cred.parent_user:
-                names += self.user_policy.get(cred.parent_user, [])
-            user_for_groups = cred.parent_user if cred and cred.parent_user else access_key
-            for g in self.groups_of(user_for_groups):
+            for g in self.groups_of(access_key):
                 names += self.group_policy.get(g, [])
             merged = Policy([])
             for n in names:
@@ -318,8 +318,26 @@ class IAMSys:
             return merged
 
     def is_allowed(self, args: Args) -> bool:
-        """Root always allowed; others evaluated against their policy set
-        (ref cmd/iam.go IsAllowed)."""
+        """Authorization (ref cmd/iam.go IsAllowed):
+        - root: always allowed;
+        - service accounts / STS creds: the PARENT's permissions gate the
+          call, and a session policy (if present) further restricts it
+          (intersection — never an escalation);
+        - plain users: their attached policy set."""
         if args.account == self.root.access_key:
+            return True
+        cred = self.users.get(args.account) or self.sts.get(args.account)
+        if cred is not None and cred.parent_user:
+            if cred.parent_user == self.root.access_key:
+                parent_ok = True
+            else:
+                parent_ok = self.effective_policy(
+                    cred.parent_user
+                ).is_allowed(args)
+            if not parent_ok:
+                return False
+            session = self.policies.get(f"sts-{args.account}")
+            if session is not None:
+                return session.is_allowed(args)
             return True
         return self.effective_policy(args.account).is_allowed(args)

@@ -1,0 +1,273 @@
+"""Background services (scanner/usage/ILM/MRF/heal sequences) and event
+notification (rules, queue store, webhook target, end-to-end through the
+S3 server)."""
+
+import io
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from minio_tpu.background import (
+    DataScanner,
+    HealState,
+    MRFHealer,
+    heal_erasure_set,
+    parse_lifecycle,
+)
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.event import (
+    EventNotifier,
+    QueueStore,
+    WebhookTarget,
+    expand_name,
+    match_rules,
+    parse_notification_config,
+    targets_from_config,
+)
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+
+NOTIF_XML = """<NotificationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+<QueueConfiguration>
+  <Id>1</Id>
+  <Queue>arn:minio:sqs:us-east-1:1:webhook</Queue>
+  <Event>s3:ObjectCreated:*</Event>
+  <Filter><S3Key>
+    <FilterRule><Name>prefix</Name><Value>photos/</Value></FilterRule>
+    <FilterRule><Name>suffix</Name><Value>.jpg</Value></FilterRule>
+  </S3Key></Filter>
+</QueueConfiguration>
+</NotificationConfiguration>"""
+
+
+def make_layer(tmp_path, n=4):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(n)
+    ]
+    sets = ErasureSets(
+        disks, n, deployment_id="12121212-3434-5656-7878-909090909090",
+        pool_index=0,
+    )
+    sets.init_format()
+    return ErasureServerPools([sets]), sets
+
+
+# ---------- rules ----------
+
+def test_expand_and_parse_rules():
+    assert "s3:ObjectCreated:Put" in expand_name("s3:ObjectCreated:*")
+    rules = parse_notification_config(NOTIF_XML)
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.arn == "arn:minio:sqs:us-east-1:1:webhook"
+    assert r.prefix == "photos/" and r.suffix == ".jpg"
+    assert match_rules(rules, "s3:ObjectCreated:Put", "photos/cat.jpg")
+    assert not match_rules(rules, "s3:ObjectCreated:Put", "docs/cat.jpg")
+    assert not match_rules(rules, "s3:ObjectRemoved:Delete", "photos/cat.jpg")
+    assert parse_notification_config("") == []
+    assert parse_notification_config("<bad") == []
+
+
+# ---------- queue store + webhook ----------
+
+def test_queue_store_fifo(tmp_path):
+    qs = QueueStore(str(tmp_path / "q"), limit=5)
+    for i in range(3):
+        qs.put({"n": i})
+    keys = qs.list()
+    assert len(keys) == 3
+    assert [qs.get(k)["n"] for k in keys] == [0, 1, 2]
+    qs.delete(keys[0])
+    assert len(qs) == 2
+    for i in range(3):
+        qs.put({"n": 10 + i})
+    with pytest.raises(RuntimeError):
+        qs.put({"overflow": True})
+
+
+class _WebhookSink(BaseHTTPRequestHandler):
+    received: list = []
+    fail = False
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        if _WebhookSink.fail:
+            self.send_response(503)
+        else:
+            _WebhookSink.received.append(json.loads(body))
+            self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture()
+def webhook_server():
+    _WebhookSink.received = []
+    _WebhookSink.fail = False
+    httpd = HTTPServer(("127.0.0.1", 0), _WebhookSink)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/hook"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_webhook_target_send_and_store_retry(tmp_path, webhook_server):
+    store = QueueStore(str(tmp_path / "wq"))
+    t = WebhookTarget("arn:minio:sqs::1:webhook", webhook_server, store=store)
+    _WebhookSink.fail = True
+    t.save({"EventName": "x"})
+    assert t.drain() == 0  # target down: event stays queued
+    assert len(store) == 1
+    _WebhookSink.fail = False
+    assert t.drain() == 1
+    assert len(store) == 0
+    assert _WebhookSink.received[0]["EventName"] == "x"
+
+
+def test_targets_from_config(tmp_path, monkeypatch):
+    from minio_tpu.config import Config
+
+    c = Config()
+    c.set_kv("notify_webhook", enable="on", endpoint="http://h/hook")
+    c.set_kv("notify_redis:cache1", enable="on", address="r:6379", key="k")
+    targets = targets_from_config(c, queue_root=str(tmp_path / "queues"))
+    arns = sorted(targets)
+    assert "arn:minio:sqs:us-east-1:1:webhook" in arns
+    assert "arn:minio:sqs:us-east-1:cache1:redis" in arns
+    assert not targets["arn:minio:sqs:us-east-1:cache1:redis"].is_active()
+
+
+def test_event_notifier_end_to_end(tmp_path, webhook_server):
+    ol, _ = make_layer(tmp_path)
+    ol.make_bucket("evbkt")
+    bm = BucketMetadataSys(ol)
+    bm.update("evbkt", "notification_xml", NOTIF_XML)
+    target = WebhookTarget("arn:minio:sqs:us-east-1:1:webhook", webhook_server)
+    notifier = EventNotifier(bm, {target.arn: target})
+    from minio_tpu.object.types import ObjectInfo
+
+    oi = ObjectInfo(bucket="evbkt", name="photos/dog.jpg", size=5,
+                    etag="abc123")
+    notifier.send("s3:ObjectCreated:Put", "evbkt", oi=oi)
+    notifier.send("s3:ObjectCreated:Put", "evbkt",
+                  oi=ObjectInfo(name="notes.txt"))
+    notifier.flush()
+    time.sleep(0.3)
+    assert len(_WebhookSink.received) == 1
+    rec = _WebhookSink.received[0]["Records"][0]
+    assert rec["s3"]["object"]["key"] == "photos/dog.jpg"
+    assert rec["eventName"] == "ObjectCreated:Put"
+    notifier.close()
+
+
+# ---------- scanner / usage / lifecycle ----------
+
+def test_scanner_usage_and_heal_sampling(tmp_path):
+    ol, _ = make_layer(tmp_path)
+    ol.make_bucket("scanbkt")
+    for i in range(5):
+        data = bytes([i]) * (1000 * (i + 1))
+        ol.put_object("scanbkt", f"obj{i}.bin", io.BytesIO(data), len(data))
+    scanner = DataScanner(ol, heal_prob=2)  # heal every 2nd object
+    usage = scanner.scan_cycle()
+    bu = usage.buckets_usage["scanbkt"]
+    assert bu.objects_count == 5
+    assert bu.objects_size == sum(1000 * (i + 1) for i in range(5))
+    assert usage.objects_total_count == 5
+    # persisted + reloadable
+    s2 = DataScanner(ol)
+    s2.load_usage()
+    assert s2.usage.objects_total_count == 5
+
+
+def test_parse_lifecycle_and_expiry(tmp_path):
+    xml_text = """<LifecycleConfiguration>
+      <Rule><ID>old</ID><Status>Enabled</Status>
+        <Filter><Prefix>tmp/</Prefix></Filter>
+        <Expiration><Days>1</Days></Expiration></Rule>
+      <Rule><ID>off</ID><Status>Disabled</Status>
+        <Expiration><Days>1</Days></Expiration></Rule>
+    </LifecycleConfiguration>"""
+    rules = parse_lifecycle(xml_text)
+    assert rules == [{"prefix": "tmp/", "expire_days": 1}]
+
+    ol, _ = make_layer(tmp_path)
+    ol.make_bucket("ilmbkt")
+    bm = BucketMetadataSys(ol)
+    bm.update("ilmbkt", "lifecycle_xml", xml_text)
+    ol.put_object("ilmbkt", "tmp/old.bin", io.BytesIO(b"x"), 1)
+    ol.put_object("ilmbkt", "keep/new.bin", io.BytesIO(b"y"), 1)
+    # age the tmp object 2 days by rewriting its mod time in the usage scan
+    scanner = DataScanner(ol, bucket_meta=bm)
+    # monkeypatch: backdate via direct metadata rewrite is complex; instead
+    # shrink the rule to 0 days which expires immediately
+    bm.update(
+        "ilmbkt", "lifecycle_xml", xml_text.replace("<Days>1</Days>",
+                                                    "<Days>0</Days>")
+    )
+    usage = scanner.scan_cycle()
+    names = {
+        o.name for o in ol.list_objects("ilmbkt", max_keys=100).objects
+    }
+    assert "tmp/old.bin" not in names
+    assert "keep/new.bin" in names
+    assert usage.buckets_usage["ilmbkt"].objects_count == 1
+
+
+# ---------- MRF + heal sequences ----------
+
+def test_mrf_drain_heals_partial_writes(tmp_path):
+    ol, sets = make_layer(tmp_path)
+    ol.make_bucket("mrfbkt")
+    data = b"m" * 100000
+    ol.put_object("mrfbkt", "part.bin", io.BytesIO(data), len(data))
+    es = sets.sets[0]
+    es.queue_mrf("mrfbkt", "part.bin", "")
+    healer = MRFHealer(ol)
+    assert healer.drain_once() == 1
+    assert es.drain_mrf() == []  # queue emptied
+
+
+def test_heal_sequence_status(tmp_path):
+    ol, _ = make_layer(tmp_path)
+    ol.make_bucket("hsbkt")
+    for i in range(3):
+        ol.put_object("hsbkt", f"h{i}.bin", io.BytesIO(b"z" * 100), 100)
+    hs = HealState(ol)
+    seq = hs.launch("hsbkt")
+    deadline = time.time() + 10
+    while seq.state == "running" and time.time() < deadline:
+        time.sleep(0.05)
+    st = seq.status()
+    assert st["state"] == "finished"
+    assert st["scanned"] == 3 and st["healed"] == 3
+    # relaunching a finished sequence starts a new one
+    seq2 = hs.launch("hsbkt")
+    assert seq2.client_token != "" and hs.all_status()
+
+
+def test_heal_erasure_set_sweep(tmp_path):
+    ol, sets = make_layer(tmp_path)
+    ol.make_bucket("sweep")
+    data = b"s" * 300000
+    ol.put_object("sweep", "a.bin", io.BytesIO(data), len(data))
+    # damage one disk's copy, then sweep-heal restores it
+    import pathlib
+    import shutil
+
+    d0root = pathlib.Path(sets.disks[0].root) / "sweep"
+    if d0root.exists():
+        shutil.rmtree(d0root)
+        sets.disks[0].make_vol("sweep")
+    result = heal_erasure_set(ol)
+    assert result["objects"] == 1 and result["failed"] == 0
+    assert (d0root / "a.bin").exists()

@@ -466,3 +466,73 @@ def test_location_and_method_not_allowed(client, bucket):
     assert status == 200 and b"LocationConstraint" in body
     status, _, _ = client.request("POST", "/")
     assert status == 405
+
+
+def test_sts_assume_role(client, server, bucket):
+    """AssumeRole issues working temp credentials scoped by the parent's
+    policy plus the inline session policy."""
+    import urllib.parse as up
+
+    form = up.urlencode({
+        "Action": "AssumeRole", "Version": "2011-06-15",
+        "DurationSeconds": "900",
+    }).encode()
+    headers = sign_v4_request(
+        SECRET, ACCESS, "POST", server.endpoint, "/", [],
+        {"Content-Type": "application/x-www-form-urlencoded"}, form,
+    )
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("POST", "/", body=form, headers=headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status == 200, body
+    ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+    root = ET.fromstring(body)
+    creds = root.find(f"{ns}AssumeRoleResult/{ns}Credentials")
+    ak = creds.find(f"{ns}AccessKeyId").text
+    sk = creds.find(f"{ns}SecretAccessKey").text
+    assert creds.find(f"{ns}SessionToken").text
+    # temp creds work for S3 calls (root parent => full access)
+    temp = Client(server, access=ak, secret=sk)
+    status, _, _ = temp.request("HEAD", f"/{bucket}")
+    assert status == 200
+
+
+def test_sts_session_policy_restricts_not_escalates(server, bucket):
+    """Regression: an inline session policy must intersect with the
+    parent's permissions — a readonly parent cannot mint a writable
+    temp credential."""
+    import json as _json
+    import urllib.parse as up
+
+    iam = server.iam
+    iam.add_user("ro-parent", "ro-parent-secret")
+    iam.attach_policy("ro-parent", ["readonly"])
+    wide_policy = _json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::*"]}],
+    })
+    form = up.urlencode({
+        "Action": "AssumeRole", "Version": "2011-06-15",
+        "DurationSeconds": "900", "Policy": wide_policy,
+    }).encode()
+    headers = sign_v4_request(
+        "ro-parent-secret", "ro-parent", "POST", server.endpoint, "/", [],
+        {"Content-Type": "application/x-www-form-urlencoded"}, form,
+    )
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("POST", "/", body=form, headers=headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status == 200, body
+    ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+    creds = ET.fromstring(body).find(f"{ns}AssumeRoleResult/{ns}Credentials")
+    temp = Client(server, access=creds.find(f"{ns}AccessKeyId").text,
+                  secret=creds.find(f"{ns}SecretAccessKey").text)
+    # reads allowed (parent readonly AND session s3:*)
+    assert temp.request("GET", f"/{bucket}/obj/one.txt")[0] == 200
+    # writes denied: session policy allows, parent does NOT
+    assert temp.request("PUT", f"/{bucket}/escalate.txt", body=b"x")[0] == 403

@@ -154,11 +154,14 @@ def extract_user_metadata(headers: dict) -> dict:
 class S3ApiHandlers:
     """All S3 endpoints bound to an ObjectLayer + subsystems."""
 
-    def __init__(self, object_layer, bucket_meta, iam, notify=None):
+    def __init__(self, object_layer, bucket_meta, iam, notify=None,
+                 config=None, sse_config=None):
         self.ol = object_layer
         self.bm = bucket_meta
         self.iam = iam
         self.notify = notify
+        self.config = config
+        self.sse_config = sse_config
 
     def _opts_for(self, bucket: str, query: dict,
                   headers: dict | None = None) -> ObjectOptions:
@@ -496,13 +499,30 @@ class S3ApiHandlers:
             raise S3Error("EntityTooLarge")
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        reader = ctx.body_reader
+        resp_extra: dict = {}
+        from . import transforms
+
+        if transforms.transforms_active(ctx.headers, self.config, ctx.object):
+            plaintext = reader.read(size)
+            stored, meta_updates, resp_extra = (
+                transforms.apply_put_transforms(
+                    ctx.headers, self.config, self.sse_config,
+                    ctx.bucket, ctx.object, plaintext,
+                )
+            )
+            opts.user_defined.update(meta_updates)
+            reader = io.BytesIO(stored)
+            size = len(stored)
         try:
             oi = self.ol.put_object(
-                ctx.bucket, ctx.object, ctx.body_reader, size, opts
+                ctx.bucket, ctx.object, reader, size, opts
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
         md5_hdr = ctx.headers.get("content-md5", "")
+        if md5_hdr and resp_extra:
+            md5_hdr = ""  # transformed bytes: stored etag != body md5
         if md5_hdr:
             import base64
 
@@ -512,6 +532,7 @@ class S3ApiHandlers:
                 # reference validates inline via hash.Reader
                 raise S3Error("BadDigest")
         headers = {"ETag": f'"{oi.etag}"'}
+        headers.update(resp_extra)
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id
         self._event("s3:ObjectCreated:Put", ctx.bucket, oi=oi)
@@ -617,21 +638,45 @@ class S3ApiHandlers:
         early = self._conditional_headers(ctx, oi)
         if early is not None:
             return early
-        rng = parse_range(ctx.headers.get("range", ""), oi.size)
-        offset, length = (rng if rng else (0, oi.size))
-        try:
-            data = self.ol.get_object_bytes(
-                ctx.bucket, ctx.object, offset=offset, length=length,
-                opts=opts,
+        from . import transforms
+
+        resp_extra: dict = {}
+        if transforms.is_transformed(oi.user_defined):
+            # Transformed objects: fetch stored bytes, invert the
+            # pipeline, then apply the range on the logical view
+            # (ref NewGetObjectReader decrypt/decompress stack).
+            try:
+                stored = self.ol.get_object_bytes(
+                    ctx.bucket, ctx.object, opts=opts
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            data_full, resp_extra = transforms.apply_get_transforms(
+                oi.user_defined, ctx.headers, self.sse_config,
+                ctx.bucket, ctx.object, stored,
             )
-        except StorageError as exc:
-            raise from_object_error(exc) from exc
+            logical_size = len(data_full)
+            rng = parse_range(ctx.headers.get("range", ""), logical_size)
+            offset, length = (rng if rng else (0, logical_size))
+            data = data_full[offset:offset + length]
+        else:
+            logical_size = oi.size
+            rng = parse_range(ctx.headers.get("range", ""), oi.size)
+            offset, length = (rng if rng else (0, oi.size))
+            try:
+                data = self.ol.get_object_bytes(
+                    ctx.bucket, ctx.object, offset=offset, length=length,
+                    opts=opts,
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
         headers = self._object_headers(ctx, oi)
+        headers.update(resp_extra)
         headers["Content-Length"] = str(len(data))
         self._event("s3:ObjectAccessed:Get", ctx.bucket, oi=oi)
         if rng:
             headers["Content-Range"] = (
-                f"bytes {offset}-{offset + length - 1}/{oi.size}"
+                f"bytes {offset}-{offset + length - 1}/{logical_size}"
             )
             return Response(206, headers, data)
         return Response(200, headers, data)
@@ -648,8 +693,26 @@ class S3ApiHandlers:
         early = self._conditional_headers(ctx, oi)
         if early is not None:
             return early
+        from . import transforms
+
         headers = self._object_headers(ctx, oi)
-        headers["Content-Length"] = str(oi.size)
+        headers["Content-Length"] = str(
+            transforms.actual_object_size(oi.user_defined, oi.size)
+        )
+        if transforms.is_transformed(oi.user_defined):
+            # SSE-C objects require the key even for HEAD (ref
+            # cmd/object-handlers.go HeadObjectHandler decrypt checks).
+            from ..crypto import sse as ssemod
+
+            if oi.user_defined.get(ssemod.META_ALGORITHM) == ssemod.ALGO_SSEC:
+                if ssemod.parse_ssec_key(ctx.headers) is None:
+                    raise S3Error("InvalidRequest", "SSE-C key required")
+                headers[ssemod.HDR_SSEC_ALGO] = "AES256"
+                headers[ssemod.HDR_SSEC_KEY_MD5] = oi.user_defined.get(
+                    ssemod.META_KEY_MD5, ""
+                )
+            elif oi.user_defined.get(ssemod.META_ALGORITHM) == ssemod.ALGO_SSES3:
+                headers[ssemod.HDR_SSE] = "AES256"
         self._event("s3:ObjectAccessed:Head", ctx.bucket, oi=oi)
         return Response(200, headers)
 

@@ -201,8 +201,13 @@ class S3Server:
     def __init__(self, object_layer, iam: IAMSys, bucket_meta,
                  notify=None, region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
-                 trace=None, config_sys=None, notification=None):
-        self.handlers = S3ApiHandlers(object_layer, bucket_meta, iam, notify)
+                 trace=None, config_sys=None, notification=None,
+                 sse_config=None):
+        self.handlers = S3ApiHandlers(
+            object_layer, bucket_meta, iam, notify,
+            config=config_sys.config if config_sys is not None else None,
+            sse_config=sse_config,
+        )
         self.admin = AdminHandlers(
             object_layer, iam, config_sys=config_sys, metrics=metrics,
             trace=trace, notification=notification,

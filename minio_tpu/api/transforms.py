@@ -1,0 +1,129 @@
+"""L5 request/response transforms: transparent compression + SSE,
+composed exactly like the reference's pipeline (compress first, then
+encrypt on PUT; decrypt, then decompress on GET — cmd/object-api-utils.go
+NewGetObjectReader :595-870, newS2CompressReader :925).
+
+The reference compresses with S2 (snappy); this runtime has no S2, so
+the codec is zlib behind the same config surface ('compression'
+subsystem, extension/mime filters). The codec name is recorded in object
+metadata, so a future S2 codec can coexist.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import zlib
+
+from ..crypto import sse as ssemod
+from .errors import S3Error
+
+META_COMPRESSION = "x-mtpu-internal-compression"
+META_COMPRESSED_SIZE = "x-mtpu-internal-compressed-size"
+META_UNCOMPRESSED_SIZE = "x-mtpu-internal-uncompressed-size"
+CODEC = "zlib"
+
+_EXCLUDED_EXTS = (".gz", ".bz2", ".rar", ".zip", ".7z", ".xz", ".mp4",
+                  ".mkv", ".mov", ".jpg", ".png", ".gif")
+
+
+def should_compress(config, object_name: str, content_type: str) -> bool:
+    """Config-gated compressibility check (ref isCompressible,
+    cmd/object-api-utils.go:445)."""
+    if config is None:
+        return False
+    kvs = config.get("compression")
+    if kvs.get("enable") != "on":
+        return False
+    name = object_name.lower()
+    if any(name.endswith(e) for e in _EXCLUDED_EXTS):
+        return False
+    exts = [e.strip() for e in kvs.get("extensions", "").split(",") if e.strip()]
+    mimes = [m.strip() for m in kvs.get("mime_types", "").split(",") if m.strip()]
+    if not exts and not mimes:
+        return True
+    if exts and any(name.endswith(e.lower()) for e in exts):
+        return True
+    if mimes and content_type and any(
+        fnmatch.fnmatchcase(content_type, m) for m in mimes
+    ):
+        return True
+    return False
+
+
+def transforms_active(headers: dict, config, object_name: str) -> bool:
+    """True when the PUT body needs buffering for transform work."""
+    if ssemod.parse_ssec_key(headers) is not None:
+        return True
+    if ssemod.wants_sse_s3(headers):
+        return True
+    return should_compress(
+        config, object_name, headers.get("content-type", "")
+    )
+
+
+def apply_put_transforms(headers: dict, config, sse_config, bucket: str,
+                         object_: str, plaintext: bytes):
+    """compress -> encrypt. Returns (stored_bytes, meta_updates,
+    response_headers)."""
+    meta: dict = {}
+    data = plaintext
+    if should_compress(config, object_, headers.get("content-type", "")):
+        compressed = zlib.compress(data, level=1)
+        # Store compressed only when it actually helps (ref skips
+        # incompressible data via S2's framing; we skip whole-object).
+        if len(compressed) < len(data):
+            meta[META_COMPRESSION] = CODEC
+            meta[META_UNCOMPRESSED_SIZE] = str(len(data))
+            meta[META_COMPRESSED_SIZE] = str(len(compressed))
+            data = compressed
+    try:
+        data, sse_meta, resp = ssemod.encrypt_request(
+            headers, bucket, object_, data, sse_config
+        )
+    except ssemod.SSEError as exc:
+        raise S3Error(
+            exc.code if exc.code in ("AccessDenied", "NotImplemented")
+            else "InvalidArgument",
+            str(exc),
+        ) from exc
+    meta.update(sse_meta)
+    return data, meta, resp
+
+
+def apply_get_transforms(stored_meta: dict, headers: dict, sse_config,
+                         bucket: str, object_: str, stored: bytes):
+    """decrypt -> decompress. Returns (plaintext, response_headers)."""
+    try:
+        data, resp = ssemod.decrypt_response(
+            stored_meta, headers, bucket, object_, stored, sse_config
+        )
+    except ssemod.SSEError as exc:
+        raise S3Error(
+            exc.code if exc.code in ("AccessDenied", "NotImplemented")
+            else "InvalidRequest",
+            str(exc),
+        ) from exc
+    codec = stored_meta.get(META_COMPRESSION, "")
+    if codec:
+        if codec != CODEC:
+            raise S3Error("InternalError", f"unknown codec {codec!r}")
+        try:
+            data = zlib.decompress(data)
+        except zlib.error as exc:
+            raise S3Error("InternalError", f"decompress: {exc}") from exc
+    return data, resp
+
+
+def is_transformed(meta: dict) -> bool:
+    return bool(meta.get(META_COMPRESSION)) or ssemod.is_encrypted(meta)
+
+
+def actual_object_size(meta: dict, stored_size: int) -> int:
+    """Logical (client-visible) size of a transformed object. With
+    compress-then-encrypt, the SSE actual-size records the COMPRESSED
+    length, so the compression marker wins."""
+    if meta.get(META_COMPRESSION):
+        return int(meta.get(META_UNCOMPRESSED_SIZE, stored_size))
+    if ssemod.is_encrypted(meta):
+        return int(meta.get(ssemod.META_ACTUAL_SIZE, stored_size))
+    return stored_size

@@ -1,0 +1,17 @@
+"""Server-side encryption (SSE-C / SSE-S3 envelope crypto) — reference:
+cmd/encryption-v1.go, cmd/crypto/."""
+
+from .sse import (
+    SSEConfig,
+    SSEError,
+    decrypt_response,
+    encrypt_request,
+    is_encrypted,
+    parse_ssec_key,
+    wants_sse_s3,
+)
+
+__all__ = [
+    "SSEConfig", "SSEError", "decrypt_response", "encrypt_request",
+    "is_encrypted", "parse_ssec_key", "wants_sse_s3",
+]

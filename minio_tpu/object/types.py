@@ -51,9 +51,11 @@ class ObjectInfo:
         version_id = fi.version_id
         if versioned and not version_id:
             version_id = "null"
+        # Internal x-mtpu-internal-* keys stay in user_defined — the L5
+        # transform layer (SSE/compression) needs them; the HTTP response
+        # builder never emits them (api/handlers._object_headers).
         user_defined = {
-            k: v for k, v in fi.metadata.items()
-            if not k.startswith("x-mtpu-internal-") and k != "etag"
+            k: v for k, v in fi.metadata.items() if k != "etag"
         }
         return cls(
             bucket=bucket,

@@ -150,11 +150,14 @@ class Server:
         self._enable_scanner = enable_scanner
 
         # --- HTTP front-end ---
+        from .crypto import SSEConfig
+
         self.s3 = S3Server(
             self.object_layer, self.iam, self.bucket_meta,
             notify=self.notifier, region=region, host=address, port=port,
             metrics=self.metrics, trace=self.trace,
             config_sys=self.config_sys,
+            sse_config=SSEConfig(self.root_password),
         )
         self.started_ns = time.time_ns()
 

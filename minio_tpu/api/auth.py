@@ -43,10 +43,13 @@ def auth_type(headers: dict, query: dict) -> str:
 
 class AuthResult:
     def __init__(self, access_key: str = "", auth: str = AUTH_ANONYMOUS,
-                 cred=None):
+                 cred=None, content_sha256: str = ""):
         self.access_key = access_key
         self.auth = auth
         self.cred = cred
+        # Declared payload hash (signature-bound); the server verifies the
+        # actual body against it before handlers consume the stream.
+        self.content_sha256 = content_sha256
 
     @property
     def is_anonymous(self) -> bool:
@@ -77,9 +80,11 @@ def authenticate(iam: IAMSys, method: str, path: str,
             cred_scope, _, _ = sign.parse_v4_auth_header(auth_hdr)
             secret = secret_for(cred_scope.access_key)
             sign.verify_v4_header(secret, method, path, query, headers)
+            lower = {k.lower(): v for k, v in headers.items()}
             return AuthResult(
                 cred_scope.access_key, at,
                 iam.get_credentials(cred_scope.access_key),
+                content_sha256=lower.get("x-amz-content-sha256", ""),
             )
         if at == AUTH_PRESIGNED_V4:
             cred_scope = sign.V4Credential(qdict.get("X-Amz-Credential", ""))
@@ -88,6 +93,7 @@ def authenticate(iam: IAMSys, method: str, path: str,
             return AuthResult(
                 cred_scope.access_key, at,
                 iam.get_credentials(cred_scope.access_key),
+                content_sha256=qdict.get("X-Amz-Content-Sha256", ""),
             )
         if at == AUTH_SIGNED_V2:
             auth_hdr = headers.get(

@@ -13,6 +13,7 @@ from __future__ import annotations
 import datetime
 import hashlib
 import io
+import re
 import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
@@ -75,6 +76,58 @@ def valid_bucket_name(bucket: str) -> bool:
     if ".." in bucket or ".-" in bucket or "-." in bucket:
         return False
     return all(c.islower() or c.isdigit() or c in ".-" for c in bucket)
+
+
+class _RangeCopyReader:
+    """Stream a source-object range in 1 MiB pulls so UploadPartCopy never
+    buffers a whole (up to 5 GiB) part in memory."""
+
+    def __init__(self, ol, bucket, object_, offset, length, opts):
+        self._ol = ol
+        self._bucket = bucket
+        self._object = object_
+        self._pos = offset
+        self._left = length
+        self._opts = opts
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        if n is None or n < 0:
+            n = self._left
+        n = min(n, self._left, 1 << 20)
+        data = self._ol.get_object_bytes(
+            self._bucket, self._object, offset=self._pos, length=n,
+            opts=self._opts,
+        )
+        self._pos += len(data)
+        self._left -= len(data)
+        if not data:
+            self._left = 0
+        return data
+
+
+def parse_copy_source(header: str) -> tuple[str, str, str]:
+    """Parse x-amz-copy-source into (bucket, object, versionId).
+
+    Shared by the dispatch layer (source authorization) and the copy
+    handler (ref cmd/object-handlers.go CopyObjectHandler source parse).
+    """
+    # Split the versionId suffix BEFORE percent-decoding: clients encode a
+    # literal '?' in the key as %3F precisely to disambiguate it from the
+    # version marker.
+    raw, vid = header, ""
+    if "?versionId=" in raw:
+        raw, _, vid = raw.partition("?versionId=")
+    src = urllib.parse.unquote(raw)
+    if src.startswith("/"):
+        src = src[1:]
+    if "/" not in src:
+        raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+    sbucket, _, sobject = src.partition("/")
+    if not sbucket or not valid_object_name(sobject):
+        raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+    return sbucket, sobject, urllib.parse.unquote(vid)
 
 
 def valid_object_name(obj: str) -> bool:
@@ -539,18 +592,9 @@ class S3ApiHandlers:
         return Response(200, headers)
 
     def _copy_object(self, ctx, copy_source: str) -> Response:
-        src = urllib.parse.unquote(copy_source)
-        if src.startswith("/"):
-            src = src[1:]
-        vid = ""
-        if "?versionId=" in src:
-            src, _, vid = src.partition("?versionId=")
-        if "/" not in src:
-            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
-        sbucket, _, sobject = src.partition("/")
+        sbucket, sobject, vid = parse_copy_source(copy_source)
         try:
             src_opts = self._opts_for(sbucket, {"versionId": vid})
-            data = self.ol.get_object_bytes(sbucket, sobject, opts=src_opts)
             src_info = self.ol.get_object_info(sbucket, sobject, src_opts)
         except StorageError as exc:
             raise from_object_error(exc) from exc
@@ -560,9 +604,14 @@ class S3ApiHandlers:
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
             opts.user_defined = dict(src_info.user_defined)
+        # Stream source -> destination in 1 MiB pulls; a multi-GiB copy
+        # must not materialize in memory.
+        reader = _RangeCopyReader(
+            self.ol, sbucket, sobject, 0, src_info.size, src_opts
+        )
         try:
             oi = self.ol.put_object(
-                ctx.bucket, ctx.object, io.BytesIO(data), len(data), opts
+                ctx.bucket, ctx.object, reader, src_info.size, opts
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
@@ -763,6 +812,14 @@ class S3ApiHandlers:
             raise S3Error("InvalidArgument", "partNumber") from exc
         if not 1 <= part_number <= MAX_PARTS:
             raise S3Error("InvalidArgument", f"partNumber {part_number}")
+        copy_source = ctx.headers.get("x-amz-copy-source", "")
+        if copy_source:
+            # UploadPartCopy (ref cmd/object-handlers.go
+            # CopyObjectPartHandler): source read already authorized in
+            # dispatch alongside the destination write.
+            return self._upload_part_copy(
+                ctx, upload_id, part_number, copy_source
+            )
         size = ctx.content_length
         if size is None:
             raise S3Error("MissingContentLength")
@@ -776,6 +833,44 @@ class S3ApiHandlers:
         except StorageError as exc:
             raise from_object_error(exc) from exc
         return Response(200, {"ETag": f'"{pi.etag}"'})
+
+    def _upload_part_copy(self, ctx, upload_id: str, part_number: int,
+                          copy_source: str) -> Response:
+        sbucket, sobject, vid = parse_copy_source(copy_source)
+        src_opts = self._opts_for(sbucket, {"versionId": vid})
+        try:
+            src_info = self.ol.get_object_info(sbucket, sobject, src_opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        rng = ctx.headers.get("x-amz-copy-source-range", "")
+        offset, length = 0, src_info.size
+        if rng:
+            # Strict 'bytes=first-last' only, fully inside the source —
+            # AWS rejects suffix/open/overlong copy ranges outright
+            # (unlike HTTP Range, which clamps).
+            m = re.fullmatch(r"bytes=(\d+)-(\d+)", rng)
+            if not m:
+                raise S3Error("InvalidArgument", rng)
+            first, last = int(m.group(1)), int(m.group(2))
+            if first > last or last >= src_info.size:
+                raise S3Error("InvalidArgument", rng)
+            offset, length = first, last - first + 1
+        if length > MAX_PART_SIZE:
+            raise S3Error("EntityTooLarge")
+        reader = _RangeCopyReader(
+            self.ol, sbucket, sobject, offset, length, src_opts
+        )
+        try:
+            pi = self.ol.put_object_part(
+                ctx.bucket, ctx.object, upload_id, part_number,
+                reader, length,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("CopyPartResult")
+        ET.SubElement(root, "LastModified").text = iso8601(pi.mod_time_ns)
+        ET.SubElement(root, "ETag").text = f'"{pi.etag}"'
+        return Response.xml(root)
 
     def complete_multipart_upload(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)

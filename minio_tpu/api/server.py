@@ -7,7 +7,9 @@ pipeline.
 
 from __future__ import annotations
 
+import hashlib
 import io
+import re
 import threading
 import urllib.parse
 import uuid
@@ -18,7 +20,30 @@ from . import sign
 from .admin import ADMIN_PREFIX, AdminHandlers
 from .auth import AUTH_STREAMING, authenticate, authorize
 from .errors import API_ERRORS, S3Error, error_xml
-from .handlers import Response, S3ApiHandlers
+from .handlers import (
+    Response,
+    S3ApiHandlers,
+    parse_copy_source,
+    valid_object_name,
+)
+
+# Buckets never served by the S3 data plane: the internal metadata
+# namespaces (IAM secrets, bucket configs, server config live there) and
+# the 'minio' route namespace (ref cmd/generic-handlers.go
+# minioReservedBucket / isMinioReservedBucket guard).
+_RESERVED_BUCKETS = {"minio", ".minio.sys", ".mtpu.sys"}
+
+# Upload IDs are server-minted UUIDs; anything outside this shape is
+# either corrupt or a path-traversal attempt (uploadId is used as a
+# directory name by both backends).
+_SAFE_UPLOAD_ID = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,127}")
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _check_reserved_bucket(bucket: str):
+    if bucket in _RESERVED_BUCKETS or bucket.startswith("."):
+        raise S3Error("AccessDenied", f"reserved bucket {bucket!r}")
 
 # S3 action names per route (subset of pkg/iam/policy/action.go).
 _ACTIONS = {
@@ -78,6 +103,31 @@ class LimitedReader:
             n = self._left
         buf = self._raw.read(n)
         self._left -= len(buf)
+        return buf
+
+
+class Sha256VerifyReader:
+    """Verify the request body against the signature-bound
+    x-amz-content-sha256 as it streams (ref pkg/hash/reader.go): the
+    declared hash alone only proves the client *claimed* a hash; the body
+    bytes must actually match it or a tampered payload slips through."""
+
+    def __init__(self, raw, want_hex: str, total: int):
+        self._raw = raw
+        self._want = want_hex.lower()
+        self._left = total
+        self._h = hashlib.sha256()
+
+    def read(self, n: int = -1) -> bytes:
+        buf = self._raw.read(n)
+        if buf:
+            self._h.update(buf)
+            self._left -= len(buf)
+        if (not buf or self._left <= 0) and self._want is not None:
+            got = self._h.hexdigest()
+            want, self._want = self._want, None  # verify once
+            if got != want:
+                raise S3Error("XAmzContentSHA256Mismatch", got)
         return buf
 
 
@@ -326,6 +376,21 @@ class S3Server:
                 raise S3Error("NotImplemented", "streaming admin request")
             self.admin.authorize(auth_result, name)
             return getattr(self.admin, name)(ctx)
+        # Central name guards for every S3 data-plane route: internal
+        # metadata buckets are unreachable regardless of policy, and
+        # object names are validated once here so no handler can be
+        # reached with `..`/absolute path segments.
+        if ctx.bucket:
+            _check_reserved_bucket(ctx.bucket)
+        if ctx.object and not valid_object_name(ctx.object):
+            raise S3Error(
+                "InvalidArgument", f"invalid object name {ctx.object!r}"
+            )
+        upload_id = ctx.qdict.get("uploadId")
+        if upload_id is not None and not _SAFE_UPLOAD_ID.fullmatch(upload_id):
+            # uploadId is joined into on-disk paths by both backends; a
+            # traversal here would bypass the bucket/object guards above.
+            raise S3Error("NoSuchUpload", upload_id[:64])
         name = route(ctx)
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
@@ -342,8 +407,33 @@ class S3Server:
             self.iam, bucket_policy, auth_result, action,
             ctx.bucket, ctx.object,
         )
+        # Copy requests read from a second location: authorize
+        # s3:GetObject on the parsed source too (ref CopyObjectHandler,
+        # cmd/object-handlers.go — the source has its own auth check).
+        if name in ("put_object", "put_object_part"):
+            copy_source = ctx.headers.get("x-amz-copy-source", "")
+            if copy_source:
+                sbucket, sobject, _ = parse_copy_source(copy_source)
+                _check_reserved_bucket(sbucket)
+                src_policy = self.handlers.bm.get(sbucket).policy()
+                authorize(
+                    self.iam, src_policy, auth_result, "s3:GetObject",
+                    sbucket, sobject,
+                )
         if auth_result.auth == AUTH_STREAMING:
             self._wrap_streaming_body(ctx, auth_result)
+        elif auth_result.content_sha256 not in ("", sign.UNSIGNED_PAYLOAD):
+            if ctx.content_length:
+                ctx.body_reader = Sha256VerifyReader(
+                    ctx.body_reader, auth_result.content_sha256,
+                    ctx.content_length,
+                )
+            elif auth_result.content_sha256.lower() != _EMPTY_SHA256:
+                # No body on the wire but the signature promised one: a
+                # truncated/stripped payload must not slip through.
+                raise S3Error(
+                    "XAmzContentSHA256Mismatch", "empty body, non-empty hash"
+                )
         if self.trace is not None:
             self.trace.publish({
                 "api": name, "method": ctx.method, "path": ctx.path,

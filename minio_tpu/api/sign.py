@@ -165,7 +165,13 @@ def verify_v4_header(secret: str, method: str, path: str,
         raise SignError("RequestTimeTooSkewed")
     if ts.strftime("%Y%m%d") != cred.date:
         raise SignError("AuthHeaderMalformed", "credential date mismatch")
-    payload_hash = lower.get("x-amz-content-sha256", UNSIGNED_PAYLOAD)
+    payload_hash = lower.get("x-amz-content-sha256")
+    if payload_hash is None:
+        # Header-signed V4 must declare the payload hash; silently
+        # treating it as UNSIGNED-PAYLOAD would unbind the body from the
+        # signature (ref cmd/signature-v4.go getContentSha256Cksum).
+        raise SignError("XAmzContentSHA256Mismatch",
+                        "missing x-amz-content-sha256")
     want = compute_v4_signature(
         secret, method, path, query, headers, signed, payload_hash,
         amz_date, cred,

@@ -138,11 +138,13 @@ class Statement:
         if not self.resources:
             # Admin-action statements carry no S3 resource.
             return True
-        res = f"{bucket}/{object_}" if object_ else bucket
-        return any(
-            match_wildcard(r, res) or match_wildcard(r, bucket)
-            for r in self.resources
-        )
+        if object_:
+            # Object-level request: only object ARNs (bucket/key patterns)
+            # may match. A bare-bucket Resource must NOT grant object
+            # actions (AWS + ref pkg/iam/policy resource-set semantics).
+            res = f"{bucket}/{object_}"
+            return any(match_wildcard(r, res) for r in self.resources)
+        return any(match_wildcard(r, bucket) for r in self.resources)
 
     def is_allowed(self, args: Args) -> bool | None:
         """None = no match; True/False = Allow/Deny verdict."""

@@ -55,18 +55,40 @@ class FSObjects:
 
     # --- paths ---
 
+    @staticmethod
+    def _safe_segments(bucket: str, object_: str = "") -> list[str]:
+        """Reject path components that would escape the storage root —
+        the HTTP layer unquotes the URL, so `..%2F` would otherwise reach
+        os.path.join (the reference guards this in xl-storage
+        checkPathLength / isValidPath; LocalStorage has the same check)."""
+        if not bucket or "/" in bucket or bucket in (".", ".."):
+            raise ErrBucketNotFound(bucket)
+        segs = [s for s in object_.split("/") if s] if object_ else []
+        for seg in segs:
+            if seg in (".", ".."):
+                raise ErrObjectNotFound(f"{bucket}/{object_}")
+        return segs
+
     def _bucket_path(self, bucket: str) -> str:
+        self._safe_segments(bucket)
         return os.path.join(self.root, bucket)
 
     def _obj_path(self, bucket: str, object_: str) -> str:
-        return os.path.join(self.root, bucket, *object_.split("/"))
+        segs = self._safe_segments(bucket, object_)
+        return os.path.join(self.root, bucket, *segs)
 
     def _meta_path(self, bucket: str, object_: str) -> str:
+        segs = self._safe_segments(bucket, object_)
         return os.path.join(
-            self.root, SYS_DIR, "meta", bucket, *object_.split("/"), "fs.json"
+            self.root, SYS_DIR, "meta", bucket, *segs, "fs.json"
         )
 
     def _upload_dir(self, bucket: str, object_: str, upload_id: str) -> str:
+        # uploadId becomes a directory name: reject separators/dot-dirs so
+        # a forged id cannot escape the multipart tree (abort rmtree's it).
+        if (not upload_id or "/" in upload_id or "\\" in upload_id
+                or upload_id in (".", "..")):
+            raise ErrInvalidUploadID(upload_id)
         sha = hashlib.sha256(f"{bucket}/{object_}".encode()).hexdigest()
         return os.path.join(self.root, SYS_DIR, "multipart", sha, upload_id)
 
@@ -119,19 +141,27 @@ class FSObjects:
         )
         md5 = hashlib.md5()
         total = 0
-        with open(tmp, "wb") as f:
-            while total < size:
-                chunk = reader.read(min(1 << 20, size - total))
-                if not chunk:
-                    break
-                md5.update(chunk)
-                f.write(chunk)
-                total += len(chunk)
-        if total != size:
-            os.unlink(tmp)
-            from ..utils.errors import ErrLessData
+        try:
+            with open(tmp, "wb") as f:
+                while total < size:
+                    chunk = reader.read(min(1 << 20, size - total))
+                    if not chunk:
+                        break
+                    md5.update(chunk)
+                    f.write(chunk)
+                    total += len(chunk)
+            if total != size:
+                from ..utils.errors import ErrLessData
 
-            raise ErrLessData(f"read {total} of {size}")
+                raise ErrLessData(f"read {total} of {size}")
+        except BaseException:
+            # reader.read may raise (e.g. body-hash verification): never
+            # leave the staged file behind.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         dst = self._obj_path(bucket, object_)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         os.replace(tmp, dst)
@@ -310,19 +340,25 @@ class FSObjects:
         md5 = hashlib.md5()
         total = 0
         tmp = os.path.join(d, f".tmp-{part_number}")
-        with open(tmp, "wb") as f:
-            while total < size:
-                chunk = reader.read(min(1 << 20, size - total))
-                if not chunk:
-                    break
-                md5.update(chunk)
-                f.write(chunk)
-                total += len(chunk)
-        if total != size:
-            os.unlink(tmp)
-            from ..utils.errors import ErrLessData
+        try:
+            with open(tmp, "wb") as f:
+                while total < size:
+                    chunk = reader.read(min(1 << 20, size - total))
+                    if not chunk:
+                        break
+                    md5.update(chunk)
+                    f.write(chunk)
+                    total += len(chunk)
+            if total != size:
+                from ..utils.errors import ErrLessData
 
-            raise ErrLessData(f"read {total} of {size}")
+                raise ErrLessData(f"read {total} of {size}")
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         os.replace(tmp, os.path.join(d, f"part.{part_number}"))
         etag = md5.hexdigest()
         with open(os.path.join(d, f"part.{part_number}.json"), "w") as f:

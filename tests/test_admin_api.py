@@ -251,8 +251,10 @@ def test_service_action(stack):
 
 def test_reserved_minio_bucket_and_health_methods(stack):
     srv = stack[0]
+    # Reserved route-namespace bucket is rejected before routing (ref
+    # cmd/generic-handlers.go minioReservedBucket -> AllAccessDisabled).
     status, body = req(srv, "PUT", "/minio")
-    assert status == 400 and b"InvalidBucketName" in body
+    assert status == 403 and b"AccessDenied" in body
     status, _ = req(srv, "PUT", "/minio/health/live", anonymous=True)
     assert status == 405
 

@@ -536,3 +536,229 @@ def test_sts_session_policy_restricts_not_escalates(server, bucket):
     assert temp.request("GET", f"/{bucket}/obj/one.txt")[0] == 200
     # writes denied: session policy allows, parent does NOT
     assert temp.request("PUT", f"/{bucket}/escalate.txt", body=b"x")[0] == 403
+
+
+# ---------- security regression tests (round-2 advisor findings) ----------
+
+
+def test_reserved_sys_buckets_unreachable(client):
+    """The internal metadata namespaces must never be served by the S3
+    data plane, even to fully-authorized principals (ref
+    cmd/generic-handlers.go minioReservedBucket guard): IAM user secrets
+    and bucket policies live there."""
+    for b in (".minio.sys", ".mtpu.sys"):
+        st, _, body = client.request(
+            "GET", f"/{b}/config/iam/users/{ACCESS}.json"
+        )
+        assert st == 403 and b"AccessDenied" in body, (b, st, body)
+        st, _, body = client.request("PUT", f"/{b}/x", body=b"evil")
+        assert st == 403
+        st, _, body = client.request("GET", f"/{b}", query=[("list-type", "2")])
+        assert st == 403
+
+
+def test_object_name_traversal_rejected(client, bucket):
+    """`..` path segments are rejected centrally in dispatch, before any
+    backend path join (the URL is unquoted, so ..%2F would otherwise
+    reach os.path.join)."""
+    for key in ("../../../etc/passwd", "a/../../b", ".."):
+        st, _, body = client.request("GET", f"/{bucket}/{key}")
+        assert st == 400 and b"InvalidArgument" in body, (key, st)
+        st, _, _ = client.request("DELETE", f"/{bucket}/{key}")
+        assert st == 400
+        st, _, _ = client.request("PUT", f"/{bucket}/{key}", body=b"x")
+        assert st == 400
+
+
+def test_copy_object_requires_source_read_permission(server, client, bucket):
+    """CopyObject must authorize s3:GetObject on the copy *source*: a
+    principal with write access to one bucket must not exfiltrate
+    unreadable objects through it (ref CopyObjectHandler source auth)."""
+    import json as _json
+
+    from minio_tpu.iam.policy import Policy
+
+    client.request("PUT", "/copydst")
+    assert client.request(
+        "PUT", f"/{bucket}/obj/one.txt", body=b"copy-source-data"
+    )[0] == 200
+    iam = server.iam
+    iam.add_user("b-writer", "b-writer-secret")
+    iam.set_policy("copydst-only", Policy.parse(_json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:PutObject", "s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::copydst/*"]}],
+    })))
+    iam.attach_policy("b-writer", ["copydst-only"])
+    restricted = Client(server, access="b-writer", secret="b-writer-secret")
+    # sanity: can write its own bucket
+    assert restricted.request("PUT", "/copydst/own", body=b"ok")[0] == 200
+    # cannot read the other bucket directly...
+    assert restricted.request("GET", f"/{bucket}/obj/one.txt")[0] == 403
+    # ...and cannot copy from it either
+    st, _, body = restricted.request(
+        "PUT", "/copydst/stolen",
+        headers={"x-amz-copy-source": f"/{bucket}/obj/one.txt"},
+    )
+    assert st == 403 and b"AccessDenied" in body
+    # root can copy
+    st, _, _ = client.request(
+        "PUT", "/copydst/legit",
+        headers={"x-amz-copy-source": f"/{bucket}/obj/one.txt"},
+    )
+    assert st == 200
+
+
+def test_bucket_arn_does_not_grant_object_actions():
+    """A statement whose Resource is the bare bucket ARN (no /*) must not
+    match object-level requests (AWS resource-set semantics)."""
+    from minio_tpu.iam.policy import Args, Policy
+
+    p = Policy.parse({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::mybucket"]}],
+    })
+    assert p.is_allowed(Args(account="u", action="s3:ListBucket",
+                             bucket="mybucket", object=""))
+    assert not p.is_allowed(Args(account="u", action="s3:GetObject",
+                                 bucket="mybucket", object="secret.txt"))
+    p2 = Policy.parse({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::mybucket/*"]}],
+    })
+    assert p2.is_allowed(Args(account="u", action="s3:GetObject",
+                              bucket="mybucket", object="secret.txt"))
+
+
+def test_tampered_body_rejected_by_content_sha256(server, bucket):
+    """The signature only binds the *declared* x-amz-content-sha256; the
+    server must hash the actual body and compare (ref pkg/hash/reader.go
+    sha256 verification), else a tampered payload passes."""
+    signed_body = b"A" * 64
+    sent_body = b"B" * 64
+    headers = sign_v4_request(
+        SECRET, ACCESS, "PUT", server.endpoint,
+        f"/{bucket}/tamper.txt", [], {}, signed_body,
+    )
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("PUT", f"/{bucket}/tamper.txt", body=sent_body,
+                 headers=headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status == 400 and b"XAmzContentSHA256Mismatch" in body
+    # object must not exist
+    c = Client(server)
+    assert c.request("GET", f"/{bucket}/tamper.txt")[0] == 404
+
+
+def test_v4_header_missing_content_sha256_rejected(server, bucket):
+    """Header-signed V4 without x-amz-content-sha256 must be rejected,
+    not silently treated as UNSIGNED-PAYLOAD."""
+    import datetime
+
+    from minio_tpu.api.sign import V4Credential, compute_v4_signature
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    headers = {"Host": server.endpoint, "X-Amz-Date": amz_date}
+    cred = V4Credential(
+        f"{ACCESS}/{now.strftime('%Y%m%d')}/us-east-1/s3/aws4_request"
+    )
+    signed = ["host", "x-amz-date"]
+    sig = compute_v4_signature(
+        SECRET, "PUT", f"/{bucket}/nosha.txt", [], headers, signed,
+        "UNSIGNED-PAYLOAD", amz_date, cred,
+    )
+    headers["Authorization"] = (
+        f"{SIGN_V4_ALGORITHM} Credential={ACCESS}/{cred.scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+    )
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("PUT", f"/{bucket}/nosha.txt", body=b"x", headers=headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status == 400 and b"XAmzContentSHA256Mismatch" in body
+
+
+def test_upload_id_traversal_rejected(client, bucket):
+    """uploadId is joined into on-disk paths; forged ids must be rejected
+    before any backend touches the filesystem (abort rmtree's the dir)."""
+    for uid in ("../../..", "..", "a/b", "../x"):
+        st, _, body = client.request(
+            "DELETE", f"/{bucket}/any",
+            query=[("uploadId", uid)],
+        )
+        assert st == 404 and b"NoSuchUpload" in body, (uid, st, body)
+        st, _, _ = client.request(
+            "PUT", f"/{bucket}/any", body=b"x",
+            query=[("partNumber", "1"), ("uploadId", uid)],
+        )
+        assert st == 404
+
+
+def test_tampered_body_leaves_no_tmp_files(server, bucket, tmp_path_factory):
+    """A body-hash mismatch mid-PUT must not leak staged tmp files (FS
+    backend regression)."""
+    import os
+    import tempfile
+
+    from minio_tpu.object.fs import FSObjects
+
+    root = tempfile.mkdtemp()
+    fs = FSObjects(root)
+    fs.make_bucket("b")
+
+    class Boom:
+        def read(self, n=-1):
+            raise RuntimeError("verify failed")
+
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        fs.put_object("b", "x", Boom(), 100)
+    tmpdir = os.path.join(root, ".mtpu.sys", "tmp")
+    assert os.listdir(tmpdir) == []
+
+
+def test_upload_part_copy(client, bucket):
+    """UploadPartCopy: x-amz-copy-source on put-part copies from an
+    existing object (with optional range) instead of reading the body."""
+    src = b"0123456789" * 1000
+    assert client.request("PUT", f"/{bucket}/part-src", body=src)[0] == 200
+    st, _, body = client.request(
+        "POST", f"/{bucket}/mpcopy", query=[("uploads", "")]
+    )
+    assert st == 200
+    upload_id = ET.fromstring(body).find(f"{NS}UploadId").text
+    st, _, body = client.request(
+        "PUT", f"/{bucket}/mpcopy",
+        query=[("partNumber", "1"), ("uploadId", upload_id)],
+        headers={"x-amz-copy-source": f"/{bucket}/part-src"},
+    )
+    assert st == 200, body
+    etag1 = ET.fromstring(body).find(f"{NS}ETag").text.strip('"')
+    st, _, body = client.request(
+        "PUT", f"/{bucket}/mpcopy",
+        query=[("partNumber", "2"), ("uploadId", upload_id)],
+        headers={"x-amz-copy-source": f"/{bucket}/part-src",
+                 "x-amz-copy-source-range": "bytes=0-4999"},
+    )
+    assert st == 200, body
+    etag2 = ET.fromstring(body).find(f"{NS}ETag").text.strip('"')
+    complete = (
+        '<CompleteMultipartUpload>'
+        f'<Part><PartNumber>1</PartNumber><ETag>"{etag1}"</ETag></Part>'
+        f'<Part><PartNumber>2</PartNumber><ETag>"{etag2}"</ETag></Part>'
+        '</CompleteMultipartUpload>'
+    ).encode()
+    st, _, body = client.request(
+        "POST", f"/{bucket}/mpcopy", query=[("uploadId", upload_id)],
+        body=complete,
+    )
+    assert st == 200, body
+    st, _, got = client.request("GET", f"/{bucket}/mpcopy")
+    assert st == 200 and got == src + src[:5000]

@@ -105,6 +105,28 @@ class StreamingBitrotWriter:
         self.bytes_written += len(chunk)
         return len(chunk)
 
+    @property
+    def device_hashable(self) -> bool:
+        """Only HighwayHash256S digests are computed on-device; other
+        algorithms must keep hashing in write() (a foreign 32-byte digest
+        would permanently mis-frame e.g. a BLAKE2b-512 shard file)."""
+        return self._algo is BitrotAlgorithm.HIGHWAYHASH256S
+
+    def write_with_digest(self, chunk, digest: bytes) -> int:
+        """Frame a chunk whose HighwayHash256 was already computed on the
+        device in the fused encode dispatch (codec.encode_batch_async) —
+        the host hashing in write() is the per-shard hot cost this
+        removes."""
+        if not self.device_hashable:
+            return self.write(chunk)
+        chunk = bytes(chunk)
+        if not chunk:
+            return 0
+        self._sink.write(digest)
+        self._sink.write(chunk)
+        self.bytes_written += len(chunk)
+        return len(chunk)
+
     def close(self):
         if hasattr(self._sink, "close"):
             self._sink.close()

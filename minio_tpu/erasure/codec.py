@@ -32,6 +32,30 @@ from ..utils.errors import (
 _DEVICE_SHARD_THRESHOLD = 4096
 
 
+def _fused_encode_hash_impl(bitmat, blocks):
+    """Parity matmul + HighwayHash of all k+m shards, one compiled unit."""
+    import jax.numpy as jnp
+
+    from ..ops.highwayhash_jax import hash256_batch_jax
+    from ..ops.rs import apply_gf_matrix
+
+    parity = apply_gf_matrix(bitmat, blocks)
+    all_shards = jnp.concatenate([blocks, parity], axis=1)
+    return parity, hash256_batch_jax(all_shards)
+
+
+_fused_encode_hash = None
+
+
+def _get_fused_encode_hash():
+    global _fused_encode_hash
+    if _fused_encode_hash is None:
+        import jax
+
+        _fused_encode_hash = jax.jit(_fused_encode_hash_impl)
+    return _fused_encode_hash
+
+
 class Erasure:
     """Erasure coding engine for one (data, parity, block_size) geometry."""
 
@@ -151,6 +175,33 @@ class Erasure:
         """
         blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
         return self._apply_parity(blocks)
+
+    def encode_batch_async(self, blocks: np.ndarray, with_hashes: bool):
+        """Dispatch a batched encode (and optionally the per-shard bitrot
+        hashes) WITHOUT materializing results on the host.
+
+        Returns (parity, hashes) where parity is a device array [B, M, S]
+        (or host ndarray on the small-shard path) and hashes is a device
+        array [B, K+M, 32] or None. The caller overlaps the device compute
+        with host IO and materializes via np.asarray when needed — the
+        double-buffered pipeline of SURVEY §7.2(4).
+
+        Fusing the HighwayHash-256 of every output shard into the same
+        dispatch replaces the reference's per-shard host hashing inside
+        parallelWriter (cmd/erasure-encode.go:93 + bitrot-streaming.go:48).
+        """
+        blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+        if blocks.shape[-1] < _DEVICE_SHARD_THRESHOLD:
+            parity = rs.gf_matmul_shards_np(self._parity_bits_np, blocks)
+            return parity, None
+        import jax.numpy as jnp
+
+        from ..ops.rs import apply_gf_matrix
+
+        dev_blocks = jnp.asarray(blocks)
+        if not with_hashes:
+            return apply_gf_matrix(self._parity_bitmat(True), dev_blocks), None
+        return _get_fused_encode_hash()(self._parity_bitmat(True), dev_blocks)
 
     # --- reconstruct / decode (cmd/erasure-coding.go:95-118) ---
 

@@ -51,10 +51,14 @@ class ParallelWriter:
         self.write_quorum = write_quorum
         self.errs: list = [None] * len(writers)
 
-    def write(self, blocks: list):
+    def write(self, blocks: list, digests: list | None = None):
         def do(i):
             try:
-                self.writers[i].write(blocks[i])
+                w = self.writers[i]
+                if digests is not None and hasattr(w, "write_with_digest"):
+                    w.write_with_digest(blocks[i], digests[i])
+                else:
+                    w.write(blocks[i])
                 self.errs[i] = None
             except Exception as exc:  # noqa: BLE001 - collected for quorum
                 self.errs[i] = exc
@@ -84,13 +88,42 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     """Read the full stream, erasure-encode, fan out to bitrot writers.
 
     Returns total bytes consumed (ref Erasure.Encode,
-    cmd/erasure-encode.go:73-109). `batch_blocks` full blocks are encoded
-    per device dispatch; the short tail block is encoded alone.
+    cmd/erasure-encode.go:73-109).
+
+    TPU-shaped pipeline (SURVEY §7.2(4)): `batch_blocks` full blocks are
+    dispatched to the device as one [B, k, S] batch — parity matmul AND
+    the per-shard HighwayHash fused in one compiled unit — and the
+    dispatch is ASYNC: while the device computes batch N, the host fans
+    out the writes of batch N-1 and reads batch N+1 from the source.
+    The short tail block is encoded alone on the host.
     """
     writer = ParallelWriter(writers, quorum)
     total = 0
     block_size = erasure.block_size
+    k = erasure.data_blocks
+    shard = erasure.shard_size()
+    want_digests = any(
+        getattr(w, "device_hashable", False) for w in writers if w is not None
+    )
     eof = False
+    pending = None  # (data [B,k,S], parity_future, hashes_future, n_blocks)
+
+    def flush(p) -> None:
+        nonlocal total
+        data, parity_f, hashes_f, n = p
+        parity = np.asarray(parity_f)  # blocks until the dispatch finishes
+        hashes = np.asarray(hashes_f) if hashes_f is not None else None
+        for bi in range(n):
+            blocks = [data[bi, j] for j in range(erasure.data_blocks)] + [
+                parity[bi, j] for j in range(erasure.parity_blocks)
+            ]
+            digests = (
+                [hashes[bi, j].tobytes() for j in range(erasure.total_shards)]
+                if hashes is not None else None
+            )
+            writer.write(blocks, digests)
+            total += block_size
+
     while not eof:
         # Gather up to batch_blocks full blocks.
         bufs: list[bytes] = []
@@ -106,27 +139,31 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
             break
 
         full = [b for b in bufs if len(b) == block_size]
-        if len(full) > 1:
-            shard = erasure.shard_size()
-            k = erasure.data_blocks
+        if full:
             # Each block zero-pads to k*shard (split semantics) before the
             # [B, k, S] batch is shipped to the device.
             data = np.zeros((len(full), k * shard), dtype=np.uint8)
             for bi, b in enumerate(full):
                 data[bi, :block_size] = np.frombuffer(b, dtype=np.uint8)
             data = data.reshape(len(full), k, shard)
-            parity = erasure.encode_batch(data)
-            for bi in range(len(full)):
-                blocks = [data[bi, j] for j in range(erasure.data_blocks)] + [
-                    parity[bi, j] for j in range(erasure.parity_blocks)
-                ]
-                writer.write(blocks)
-                total += block_size
-            bufs = [b for b in bufs if len(b) != block_size]
+            parity_f, hashes_f = erasure.encode_batch_async(
+                data, with_hashes=want_digests
+            )
+            if pending is not None:
+                flush(pending)  # overlap: batch N computes while N-1 writes
+            pending = (data, parity_f, hashes_f, len(full))
+        # Tail (or empty-object sentinel): host path, after the batches.
         for b in bufs:
+            if len(b) == block_size:
+                continue
+            if pending is not None:
+                flush(pending)
+                pending = None
             blocks = erasure.encode_data(b)
             writer.write(blocks)
             total += len(b)
+    if pending is not None:
+        flush(pending)
     return total
 
 

@@ -56,9 +56,17 @@ def _apply_bits(bitmat: jax.Array, shards: jax.Array) -> jax.Array:
 
 def apply_gf_matrix(bitmat, shards) -> jax.Array:
     """Public entry: bitmat int8 [8R,8K] (from gf.bit_matrix), shards
-    uint8 [..., K, S]. Leading dims are batch."""
+    uint8 [..., K, S]. Leading dims are batch.
+
+    On TPU the fused Pallas kernel runs (bit-planes stay in VMEM, see
+    ops/rs_pallas.py); elsewhere the XLA einsum formulation below.
+    """
+    from . import rs_pallas
+
     bitmat = jnp.asarray(bitmat, dtype=jnp.int8)
     shards = jnp.asarray(shards, dtype=jnp.uint8)
+    if rs_pallas.pallas_supported() and shards.shape[-1] >= 128:
+        return rs_pallas.apply_gf_matrix_pallas(bitmat, shards)
     return _apply_bits(bitmat, shards)
 
 

@@ -1,0 +1,62 @@
+"""Backend bring-up armor for the wedge-prone axon TPU tunnel.
+
+The container pins JAX_PLATFORMS=axon and a sitecustomize hook imports
+jax (registering the axon PJRT plugin) at interpreter start; when the
+tunnel relay is down, ANY backend init — even with JAX_PLATFORMS=cpu in
+the env — hangs forever. The only reliable CPU fallback is to strip the
+non-CPU backend factories before first device use.
+
+Ordering constraint: pallas must be imported BEFORE the registry is
+stripped — it registers TPU MLIR lowerings at import time and raises
+"unknown platform tpu" afterwards.
+
+This module must not import jax at module-import time (callers decide
+when backend init is safe).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU backend, optionally with N virtual devices.
+
+    Safe to call only before jax initializes a backend in this process;
+    afterwards it raises RuntimeError if the initialized backend doesn't
+    satisfy the request (loud failure beats a silent wrong-device run).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    try:
+        from jax.experimental import pallas as _pallas  # noqa: F401
+    except Exception:
+        pass
+
+    try:
+        import jax._src.xla_bridge as xb
+
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                del xb._backend_factories[name]
+    except Exception:
+        pass
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        devs = jax.devices()
+        if devs[0].platform != "cpu" or len(devs) < n_devices:
+            raise RuntimeError(
+                "force_cpu needs a fresh process: jax already initialized "
+                f"with {len(devs)} {devs[0].platform} device(s), cannot "
+                f"force an {n_devices}-device CPU mesh"
+            )

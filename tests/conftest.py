@@ -1,36 +1,12 @@
 """Test configuration: force the CPU backend with 8 virtual devices so the
 multi-chip sharding paths (jax.sharding.Mesh over dp/lane axes) are
-exercised without TPU hardware.
+exercised without TPU hardware, and so tests never depend on the health
+of the wedge-prone axon TPU tunnel.
 
-Two things must happen before jax initializes a backend:
-1. JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8 — forced,
-   not setdefault: the container env pins JAX_PLATFORMS=axon (the real-TPU
-   tunnel) and tests must not depend on tunnel health.
-2. Drop every non-CPU backend factory. The axon PJRT plugin is registered
-   eagerly by a sitecustomize hook at interpreter start; if its relay is
-   wedged, backend init hangs forever even with JAX_PLATFORMS=cpu.
+All the ordering-sensitive armor lives in minio_tpu.utils.jaxenv.force_cpu
+(shared with bench.py and __graft_entry__.dryrun_multichip).
 """
 
-import os
+from minio_tpu.utils.jaxenv import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-try:
-    import jax._src.xla_bridge as _xb
-
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            del _xb._backend_factories[_name]
-except Exception:
-    pass
-
-# The sitecustomize hook imports jax at interpreter start, so jax's config
-# already latched JAX_PLATFORMS=axon from the container env; override it.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)

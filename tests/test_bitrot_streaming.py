@@ -241,3 +241,42 @@ def test_heal_stream_restores_shards():
     heal_stream(e, writers, readers, size)
     for i in stale:
         assert healed[i].sink.getvalue() == shards[i].sink.getvalue()
+
+
+def test_fused_device_encode_hash_roundtrip():
+    """PUT with device-fused parity+HighwayHash (encode_batch_async) must
+    produce frames the host streaming verifier accepts bit-exactly, across
+    multiple batches and a short tail (the pipelined encode_stream path)."""
+    import numpy as np
+
+    k, m = 2, 2
+    block_size = k * 8192  # shard 8192 >= device threshold
+    e = Erasure(k, m, block_size)
+    rng = np.random.default_rng(42)
+    # 5 full blocks (two batches at batch_blocks=2 + one) + 1000-byte tail
+    data = rng.integers(0, 256, size=5 * block_size + 1000,
+                        dtype=np.uint8).tobytes()
+    sinks = [io.BytesIO() for _ in range(k + m)]
+    writers = [StreamingBitrotWriter(s) for s in sinks]
+    n = encode_stream(e, io.BytesIO(data), writers, quorum=k + 1,
+                      batch_blocks=2)
+    assert n == len(data)
+
+    # Decode with host-side verifying readers: any device/host hash or
+    # parity mismatch raises ErrFileCorrupt / fails equality.
+    total_len = len(data)
+    shard_file = e.shard_file_size(total_len)
+    readers = []
+    for s in sinks:
+        raw = s.getvalue()
+
+        def opener(off, ln, raw=raw):
+            return io.BytesIO(raw[off:off + ln])
+
+        readers.append(
+            StreamingBitrotReader(opener, shard_file, e.shard_size())
+        )
+    out = io.BytesIO()
+    written, hint = decode_stream(e, out, readers, 0, total_len, total_len)
+    assert written == total_len and hint is None
+    assert out.getvalue() == data

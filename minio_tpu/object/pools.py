@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import heapq
 import io
+import threading
 
 from ..storage.xlmeta import XLMeta
 from ..utils.errors import (
@@ -29,6 +30,19 @@ class ErasureServerPools:
         if not pools:
             raise ValueError("need at least one pool")
         self.pools = pools
+        # Metacache listing state: per-bucket mutation generation (bumped
+        # on every write/delete) + the node-local cache of sorted listing
+        # streams (ref cmd/metacache-server-pool.go:59; see metacache.py
+        # for the design deltas).
+        from .metacache import MetacacheManager
+
+        self._list_gen: dict[str, int] = {}
+        self._gen_lock = threading.Lock()
+        self._metacache = MetacacheManager()
+
+    def _bump_gen(self, bucket: str):
+        with self._gen_lock:
+            self._list_gen[bucket] = self._list_gen.get(bucket, 0) + 1
 
     # --- pool routing ---
 
@@ -74,6 +88,8 @@ class ErasureServerPools:
     def delete_bucket(self, bucket: str, force: bool = False):
         for pool in self.pools:
             pool.delete_bucket(bucket, force=force)
+        self._metacache.invalidate_bucket(bucket)
+        self._list_gen.pop(bucket, None)
 
     def bucket_exists(self, bucket: str) -> bool:
         return any(p.bucket_exists(bucket) for p in self.pools)
@@ -101,7 +117,9 @@ class ErasureServerPools:
     def put_object(self, bucket, object_, reader, size, opts=None):
         self._check_bucket(bucket)
         idx = self._pool_for_put(bucket, object_, opts)
-        return self.pools[idx].put_object(bucket, object_, reader, size, opts)
+        oi = self.pools[idx].put_object(bucket, object_, reader, size, opts)
+        self._bump_gen(bucket)
+        return oi
 
     def get_object(self, bucket, object_, writer, offset=0, length=-1, opts=None):
         self._check_bucket(bucket)
@@ -133,7 +151,9 @@ class ErasureServerPools:
         last_exc = None
         for pool in self.pools:
             try:
-                return pool.delete_object(bucket, object_, opts)
+                out = pool.delete_object(bucket, object_, opts)
+                self._bump_gen(bucket)
+                return out
             except (ErrObjectNotFound, ErrVersionNotFound) as exc:
                 last_exc = exc
         raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
@@ -148,39 +168,70 @@ class ErasureServerPools:
         except Exception as exc:  # noqa: BLE001
             return exc
 
-    # --- listing (merged raw walk; ref cmd/erasure-server-pool.go:876-1030) ---
+    # --- listing (metacache-served; ref cmd/erasure-server-pool.go:876,
+    # --- cmd/metacache-server-pool.go:59-239) ---
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self._check_bucket(bucket)
+        gen = self._list_gen.get(bucket, 0)
+
+        def stream_factory():
+            streams = [p.list_objects_raw(bucket, prefix) for p in self.pools]
+            merged = heapq.merge(*streams, key=lambda t: t[0])
+
+            def dedup():
+                last = None
+                for name, blob in merged:
+                    if name == last:
+                        continue
+                    last = name
+                    yield name, blob
+
+            return dedup()
+
+        from .metacache import StaleListingCache
+
         out = ListObjectsInfo()
         prefixes: set[str] = set()
-        streams = [p.list_objects_raw(bucket, prefix) for p in self.pools]
-        merged = heapq.merge(*streams, key=lambda t: t[0])
-        last_name = None
-        for name, meta_blob in merged:
-            if name == last_name:
-                continue
-            last_name = name
-            if marker and name <= marker:
-                continue
-            if delimiter:
-                rest = name[len(prefix):]
-                if delimiter in rest:
-                    prefixes.add(prefix + rest.split(delimiter, 1)[0] + delimiter)
-                    continue
-            if len(out.objects) >= max_keys:
-                out.is_truncated = True
-                out.next_marker = out.objects[-1].name if out.objects else name
-                break
+        cursor = marker
+        while True:
+            # Over-fetch: delimiter roll-up and delete markers consume
+            # entries without emitting keys.
             try:
-                meta = XLMeta.from_bytes(meta_blob)
-                fi = meta.to_file_info(bucket, name, None)
-            except Exception:  # noqa: BLE001 - skip unreadable entries
+                entries, exhausted = self._metacache.page(
+                    bucket, prefix, gen, cursor, max_keys + 1, stream_factory
+                )
+            except StaleListingCache:
+                # Raced an invalidation (concurrent write/eviction): the
+                # next page call builds a fresh cache at the new gen.
+                gen = self._list_gen.get(bucket, 0)
                 continue
-            if fi.deleted:
-                continue  # latest is a delete marker
-            out.objects.append(ObjectInfo.from_file_info(fi, bucket, name))
+            for name, meta_blob in entries:
+                cursor = name
+                if delimiter:
+                    rest = name[len(prefix):]
+                    if delimiter in rest:
+                        prefixes.add(
+                            prefix + rest.split(delimiter, 1)[0] + delimiter
+                        )
+                        continue
+                try:
+                    meta = XLMeta.from_bytes(meta_blob)
+                    fi = meta.to_file_info(bucket, name, None)
+                except Exception:  # noqa: BLE001 - skip unreadable entries
+                    continue
+                if fi.deleted:
+                    continue  # latest is a delete marker
+                if len(out.objects) >= max_keys:
+                    out.is_truncated = True
+                    out.next_marker = (
+                        out.objects[-1].name if out.objects else name
+                    )
+                    break
+                out.objects.append(ObjectInfo.from_file_info(fi, bucket, name))
+            if out.is_truncated or exhausted or not entries:
+                break
         out.prefixes = sorted(prefixes)
         return out
 
@@ -230,9 +281,11 @@ class ErasureServerPools:
     def complete_multipart_upload(self, bucket, object_, upload_id, parts,
                                   opts=None):
         pool = self._pool_for_upload(bucket, object_, upload_id)
-        return pool.complete_multipart_upload(
+        oi = pool.complete_multipart_upload(
             bucket, object_, upload_id, parts, opts
         )
+        self._bump_gen(bucket)
+        return oi
 
     # --- heal ---
 
@@ -247,6 +300,9 @@ class ErasureServerPools:
                 continue
         if not results:
             raise ErrObjectNotFound(f"{bucket}/{object_}")
+        # Heal can rewrite xl.meta or purge dangling objects — both are
+        # listing-visible mutations.
+        self._bump_gen(bucket)
         return results[0] if len(results) == 1 else results
 
     def heal_bucket(self, bucket):

@@ -21,7 +21,8 @@ ADMIN_PREFIX = "/minio/admin/v3"
 
 class AdminHandlers:
     def __init__(self, object_layer, iam, config_sys=None, metrics=None,
-                 trace=None, notification=None, lockers=None):
+                 trace=None, notification=None, lockers=None,
+                 bucket_meta=None, repl_pool=None):
         self.ol = object_layer
         self.iam = iam
         self.config_sys = config_sys
@@ -29,6 +30,8 @@ class AdminHandlers:
         self.trace = trace
         self.notification = notification
         self.lockers = lockers
+        self.bm = bucket_meta
+        self.repl = repl_pool
         self.started = time.time()
 
     # --- routing ---
@@ -59,6 +62,10 @@ class AdminHandlers:
             ("GET", "trace"): "trace_poll",
             ("POST", "service"): "service_action",
             ("GET", "accountinfo"): "account_info",
+            ("PUT", "set-remote-target"): "set_remote_target",
+            ("GET", "list-remote-targets"): "list_remote_targets",
+            ("DELETE", "remove-remote-target"): "remove_remote_target",
+            ("GET", "replication-stats"): "replication_stats",
         }
         name = table.get((m, head))
         if name is None:
@@ -88,6 +95,10 @@ class AdminHandlers:
         "trace_poll": "admin:ServerTrace",
         "service_action": "admin:ServiceRestart",
         "account_info": "admin:AccountInfo",
+        "set_remote_target": "admin:SetBucketTarget",
+        "list_remote_targets": "admin:GetBucketTarget",
+        "remove_remote_target": "admin:SetBucketTarget",
+        "replication_stats": "admin:ReplicationDiff",
     }
 
     def authorize(self, auth_result, name: str):
@@ -364,3 +375,71 @@ class AdminHandlers:
                 continue
             buckets.append({"name": b.name, "createdNs": b.created_ns})
         return self._json({"accountName": "minio-tpu", "buckets": buckets})
+
+    # --- replication targets (ref cmd/admin-bucket-handlers.go
+    # --- SetRemoteTargetHandler / ListRemoteTargetsHandler) ---
+
+    def set_remote_target(self, ctx) -> Response:
+        if self.bm is None:
+            raise S3Error("NotImplemented", "no bucket metadata sys")
+        bucket = ctx.qdict.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket required")
+        from ..replication.config import (
+            ReplicationTarget,
+            dump_targets,
+            load_targets,
+        )
+
+        try:
+            d = json.loads(ctx.body)
+            if not isinstance(d, dict):
+                raise ValueError("target must be a JSON object")
+            target = ReplicationTarget.from_dict(d)
+        except (ValueError, TypeError, AttributeError) as exc:
+            raise S3Error("InvalidArgument", f"bad target: {exc}") from exc
+        if not target.endpoint or not target.target_bucket:
+            raise S3Error("InvalidArgument", "endpoint and target_bucket required")
+        if not target.arn:
+            import uuid as _uuid
+
+            target.arn = (
+                f"arn:minio:replication::{_uuid.uuid4()}:{target.target_bucket}"
+            )
+        bmeta = self.bm.get(bucket)
+        targets = load_targets(bmeta.replication_targets_json)
+        targets = [t for t in targets if t.arn != target.arn] + [target]
+        self.bm.update(bucket, "replication_targets_json",
+                       dump_targets(targets))
+        return self._json({"arn": target.arn})
+
+    def list_remote_targets(self, ctx) -> Response:
+        if self.bm is None:
+            raise S3Error("NotImplemented", "no bucket metadata sys")
+        bucket = ctx.qdict.get("bucket", "")
+        from ..replication.config import load_targets
+
+        targets = load_targets(self.bm.get(bucket).replication_targets_json)
+        out = []
+        for t in targets:
+            d = t.to_dict()
+            d.pop("secret_key", None)  # never echo credentials
+            out.append(d)
+        return self._json(out)
+
+    def remove_remote_target(self, ctx) -> Response:
+        if self.bm is None:
+            raise S3Error("NotImplemented", "no bucket metadata sys")
+        bucket = ctx.qdict.get("bucket", "")
+        arn = ctx.qdict.get("arn", "")
+        from ..replication.config import dump_targets, load_targets
+
+        targets = load_targets(self.bm.get(bucket).replication_targets_json)
+        kept = [t for t in targets if t.arn != arn]
+        self.bm.update(bucket, "replication_targets_json", dump_targets(kept))
+        return self._json({"removed": len(targets) - len(kept)})
+
+    def replication_stats(self, ctx) -> Response:
+        if self.repl is None:
+            return self._json({})
+        return self._json(dict(self.repl.stats))

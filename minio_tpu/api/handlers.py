@@ -208,17 +208,45 @@ class S3ApiHandlers:
     """All S3 endpoints bound to an ObjectLayer + subsystems."""
 
     def __init__(self, object_layer, bucket_meta, iam, notify=None,
-                 config=None, sse_config=None):
+                 config=None, sse_config=None, repl_pool=None):
         self.ol = object_layer
         self.bm = bucket_meta
         self.iam = iam
         self.notify = notify
         self.config = config
         self.sse_config = sse_config
+        self.repl = repl_pool
+
+    # ---------- replication hooks (ref cmd/bucket-replication.go) ----------
+
+    def _repl_rule(self, bucket: str, key: str):
+        if self.repl is None:
+            return None
+        bmeta = self.bm.get(bucket)
+        if not bmeta.replication_xml:
+            return None
+        from ..replication.config import ReplicationConfig
+
+        try:
+            return ReplicationConfig.parse(bmeta.replication_xml).rule_for(key)
+        except Exception:  # noqa: BLE001 - malformed config never blocks IO
+            return None
+
+    def _schedule_replication(self, bucket: str, key: str,
+                              version_id: str, op: str):
+        from ..replication.pool import ReplicationTask
+
+        self.repl.schedule(ReplicationTask(
+            bucket=bucket, object=key, version_id=version_id, op=op,
+        ))
 
     def _opts_for(self, bucket: str, query: dict,
                   headers: dict | None = None) -> ObjectOptions:
         bmeta = self.bm.get(bucket)
+        # versionId="null" stays the literal sentinel here so the object
+        # layer still sees a TARGETED request (a null-targeted delete must
+        # remove the null version, not lay down a delete marker); the
+        # xl.meta journal maps it to the internal empty version id.
         return ObjectOptions(
             version_id=query.get("versionId", ""),
             versioned=bmeta.versioning_enabled,
@@ -362,6 +390,68 @@ class S3ApiHandlers:
                 base64.b64encode(res.next_marker.encode()).decode()
             )
         self._fill_entries(root, res, owner=fetch_owner)
+        return Response.xml(root)
+
+    def list_object_versions(self, ctx) -> Response:
+        """GET /bucket?versions (ref ListObjectVersionsHandler,
+        cmd/bucket-listobjects-handlers.go:214-352)."""
+        self._check_bucket(ctx.bucket)
+        q = ctx.qdict
+        prefix = q.get("prefix", "")
+        key_marker = q.get("key-marker", "")
+        vid_marker = q.get("version-id-marker", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "max-keys negative")
+        if vid_marker and not key_marker:
+            raise S3Error(
+                "InvalidArgument", "version-id-marker without key-marker"
+            )
+        try:
+            res = self.ol.list_object_versions(
+                ctx.bucket, prefix=prefix, key_marker=key_marker,
+                version_id_marker=vid_marker, delimiter=delimiter,
+                max_keys=max_keys,
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        root = _xml_root("ListVersionsResult")
+        ET.SubElement(root, "Name").text = ctx.bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "KeyMarker").text = key_marker
+        if vid_marker:
+            ET.SubElement(root, "VersionIdMarker").text = vid_marker
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if res.is_truncated else "false"
+        )
+        if res.is_truncated:
+            ET.SubElement(root, "NextKeyMarker").text = res.next_key_marker
+            ET.SubElement(root, "NextVersionIdMarker").text = (
+                res.next_version_id_marker
+            )
+        for oi in res.versions:
+            tag = "DeleteMarker" if oi.delete_marker else "Version"
+            v = ET.SubElement(root, tag)
+            ET.SubElement(v, "Key").text = oi.name
+            ET.SubElement(v, "VersionId").text = oi.version_id or "null"
+            ET.SubElement(v, "IsLatest").text = (
+                "true" if oi.is_latest else "false"
+            )
+            ET.SubElement(v, "LastModified").text = iso8601(oi.mod_time_ns)
+            if not oi.delete_marker:
+                ET.SubElement(v, "ETag").text = f'"{oi.etag}"'
+                ET.SubElement(v, "Size").text = str(oi.size)
+                ET.SubElement(v, "StorageClass").text = "STANDARD"
+            o = ET.SubElement(v, "Owner")
+            ET.SubElement(o, "ID").text = "minio-tpu"
+            ET.SubElement(o, "DisplayName").text = "minio-tpu"
+        for p in res.prefixes:
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
         return Response.xml(root)
 
     def _fill_entries(self, root, res, owner: bool = True):
@@ -552,6 +642,16 @@ class S3ApiHandlers:
             raise S3Error("EntityTooLarge")
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        repl_rule = self._repl_rule(ctx.bucket, ctx.object)
+        incoming_replica = (
+            opts.user_defined.get("x-amz-meta-mtpu-replication") == "replica"
+        )
+        if repl_rule is not None:
+            from ..replication.pool import PENDING, REPL_STATUS_KEY, REPLICA
+
+            opts.user_defined[REPL_STATUS_KEY] = (
+                REPLICA if incoming_replica else PENDING
+            )
         reader = ctx.body_reader
         resp_extra: dict = {}
         from . import transforms
@@ -589,6 +689,10 @@ class S3ApiHandlers:
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id
         self._event("s3:ObjectCreated:Put", ctx.bucket, oi=oi)
+        if repl_rule is not None and not incoming_replica:
+            vid = oi.version_id if oi.version_id != "null" else ""
+            self._schedule_replication(ctx.bucket, ctx.object, vid, "put")
+            headers["X-Amz-Replication-Status"] = "PENDING"
         return Response(200, headers)
 
     def _copy_object(self, ctx, copy_source: str) -> Response:
@@ -604,6 +708,11 @@ class S3ApiHandlers:
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
             opts.user_defined = dict(src_info.user_defined)
+        repl_rule = self._repl_rule(ctx.bucket, ctx.object)
+        if repl_rule is not None:
+            from ..replication.pool import PENDING, REPL_STATUS_KEY
+
+            opts.user_defined[REPL_STATUS_KEY] = PENDING
         # Stream source -> destination in 1 MiB pulls; a multi-GiB copy
         # must not materialize in memory.
         reader = _RangeCopyReader(
@@ -615,6 +724,9 @@ class S3ApiHandlers:
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        if repl_rule is not None:
+            vid = oi.version_id if oi.version_id != "null" else ""
+            self._schedule_replication(ctx.bucket, ctx.object, vid, "put")
         root = _xml_root("CopyObjectResult")
         ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
         ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
@@ -665,6 +777,12 @@ class S3ApiHandlers:
         }
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id
+        from ..replication.pool import REPL_STATUS_KEY
+
+        if REPL_STATUS_KEY in oi.user_defined:
+            headers["X-Amz-Replication-Status"] = (
+                oi.user_defined[REPL_STATUS_KEY]
+            )
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
@@ -780,6 +898,17 @@ class S3ApiHandlers:
             if api.api.code not in ("NoSuchKey", "NoSuchVersion"):
                 raise api from exc
         self._event("s3:ObjectRemoved:Delete", ctx.bucket, key=ctx.object)
+        # Replicate un-targeted deletes (a versionId-targeted permanent
+        # delete stays local, ref replicateDelete semantics).
+        if "versionId" not in ctx.qdict:
+            rule = self._repl_rule(ctx.bucket, ctx.object)
+            if rule is not None:
+                op = (
+                    "delete-marker"
+                    if headers.get("x-amz-delete-marker") == "true"
+                    else "delete"
+                )
+                self._schedule_replication(ctx.bucket, ctx.object, "", op)
         return Response(204, headers)
 
     # ---------- multipart ----------

@@ -54,6 +54,7 @@ _ACTIONS = {
     "get_bucket_location": "s3:GetBucketLocation",
     "list_objects_v1": "s3:ListBucket",
     "list_objects_v2": "s3:ListBucket",
+    "list_object_versions": "s3:ListBucketVersions",
     "delete_multiple_objects": "s3:DeleteObject",
     "put_bucket_policy": "s3:PutBucketPolicy",
     "get_bucket_policy": "s3:GetBucketPolicy",
@@ -189,6 +190,8 @@ def route(ctx: RequestContext) -> str:
                 return "bucket_notification"
             if "uploads" in q:
                 return "list_multipart_uploads"
+            if "versions" in q:
+                return "list_object_versions"
             if q.get("list-type") == "2":
                 return "list_objects_v2"
             return "list_objects_v1"
@@ -253,14 +256,20 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
                  sse_config=None):
+        from ..replication import ReplicationPool
+
+        self.repl_pool = ReplicationPool(
+            object_layer, bucket_meta, sse_config=sse_config
+        ).start()
         self.handlers = S3ApiHandlers(
             object_layer, bucket_meta, iam, notify,
             config=config_sys.config if config_sys is not None else None,
-            sse_config=sse_config,
+            sse_config=sse_config, repl_pool=self.repl_pool,
         )
         self.admin = AdminHandlers(
             object_layer, iam, config_sys=config_sys, metrics=metrics,
             trace=trace, notification=notification,
+            bucket_meta=bucket_meta, repl_pool=self.repl_pool,
         )
         self.iam = iam
         self.region = region
@@ -294,6 +303,7 @@ class S3Server:
         return self
 
     def stop(self):
+        self.repl_pool.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
@@ -407,6 +417,15 @@ class S3Server:
             self.iam, bucket_policy, auth_result, action,
             ctx.bucket, ctx.object,
         )
+        # Replica-marked writes suppress re-replication, so the marker is
+        # privileged: only principals with s3:ReplicateObject may set it
+        # (ref auth-handler.go ReplicateObjectAction check).
+        if (name == "put_object"
+                and ctx.headers.get("x-amz-meta-mtpu-replication")):
+            authorize(
+                self.iam, bucket_policy, auth_result, "s3:ReplicateObject",
+                ctx.bucket, ctx.object,
+            )
         # Copy requests read from a second location: authorize
         # s3:GetObject on the parsed source too (ref CopyObjectHandler,
         # cmd/object-handlers.go — the source has its own auth check).

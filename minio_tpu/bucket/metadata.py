@@ -24,7 +24,7 @@ class BucketMetadata:
     FIELDS = (
         "policy_json", "versioning_xml", "tagging_xml", "lifecycle_xml",
         "sse_xml", "quota_json", "object_lock_xml", "notification_xml",
-        "replication_xml",
+        "replication_xml", "replication_targets_json",
     )
 
     def __init__(self, name: str):

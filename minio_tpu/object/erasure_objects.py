@@ -278,6 +278,34 @@ class ErasureObjects(MultipartMixin):
         fi.num_versions = 1
         return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
 
+    def update_object_metadata(self, bucket: str, object_: str,
+                               version_id: str, updates: dict) -> None:
+        """Merge `updates` into a version's user metadata on all online
+        disks (the reference's updateObjectMeta, used by replication to
+        flip X-Amz-Replication-Status, cmd/bucket-replication.go:700+)."""
+        # read_data=True: the per-disk FileInfo carries inline small-object
+        # shards; rewriting the version without them would destroy data.
+        fi, fis, _ = self._read_quorum_file_info(
+            bucket, object_, version_id, read_data=True
+        )
+        new_meta = dict(fi.metadata)
+        new_meta.update(updates)
+
+        def do(i):
+            disk = self.disks[i]
+            meta = fis[i]
+            if disk is None or meta is None:
+                return
+            m = FileInfo.from_dict(meta.to_dict())
+            m.volume, m.name = bucket, object_
+            m.metadata = dict(new_meta)
+            try:
+                disk.update_metadata(bucket, object_, m)
+            except Exception:  # noqa: BLE001 - best effort per disk
+                pass
+
+        list(_obj_pool.map(do, range(len(self.disks))))
+
     def _cleanup_tmp(self, disks: list, tmp_id: str):
         for disk in disks:
             if disk is None:

@@ -255,6 +255,29 @@ class FSObjects:
 
     # --- listing (tree walk, ref cmd/tree-walk.go) ---
 
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             key_marker: str = "",
+                             version_id_marker: str = "",
+                             delimiter: str = "",
+                             max_keys: int = 1000):
+        """FS mode has no versioning (ref fs-v1 rejects versioned APIs with
+        NotImplemented for writes); listing versions reports every object
+        as its single 'null' version, matching S3 on an unversioned
+        bucket."""
+        from .types import ListObjectVersionsInfo
+
+        lo = self.list_objects(bucket, prefix, key_marker, delimiter, max_keys)
+        out = ListObjectVersionsInfo(
+            is_truncated=lo.is_truncated,
+            next_key_marker=lo.next_marker,
+            prefixes=lo.prefixes,
+        )
+        for oi in lo.objects:
+            oi.version_id = "null"
+            oi.is_latest = True
+            out.versions.append(oi)
+        return out
+
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000,
                      opts=None) -> ListObjectsInfo:

@@ -171,12 +171,10 @@ class ErasureServerPools:
     # --- listing (metacache-served; ref cmd/erasure-server-pool.go:876,
     # --- cmd/metacache-server-pool.go:59-239) ---
 
-    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
-                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
-        self._check_bucket(bucket)
-        gen = self._list_gen.get(bucket, 0)
-
-        def stream_factory():
+    def _merged_stream_factory(self, bucket: str, prefix: str):
+        """Factory of the deduplicated cross-pool sorted (name, xl.meta)
+        stream — the single source both listing APIs cache from."""
+        def factory():
             streams = [p.list_objects_raw(bucket, prefix) for p in self.pools]
             merged = heapq.merge(*streams, key=lambda t: t[0])
 
@@ -189,6 +187,16 @@ class ErasureServerPools:
                     yield name, blob
 
             return dedup()
+
+        return factory
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
+        self._check_bucket(bucket)
+        if max_keys <= 0:
+            return ListObjectsInfo()  # S3: max-keys=0 -> empty, not truncated
+        gen = self._list_gen.get(bucket, 0)
+        stream_factory = self._merged_stream_factory(bucket, prefix)
 
         from .metacache import StaleListingCache
 
@@ -231,6 +239,92 @@ class ErasureServerPools:
                     break
                 out.objects.append(ObjectInfo.from_file_info(fi, bucket, name))
             if out.is_truncated or exhausted or not entries:
+                break
+        out.prefixes = sorted(prefixes)
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             key_marker: str = "",
+                             version_id_marker: str = "",
+                             delimiter: str = "",
+                             max_keys: int = 1000):
+        """ListObjectVersions: every version (objects AND delete markers)
+        of every key, keys ascending, versions newest-first within a key
+        (ref cmd/bucket-listobjects-handlers.go:214-352 +
+        erasure-server-pool.go ListObjectVersions). Served from the same
+        metacache streams as list_objects — the xl.meta blobs carry the
+        full version journal, so no extra disk reads are needed."""
+        from ..storage.fileinfo import FileInfo
+        from .metacache import StaleListingCache
+        from .types import ListObjectVersionsInfo
+
+        self._check_bucket(bucket)
+        if max_keys <= 0:
+            return ListObjectVersionsInfo()  # S3: empty, not truncated
+        gen = self._list_gen.get(bucket, 0)
+        stream_factory = self._merged_stream_factory(bucket, prefix)
+
+        out = ListObjectVersionsInfo()
+        prefixes: set[str] = set()
+        # Page from the key BEFORE key_marker so version_id_marker can
+        # resume mid-key.
+        cursor = key_marker[:-1] if key_marker else ""
+        vid_skip = version_id_marker
+        truncated = False
+        while not truncated:
+            try:
+                entries, exhausted = self._metacache.page(
+                    bucket, prefix, gen, cursor, max_keys + 1, stream_factory
+                )
+            except StaleListingCache:
+                gen = self._list_gen.get(bucket, 0)
+                continue
+            for name, meta_blob in entries:
+                cursor = name
+                if key_marker and name < key_marker:
+                    continue
+                if key_marker and name == key_marker and not vid_skip:
+                    continue  # marker key fully consumed last page
+                if delimiter:
+                    rest = name[len(prefix):]
+                    if delimiter in rest:
+                        prefixes.add(
+                            prefix + rest.split(delimiter, 1)[0] + delimiter
+                        )
+                        continue
+                try:
+                    meta = XLMeta.from_bytes(meta_blob)
+                except Exception:  # noqa: BLE001
+                    continue
+                versions = meta.versions
+                if key_marker and name == key_marker and vid_skip:
+                    # resume after version_id_marker within this key
+                    idx = next(
+                        (i + 1 for i, v in enumerate(versions)
+                         if (v["vid"] or "null") == vid_skip),
+                        len(versions),
+                    )
+                    versions = versions[idx:]
+                    vid_skip = ""
+                for i, v in enumerate(versions):
+                    if len(out.versions) >= max_keys:
+                        truncated = True
+                        out.is_truncated = True
+                        last = out.versions[-1] if out.versions else None
+                        out.next_key_marker = last.name if last else name
+                        out.next_version_id_marker = (
+                            (last.version_id or "null") if last else ""
+                        )
+                        break
+                    fi = FileInfo.from_dict(v)
+                    fi.volume, fi.name = bucket, name
+                    fi.is_latest = meta.versions[0]["vid"] == v["vid"]
+                    oi = ObjectInfo.from_file_info(fi, bucket, name,
+                                                   versioned=True)
+                    out.versions.append(oi)
+                if truncated:
+                    break
+            if truncated or exhausted or not entries:
                 break
         out.prefixes = sorted(prefixes)
         return out
@@ -286,6 +380,17 @@ class ErasureServerPools:
         )
         self._bump_gen(bucket)
         return oi
+
+    def update_object_metadata(self, bucket, object_, version_id, updates):
+        last_exc = None
+        for pool in self.pools:
+            try:
+                return pool.update_object_metadata(
+                    bucket, object_, version_id, updates
+                )
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
 
     # --- heal ---
 

@@ -237,6 +237,11 @@ class ErasureSets:
             bucket, object_, upload_id, parts, opts
         )
 
+    def update_object_metadata(self, bucket, object_, version_id, updates):
+        return self.get_hashed_set(object_).update_object_metadata(
+            bucket, object_, version_id, updates
+        )
+
     def heal_object(self, bucket, object_, version_id="", remove_dangling=False):
         return self.get_hashed_set(object_).heal_object(
             bucket, object_, version_id, remove_dangling

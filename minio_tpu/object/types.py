@@ -83,6 +83,18 @@ class ListObjectsInfo:
 
 
 @dataclass
+class ListObjectVersionsInfo:
+    """Result of ListObjectVersions (ref cmd/object-api-datatypes.go
+    ListObjectVersionsInfo)."""
+
+    is_truncated: bool = False
+    next_key_marker: str = ""
+    next_version_id_marker: str = ""
+    versions: list[ObjectInfo] = field(default_factory=list)  # incl. markers
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
 class MultipartInfo:
     bucket: str = ""
     object: str = ""

@@ -1,0 +1,14 @@
+"""Bucket replication (CRR): async cross-cluster copy of object writes
+and deletes — the equivalent of the reference's
+cmd/bucket-replication.go / cmd/bucket-targets.go subsystem."""
+
+from .client import S3Client
+from .config import ReplicationConfig, ReplicationTarget
+from .pool import ReplicationPool
+
+__all__ = [
+    "ReplicationConfig",
+    "ReplicationPool",
+    "ReplicationTarget",
+    "S3Client",
+]

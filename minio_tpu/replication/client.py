@@ -1,0 +1,91 @@
+"""Minimal SigV4 S3 client used by the replication workers (and handy as
+a general client library). The reference uses minio-go for its remote
+targets (cmd/bucket-targets.go); this is the same surface reduced to
+what replication needs: put/delete/head with metadata and version ids.
+"""
+
+from __future__ import annotations
+
+import http.client
+import urllib.parse
+
+from ..api.sign import sign_v4_request
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"HTTP {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+class S3Client:
+    """One remote endpoint + credential pair."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", timeout: int = 30):
+        # endpoint is "host:port" (http assumed — in-cluster replication
+        # plane; TLS termination is a fronting concern here).
+        self.endpoint = endpoint
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str,
+                 query: list[tuple[str, str]] | None = None,
+                 headers: dict | None = None, body: bytes = b""):
+        query = query or []
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        headers = sign_v4_request(
+            self.secret_key, self.access_key, method, self.endpoint,
+            path, query, dict(headers or {}), body, region=self.region,
+        )
+        conn = http.client.HTTPConnection(self.endpoint, timeout=self.timeout)
+        try:
+            conn.request(method, url, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # --- object ops ---
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   metadata: dict | None = None) -> dict:
+        headers = dict(metadata or {})
+        st, h, body = self._request("PUT", f"/{bucket}/{key}",
+                                    headers=headers, body=data)
+        if st != 200:
+            raise S3Error(st, body)
+        return h
+
+    def get_object(self, bucket: str, key: str,
+                   version_id: str = "") -> tuple[bytes, dict]:
+        q = [("versionId", version_id)] if version_id else []
+        st, h, body = self._request("GET", f"/{bucket}/{key}", query=q)
+        if st != 200:
+            raise S3Error(st, body)
+        return body, h
+
+    def head_object(self, bucket: str, key: str,
+                    version_id: str = "") -> dict:
+        q = [("versionId", version_id)] if version_id else []
+        st, h, body = self._request("HEAD", f"/{bucket}/{key}", query=q)
+        if st != 200:
+            raise S3Error(st, body)
+        return h
+
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str = "") -> dict:
+        q = [("versionId", version_id)] if version_id else []
+        st, h, body = self._request("DELETE", f"/{bucket}/{key}", query=q)
+        if st not in (200, 204):
+            raise S3Error(st, body)
+        return h
+
+    def bucket_exists(self, bucket: str) -> bool:
+        st, _, _ = self._request("HEAD", f"/{bucket}")
+        return st == 200

@@ -1,0 +1,127 @@
+"""Replication configuration: the S3 ReplicationConfiguration XML rules
+(ref pkg/bucket/replication/) and the remote-target registry
+(ref cmd/bucket-targets.go BucketTargetSys) persisted in bucket
+metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _find_text(el, tag: str, default: str = "") -> str:
+    child = el.find(f"{_NS}{tag}")
+    if child is None:
+        child = el.find(tag)  # tolerate un-namespaced configs
+    return (child.text or "").strip() if child is not None else default
+
+
+def _find(el, tag: str):
+    child = el.find(f"{_NS}{tag}")
+    return child if child is not None else el.find(tag)
+
+
+@dataclass
+class ReplicationRule:
+    id: str = ""
+    status: str = "Enabled"
+    priority: int = 0
+    prefix: str = ""
+    destination_arn: str = ""
+    delete_marker_replication: bool = False
+    delete_replication: bool = False
+
+    @property
+    def active(self) -> bool:
+        return self.status == "Enabled"
+
+    def matches(self, key: str) -> bool:
+        return self.active and key.startswith(self.prefix)
+
+
+@dataclass
+class ReplicationConfig:
+    role: str = ""
+    rules: list[ReplicationRule] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, xml_text: str) -> "ReplicationConfig":
+        root = ET.fromstring(xml_text)
+        cfg = cls(role=_find_text(root, "Role"))
+        for rule_el in list(root):
+            if not rule_el.tag.endswith("Rule"):
+                continue
+            rule = ReplicationRule(
+                id=_find_text(rule_el, "ID"),
+                status=_find_text(rule_el, "Status", "Enabled"),
+                prefix=_find_text(rule_el, "Prefix"),
+            )
+            try:
+                rule.priority = int(_find_text(rule_el, "Priority", "0"))
+            except ValueError:
+                rule.priority = 0
+            filt = _find(rule_el, "Filter")
+            if filt is not None:
+                rule.prefix = _find_text(filt, "Prefix", rule.prefix)
+            dest = _find(rule_el, "Destination")
+            if dest is not None:
+                rule.destination_arn = _find_text(dest, "Bucket")
+            dmr = _find(rule_el, "DeleteMarkerReplication")
+            if dmr is not None:
+                rule.delete_marker_replication = (
+                    _find_text(dmr, "Status") == "Enabled"
+                )
+            dr = _find(rule_el, "DeleteReplication")
+            if dr is not None:
+                rule.delete_replication = (
+                    _find_text(dr, "Status") == "Enabled"
+                )
+            cfg.rules.append(rule)
+        cfg.rules.sort(key=lambda r: -r.priority)
+        return cfg
+
+    def rule_for(self, key: str) -> ReplicationRule | None:
+        for r in self.rules:
+            if r.matches(key):
+                return r
+        return None
+
+
+@dataclass
+class ReplicationTarget:
+    """One remote cluster target (ref madmin.BucketTarget)."""
+
+    arn: str = ""
+    endpoint: str = ""
+    access_key: str = ""
+    secret_key: str = ""
+    target_bucket: str = ""
+    region: str = "us-east-1"
+
+    def to_dict(self) -> dict:
+        return {
+            "arn": self.arn, "endpoint": self.endpoint,
+            "access_key": self.access_key, "secret_key": self.secret_key,
+            "target_bucket": self.target_bucket, "region": self.region,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicationTarget":
+        return cls(**{k: d.get(k, "") for k in (
+            "arn", "endpoint", "access_key", "secret_key",
+            "target_bucket",
+        )}, region=d.get("region", "us-east-1"))
+
+
+def load_targets(raw_json: str) -> list[ReplicationTarget]:
+    if not raw_json:
+        return []
+    return [ReplicationTarget.from_dict(d) for d in json.loads(raw_json)]
+
+
+def dump_targets(targets: list[ReplicationTarget]) -> str:
+    return json.dumps([t.to_dict() for t in targets])

@@ -1,0 +1,255 @@
+"""ReplicationPool: the async worker pool that copies object writes and
+deletes to remote targets, with a bounded main queue and an MRF-style
+retry queue — the equivalent of the reference's ReplicationPool
+(cmd/bucket-replication.go:817-940: N workers over a 1000-deep channel,
+100k-deep MRF retry channel) with threads in place of goroutines.
+
+Status protocol (ref replicateObject :574): the writer stamps the source
+version's metadata with X-Amz-Replication-Status=PENDING; a worker
+copies the bytes + user metadata to the target bucket and flips the
+source status to COMPLETED or FAILED. FAILED/missed operations get
+re-queued by the retry drain, mirroring the MRF behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+# Internal metadata key holding the replication status (surfaced as the
+# X-Amz-Replication-Status response header).
+REPL_STATUS_KEY = "x-mtpu-internal-replication-status"
+
+PENDING = "PENDING"
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+REPLICA = "REPLICA"
+
+
+@dataclass
+class ReplicationTask:
+    bucket: str
+    object: str
+    version_id: str = ""
+    op: str = "put"  # "put" | "delete" | "delete-marker"
+    attempts: int = 0
+    enqueued_ns: int = field(default_factory=time.monotonic_ns)
+
+
+class ReplicationPool:
+    """Worker pool bound to one local ObjectLayer + target registry."""
+
+    MAX_QUEUE = 1000
+    MAX_RETRY_QUEUE = 100_000
+    MAX_ATTEMPTS = 3
+
+    def __init__(self, object_layer, bucket_meta, workers: int = 4,
+                 retry_interval: float = 1.0, sse_config=None):
+        self.ol = object_layer
+        self.bm = bucket_meta
+        self.sse_config = sse_config
+        self._queue: deque[ReplicationTask] = deque()
+        self._retry: deque[ReplicationTask] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._retry_interval = retry_interval
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"mtpu-repl-{i}")
+            for i in range(workers)
+        ]
+        self._retry_thread = threading.Thread(
+            target=self._retry_drain, daemon=True, name="mtpu-repl-retry"
+        )
+        self.stats = {"queued": 0, "completed": 0, "failed": 0,
+                      "retried": 0, "dropped": 0}
+        self._inflight = 0
+
+    def start(self) -> "ReplicationPool":
+        for t in self._threads:
+            t.start()
+        self._retry_thread.start()
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    # --- scheduling (ref scheduleReplication :1080) ---
+
+    def schedule(self, task: ReplicationTask):
+        with self._cv:
+            if len(self._queue) >= self.MAX_QUEUE:
+                # overflow to the retry queue rather than dropping
+                if len(self._retry) < self.MAX_RETRY_QUEUE:
+                    self._retry.append(task)
+                    self.stats["retried"] += 1
+                else:
+                    self.stats["dropped"] += 1
+                return
+            self._queue.append(task)
+            self.stats["queued"] += 1
+            self._cv.notify()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Test/ops helper: wait until both queues are empty."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._queue and not self._retry and not self._inflight:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # --- workers ---
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(timeout=1.0)
+                if self._stop:
+                    return
+                task = self._queue.popleft()
+                self._inflight += 1
+            mark_failed = False
+            try:
+                self._replicate(task)
+            except Exception:  # noqa: BLE001 - re-queue below
+                task.attempts += 1
+                with self._cv:
+                    if (task.attempts < self.MAX_ATTEMPTS
+                            and len(self._retry) < self.MAX_RETRY_QUEUE):
+                        self._retry.append(task)
+                        self.stats["retried"] += 1
+                    else:
+                        self.stats["failed"] += 1
+                        mark_failed = True
+            finally:
+                if mark_failed:
+                    # metadata I/O stays OUTSIDE the pool lock: a hung disk
+                    # must not stall schedule() callers cluster-wide
+                    self._mark(task, FAILED)
+                with self._cv:
+                    self._inflight -= 1
+
+    def _retry_drain(self):
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+            time.sleep(self._retry_interval)
+            with self._cv:
+                tasks, self._retry = list(self._retry), deque()
+            for t in tasks:
+                self.schedule(t)
+
+    # --- the actual copy (ref replicateObject :574) ---
+
+    def _targets_for(self, bucket: str):
+        from .config import ReplicationConfig, load_targets
+
+        bmeta = self.bm.get(bucket)
+        if not bmeta.replication_xml:
+            return None, []
+        cfg = ReplicationConfig.parse(bmeta.replication_xml)
+        targets = load_targets(
+            getattr(bmeta, "replication_targets_json", "") or ""
+        )
+        return cfg, targets
+
+    def _client_for(self, target):
+        from .client import S3Client
+
+        return S3Client(target.endpoint, target.access_key,
+                        target.secret_key, region=target.region)
+
+    def _replicate(self, task: ReplicationTask):
+        from ..object.types import ObjectOptions
+
+        cfg, targets = self._targets_for(task.bucket)
+        rule = cfg.rule_for(task.object) if cfg is not None else None
+        if rule is None or not targets:
+            # Config/targets vanished after scheduling: a PENDING stamp
+            # must not linger forever.
+            self.stats["failed"] += 1
+            self._mark(task, FAILED)
+            return
+        # Only targets the rule actually names; a dangling destination ARN
+        # must NOT spill data to unrelated targets.
+        if rule.destination_arn:
+            matched = [t for t in targets if t.arn == rule.destination_arn]
+        else:
+            matched = list(targets)
+        if not matched:
+            self.stats["failed"] += 1
+            self._mark(task, FAILED)
+            return
+
+        if task.op == "put":
+            opts = ObjectOptions(version_id=task.version_id)
+            data = self.ol.get_object_bytes(task.bucket, task.object,
+                                            opts=opts)
+            info = self.ol.get_object_info(task.bucket, task.object, opts)
+            from ..api import transforms
+
+            if transforms.is_transformed(info.user_defined):
+                # Stored bytes are encrypted/compressed: invert to the
+                # logical object before shipping (the target applies its
+                # own transforms). SSE-C can't be inverted without the
+                # client key -> raises -> FAILED, like the reference.
+                data, _ = transforms.apply_get_transforms(
+                    info.user_defined, {}, self.sse_config,
+                    task.bucket, task.object, data,
+                )
+            headers = {
+                k: v for k, v in info.user_defined.items()
+                if k.startswith("x-amz-meta-")
+            }
+            if info.content_type:
+                headers["Content-Type"] = info.content_type
+            # Mark the copy as a replica so the target doesn't re-replicate
+            # (ref ReplicationStatusReplica).
+            headers["x-amz-meta-mtpu-replication"] = "replica"
+            for t in matched:
+                self._client_for(t).put_object(
+                    t.target_bucket or task.bucket, task.object, data,
+                    metadata=headers,
+                )
+            self._mark(task, COMPLETED)
+            self.stats["completed"] += 1
+        elif task.op in ("delete", "delete-marker"):
+            # Permanent deletes and delete markers each have their own
+            # rule switch (ref DeleteReplication / DeleteMarkerReplication).
+            wanted = (
+                rule.delete_marker_replication
+                if task.op == "delete-marker" else rule.delete_replication
+            )
+            if not wanted:
+                return
+            for t in matched:
+                try:
+                    self._client_for(t).delete_object(
+                        t.target_bucket or task.bucket, task.object
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    from .client import S3Error
+
+                    if isinstance(exc, S3Error) and exc.status == 404:
+                        continue  # already gone on the target
+                    raise
+            self.stats["completed"] += 1
+
+    def _mark(self, task: ReplicationTask, status: str):
+        if task.op != "put":
+            return
+        try:
+            self.ol.update_object_metadata(
+                task.bucket, task.object, task.version_id,
+                {REPL_STATUS_KEY: status},
+            )
+        except Exception:  # noqa: BLE001 - status is advisory
+            pass

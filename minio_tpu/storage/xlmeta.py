@@ -21,8 +21,14 @@ from .fileinfo import FileInfo
 XL_META_MAGIC = b"XLT1"
 XL_META_VERSION = 1
 
-# Sentinel for the "null" (unversioned) version, ref nullVersionID.
+# Internal id of the "null" (unversioned) version, ref nullVersionID.
+# Clients address it as the literal "null" (S3 semantics); the journal
+# stores it with an empty id.
 NULL_VERSION_ID = ""
+
+
+def _normalize_vid(version_id: str | None) -> str | None:
+    return NULL_VERSION_ID if version_id == "null" else version_id
 
 
 class XLMeta:
@@ -58,17 +64,21 @@ class XLMeta:
         self.versions.sort(key=lambda v: v["mt"], reverse=True)
 
     def add_version(self, fi: FileInfo):
-        """Insert or replace the version with fi's version_id."""
+        """Insert or replace the version with fi's version_id. The write
+        path normalizes the client-facing "null" sentinel too, so all
+        three journal entry points agree on the internal empty id."""
         d = fi.to_dict()
-        self.versions = [v for v in self.versions if v["vid"] != fi.version_id]
+        d["vid"] = _normalize_vid(d["vid"]) or NULL_VERSION_ID
+        self.versions = [v for v in self.versions if v["vid"] != d["vid"]]
         self.versions.append(d)
         self._sort()
 
     def delete_version(self, fi: FileInfo) -> str:
         """Remove a version; returns its data_dir (to be deleted by the
         caller). Raises ErrFileVersionNotFound when absent."""
+        want = _normalize_vid(fi.version_id)
         for i, v in enumerate(self.versions):
-            if v["vid"] == fi.version_id:
+            if v["vid"] == want:
                 if v["del"] and not fi.deleted:
                     # deleting a delete-marker explicitly is fine
                     pass
@@ -77,6 +87,7 @@ class XLMeta:
         raise ErrFileVersionNotFound(f"version {fi.version_id!r} not found")
 
     def find_version(self, version_id: str) -> dict:
+        version_id = _normalize_vid(version_id)
         for v in self.versions:
             if v["vid"] == version_id:
                 return v

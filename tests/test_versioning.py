@@ -1,0 +1,243 @@
+"""Full versioning surface over HTTP: ListObjectVersions,
+versionId-targeted GET/HEAD/DELETE, null-version semantics, pagination —
+the black-box analog of the reference's versioned-API tests
+(cmd/bucket-listobjects-handlers.go:214, cmd/erasure-object_test.go
+versioned cases)."""
+
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.api import S3Server
+from minio_tpu.api.sign import sign_v4_request
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+
+ACCESS, SECRET = "vroot", "vroot-secret-key"
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+VERSIONING_ON = (
+    '<VersioningConfiguration xmlns='
+    '"http://s3.amazonaws.com/doc/2006-03-01/">'
+    "<Status>Enabled</Status></VersioningConfiguration>"
+).encode()
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("vers")
+    disks = [
+        LocalStorage(str(tmp / f"d{i}"), endpoint=f"d{i}") for i in range(4)
+    ]
+    sets = ErasureSets(
+        disks, 4, deployment_id="0f0e0d0c-0b0a-0908-0706-050403020100",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    srv = S3Server(ol, IAMSys(ACCESS, SECRET), BucketMetadataSys(ol)).start()
+    yield srv
+    srv.stop()
+
+
+def req(srv, method, path, query=None, headers=None, body=b""):
+    query = query or []
+    qs = urllib.parse.urlencode(query)
+    url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+    headers = sign_v4_request(
+        SECRET, ACCESS, method, srv.endpoint, path, query,
+        dict(headers or {}), body,
+    )
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+    conn.request(method, url, body=body, headers=headers)
+    r = conn.getresponse()
+    data = r.read()
+    conn.close()
+    return r.status, dict(r.getheaders()), data
+
+
+@pytest.fixture(scope="module")
+def vbucket(server):
+    assert req(server, "PUT", "/vbk")[0] == 200
+    st, _, _ = req(server, "PUT", "/vbk", query=[("versioning", "")],
+                   body=VERSIONING_ON)
+    assert st == 200
+    return "vbk"
+
+
+def test_versioned_put_get_delete_cycle(server, vbucket):
+    vids = []
+    for body in (b"one", b"two", b"three"):
+        st, h, _ = req(server, "PUT", f"/{vbucket}/doc", body=body)
+        assert st == 200
+        vids.append(h["x-amz-version-id"])
+    assert len(set(vids)) == 3
+
+    # unversioned GET returns latest
+    st, h, body = req(server, "GET", f"/{vbucket}/doc")
+    assert st == 200 and body == b"three"
+    assert h["x-amz-version-id"] == vids[2]
+    # versionId-targeted GET and HEAD
+    st, h, body = req(server, "GET", f"/{vbucket}/doc",
+                      query=[("versionId", vids[0])])
+    assert st == 200 and body == b"one" and h["x-amz-version-id"] == vids[0]
+    st, h, _ = req(server, "HEAD", f"/{vbucket}/doc",
+                   query=[("versionId", vids[1])])
+    assert st == 200 and h["Content-Length"] == "3"
+
+    # versioned DELETE lays down a delete marker
+    st, h, _ = req(server, "DELETE", f"/{vbucket}/doc")
+    assert st == 204
+    marker_vid = h["x-amz-version-id"]
+    assert h.get("x-amz-delete-marker") == "true" or marker_vid
+    assert req(server, "GET", f"/{vbucket}/doc")[0] == 404
+    # old versions remain addressable
+    st, _, body = req(server, "GET", f"/{vbucket}/doc",
+                      query=[("versionId", vids[1])])
+    assert st == 200 and body == b"two"
+
+    # ListObjectVersions shows 3 versions + 1 delete marker, newest first
+    st, _, body = req(server, "GET", f"/{vbucket}",
+                      query=[("versions", ""), ("prefix", "doc")])
+    assert st == 200, body
+    root = ET.fromstring(body)
+    markers = root.findall(f"{NS}DeleteMarker")
+    versions = root.findall(f"{NS}Version")
+    assert len(markers) == 1 and len(versions) == 3
+    assert markers[0].find(f"{NS}IsLatest").text == "true"
+    got_vids = [v.find(f"{NS}VersionId").text for v in versions]
+    assert got_vids == [vids[2], vids[1], vids[0]]
+
+    # delete the marker by id restores the previous latest
+    st, _, _ = req(server, "DELETE", f"/{vbucket}/doc",
+                   query=[("versionId", marker_vid)])
+    assert st == 204
+    st, _, body = req(server, "GET", f"/{vbucket}/doc")
+    assert st == 200 and body == b"three"
+
+    # versionId-targeted DELETE permanently removes one version
+    st, _, _ = req(server, "DELETE", f"/{vbucket}/doc",
+                   query=[("versionId", vids[1])])
+    assert st == 204
+    st, _, _ = req(server, "GET", f"/{vbucket}/doc",
+                   query=[("versionId", vids[1])])
+    assert st == 404
+
+
+def test_null_version_semantics(server):
+    """Objects written before versioning was enabled keep the 'null'
+    version id and stay addressable as versionId=null."""
+    assert req(server, "PUT", "/nullb")[0] == 200
+    st, h, _ = req(server, "PUT", "/nullb/pre", body=b"prever")
+    assert st == 200 and "x-amz-version-id" not in h
+    # enable versioning afterwards
+    st, _, _ = req(server, "PUT", "/nullb", query=[("versioning", "")],
+                   body=VERSIONING_ON)
+    assert st == 200
+    st, h, _ = req(server, "PUT", "/nullb/pre", body=b"v2")
+    v2 = h["x-amz-version-id"]
+    assert v2 and v2 != "null"
+    # null version still addressable
+    st, _, body = req(server, "GET", "/nullb/pre",
+                      query=[("versionId", "null")])
+    assert st == 200 and body == b"prever"
+    # versions list shows null + v2
+    st, _, body = req(server, "GET", "/nullb",
+                      query=[("versions", "")])
+    root = ET.fromstring(body)
+    vids = [v.find(f"{NS}VersionId").text
+            for v in root.findall(f"{NS}Version")]
+    assert vids == [v2, "null"]
+    # targeted delete of the null version removes it, v2 stays latest
+    st, _, _ = req(server, "DELETE", "/nullb/pre",
+                   query=[("versionId", "null")])
+    assert st == 204
+    st, _, _ = req(server, "GET", "/nullb/pre",
+                   query=[("versionId", "null")])
+    assert st == 404
+    st, _, body = req(server, "GET", "/nullb/pre")
+    assert st == 200 and body == b"v2"
+
+
+def test_list_versions_pagination(server):
+    assert req(server, "PUT", "/pgb")[0] == 200
+    st, _, _ = req(server, "PUT", "/pgb", query=[("versioning", "")],
+                   body=VERSIONING_ON)
+    assert st == 200
+    # 4 keys x 3 versions = 12 entries
+    for k in range(4):
+        for v in range(3):
+            assert req(server, "PUT", f"/pgb/k{k}",
+                       body=f"{k}-{v}".encode())[0] == 200
+    seen = []
+    key_marker, vid_marker = "", ""
+    pages = 0
+    while True:
+        q = [("versions", ""), ("max-keys", "5")]
+        if key_marker:
+            q += [("key-marker", key_marker)]
+        if vid_marker:
+            q += [("version-id-marker", vid_marker)]
+        st, _, body = req(server, "GET", "/pgb", query=q)
+        assert st == 200, body
+        root = ET.fromstring(body)
+        for v in root.iter():
+            if v.tag in (f"{NS}Version", f"{NS}DeleteMarker"):
+                seen.append((v.find(f"{NS}Key").text,
+                             v.find(f"{NS}VersionId").text))
+        pages += 1
+        if root.find(f"{NS}IsTruncated").text != "true":
+            break
+        key_marker = root.find(f"{NS}NextKeyMarker").text
+        vid_marker = root.find(f"{NS}NextVersionIdMarker").text
+    assert len(seen) == 12 and len(set(seen)) == 12
+    assert pages == 3
+    assert [k for k, _ in seen] == sorted([f"k{k}" for k in range(4)] * 3)
+
+
+def test_version_listing_delimiter(server):
+    assert req(server, "PUT", "/dvb")[0] == 200
+    st, _, _ = req(server, "PUT", "/dvb", query=[("versioning", "")],
+                   body=VERSIONING_ON)
+    req(server, "PUT", "/dvb/dir/a", body=b"1")
+    req(server, "PUT", "/dvb/rootfile", body=b"2")
+    st, _, body = req(server, "GET", "/dvb",
+                      query=[("versions", ""), ("delimiter", "/")])
+    root = ET.fromstring(body)
+    keys = [v.find(f"{NS}Key").text for v in root.findall(f"{NS}Version")]
+    prefixes = [p.find(f"{NS}Prefix").text
+                for p in root.findall(f"{NS}CommonPrefixes")]
+    assert keys == ["rootfile"] and prefixes == ["dir/"]
+
+
+def test_max_keys_zero_versions(server, vbucket):
+    st, _, body = req(server, "GET", f"/{vbucket}",
+                      query=[("versions", ""), ("max-keys", "0")])
+    assert st == 200
+    root = ET.fromstring(body)
+    assert root.find(f"{NS}IsTruncated").text == "false"
+    assert not root.findall(f"{NS}Version")
+
+
+def test_put_with_null_version_id_stays_addressable(server):
+    """A write that targets versionId=null must store the internal empty
+    id, not the literal 'null' (which lookups could never find)."""
+    assert req(server, "PUT", "/nwb")[0] == 200
+    st, _, _ = req(server, "PUT", "/nwb", query=[("versioning", "")],
+                   body=VERSIONING_ON)
+    st, _, _ = req(server, "PUT", "/nwb/obj",
+                   query=[("versionId", "null")], body=b"nullwrite")
+    assert st == 200
+    st, _, body = req(server, "GET", "/nwb/obj",
+                      query=[("versionId", "null")])
+    assert st == 200 and body == b"nullwrite"
+    st, _, _ = req(server, "DELETE", "/nwb/obj",
+                   query=[("versionId", "null")])
+    assert st == 204
+    assert req(server, "GET", "/nwb/obj",
+               query=[("versionId", "null")])[0] == 404

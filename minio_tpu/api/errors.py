@@ -69,6 +69,8 @@ API_ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("NoSuchLifecycleConfiguration", "The lifecycle configuration does not exist.", HTTPStatus.NOT_FOUND),
     _E("NoSuchTagSet", "The TagSet does not exist.", HTTPStatus.NOT_FOUND),
     _E("ReplicationConfigurationNotFoundError", "The replication configuration was not found.", HTTPStatus.NOT_FOUND),
+    _E("ReplicationNeedsVersioningError", "Versioning must be 'Enabled' on the bucket to apply a replication configuration.", HTTPStatus.BAD_REQUEST),
+    _E("InvalidBucketState", "The request is not valid for the current state of the bucket.", HTTPStatus.CONFLICT),
     _E("ServerSideEncryptionConfigurationNotFoundError", "The server side encryption configuration was not found.", HTTPStatus.NOT_FOUND),
     _E("NoSuchObjectLockConfiguration", "The specified object does not have a ObjectLock configuration.", HTTPStatus.NOT_FOUND),
     _E("ObjectLockConfigurationNotFoundError", "Object Lock configuration does not exist for this bucket.", HTTPStatus.NOT_FOUND),

@@ -549,7 +549,7 @@ class S3ApiHandlers:
         return Response(204)
 
     def _xml_subresource(self, ctx, fld: str, missing_code: str,
-                         root_tag: str | None = None):
+                         root_tag: str | None = None, pre_put=None):
         """GET/PUT/DELETE for the XML-blob bucket subresources."""
         self._check_bucket(ctx.bucket)
         if ctx.method == "GET":
@@ -560,6 +560,8 @@ class S3ApiHandlers:
             return Response(200, {"Content-Type": "application/xml"},
                             val.encode())
         if ctx.method == "PUT":
+            if pre_put is not None:
+                pre_put()
             try:
                 ET.fromstring(ctx.body)
             except ET.ParseError as exc:
@@ -573,9 +575,22 @@ class S3ApiHandlers:
         self._check_bucket(ctx.bucket)
         if ctx.method == "PUT":
             try:
-                ET.fromstring(ctx.body)
+                root = ET.fromstring(ctx.body)
             except ET.ParseError as exc:
                 raise S3Error("MalformedXML", str(exc)) from exc
+            status = ""
+            for el in root.iter():
+                if el.tag.endswith("Status"):
+                    status = (el.text or "").strip()
+            if status != "Enabled" and self.bm.get(ctx.bucket).replication_xml:
+                # Suspending versioning would silently break delete-marker
+                # replication (ref cmd/bucket-handlers.go
+                # PutBucketVersioningHandler replication/lock guards).
+                raise S3Error(
+                    "InvalidBucketState",
+                    "A replication configuration is present on this bucket, "
+                    "so the versioning state cannot be suspended.",
+                )
             self.bm.update(ctx.bucket, "versioning_xml", ctx.body.decode())
             return Response(200)
         bm = self.bm.get(ctx.bucket)
@@ -604,8 +619,17 @@ class S3ApiHandlers:
         )
 
     def bucket_replication(self, ctx) -> Response:
+        # Replication requires versioning on the source bucket so deletes
+        # become replicable delete markers (ref cmd/bucket-handlers.go
+        # PutBucketReplicationConfigHandler ErrReplicationNeedsVersioningError,
+        # cmd/bucket-replication.go:574 version-aware replicateDelete).
+        def _needs_versioning():
+            if not self.bm.get(ctx.bucket).versioning_enabled:
+                raise S3Error("ReplicationNeedsVersioningError")
+
         return self._xml_subresource(
-            ctx, "replication_xml", "ReplicationConfigurationNotFoundError"
+            ctx, "replication_xml", "ReplicationConfigurationNotFoundError",
+            pre_put=_needs_versioning,
         )
 
     def bucket_notification(self, ctx) -> Response:

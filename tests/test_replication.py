@@ -73,9 +73,22 @@ def clusters(tmp_path):
     dst.stop()
 
 
+VERSIONING_XML = (
+    '<VersioningConfiguration xmlns='
+    '"http://s3.amazonaws.com/doc/2006-03-01/">'
+    "<Status>Enabled</Status></VersioningConfiguration>"
+)
+
+
 def _setup_replication(src, dst, bucket="crr", dst_bucket="crr-copy"):
     assert req(src, "PUT", f"/{bucket}")[0] == 200
     assert req(dst, "PUT", f"/{dst_bucket}")[0] == 200
+    # Replication requires versioning on both ends (ref
+    # ErrReplicationNeedsVersioningError / remote-target version checks).
+    for srv, b in ((src, bucket), (dst, dst_bucket)):
+        st, _, _ = req(srv, "PUT", f"/{b}", query=[("versioning", "")],
+                       body=VERSIONING_XML.encode())
+        assert st == 200
     # register remote target via admin API
     target = {
         "endpoint": dst.endpoint, "access_key": AK, "secret_key": SK,
@@ -94,6 +107,27 @@ def _setup_replication(src, dst, bucket="crr", dst_bucket="crr-copy"):
     )
     assert st == 200, body
     return bucket, dst_bucket
+
+
+def test_replication_config_requires_versioning(clusters):
+    src, _ = clusters
+    assert req(src, "PUT", "/unver")[0] == 200
+    st, _, body = req(
+        src, "PUT", "/unver", query=[("replication", "")],
+        body=REPL_XML.format(arn="arn:minio:replication::x:t").encode(),
+    )
+    assert st == 400
+    assert b"ReplicationNeedsVersioningError" in body
+
+
+def test_versioning_cannot_suspend_under_replication(clusters):
+    src, dst = clusters
+    bucket, _ = _setup_replication(src, dst)
+    suspend = VERSIONING_XML.replace("Enabled", "Suspended")
+    st, _, body = req(src, "PUT", f"/{bucket}", query=[("versioning", "")],
+                      body=suspend.encode())
+    assert st == 409
+    assert b"InvalidBucketState" in body
 
 
 def test_crr_put_roundtrip(clusters):

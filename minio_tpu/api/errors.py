@@ -128,6 +128,7 @@ def from_object_error(exc: Exception) -> "S3Error":
         (oe.ErrLessData, "IncompleteBody"),
         (oe.ErrMoreData, "IncompleteBody"),
         (oe.ErrObjectExistsAsDirectory, "MethodNotAllowed"),
+        (oe.ErrBadDigest, "BadDigest"),
     ]
     for etype, code in mapping:
         if isinstance(exc, etype):

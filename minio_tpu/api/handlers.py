@@ -680,8 +680,17 @@ class S3ApiHandlers:
         resp_extra: dict = {}
         from . import transforms
 
+        want_md5_hex = self._parse_content_md5(ctx.headers)
         if transforms.transforms_active(ctx.headers, self.config, ctx.object):
             plaintext = reader.read(size)
+            if want_md5_hex:
+                # Stored bytes are encrypted/compressed, so the layer-level
+                # check can't see the declared digest: verify the plaintext
+                # here, before anything is written.
+                import hashlib
+
+                if hashlib.md5(plaintext).hexdigest() != want_md5_hex:
+                    raise S3Error("BadDigest")
             stored, meta_updates, resp_extra = (
                 transforms.apply_put_transforms(
                     ctx.headers, self.config, self.sse_config,
@@ -691,23 +700,17 @@ class S3ApiHandlers:
             opts.user_defined.update(meta_updates)
             reader = io.BytesIO(stored)
             size = len(stored)
+        else:
+            # Verified inside the object layer during the encode stream,
+            # BEFORE commit (ref hash.NewReader wired at
+            # cmd/object-handlers.go:1555-1570).
+            opts.want_md5_hex = want_md5_hex
         try:
             oi = self.ol.put_object(
                 ctx.bucket, ctx.object, reader, size, opts
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
-        md5_hdr = ctx.headers.get("content-md5", "")
-        if md5_hdr and resp_extra:
-            md5_hdr = ""  # transformed bytes: stored etag != body md5
-        if md5_hdr:
-            import base64
-
-            want = base64.b64decode(md5_hdr).hex()
-            if want != oi.etag:
-                # best-effort: object already committed in layer; the
-                # reference validates inline via hash.Reader
-                raise S3Error("BadDigest")
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(resp_extra)
         if oi.version_id and oi.version_id != "null":
@@ -732,6 +735,32 @@ class S3ApiHandlers:
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
             opts.user_defined = dict(src_info.user_defined)
+        if (sbucket, sobject) == (ctx.bucket, ctx.object) and not vid:
+            # Self-copy. Without REPLACE it's illegal (AWS InvalidRequest);
+            # with REPLACE it's a metadata-only update — never re-put the
+            # bytes, which would deadlock the writer lock against its own
+            # locked source read (ref cmd/object-handlers.go cpSrcDstSame /
+            # srcInfo.metadataOnly).
+            if directive != "REPLACE":
+                raise S3Error(
+                    "InvalidRequest",
+                    "This copy request is illegal because it is being made "
+                    "to the same object without changing metadata.",
+                )
+            try:
+                self.ol.update_object_metadata(
+                    ctx.bucket, ctx.object, src_info.version_id or "",
+                    opts.user_defined, replace_user_meta=True,
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            root = _xml_root("CopyObjectResult")
+            ET.SubElement(root, "LastModified").text = iso8601(
+                src_info.mod_time_ns
+            )
+            ET.SubElement(root, "ETag").text = f'"{src_info.etag}"'
+            self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=src_info)
+            return Response.xml(root)
         repl_rule = self._repl_rule(ctx.bucket, ctx.object)
         if repl_rule is not None:
             from ..replication.pool import PENDING, REPL_STATUS_KEY
@@ -759,6 +788,24 @@ class S3ApiHandlers:
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id
         return Response.xml(root, headers=headers)
+
+    @staticmethod
+    def _parse_content_md5(headers: dict) -> str:
+        """Decode the Content-MD5 header to hex ('' if absent); malformed
+        base64 is InvalidDigest (ref cmd/utils.go md5 header parsing)."""
+        md5_hdr = headers.get("content-md5", "")
+        if not md5_hdr:
+            return ""
+        import base64
+        import binascii
+
+        try:
+            raw = base64.b64decode(md5_hdr, validate=True)
+        except (binascii.Error, ValueError) as exc:
+            raise S3Error("InvalidDigest") from exc
+        if len(raw) != 16:
+            raise S3Error("InvalidDigest")
+        return raw.hex()
 
     def _conditional_headers(self, ctx, oi):
         """If-Match / If-None-Match / If-(Un)Modified-Since
@@ -978,10 +1025,13 @@ class S3ApiHandlers:
             raise S3Error("MissingContentLength")
         if size > MAX_PART_SIZE:
             raise S3Error("EntityTooLarge")
+        part_opts = ObjectOptions(
+            want_md5_hex=self._parse_content_md5(ctx.headers)
+        )
         try:
             pi = self.ol.put_object_part(
                 ctx.bucket, ctx.object, upload_id, part_number,
-                ctx.body_reader, size,
+                ctx.body_reader, size, part_opts,
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc

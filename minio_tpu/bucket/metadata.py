@@ -49,13 +49,31 @@ class BucketMetadata:
 
     # --- parsed views ---
 
+    def _versioning_status(self) -> str:
+        """Parse the stored VersioningConfiguration Status tolerantly
+        (namespace/whitespace-agnostic), matching what the PUT handler
+        accepts — a substring match would call ' Enabled ' disabled."""
+        if not self.versioning_xml:
+            return ""
+        import xml.etree.ElementTree as ET
+
+        try:
+            root = ET.fromstring(self.versioning_xml)
+        except ET.ParseError:
+            return ""
+        status = ""
+        for el in root.iter():
+            if el.tag.endswith("Status"):
+                status = (el.text or "").strip()
+        return status
+
     @property
     def versioning_enabled(self) -> bool:
-        return "<Status>Enabled</Status>" in self.versioning_xml
+        return self._versioning_status() == "Enabled"
 
     @property
     def versioning_suspended(self) -> bool:
-        return "<Status>Suspended</Status>" in self.versioning_xml
+        return self._versioning_status() == "Suspended"
 
     def policy(self):
         from ..iam.policy import Policy

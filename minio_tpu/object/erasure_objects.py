@@ -28,6 +28,7 @@ from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
 from ..storage.local import SMALL_FILE_THRESHOLD, SYSTEM_META_BUCKET
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
+    ErrBadDigest,
     ErrDiskNotFound,
     ErrErasureReadQuorum,
     ErrErasureWriteQuorum,
@@ -87,6 +88,35 @@ class ErasureObjects(MultipartMixin):
 
     # ------------------------------------------------------------------
     # helpers
+
+    # Lock acquisition is bounded so a lock cycle (e.g. two opposing
+    # cross-object copies) degrades to a retriable 503, never a wedged
+    # worker thread (the reference's dsync acquisition timeout).
+    NS_LOCK_TIMEOUT_S = 120.0
+
+    from contextlib import contextmanager as _ctxmgr
+
+    @_ctxmgr
+    def _locked_write(self, bucket: str, object_: str):
+        from ..utils.errors import ErrOperationTimedOut
+
+        try:
+            with self._ns_lock.write(f"{bucket}/{object_}",
+                                     timeout=self.NS_LOCK_TIMEOUT_S):
+                yield
+        except TimeoutError as exc:
+            raise ErrOperationTimedOut(f"{bucket}/{object_}") from exc
+
+    @_ctxmgr
+    def _locked_read(self, bucket: str, object_: str):
+        from ..utils.errors import ErrOperationTimedOut
+
+        try:
+            with self._ns_lock.read(f"{bucket}/{object_}",
+                                    timeout=self.NS_LOCK_TIMEOUT_S):
+                yield
+        except TimeoutError as exc:
+            raise ErrOperationTimedOut(f"{bucket}/{object_}") from exc
 
     def _object_erasure(self, k: int, m: int) -> Erasure:
         return Erasure(k, m, BLOCK_SIZE_V2)
@@ -162,6 +192,17 @@ class ErasureObjects(MultipartMixin):
     def put_object(self, bucket: str, object_: str, reader, size: int,
                    opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
+        if opts.no_lock:
+            return self._put_object(bucket, object_, reader, size, opts)
+        # Serialize concurrent writers of one object so rename_data /
+        # write_metadata cannot interleave across disks into a
+        # mixed-mod-time quorum state (ref NSLock at
+        # cmd/erasure-object.go:741-749).
+        with self._locked_write(bucket, object_):
+            return self._put_object(bucket, object_, reader, size, opts)
+
+    def _put_object(self, bucket: str, object_: str, reader, size: int,
+                    opts: ObjectOptions) -> ObjectInfo:
         n = self.set_drive_count
         parity = self.default_parity
         data_blocks = n - parity
@@ -217,6 +258,14 @@ class ErasureObjects(MultipartMixin):
         mod_time_ns = time.time_ns()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         etag = tee.md5_hex()
+        if opts.want_md5_hex and etag != opts.want_md5_hex:
+            # Digest verified against the encode stream BEFORE the commit
+            # rename: a BadDigest must leave nothing behind (ref
+            # pkg/hash/reader.go inline verification).
+            self._cleanup_tmp(disks_by_shard, tmp_id)
+            raise ErrBadDigest(
+                f"content md5 {etag} != declared {opts.want_md5_hex}"
+            )
 
         metadata = dict(opts.user_defined)
         metadata["etag"] = etag
@@ -279,16 +328,33 @@ class ErasureObjects(MultipartMixin):
         return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
 
     def update_object_metadata(self, bucket: str, object_: str,
-                               version_id: str, updates: dict) -> None:
+                               version_id: str, updates: dict,
+                               replace_user_meta: bool = False) -> None:
         """Merge `updates` into a version's user metadata on all online
         disks (the reference's updateObjectMeta, used by replication to
-        flip X-Amz-Replication-Status, cmd/bucket-replication.go:700+)."""
+        flip X-Amz-Replication-Status, cmd/bucket-replication.go:700+).
+        `replace_user_meta` drops existing x-amz-meta-* keys first
+        (metadata-REPLACE self-copy)."""
+        # Read-modify-write of every disk's xl.meta: exclusive lock so a
+        # concurrent put/heal can't interleave (ref updateObjectMeta under
+        # the caller-held NSLock).
+        with self._locked_write(bucket, object_):
+            self._update_object_metadata(bucket, object_, version_id,
+                                         updates, replace_user_meta)
+
+    def _update_object_metadata(self, bucket: str, object_: str,
+                                version_id: str, updates: dict,
+                                replace_user_meta: bool = False) -> None:
         # read_data=True: the per-disk FileInfo carries inline small-object
         # shards; rewriting the version without them would destroy data.
         fi, fis, _ = self._read_quorum_file_info(
             bucket, object_, version_id, read_data=True
         )
-        new_meta = dict(fi.metadata)
+        if replace_user_meta:
+            new_meta = {k: v for k, v in fi.metadata.items()
+                        if not k.startswith("x-amz-meta-")}
+        else:
+            new_meta = dict(fi.metadata)
         new_meta.update(updates)
 
         def do(i):
@@ -365,6 +431,18 @@ class ErasureObjects(MultipartMixin):
                    offset: int = 0, length: int = -1,
                    opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
+        if opts.no_lock:
+            return self._get_object(bucket, object_, writer, offset,
+                                    length, opts)
+        # Shared read lock: a concurrent put/heal of the same object must
+        # not swap data dirs mid-stream (ref cmd/erasure-object.go:145-165).
+        with self._locked_read(bucket, object_):
+            return self._get_object(bucket, object_, writer, offset,
+                                    length, opts)
+
+    def _get_object(self, bucket: str, object_: str, writer,
+                    offset: int, length: int,
+                    opts: ObjectOptions) -> ObjectInfo:
         fi, fis, errs = self._read_quorum_file_info(
             bucket, object_, opts.version_id, read_data=True
         )
@@ -449,6 +527,13 @@ class ErasureObjects(MultipartMixin):
     def delete_object(self, bucket: str, object_: str,
                       opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
+        if opts.no_lock:
+            return self._delete_object(bucket, object_, opts)
+        with self._locked_write(bucket, object_):
+            return self._delete_object(bucket, object_, opts)
+
+    def _delete_object(self, bucket: str, object_: str,
+                       opts: ObjectOptions) -> ObjectInfo:
         n = self.set_drive_count
         write_quorum = n // 2 + 1
 
@@ -545,6 +630,15 @@ class ErasureObjects(MultipartMixin):
 
     def heal_object(self, bucket: str, object_: str, version_id: str = "",
                     remove_dangling: bool = False) -> dict:
+        # Exclusive lock: healing rewrites shards + metadata, so it must
+        # not race a foreground put/delete of the same object
+        # (ref healObject takes the write NSLock, cmd/erasure-healing.go).
+        with self._locked_write(bucket, object_):
+            return self._heal_object(bucket, object_, version_id,
+                                     remove_dangling)
+
+    def _heal_object(self, bucket: str, object_: str, version_id: str,
+                     remove_dangling: bool) -> dict:
         fis, errs = read_all_file_info(
             self.disks, bucket, object_, version_id, read_data=True
         )
@@ -580,8 +674,10 @@ class ErasureObjects(MultipartMixin):
             # Dangling object (ref isObjectDangling :776).
             if remove_dangling:
                 try:
+                    # no_lock: the heal wrapper already holds the write lock.
                     self.delete_object(
-                        bucket, object_, ObjectOptions(version_id=version_id)
+                        bucket, object_,
+                        ObjectOptions(version_id=version_id, no_lock=True),
                     )
                 except (ErrObjectNotFound, ErrVersionNotFound):
                     pass  # already gone on most disks — purge complete

@@ -162,6 +162,17 @@ class FSObjects:
             except OSError:
                 pass
             raise
+        etag_hex = md5.hexdigest()
+        if opts.want_md5_hex and etag_hex != opts.want_md5_hex:
+            from ..utils.errors import ErrBadDigest
+
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise ErrBadDigest(
+                f"content md5 {etag_hex} != declared {opts.want_md5_hex}"
+            )
         dst = self._obj_path(bucket, object_)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         os.replace(tmp, dst)
@@ -177,6 +188,19 @@ class FSObjects:
         with open(mp, "w") as f:
             json.dump(meta, f)
         return self._info(bucket, object_, meta)
+
+    def update_object_metadata(self, bucket, object_, version_id, updates,
+                               replace_user_meta=False) -> None:
+        """Metadata-only update (replication status flips, metadata-REPLACE
+        self-copy) — the FS analog of updateObjectMeta."""
+        meta = self._load_meta(bucket, object_)
+        user = {} if replace_user_meta else dict(meta.get("meta") or {})
+        user.update(updates)
+        meta["meta"] = user
+        mp = self._meta_path(bucket, object_)
+        os.makedirs(os.path.dirname(mp), exist_ok=True)
+        with open(mp, "w") as f:
+            json.dump(meta, f)
 
     def _load_meta(self, bucket: str, object_: str) -> dict:
         try:
@@ -382,8 +406,18 @@ class FSObjects:
             except OSError:
                 pass
             raise
-        os.replace(tmp, os.path.join(d, f"part.{part_number}"))
         etag = md5.hexdigest()
+        if opts is not None and opts.want_md5_hex and etag != opts.want_md5_hex:
+            from ..utils.errors import ErrBadDigest
+
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise ErrBadDigest(
+                f"part md5 {etag} != declared {opts.want_md5_hex}"
+            )
+        os.replace(tmp, os.path.join(d, f"part.{part_number}"))
         with open(os.path.join(d, f"part.{part_number}.json"), "w") as f:
             json.dump({"etag": etag, "size": total,
                        "mod_time_ns": time.time_ns()}, f)

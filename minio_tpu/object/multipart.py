@@ -19,6 +19,7 @@ from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
 from ..storage.local import SYSTEM_META_BUCKET
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
+    ErrBadDigest,
     ErrDiskNotFound,
     ErrInvalidPart,
     ErrInvalidUploadID,
@@ -126,6 +127,10 @@ class MultipartMixin:
         disks_by_shard = shuffle_disks(self.disks, fi.erasure.distribution)
 
         tee = TeeMD5Reader(reader)
+        # Stage under a tmp name: a re-upload of an existing part number
+        # must not clobber the journaled shards until it fully verifies
+        # (digest + length), or an aborted retry destroys committed data.
+        tmp_part = f"part.{part_number}.tmp.{new_uuid()}"
         writers: list = [None] * len(disks_by_shard)
         sinks: list = [None] * len(disks_by_shard)
         for i, disk in enumerate(disks_by_shard):
@@ -133,14 +138,29 @@ class MultipartMixin:
                 continue
             try:
                 sinks[i] = disk.create_file_writer(
-                    SYSTEM_META_BUCKET, f"{upload_path}/part.{part_number}"
+                    SYSTEM_META_BUCKET, f"{upload_path}/{tmp_part}"
                 )
                 writers[i] = StreamingBitrotWriter(
                     sinks[i], BitrotAlgorithm.HIGHWAYHASH256S
                 )
             except Exception:  # noqa: BLE001
                 writers[i] = None
-        total = encode_stream(erasure, tee, writers, write_quorum)
+
+        def _drop_tmp():
+            for disk in disks_by_shard:
+                if disk is None:
+                    continue
+                try:
+                    disk.delete(SYSTEM_META_BUCKET,
+                                f"{upload_path}/{tmp_part}")
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+
+        try:
+            total = encode_stream(erasure, tee, writers, write_quorum)
+        except Exception:
+            _drop_tmp()
+            raise
         for s in sinks:
             if s is not None:
                 try:
@@ -148,9 +168,29 @@ class MultipartMixin:
                 except Exception:  # noqa: BLE001
                     pass
         if size >= 0 and total != size:
+            _drop_tmp()
             raise ErrLessData(f"read {total}, want {size}")
 
         etag = tee.md5_hex()
+        if opts is not None and opts.want_md5_hex and etag != opts.want_md5_hex:
+            # Bad digest: staged shards dropped before the journal (and the
+            # previous part's shards) are ever touched (ref
+            # pkg/hash/reader.go).
+            _drop_tmp()
+            raise ErrBadDigest(
+                f"part md5 {etag} != declared {opts.want_md5_hex}"
+            )
+        # Verified: move into place on every disk that took the stream.
+        for i, disk in enumerate(disks_by_shard):
+            if disk is None or writers[i] is None:
+                continue
+            try:
+                disk.rename_file(
+                    SYSTEM_META_BUCKET, f"{upload_path}/{tmp_part}",
+                    SYSTEM_META_BUCKET, f"{upload_path}/part.{part_number}",
+                )
+            except Exception:  # noqa: BLE001 - per-disk best effort
+                pass
         # Journal the part on every disk's upload xl.meta. The journal
         # update is a read-modify-write, so concurrent part uploads for the
         # same upload id are serialized per upload (the reference holds the
@@ -319,7 +359,12 @@ class MultipartMixin:
             except Exception as exc:  # noqa: BLE001
                 errs[shard_i] = exc
 
-        list(_mp_pool.map(commit, range(len(disks_by_shard))))
+        # The final rename_data fan-out commits the destination object's
+        # xl.meta: hold the same per-object write lock as put_object so a
+        # racing PutObject can't interleave into a mixed-mod-time quorum
+        # (ref CompleteMultipartUpload NSLock, cmd/erasure-multipart.go:736).
+        with self._ns_lock.write(f"{bucket}/{object_}"):
+            list(_mp_pool.map(commit, range(len(disks_by_shard))))
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise err

@@ -381,12 +381,13 @@ class ErasureServerPools:
         self._bump_gen(bucket)
         return oi
 
-    def update_object_metadata(self, bucket, object_, version_id, updates):
+    def update_object_metadata(self, bucket, object_, version_id, updates,
+                               replace_user_meta=False):
         last_exc = None
         for pool in self.pools:
             try:
                 return pool.update_object_metadata(
-                    bucket, object_, version_id, updates
+                    bucket, object_, version_id, updates, replace_user_meta
                 )
             except (ErrObjectNotFound, ErrVersionNotFound) as exc:
                 last_exc = exc

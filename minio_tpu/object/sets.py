@@ -237,9 +237,10 @@ class ErasureSets:
             bucket, object_, upload_id, parts, opts
         )
 
-    def update_object_metadata(self, bucket, object_, version_id, updates):
+    def update_object_metadata(self, bucket, object_, version_id, updates,
+                               replace_user_meta=False):
         return self.get_hashed_set(object_).update_object_metadata(
-            bucket, object_, version_id, updates
+            bucket, object_, version_id, updates, replace_user_meta
         )
 
     def heal_object(self, bucket, object_, version_id="", remove_dangling=False):

@@ -21,6 +21,11 @@ class ObjectOptions:
     delete_marker: bool = False
     no_lock: bool = False
     part_number: int = 0
+    # Expected hex MD5 of the incoming bytes (from Content-MD5). Verified
+    # against the streamed digest BEFORE commit so a mismatch aborts with
+    # no object left behind (ref pkg/hash/reader.go wired at
+    # cmd/object-handlers.go:1555-1570).
+    want_md5_hex: str = ""
 
 
 @dataclass

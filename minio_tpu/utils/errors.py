@@ -113,6 +113,17 @@ class ErrObjectExistsAsDirectory(StorageError):
     """Object name collides with a directory prefix (ref: ObjectExistsAsDirectory)."""
 
 
+class ErrBadDigest(StorageError):
+    """Content digest mismatch detected before commit (ref: hash.Reader
+    SHA256/MD5 mismatch, /root/reference/pkg/hash/reader.go)."""
+
+
+class ErrOperationTimedOut(StorageError):
+    """Namespace-lock acquisition timed out (ref: OperationTimedOut,
+    cmd/typed-errors.go) — surfaces as a retriable 503 instead of a
+    permanently wedged request."""
+
+
 # --- Reed-Solomon codec errors (mirror klauspost/reedsolomon, used by
 # --- cmd/erasure-coding.go:44-48) ---
 

@@ -129,6 +129,7 @@ def from_object_error(exc: Exception) -> "S3Error":
         (oe.ErrMoreData, "IncompleteBody"),
         (oe.ErrObjectExistsAsDirectory, "MethodNotAllowed"),
         (oe.ErrBadDigest, "BadDigest"),
+        (oe.ErrOperationTimedOut, "SlowDown"),
     ]
     for etype, code in mapping:
         if isinstance(exc, etype):

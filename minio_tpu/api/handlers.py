@@ -735,12 +735,13 @@ class S3ApiHandlers:
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
             opts.user_defined = dict(src_info.user_defined)
-        if (sbucket, sobject) == (ctx.bucket, ctx.object) and not vid:
-            # Self-copy. Without REPLACE it's illegal (AWS InvalidRequest);
-            # with REPLACE it's a metadata-only update — never re-put the
-            # bytes, which would deadlock the writer lock against its own
-            # locked source read (ref cmd/object-handlers.go cpSrcDstSame /
-            # srcInfo.metadataOnly).
+        self_copy = (sbucket, sobject) == (ctx.bucket, ctx.object)
+        if self_copy and not vid and not opts.versioned:
+            # Unversioned self-copy. Without REPLACE it's illegal (AWS
+            # InvalidRequest); with REPLACE it's a metadata-only update —
+            # never re-put the bytes, which would deadlock the writer lock
+            # against its own locked source read (ref cpSrcDstSame /
+            # srcInfo.metadataOnly, cmd/object-handlers.go).
             if directive != "REPLACE":
                 raise S3Error(
                     "InvalidRequest",
@@ -748,7 +749,7 @@ class S3ApiHandlers:
                     "to the same object without changing metadata.",
                 )
             try:
-                self.ol.update_object_metadata(
+                mod_time_ns = self.ol.update_object_metadata(
                     ctx.bucket, ctx.object, src_info.version_id or "",
                     opts.user_defined, replace_user_meta=True,
                 )
@@ -756,11 +757,43 @@ class S3ApiHandlers:
                 raise from_object_error(exc) from exc
             root = _xml_root("CopyObjectResult")
             ET.SubElement(root, "LastModified").text = iso8601(
-                src_info.mod_time_ns
+                mod_time_ns or src_info.mod_time_ns
             )
             ET.SubElement(root, "ETag").text = f'"{src_info.etag}"'
             self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=src_info)
             return Response.xml(root)
+        if self_copy:
+            # Versioned self-copy (new version of the same key) or a
+            # versionId restore: the source read must COMPLETE before the
+            # destination put takes the same write lock, so buffer the
+            # version's bytes up front instead of streaming under the lock.
+            repl_rule = self._repl_rule(ctx.bucket, ctx.object)
+            if repl_rule is not None:
+                from ..replication.pool import PENDING, REPL_STATUS_KEY
+
+                opts.user_defined[REPL_STATUS_KEY] = PENDING
+            try:
+                data = self.ol.get_object_bytes(sbucket, sobject,
+                                                opts=src_opts)
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            try:
+                oi = self.ol.put_object(
+                    ctx.bucket, ctx.object, io.BytesIO(data), len(data), opts
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            if repl_rule is not None:
+                rvid = oi.version_id if oi.version_id != "null" else ""
+                self._schedule_replication(ctx.bucket, ctx.object, rvid, "put")
+            root = _xml_root("CopyObjectResult")
+            ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
+            ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
+            self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
+            headers = {}
+            if oi.version_id and oi.version_id != "null":
+                headers["x-amz-version-id"] = oi.version_id
+            return Response.xml(root, headers=headers)
         repl_rule = self._repl_rule(ctx.bucket, ctx.object)
         if repl_rule is not None:
             from ..replication.pool import PENDING, REPL_STATUS_KEY

@@ -112,6 +112,44 @@ class StreamingBitrotWriter:
         would permanently mis-frame e.g. a BLAKE2b-512 shard file)."""
         return self._algo is BitrotAlgorithm.HIGHWAYHASH256S
 
+    def write_frames(self, strip, chunk_size: int) -> int:
+        """Frame a whole strip of consecutive chunks ([H||chunk]* for each
+        chunk_size slice) in ONE native call and ONE sink write — the
+        batched fast path of the host-fed encode pipeline. Falls back to
+        the per-chunk write() when the native library (or the streaming
+        algorithm) is unavailable."""
+        strip = memoryview(strip)
+        n = len(strip)
+        if n == 0:
+            return 0
+        from .. import native
+
+        lib = native.load()
+        if lib is None or self._algo is not BitrotAlgorithm.HIGHWAYHASH256S:
+            written = 0
+            for off in range(0, n, chunk_size):
+                written += self.write(strip[off:off + chunk_size])
+            return written
+        import ctypes
+
+        n_chunks = ceil_frac(n, chunk_size)
+        src = np.frombuffer(strip, dtype=np.uint8)
+        need = n + 32 * n_chunks
+        # Reuse one framing buffer per writer: a fresh multi-MiB empty()
+        # per batch costs a page-fault pass over the whole buffer.
+        out = getattr(self, "_frame_buf", None)
+        if out is None or out.size < need:
+            out = np.empty(need, dtype=np.uint8)
+            self._frame_buf = out
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.hh256_frame(
+            highwayhash.MAGIC_KEY, src.ctypes.data_as(u8p), n, chunk_size,
+            out.ctypes.data_as(u8p),
+        )
+        self._sink.write(memoryview(out)[:need])
+        self.bytes_written += n
+        return n
+
     def write_with_digest(self, chunk, digest: bytes) -> int:
         """Frame a chunk whose HighwayHash256 was already computed on the
         device in the fused encode dispatch (codec.encode_batch_async) —

@@ -82,6 +82,44 @@ class ParallelWriter:
         if err is not None:
             raise err
 
+    def write_strips(self, strips: list, chunk_size: int):
+        """Batched fan-out: strips[i] holds SEVERAL consecutive chunks for
+        shard i; each writer frames+writes its whole strip in one native
+        call (StreamingBitrotWriter.write_frames). One task per shard per
+        batch instead of one per shard per block — the Python-overhead
+        fix for the host-fed pipeline."""
+        def do(i):
+            try:
+                w = self.writers[i]
+                if hasattr(w, "write_frames"):
+                    w.write_frames(strips[i], chunk_size)
+                else:
+                    strip = memoryview(strips[i])
+                    for off in range(0, len(strip), chunk_size):
+                        w.write(strip[off:off + chunk_size])
+                self.errs[i] = None
+            except Exception as exc:  # noqa: BLE001 - collected for quorum
+                self.errs[i] = exc
+                self.writers[i] = None
+
+        futures = []
+        for i in range(len(self.writers)):
+            if self.writers[i] is None:
+                self.errs[i] = ErrDiskNotFound(f"writer {i}")
+                continue
+            futures.append(_io_pool.submit(do, i))
+        for f in futures:
+            f.result()
+
+        nil_count = sum(1 for e in self.errs if e is None)
+        if nil_count >= self.write_quorum:
+            return
+        err = reduce_write_quorum_errs(
+            self.errs, OBJECT_OP_IGNORED_ERRS, self.write_quorum
+        )
+        if err is not None:
+            raise err
+
 
 def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
                   batch_blocks: int = 8) -> int:
@@ -97,11 +135,20 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     out the writes of batch N-1 and reads batch N+1 from the source.
     The short tail block is encoded alone on the host.
     """
+    from .codec import _select_engine
+
     writer = ParallelWriter(writers, quorum)
-    total = 0
     block_size = erasure.block_size
-    k = erasure.data_blocks
     shard = erasure.shard_size()
+    if _select_engine(shard) == "native":
+        # Host-native engine: the batched strip pipeline (no device
+        # round-trip to overlap; one GFNI encode + one framing call per
+        # shard per batch).
+        return _encode_stream_native(
+            erasure, src, writer, batch_blocks
+        )
+    total = 0
+    k = erasure.data_blocks
     want_digests = any(
         getattr(w, "device_hashable", False) for w in writers if w is not None
     )
@@ -167,8 +214,105 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     return total
 
 
+def _encode_stream_native(erasure: Erasure, src, writer: ParallelWriter,
+                          batch_blocks: int) -> int:
+    """Strip-based host pipeline: gather B full blocks as [k, B*S] strips
+    (columns of the GF matmul are independent, so B blocks fuse into one
+    2-D native encode), then one framing+write call per shard. Python
+    per-block work drops to a single scatter copy."""
+    from ..ops import gf_native
+
+    total = 0
+    block_size = erasure.block_size
+    k = erasure.data_blocks
+    m = erasure.parity_blocks
+    shard = erasure.shard_size()
+    buf = np.empty((k, batch_blocks * shard), dtype=np.uint8)
+    eof = False
+    wrote_anything = False
+
+    # readinto scatters source bytes straight into the strip rows (one
+    # copy); readers without readinto take the read()+scatter fallback.
+    can_readinto = hasattr(src, "readinto")
+    pad = k * shard - block_size  # split's zero pad, lives in the last row
+
+    def _fill_block(col: int) -> int:
+        """Read one block directly into buf[:, col:col+shard]; returns
+        bytes read (0 on EOF, < block_size on a short tail read that the
+        caller must re-handle via the bytes path)."""
+        got = 0
+        for j in range(k):
+            want = shard if j < k - 1 else shard - pad
+            view = memoryview(buf[j, col: col + want])
+            while want:
+                n = src.readinto(view[len(view) - want:])
+                if not n:
+                    return got
+                got += n
+                want -= n
+        if pad:
+            buf[k - 1, col + shard - pad: col + shard] = 0
+        return got
+
+    while not eof:
+        nb = 0
+        tail: bytes | None = None
+        while nb < batch_blocks:
+            if can_readinto:
+                col = nb * shard
+                got = _fill_block(col)
+                if got < block_size:
+                    eof = True
+                    if got or (total == 0 and not nb and not wrote_anything):
+                        # Reassemble the short tail for the bytes path.
+                        parts = []
+                        left = got
+                        for j in range(k):
+                            take = min(left, shard)
+                            parts.append(buf[j, col: col + take].tobytes())
+                            left -= take
+                            if left == 0:
+                                break
+                        tail = b"".join(parts)
+                    break
+            else:
+                b = _read_full(src, block_size)
+                if len(b) < block_size:
+                    eof = True
+                    if b or (total == 0 and not nb and not wrote_anything):
+                        tail = b
+                    break
+                arr = np.frombuffer(b, dtype=np.uint8)
+                col = nb * shard
+                for j in range(k):
+                    row = arr[j * shard: (j + 1) * shard]
+                    buf[j, col: col + len(row)] = row
+                    if len(row) < shard:
+                        buf[j, col + len(row): col + shard] = 0
+            nb += 1
+        if nb:
+            strips = buf[:, : nb * shard]
+            parity = gf_native.apply_matrix(erasure._parity_mat, strips)
+            writer.write_strips(
+                [strips[j] for j in range(k)]
+                + [parity[i] for i in range(m)],
+                shard,
+            )
+            total += nb * block_size
+            wrote_anything = True
+        if tail is not None:
+            blocks = erasure.encode_data(tail)
+            writer.write(blocks)
+            total += len(tail)
+            wrote_anything = True
+    return total
+
+
 def _read_full(src, n: int) -> bytes:
-    out = bytearray()
+    first = src.read(n)
+    if len(first) == n or not first:
+        return first  # common case (BytesIO, files): zero extra copies
+    out = bytearray(first)
     while len(out) < n:
         chunk = src.read(n - len(out))
         if not chunk:

@@ -13,7 +13,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_DIR, "_build")
-_SOURCES = ["highwayhash.c"]
+_SOURCES = ["highwayhash.c", "gfapply.c"]
 _LIB_NAME = "libmtpu_native.so"
 
 _lock = threading.Lock()
@@ -38,19 +38,22 @@ def _build() -> str | None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
     tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs]
-    try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=120
-        )
-        os.replace(tmp, so_path)
-        return so_path
-    except (subprocess.SubprocessError, OSError):
+    # -march=native unlocks pshufb/AVX2 for the GF kernel; retry without
+    # it (scalar fallback paths in the C) on exotic toolchains.
+    for extra in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
+        cmd = ["cc", "-O3", *extra, "-shared", "-fPIC", "-o", tmp, *srcs]
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return None
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            os.replace(tmp, so_path)
+            return so_path
+        except (subprocess.SubprocessError, OSError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return None
 
 
 def load() -> ctypes.CDLL | None:
@@ -84,6 +87,26 @@ def load() -> ctypes.CDLL | None:
         lib.hh256_hash_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_size_t, u8p,
+        ]
+        lib.gf_apply.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+            ctypes.c_size_t, ctypes.c_int,
+        ]
+        lib.gf_apply_batch.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int,
+        ]
+        lib.hh256_frame.argtypes = [
+            ctypes.c_char_p, u8p, ctypes.c_size_t, ctypes.c_size_t, u8p,
+        ]
+        lib.gf_engine_kind.restype = ctypes.c_int
+        lib.gf_apply_affine.argtypes = [
+            u64p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+            ctypes.c_size_t, ctypes.c_int,
+        ]
+        lib.gf_apply_affine_batch.argtypes = [
+            u64p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int,
         ]
         _lib = lib
         return _lib

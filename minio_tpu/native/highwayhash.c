@@ -63,12 +63,55 @@ static inline void update(hh_state *s, const uint64_t p[4]) {
     zipper_add(s->v1, s->v0);
 }
 
+#ifdef __AVX2__
+#include <immintrin.h>
+
+/* The zipper is a byte permutation within each (v[2i], v[2i+1]) pair —
+ * i.e. within each 128-bit half of the state vector — so the whole
+ * 4-lane update maps onto one ymm register per state row. Derived from
+ * zipper_pair above: add_e bytes = [e3 o4 e2 e5 o6 e1 o7 e0], add_o
+ * bytes = [o3 e4 o2 o5 o1 e6 o0 e7]. */
+static inline __m256i hh_zipper(__m256i v) {
+    const __m256i mask = _mm256_broadcastsi128_si256(_mm_setr_epi8(
+        3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7));
+    return _mm256_shuffle_epi8(v, mask);
+}
+
+static void update_packets_avx2(hh_state *s, const uint8_t *data, size_t n) {
+    __m256i v0 = _mm256_loadu_si256((const __m256i *)s->v0);
+    __m256i v1 = _mm256_loadu_si256((const __m256i *)s->v1);
+    __m256i mul0 = _mm256_loadu_si256((const __m256i *)s->mul0);
+    __m256i mul1 = _mm256_loadu_si256((const __m256i *)s->mul1);
+    for (size_t i = 0; i < n; i++) {
+        __m256i p = _mm256_loadu_si256((const __m256i *)(data + 32 * i));
+        v1 = _mm256_add_epi64(v1, _mm256_add_epi64(mul0, p));
+        /* mul_epu32 == (lo32 of a) * (lo32 of b) per 64-bit lane, which
+         * is exactly (v1 & 0xffffffff) * (v0 >> 32). */
+        mul0 = _mm256_xor_si256(
+            mul0, _mm256_mul_epu32(v1, _mm256_srli_epi64(v0, 32)));
+        v0 = _mm256_add_epi64(v0, mul1);
+        mul1 = _mm256_xor_si256(
+            mul1, _mm256_mul_epu32(v0, _mm256_srli_epi64(v1, 32)));
+        v0 = _mm256_add_epi64(v0, hh_zipper(v1));
+        v1 = _mm256_add_epi64(v1, hh_zipper(v0));
+    }
+    _mm256_storeu_si256((__m256i *)s->v0, v0);
+    _mm256_storeu_si256((__m256i *)s->v1, v1);
+    _mm256_storeu_si256((__m256i *)s->mul0, mul0);
+    _mm256_storeu_si256((__m256i *)s->mul1, mul1);
+}
+#endif
+
 static void update_packets(hh_state *s, const uint8_t *data, size_t n) {
+#ifdef __AVX2__
+    update_packets_avx2(s, data, n);
+#else
     uint64_t p[4];
     for (size_t i = 0; i < n; i++) {
         memcpy(p, data + 32 * i, 32);
         update(s, p);
     }
+#endif
 }
 
 static void update_remainder(hh_state *s, const uint8_t *tail, size_t mod32) {
@@ -166,5 +209,21 @@ void hh256_hash_batch(const uint8_t *key32, const uint8_t *data, size_t n,
                       size_t len, uint8_t *out) {
     for (size_t i = 0; i < n; i++) {
         hh256_hash(key32, data + i * len, len, out + i * 32);
+    }
+}
+
+/* Frame a shard strip into the streaming-bitrot layout [H(chunk)||chunk]*
+ * in one call (cmd/bitrot-streaming.go:48-59) — the per-chunk Python
+ * loop was the hot cost of the host-fed encode path. `out` must hold
+ * len + 32 * ceil(len/chunk) bytes. */
+void hh256_frame(const uint8_t *key32, const uint8_t *data, size_t len,
+                 size_t chunk, uint8_t *out) {
+    size_t off = 0;
+    while (off < len) {
+        size_t c = len - off < chunk ? len - off : chunk;
+        hh256_hash(key32, data + off, c, out);
+        memcpy(out + 32, data + off, c);
+        out += 32 + c;
+        off += c;
     }
 }

@@ -333,18 +333,20 @@ class ErasureObjects(MultipartMixin):
         """Merge `updates` into a version's user metadata on all online
         disks (the reference's updateObjectMeta, used by replication to
         flip X-Amz-Replication-Status, cmd/bucket-replication.go:700+).
-        `replace_user_meta` drops existing x-amz-meta-* keys first
-        (metadata-REPLACE self-copy)."""
+        `replace_user_meta` drops existing x-amz-meta-* keys first and
+        stamps a fresh mod time (metadata-REPLACE self-copy; AWS bumps
+        LastModified). Returns the new mod time ns, or None when the mod
+        time was left untouched."""
         # Read-modify-write of every disk's xl.meta: exclusive lock so a
         # concurrent put/heal can't interleave (ref updateObjectMeta under
         # the caller-held NSLock).
         with self._locked_write(bucket, object_):
-            self._update_object_metadata(bucket, object_, version_id,
-                                         updates, replace_user_meta)
+            return self._update_object_metadata(bucket, object_, version_id,
+                                                updates, replace_user_meta)
 
     def _update_object_metadata(self, bucket: str, object_: str,
                                 version_id: str, updates: dict,
-                                replace_user_meta: bool = False) -> None:
+                                replace_user_meta: bool = False) -> int | None:
         # read_data=True: the per-disk FileInfo carries inline small-object
         # shards; rewriting the version without them would destroy data.
         fi, fis, _ = self._read_quorum_file_info(
@@ -356,6 +358,7 @@ class ErasureObjects(MultipartMixin):
         else:
             new_meta = dict(fi.metadata)
         new_meta.update(updates)
+        new_mod_time = time.time_ns() if replace_user_meta else None
 
         def do(i):
             disk = self.disks[i]
@@ -365,12 +368,15 @@ class ErasureObjects(MultipartMixin):
             m = FileInfo.from_dict(meta.to_dict())
             m.volume, m.name = bucket, object_
             m.metadata = dict(new_meta)
+            if new_mod_time is not None:
+                m.mod_time_ns = new_mod_time
             try:
                 disk.update_metadata(bucket, object_, m)
             except Exception:  # noqa: BLE001 - best effort per disk
                 pass
 
         list(_obj_pool.map(do, range(len(self.disks))))
+        return new_mod_time
 
     def _cleanup_tmp(self, disks: list, tmp_id: str):
         for disk in disks:

@@ -190,17 +190,23 @@ class FSObjects:
         return self._info(bucket, object_, meta)
 
     def update_object_metadata(self, bucket, object_, version_id, updates,
-                               replace_user_meta=False) -> None:
+                               replace_user_meta=False):
         """Metadata-only update (replication status flips, metadata-REPLACE
-        self-copy) — the FS analog of updateObjectMeta."""
+        self-copy) — the FS analog of updateObjectMeta. Returns the new
+        mod time ns when replace_user_meta stamped one, else None."""
         meta = self._load_meta(bucket, object_)
         user = {} if replace_user_meta else dict(meta.get("meta") or {})
         user.update(updates)
         meta["meta"] = user
+        new_mod_time = None
+        if replace_user_meta:
+            new_mod_time = time.time_ns()
+            meta["mod_time_ns"] = new_mod_time
         mp = self._meta_path(bucket, object_)
         os.makedirs(os.path.dirname(mp), exist_ok=True)
         with open(mp, "w") as f:
             json.dump(meta, f)
+        return new_mod_time
 
     def _load_meta(self, bucket: str, object_: str) -> dict:
         try:

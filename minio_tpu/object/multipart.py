@@ -21,6 +21,7 @@ from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
     ErrBadDigest,
     ErrDiskNotFound,
+    ErrErasureWriteQuorum,
     ErrInvalidPart,
     ErrInvalidUploadID,
     ErrLessData,
@@ -180,17 +181,39 @@ class MultipartMixin:
             raise ErrBadDigest(
                 f"part md5 {etag} != declared {opts.want_md5_hex}"
             )
-        # Verified: move into place on every disk that took the stream.
+        # Verified: move into place on every disk that took the stream,
+        # under the same write quorum as the stream itself — a part whose
+        # renames mostly failed must NOT be journaled as uploaded.
+        rename_errs: list = [None] * len(disks_by_shard)
+        renamed: list[int] = []
         for i, disk in enumerate(disks_by_shard):
             if disk is None or writers[i] is None:
+                rename_errs[i] = ErrDiskNotFound(f"disk {i}")
                 continue
             try:
                 disk.rename_file(
                     SYSTEM_META_BUCKET, f"{upload_path}/{tmp_part}",
                     SYSTEM_META_BUCKET, f"{upload_path}/part.{part_number}",
                 )
-            except Exception:  # noqa: BLE001 - per-disk best effort
-                pass
+                renamed.append(i)
+            except Exception as exc:  # noqa: BLE001 - reduced below
+                rename_errs[i] = exc
+        if len(renamed) < write_quorum:
+            for i in renamed:
+                try:
+                    disks_by_shard[i].delete(
+                        SYSTEM_META_BUCKET,
+                        f"{upload_path}/part.{part_number}",
+                    )
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+            _drop_tmp()
+            err = reduce_write_quorum_errs(
+                rename_errs, OBJECT_OP_IGNORED_ERRS, write_quorum
+            )
+            raise err if err else ErrErasureWriteQuorum(
+                f"part {part_number}: {len(renamed)} renames succeeded"
+            )
         # Journal the part on every disk's upload xl.meta. The journal
         # update is a read-modify-write, so concurrent part uploads for the
         # same upload id are serialized per upload (the reference holds the
@@ -363,7 +386,7 @@ class MultipartMixin:
         # xl.meta: hold the same per-object write lock as put_object so a
         # racing PutObject can't interleave into a mixed-mod-time quorum
         # (ref CompleteMultipartUpload NSLock, cmd/erasure-multipart.go:736).
-        with self._ns_lock.write(f"{bucket}/{object_}"):
+        with self._locked_write(bucket, object_):
             list(_mp_pool.map(commit, range(len(disks_by_shard))))
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:

@@ -153,5 +153,25 @@ class TeeMD5Reader:
             self.bytes_read += len(buf)
         return buf
 
+    def readinto(self, b) -> int:
+        """Zero-copy fill when the source supports it — keeps the strip
+        pipeline's readinto scatter path (erasure/streaming.py) live for
+        production puts, not just benchmarks."""
+        view = memoryview(b)
+        src_readinto = getattr(self._src, "readinto", None)
+        if src_readinto is not None:
+            n = src_readinto(view)
+            if n:
+                self._md5.update(view[:n])
+                self.bytes_read += n
+            return n or 0
+        buf = self._src.read(len(view))
+        n = len(buf)
+        if n:
+            view[:n] = buf
+            self._md5.update(buf)
+            self.bytes_read += n
+        return n
+
     def md5_hex(self) -> str:
         return self._md5.hexdigest()

@@ -1,0 +1,145 @@
+"""Native-C GF(2^8) matrix engine (native/gfapply.c) — the host-side
+counterpart of klauspost/reedsolomon's SIMD loops
+(/root/reference/cmd/erasure-coding.go:62) and the fallback encode engine
+when the accelerator link cannot sustain the stream (engine policy in
+erasure/codec.py).
+
+Three ISA tiers, chosen by the compiled library:
+- GFNI/AVX-512: each coefficient's 8x8 GF(2) bit matrix (the SAME
+  expansion ops/gf.py feeds the MXU) is applied to 64 bytes per
+  vgf2p8affineqb instruction.
+- SSSE3: split-nibble pshufb tables ("Screaming Fast Galois Field
+  Arithmetic").
+- scalar: nibble tables, portable C.
+
+The field math stays in ops/gf.py (poly 0x11D); this module builds the
+per-coefficient operands and moves bytes. Bit-exactness against
+gf.gf_matmul_shards_ref is enforced by tests/test_gf_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+
+import numpy as np
+
+from . import gf
+
+
+def _lib():
+    from .. import native
+
+    return native.load()
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+@functools.cache
+def engine_kind() -> int:
+    """2 = GFNI/AVX-512, 1 = SSSE3 shuffle, 0 = scalar, -1 = no lib."""
+    lib = _lib()
+    if lib is None:
+        return -1
+    return int(lib.gf_engine_kind())
+
+
+@functools.lru_cache(maxsize=64)
+def _nibble_tables(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    """tables[r][k][2][16]: T_lo[n]=c*n, T_hi[n]=c*(n<<4) per coefficient."""
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    tables = np.empty((r, k, 2, 16), dtype=np.uint8)
+    for rr in range(r):
+        for j in range(k):
+            c = int(mat[rr, j])
+            tables[rr, j, 0] = [gf.gf_mul(c, x) for x in range(16)]
+            tables[rr, j, 1] = [gf.gf_mul(c, x << 4) for x in range(16)]
+    return np.ascontiguousarray(tables)
+
+
+@functools.lru_cache(maxsize=64)
+def _affine_qwords(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    """qwords[r][k]: multiply-by-c as the 8x8 GF(2) matrix operand of
+    vgf2p8affineqb.
+
+    Per the instruction's semantics (Intel SDM GF2P8AFFINEQB):
+      out.bit[i] = parity(A.byte[7-i] AND x)
+    so matrix byte (7-p) must hold row p of the LSB-first bit matrix
+    (out_bit p = XOR_q B[p][q]*in_bit[q]) packed LSB-first.
+    """
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    out = np.empty((r, k), dtype=np.uint64)
+    for rr in range(r):
+        for j in range(k):
+            c = int(mat[rr, j])
+            q = 0
+            for p in range(8):
+                # Row p: bit q set iff bit p of c*(1<<q) is set.
+                row = 0
+                for b in range(8):
+                    if (gf.gf_mul(c, 1 << b) >> p) & 1:
+                        row |= 1 << b
+                q |= row << (8 * (7 - p))
+            out[rr, j] = np.uint64(q)
+    return np.ascontiguousarray(out)
+
+
+def _threads() -> int:
+    env = os.environ.get("MTPU_NATIVE_THREADS", "")
+    if env.isdigit() and int(env) > 0:
+        return int(env)
+    return min(os.cpu_count() or 4, 16)
+
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(_U8P)
+
+
+def apply_matrix(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """mat uint8 [R, K] GF bytes, shards uint8 [K, S] -> [R, S]."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native GF engine unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    r, k = mat.shape
+    s = shards.shape[-1]
+    assert shards.shape == (k, s), (mat.shape, shards.shape)
+    out = np.empty((r, s), dtype=np.uint8)
+    if engine_kind() == 2:
+        qw = _affine_qwords(mat.tobytes(), r, k)
+        lib.gf_apply_affine(qw.ctypes.data_as(_U64P), r, k, _u8(shards),
+                            _u8(out), s, _threads())
+    else:
+        tables = _nibble_tables(mat.tobytes(), r, k)
+        lib.gf_apply(_u8(tables), r, k, _u8(shards), _u8(out), s, _threads())
+    return out
+
+
+def apply_matrix_batch(mat: np.ndarray, blocks: np.ndarray) -> np.ndarray:
+    """mat uint8 [R, K], blocks uint8 [B, K, S] -> [B, R, S]."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native GF engine unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    r, k = mat.shape
+    b, kk, s = blocks.shape
+    assert kk == k, (mat.shape, blocks.shape)
+    out = np.empty((b, r, s), dtype=np.uint8)
+    if engine_kind() == 2:
+        qw = _affine_qwords(mat.tobytes(), r, k)
+        lib.gf_apply_affine_batch(qw.ctypes.data_as(_U64P), r, k,
+                                  _u8(blocks), _u8(out), b, s, _threads())
+    else:
+        tables = _nibble_tables(mat.tobytes(), r, k)
+        lib.gf_apply_batch(_u8(tables), r, k, _u8(blocks), _u8(out), b, s,
+                           _threads())
+    return out

@@ -58,14 +58,22 @@ def apply_gf_matrix(bitmat, shards) -> jax.Array:
     """Public entry: bitmat int8 [8R,8K] (from gf.bit_matrix), shards
     uint8 [..., K, S]. Leading dims are batch.
 
-    On TPU the fused Pallas kernel runs (bit-planes stay in VMEM, see
-    ops/rs_pallas.py); elsewhere the XLA einsum formulation below.
+    Kernel policy (round-3 measurement on the real chip, 1 GiB
+    device-resident dispatches): XLA's einsum formulation 28.3 GB/s,
+    plane-major Pallas 27.5 GB/s, the earlier interleaved Pallas kernel
+    13.5 GB/s — XLA already fuses unpack/matmul/pack into one kernel, so
+    hand-fusing buys nothing and its fixed tiling loses slightly. The
+    shipping path is therefore the einsum; set MTPU_RS_KERNEL=pallas to
+    opt in to the Pallas kernel (kept bit-exact for experimentation).
     """
+    import os
+
     from . import rs_pallas
 
     bitmat = jnp.asarray(bitmat, dtype=jnp.int8)
     shards = jnp.asarray(shards, dtype=jnp.uint8)
-    if rs_pallas.pallas_supported() and shards.shape[-1] >= 128:
+    if (os.environ.get("MTPU_RS_KERNEL", "einsum") == "pallas"
+            and rs_pallas.pallas_supported() and shards.shape[-1] >= 128):
         return rs_pallas.apply_gf_matrix_pallas(bitmat, shards)
     return _apply_bits(bitmat, shards)
 

@@ -49,16 +49,20 @@ def pallas_available() -> bool:
 
 
 def _gf_kernel(bitmat_ref, shards_ref, out_ref):
-    """One (batch block, shard tile): fused unpack -> matmul -> pack."""
-    k8 = bitmat_ref.shape[1]
+    """One (batch block, shard tile): fused unpack -> matmul -> pack.
+
+    Bit-planes are PLANE-MAJOR: bits row b*K + j is bit b of input row j,
+    built by concatenating the 8 shifted planes along sublanes. The
+    original interleaved layout (row j*8 + b) needed a stack+reshape that
+    Mosaic lowers to an expensive relayout — plane-major measured 2x
+    faster on the real chip (13.5 -> 27.5 GB/s, latency-bound tunnel).
+    The caller permutes bitmat's columns to match (_plane_major_cols)."""
     r8 = bitmat_ref.shape[0]
-    k = k8 // 8
     r = r8 // 8
 
     tile = shards_ref[0].astype(jnp.int32)  # [K, T]
-    # Unpack LSB-first bit-planes: row 8*j + b is bit b of input row j.
     planes = [((tile >> b) & 1) for b in range(8)]
-    bits = jnp.stack(planes, axis=1).reshape(k8, tile.shape[-1])  # [8K, T]
+    bits = jnp.concatenate(planes, axis=0)  # [8K, T] plane-major
 
     acc = jax.lax.dot_general(
         bitmat_ref[...].astype(jnp.int8), bits.astype(jnp.int8),
@@ -72,6 +76,14 @@ def _gf_kernel(bitmat_ref, shards_ref, out_ref):
     ))
     packed = jnp.sum(obits * weights, axis=1)  # [R, T] int32
     out_ref[0] = packed.astype(jnp.uint8)
+
+
+@functools.cache
+def _plane_major_cols(k8: int) -> tuple[int, ...]:
+    """Column permutation taking an interleaved bit-matrix (col j*8 + b)
+    to the kernel's plane-major bit order (col b*K + j)."""
+    k = k8 // 8
+    return tuple(j * 8 + b for b in range(8) for j in range(k))
 
 
 @functools.partial(
@@ -114,6 +126,7 @@ def apply_gf_matrix_pallas(bitmat, shards, tile: int = DEFAULT_TILE,
     kernel itself runs on [B, K, S]).
     """
     bitmat = jnp.asarray(bitmat, dtype=jnp.int8)
+    bitmat = bitmat[:, list(_plane_major_cols(bitmat.shape[1]))]
     shards = jnp.asarray(shards, dtype=jnp.uint8)
     lead = shards.shape[:-2]
     k, s = shards.shape[-2:]

@@ -193,6 +193,34 @@ def test_self_copy_is_metadata_update_not_deadlock(s3_client):
     assert h.get("x-amz-meta-color") == "blue"
 
 
+def test_versioned_self_copy_creates_new_version(s3_client):
+    """On a versioned bucket, self-copy must lay a NEW version (no
+    deadlock against the writer lock, no in-place mutation)."""
+    cl = s3_client
+    assert cl.request("PUT", "/vselfcp")[0] == 200
+    vx = ('<VersioningConfiguration xmlns='
+          '"http://s3.amazonaws.com/doc/2006-03-01/">'
+          "<Status>Enabled</Status></VersioningConfiguration>")
+    assert cl.request("PUT", "/vselfcp", query=[("versioning", "")],
+                      body=vx.encode())[0] == 200
+    body = b"versioned self copy"
+    st, h1, _ = cl.request("PUT", "/vselfcp/obj", body=body)
+    assert st == 200
+    v1 = h1.get("x-amz-version-id")
+    st, h2, _ = cl.request(
+        "PUT", "/vselfcp/obj",
+        headers={"x-amz-copy-source": "/vselfcp/obj"})
+    assert st == 200
+    v2 = h2.get("x-amz-version-id")
+    assert v1 and v2 and v1 != v2
+    st, _, got = cl.request("GET", "/vselfcp/obj")
+    assert st == 200 and got == body
+    # the original version is still retrievable
+    st, _, got = cl.request("GET", "/vselfcp/obj",
+                            query=[("versionId", v1)])
+    assert st == 200 and got == body
+
+
 def test_part_reupload_bad_digest_keeps_old_part(eset):
     """A failed re-upload of an existing part number must not destroy the
     journaled part's shards (stage-to-tmp, rename-on-verify)."""

@@ -736,18 +736,19 @@ class S3ApiHandlers:
         else:
             opts.user_defined = dict(src_info.user_defined)
         self_copy = (sbucket, sobject) == (ctx.bucket, ctx.object)
+        if self_copy and not vid and directive != "REPLACE":
+            # AWS rejects untargeted self-copy without changed metadata
+            # regardless of bucket versioning (ref cpSrcDstSame,
+            # cmd/object-handlers.go).
+            raise S3Error(
+                "InvalidRequest",
+                "This copy request is illegal because it is being made "
+                "to the same object without changing metadata.",
+            )
         if self_copy and not vid and not opts.versioned:
-            # Unversioned self-copy. Without REPLACE it's illegal (AWS
-            # InvalidRequest); with REPLACE it's a metadata-only update —
-            # never re-put the bytes, which would deadlock the writer lock
-            # against its own locked source read (ref cpSrcDstSame /
-            # srcInfo.metadataOnly, cmd/object-handlers.go).
-            if directive != "REPLACE":
-                raise S3Error(
-                    "InvalidRequest",
-                    "This copy request is illegal because it is being made "
-                    "to the same object without changing metadata.",
-                )
+            # Unversioned REPLACE self-copy: metadata-only update — never
+            # re-put the bytes, which would deadlock the writer lock
+            # against its own locked source read (srcInfo.metadataOnly).
             try:
                 mod_time_ns = self.ol.update_object_metadata(
                     ctx.bucket, ctx.object, src_info.version_id or "",
@@ -755,68 +756,61 @@ class S3ApiHandlers:
                 )
             except StorageError as exc:
                 raise from_object_error(exc) from exc
-            root = _xml_root("CopyObjectResult")
-            ET.SubElement(root, "LastModified").text = iso8601(
-                mod_time_ns or src_info.mod_time_ns
-            )
-            ET.SubElement(root, "ETag").text = f'"{src_info.etag}"'
+            src_info.mod_time_ns = mod_time_ns or src_info.mod_time_ns
             self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=src_info)
-            return Response.xml(root)
-        if self_copy:
-            # Versioned self-copy (new version of the same key) or a
-            # versionId restore: the source read must COMPLETE before the
-            # destination put takes the same write lock, so buffer the
-            # version's bytes up front instead of streaming under the lock.
-            repl_rule = self._repl_rule(ctx.bucket, ctx.object)
-            if repl_rule is not None:
-                from ..replication.pool import PENDING, REPL_STATUS_KEY
+            return self._copy_result(src_info)
 
-                opts.user_defined[REPL_STATUS_KEY] = PENDING
-            try:
-                data = self.ol.get_object_bytes(sbucket, sobject,
-                                                opts=src_opts)
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
-            try:
-                oi = self.ol.put_object(
-                    ctx.bucket, ctx.object, io.BytesIO(data), len(data), opts
-                )
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
-            if repl_rule is not None:
-                rvid = oi.version_id if oi.version_id != "null" else ""
-                self._schedule_replication(ctx.bucket, ctx.object, rvid, "put")
-            root = _xml_root("CopyObjectResult")
-            ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
-            ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
-            self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
-            headers = {}
-            if oi.version_id and oi.version_id != "null":
-                headers["x-amz-version-id"] = oi.version_id
-            return Response.xml(root, headers=headers)
         repl_rule = self._repl_rule(ctx.bucket, ctx.object)
         if repl_rule is not None:
             from ..replication.pool import PENDING, REPL_STATUS_KEY
 
             opts.user_defined[REPL_STATUS_KEY] = PENDING
-        # Stream source -> destination in 1 MiB pulls; a multi-GiB copy
-        # must not materialize in memory.
-        reader = _RangeCopyReader(
-            self.ol, sbucket, sobject, 0, src_info.size, src_opts
-        )
-        try:
-            oi = self.ol.put_object(
-                ctx.bucket, ctx.object, reader, src_info.size, opts
+        if self_copy:
+            # Versioned self-copy (new version of the same key) or a
+            # versionId restore: the source read must COMPLETE before the
+            # destination put takes the same write lock. Spool through a
+            # temp file, not memory — a multi-GiB restore must not be an
+            # unbounded allocation.
+            import tempfile
+
+            with tempfile.TemporaryFile() as spool:
+                try:
+                    self.ol.get_object(sbucket, sobject, spool,
+                                       opts=src_opts)
+                except StorageError as exc:
+                    raise from_object_error(exc) from exc
+                size = spool.tell()
+                spool.seek(0)
+                try:
+                    oi = self.ol.put_object(
+                        ctx.bucket, ctx.object, spool, size, opts
+                    )
+                except StorageError as exc:
+                    raise from_object_error(exc) from exc
+        else:
+            # Stream source -> destination in 1 MiB pulls; a multi-GiB
+            # copy must not materialize in memory.
+            reader = _RangeCopyReader(
+                self.ol, sbucket, sobject, 0, src_info.size, src_opts
             )
-        except StorageError as exc:
-            raise from_object_error(exc) from exc
+            try:
+                oi = self.ol.put_object(
+                    ctx.bucket, ctx.object, reader, src_info.size, opts
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
         if repl_rule is not None:
-            vid = oi.version_id if oi.version_id != "null" else ""
-            self._schedule_replication(ctx.bucket, ctx.object, vid, "put")
+            rvid = oi.version_id if oi.version_id != "null" else ""
+            self._schedule_replication(ctx.bucket, ctx.object, rvid, "put")
+        self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
+        return self._copy_result(oi)
+
+    @staticmethod
+    def _copy_result(oi) -> Response:
+        """CopyObjectResult XML + version header (shared epilogue)."""
         root = _xml_root("CopyObjectResult")
         ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
         ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
-        self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
         headers = {}
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id

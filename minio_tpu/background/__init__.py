@@ -4,6 +4,7 @@ admin heal sequences, erasure-set sweeps, stale upload cleanup
 cmd/global-heal.go, cmd/admin-heal-ops.go)."""
 
 from .heal import HealSequence, HealState, MRFHealer, heal_erasure_set
+from .monitor import DiskMonitor
 from .scanner import (
     DataScanner,
     DataUsageInfo,
@@ -13,5 +14,6 @@ from .scanner import (
 
 __all__ = [
     "DataScanner", "DataUsageInfo", "DynamicSleeper", "parse_lifecycle",
+    "DiskMonitor",
     "HealSequence", "HealState", "MRFHealer", "heal_erasure_set",
 ]

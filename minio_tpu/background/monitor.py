@@ -1,0 +1,166 @@
+"""Disk liveness monitoring: the health-check/reconnect loop of the
+reference's monitorAndConnectEndpoints (/root/reference/cmd/
+erasure-sets.go:282-308) and its setReconnectEvent -> MRF drain (:88-96).
+
+Each tick every disk of every erasure set is probed (ping). Probes run
+asynchronously on a small pool, so one hung remote (RPC timeout) never
+stalls the sweep or detection on other disks. A disk is pulled from its
+set (slot becomes None, the reference's OfflineDisk) only after
+`fail_threshold` CONSECUTIVE failed probes — a single transient blip
+doesn't degrade writes — and is restored on the first successful probe.
+Every write during an outage lands in the set's MRF queue; restoration
+kicks the MRF healer so the stale disk catches up within one interval.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+_probe_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="mtpu-probe")
+
+
+def _probe(disk) -> bool:
+    try:
+        ping = getattr(disk, "ping", None)
+        if ping is not None:
+            ping()
+        else:
+            disk.disk_info()
+        return True
+    except Exception:  # noqa: BLE001 - any failure means offline
+        return False
+
+
+class DiskMonitor:
+    """Health-check loop over an ErasureServerPools object layer."""
+
+    def __init__(self, object_layer, mrf_healer=None, interval_s: float = 1.0,
+                 fail_threshold: int = 2, metrics=None, logger=None):
+        self.ol = object_layer
+        self.mrf = mrf_healer
+        self.interval_s = interval_s
+        self.fail_threshold = max(1, fail_threshold)
+        self.metrics = metrics
+        self.logger = logger
+        # (id(set), slot) -> disk object pulled from that slot.
+        self._offline: dict[tuple[int, int], object] = {}
+        self._fails: dict[tuple[int, int], int] = {}
+        # key -> completed probe result; key in _pending = probe in flight.
+        self._results: dict[tuple[int, int], bool] = {}
+        self._pending: set[tuple[int, int]] = set()
+        self._state_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.events: deque[tuple[str, str]] = deque(maxlen=256)
+
+    def _submit_probe(self, key: tuple[int, int], disk) -> None:
+        with self._state_lock:
+            if key in self._pending:
+                return  # previous probe still hung — counts as no news
+            self._pending.add(key)
+
+        def run():
+            ok = _probe(disk)
+            with self._state_lock:
+                self._results[key] = ok
+                self._pending.discard(key)
+
+        _probe_pool.submit(run)
+
+    # -- one sweep (exposed for tests/admin) --
+
+    def check_once(self, wait: bool = True) -> dict:
+        """Kick probes for every disk, apply any completed results.
+
+        `wait=True` (tests, admin on-demand checks) blocks briefly until
+        this round's probes complete; the background loop passes False so
+        a hung disk can never stall the sweep — its result applies on a
+        later tick whenever the probe returns.
+        """
+        went_offline: list[str] = []
+        reconnected: list[str] = []
+        for pool in getattr(self.ol, "pools", []):
+            for es in pool.sets:
+                for i in range(len(es.disks)):
+                    key = (id(es), i)
+                    disk = es.disks[i]
+                    target = disk if disk is not None else self._offline.get(key)
+                    if target is None:
+                        continue
+                    self._submit_probe(key, target)
+        if wait:
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with self._state_lock:
+                    if not self._pending:
+                        break
+                time.sleep(0.01)
+
+        with self._state_lock:
+            results, self._results = self._results, {}
+        for pool in getattr(self.ol, "pools", []):
+            for es in pool.sets:
+                for i in range(len(es.disks)):
+                    key = (id(es), i)
+                    if key not in results:
+                        continue
+                    ok = results[key]
+                    disk = es.disks[i]
+                    if disk is not None:
+                        if ok:
+                            self._fails.pop(key, None)
+                            continue
+                        fails = self._fails.get(key, 0) + 1
+                        self._fails[key] = fails
+                        if fails < self.fail_threshold:
+                            continue
+                        self._offline[key] = disk
+                        es.disks[i] = None
+                        went_offline.append(disk.endpoint())
+                        self.events.append(("offline", disk.endpoint()))
+                        if self.metrics is not None:
+                            self.metrics.inc("disk_offline_total")
+                    elif key in self._offline and ok:
+                        saved = self._offline.pop(key)
+                        self._fails.pop(key, None)
+                        es.disks[i] = saved
+                        reconnected.append(saved.endpoint())
+                        self.events.append(("online", saved.endpoint()))
+                        if self.metrics is not None:
+                            self.metrics.inc("disk_reconnect_total")
+        if reconnected and self.mrf is not None:
+            # Reconnect event: drain the MRF queues now so writes that
+            # missed the disk are healed onto it (ref setReconnectEvent).
+            try:
+                self.mrf.drain_once()
+            except Exception as exc:  # noqa: BLE001 - heal is best effort
+                if self.logger is not None:
+                    self.logger.log_once_if(exc, "monitor-mrf")
+        return {"offline": went_offline, "reconnected": reconnected}
+
+    def offline_endpoints(self) -> list[str]:
+        return [d.endpoint() for d in self._offline.values()]
+
+    # -- loop --
+
+    def start(self) -> "DiskMonitor":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.check_once(wait=False)
+                except Exception as exc:  # noqa: BLE001 - keep monitoring
+                    if self.logger is not None:
+                        self.logger.log_once_if(exc, "monitor-loop")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="mtpu-disk-monitor"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

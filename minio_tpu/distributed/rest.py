@@ -72,10 +72,25 @@ class RPCServer:
         self.prefix = prefix.rstrip("/")
         self.secret = secret
         self._methods: dict = {}
+        # Live connection sockets, so stop() can sever keep-alive peers —
+        # shutdown() alone leaves pooled client connections being served
+        # by their handler threads, which is not what "node died" means.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                with outer._conns_lock:
+                    outer._conns.add(self.connection)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.connection)
+                super().finish()
 
             def log_message(self, fmt, *args):
                 pass
@@ -101,6 +116,19 @@ class RPCServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        import socket as _socket
+
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._thread:
             self._thread.join(timeout=5)
 

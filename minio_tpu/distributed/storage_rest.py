@@ -321,6 +321,11 @@ class RemoteStorage(StorageAPI):
 
     # --- identity ---
 
+    def ping(self) -> None:
+        """Round-trip liveness probe over the REST plane (the reference's
+        storage client health check)."""
+        self._call("ping")
+
     def is_online(self) -> bool:
         return self._client.online
 

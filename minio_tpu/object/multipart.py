@@ -199,14 +199,12 @@ class MultipartMixin:
             except Exception as exc:  # noqa: BLE001 - reduced below
                 rename_errs[i] = exc
         if len(renamed) < write_quorum:
-            for i in renamed:
-                try:
-                    disks_by_shard[i].delete(
-                        SYSTEM_META_BUCKET,
-                        f"{upload_path}/part.{part_number}",
-                    )
-                except Exception:  # noqa: BLE001 - best effort
-                    pass
+            # Leave the renamed shards in place: for a part re-upload they
+            # may now be the only >=k consistent copy (the old shards they
+            # replaced are gone) — deleting them would destroy the part
+            # outright. The journal keeps the OLD etag, so a retry or a
+            # complete with the old etag surfaces InvalidPart rather than
+            # silent loss.
             _drop_tmp()
             err = reduce_write_quorum_errs(
                 rename_errs, OBJECT_OP_IGNORED_ERRS, write_quorum

@@ -11,7 +11,7 @@ import os
 import time
 
 from .api import S3Server
-from .background import DataScanner, HealState, MRFHealer
+from .background import DataScanner, DiskMonitor, HealState, MRFHealer
 from .bucket import BucketMetadataSys
 from .config import ConfigSys
 from .event import EventNotifier, targets_from_config
@@ -147,6 +147,13 @@ class Server:
             self.object_layer, self.bucket_meta,
             metrics=self.metrics, logger=self.logger,
         )
+        # Disk liveness loop (ref monitorAndConnectEndpoints,
+        # cmd/erasure-sets.go:282): offline detection + reconnect-driven
+        # MRF heal.
+        self.disk_monitor = DiskMonitor(
+            self.object_layer, mrf_healer=self.mrf,
+            metrics=self.metrics, logger=self.logger,
+        )
         self._enable_scanner = enable_scanner
 
         # --- HTTP front-end ---
@@ -192,6 +199,7 @@ class Server:
         if self.mode == "erasure" and self._enable_scanner:
             self.mrf.start()
             self.scanner.start()
+            self.disk_monitor.start()
         self.s3.start()
         return self
 
@@ -199,6 +207,7 @@ class Server:
         self.s3.stop()
         self.scanner.stop()
         self.mrf.stop()
+        self.disk_monitor.stop()
         self.notifier.close()
 
     @property

@@ -87,6 +87,12 @@ class LocalStorage(StorageAPI):
 
     # --- identity ---
 
+    def ping(self) -> None:
+        """Liveness probe for the disk monitor: online flag + the root
+        directory still being there (a pulled mount raises)."""
+        self._require_online()
+        os.stat(self.root)
+
     def is_online(self) -> bool:
         return self._online
 

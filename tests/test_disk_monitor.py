@@ -1,0 +1,169 @@
+"""Disk liveness monitor: offline detection pulls the disk from its set,
+writes proceed on quorum and queue MRF, and reconnection restores the
+slot and auto-heals — the monitorAndConnectEndpoints + setReconnectEvent
+behavior (/root/reference/cmd/erasure-sets.go:282-308,:88-96)."""
+
+import io
+import time
+
+import pytest
+
+from minio_tpu.background.heal import MRFHealer
+from minio_tpu.background.monitor import DiskMonitor
+from minio_tpu.distributed import RemoteStorage, StorageRESTServer
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+
+SECRET = "monitor-secret"
+DEP = "99999999-8888-7777-6666-555555555555"
+
+
+def _mk_pool(disks):
+    sets = ErasureSets(disks, 4, deployment_id=DEP, pool_index=0)
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    ol.make_bucket("mon")
+    return ol, sets
+
+
+def test_local_disk_offline_and_reconnect(tmp_path):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    ol, sets = _mk_pool(disks)
+    mrf = MRFHealer(ol)
+    mon = DiskMonitor(ol, mrf_healer=mrf)
+
+    assert mon.check_once() == {"offline": [], "reconnected": []}
+
+    # Disk d2 dies: pulled only after fail_threshold consecutive
+    # failures (a single blip must not degrade writes).
+    disks[2].set_online(False)
+    assert mon.check_once()["offline"] == []
+    res = mon.check_once()
+    assert res["offline"] == ["d2"]
+    es = sets.sets[0]
+    assert es.disks.count(None) == 1
+
+    # Writes proceed on quorum and remember the miss in MRF.
+    body = b"written while degraded" * 1000
+    ol.put_object("mon", "degraded.bin", io.BytesIO(body), len(body))
+    assert ol.get_object_bytes("mon", "degraded.bin") == body
+    with es._mrf_lock:
+        assert len(es._mrf) >= 1
+
+    # Disk returns: slot restored, MRF drained, object healed everywhere.
+    disks[2].set_online(True)
+    res = mon.check_once()
+    assert res["reconnected"] == ["d2"]
+    assert es.disks.count(None) == 0
+    with es._mrf_lock:
+        assert es._mrf == []
+    # every online disk now holds a copy of the version metadata
+    ok = 0
+    for d in es.disks:
+        try:
+            d.read_version("mon", "degraded.bin")
+            ok += 1
+        except Exception:  # noqa: BLE001
+            continue
+    assert ok == 4
+
+
+def test_rest_server_kill_and_restart_heals(tmp_path):
+    """Kill a storage REST node mid-workload; writes keep succeeding on
+    quorum; restart the node on the same port; the monitor reconnects
+    the disks and the MRF heal catches the stale shards up."""
+    remote_disks = [
+        LocalStorage(str(tmp_path / f"rd{i}"), endpoint=f"rd{i}")
+        for i in range(2)
+    ]
+    srv = StorageRESTServer(remote_disks, SECRET).start()
+    host, port = srv.endpoint.rsplit(":", 1)
+    local = [
+        LocalStorage(str(tmp_path / f"ld{i}"), endpoint=f"ld{i}")
+        for i in range(2)
+    ]
+    remote = [
+        RemoteStorage(srv.endpoint, f"rd{i}", SECRET, timeout=2.0)
+        for i in range(2)
+    ]
+    ol, sets = _mk_pool(local + remote)
+    es = sets.sets[0]
+    mrf = MRFHealer(ol)
+    mon = DiskMonitor(ol, mrf_healer=mrf)
+
+    body = b"pre-outage" * 4096
+    ol.put_object("mon", "a.bin", io.BytesIO(body), len(body))
+
+    # Node dies (two consecutive failed probes pull both its disks).
+    srv.stop()
+    mon.check_once()
+    res = mon.check_once()
+    assert len(res["offline"]) == 2
+    assert es.disks.count(None) == 2
+
+    # With half the set gone, writes (quorum 3 of 4) must fail but
+    # degraded reads (quorum 2 = data shards) still serve.
+    assert ol.get_object_bytes("mon", "a.bin") == body
+
+    # Node restarts on the same port.
+    srv2 = StorageRESTServer(remote_disks, SECRET,
+                             host=host, port=int(port)).start()
+    try:
+        deadline = time.time() + 10
+        reconnected = []
+        while time.time() < deadline and len(reconnected) < 2:
+            reconnected += mon.check_once()["reconnected"]
+            time.sleep(0.1)
+        assert len(reconnected) == 2, reconnected
+        assert es.disks.count(None) == 0
+        # object still fully readable, all four disks answer
+        assert ol.get_object_bytes("mon", "a.bin") == body
+    finally:
+        srv2.stop()
+
+
+def test_six_disk_outage_write_then_auto_heal(tmp_path):
+    """On a wider set (6 disks, parity 2 -> write quorum tolerates 2
+    down), writes DURING the outage land in MRF and heal onto the
+    returned disks within one monitor sweep."""
+    disks = [
+        LocalStorage(str(tmp_path / f"w{i}"), endpoint=f"w{i}")
+        for i in range(6)
+    ]
+    sets = ErasureSets(disks, 6, deployment_id=DEP, pool_index=0,
+                       default_parity=2)
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    ol.make_bucket("mon")
+    es = sets.sets[0]
+    mrf = MRFHealer(ol)
+    mon = DiskMonitor(ol, mrf_healer=mrf)
+
+    disks[1].set_online(False)
+    disks[4].set_online(False)
+    mon.check_once()
+    assert len(mon.check_once()["offline"]) == 2
+
+    body = b"outage write" * 20000
+    ol.put_object("mon", "heal-me.bin", io.BytesIO(body), len(body))
+
+    disks[1].set_online(True)
+    disks[4].set_online(True)
+    res = mon.check_once()
+    assert len(res["reconnected"]) == 2
+    # MRF drained by the reconnect event: shards now on all six disks
+    with es._mrf_lock:
+        assert es._mrf == []
+    ok = 0
+    for d in es.disks:
+        try:
+            d.read_version("mon", "heal-me.bin")
+            ok += 1
+        except Exception:  # noqa: BLE001
+            continue
+    assert ok == 6
+    assert ol.get_object_bytes("mon", "heal-me.bin") == body

@@ -207,9 +207,15 @@ def test_versioned_self_copy_creates_new_version(s3_client):
     st, h1, _ = cl.request("PUT", "/vselfcp/obj", body=body)
     assert st == 200
     v1 = h1.get("x-amz-version-id")
-    st, h2, _ = cl.request(
+    # Self-copy without changed metadata is illegal even when versioned
+    st, _, resp = cl.request(
         "PUT", "/vselfcp/obj",
         headers={"x-amz-copy-source": "/vselfcp/obj"})
+    assert st == 400 and b"InvalidRequest" in resp
+    st, h2, _ = cl.request(
+        "PUT", "/vselfcp/obj",
+        headers={"x-amz-copy-source": "/vselfcp/obj",
+                 "x-amz-metadata-directive": "REPLACE"})
     assert st == 200
     v2 = h2.get("x-amz-version-id")
     assert v1 and v2 and v1 != v2

@@ -66,6 +66,8 @@ class AdminHandlers:
             ("GET", "list-remote-targets"): "list_remote_targets",
             ("DELETE", "remove-remote-target"): "remove_remote_target",
             ("GET", "replication-stats"): "replication_stats",
+            ("PUT", "set-bucket-quota"): "set_bucket_quota",
+            ("GET", "get-bucket-quota"): "get_bucket_quota",
         }
         name = table.get((m, head))
         if name is None:
@@ -98,6 +100,8 @@ class AdminHandlers:
         "set_remote_target": "admin:SetBucketTarget",
         "list_remote_targets": "admin:GetBucketTarget",
         "remove_remote_target": "admin:SetBucketTarget",
+        "set_bucket_quota": "admin:SetBucketQuota",
+        "get_bucket_quota": "admin:GetBucketQuota",
         "replication_stats": "admin:ReplicationDiff",
     }
 
@@ -378,6 +382,42 @@ class AdminHandlers:
 
     # --- replication targets (ref cmd/admin-bucket-handlers.go
     # --- SetRemoteTargetHandler / ListRemoteTargetsHandler) ---
+
+    # ---------- bucket quota (ref cmd/admin-bucket-handlers.go
+    # PutBucketQuotaConfigHandler / GetBucketQuotaConfigHandler) ----------
+
+    def set_bucket_quota(self, ctx) -> Response:
+        if self.bm is None:
+            raise S3Error("NotImplemented", "no bucket metadata sys")
+        bucket = ctx.qdict.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket required")
+        if ctx.body:
+            try:
+                cfg = json.loads(ctx.body)
+                quota = int(cfg.get("quota") or 0)
+                qtype = (cfg.get("quotatype") or "hard").lower()
+            except (ValueError, TypeError, AttributeError) as exc:
+                raise S3Error("InvalidArgument", f"bad quota: {exc}") from exc
+            if quota < 0 or qtype not in ("hard", "fifo"):
+                raise S3Error("InvalidArgument", "bad quota config")
+            raw = json.dumps({"quota": quota, "quotatype": qtype})
+        else:
+            raw = ""  # empty body clears the quota (madmin semantics)
+        self.bm.update(bucket, "quota_json", raw)
+        return self._json({"status": "ok"})
+
+    def get_bucket_quota(self, ctx) -> Response:
+        if self.bm is None:
+            raise S3Error("NotImplemented", "no bucket metadata sys")
+        bucket = ctx.qdict.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket required")
+        raw = getattr(self.bm.get(bucket), "quota_json", "") or ""
+        if not raw:
+            return self._json({})
+        return Response(200, {"Content-Type": "application/json"},
+                        raw.encode())
 
     def set_remote_target(self, ctx) -> Response:
         if self.bm is None:

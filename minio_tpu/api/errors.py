@@ -130,6 +130,7 @@ def from_object_error(exc: Exception) -> "S3Error":
         (oe.ErrObjectExistsAsDirectory, "MethodNotAllowed"),
         (oe.ErrBadDigest, "BadDigest"),
         (oe.ErrOperationTimedOut, "SlowDown"),
+        (oe.ErrQuotaExceeded, "QuotaExceeded"),
     ]
     for etype, code in mapping:
         if isinstance(exc, etype):

@@ -208,7 +208,9 @@ class S3ApiHandlers:
     """All S3 endpoints bound to an ObjectLayer + subsystems."""
 
     def __init__(self, object_layer, bucket_meta, iam, notify=None,
-                 config=None, sse_config=None, repl_pool=None):
+                 config=None, sse_config=None, repl_pool=None, quota=None):
+        from ..bucket.quota import BucketQuotaSys
+
         self.ol = object_layer
         self.bm = bucket_meta
         self.iam = iam
@@ -216,6 +218,63 @@ class S3ApiHandlers:
         self.config = config
         self.sse_config = sse_config
         self.repl = repl_pool
+        self.quota = quota or BucketQuotaSys(object_layer, bucket_meta)
+
+    # ---------- object lock helpers (ref cmd/bucket-object-lock.go) -------
+
+    def _lock_config(self, bucket: str):
+        from ..bucket import objectlock as ol_mod
+
+        xml_text = self.bm.get(bucket).object_lock_xml
+        if not xml_text:
+            return None
+        try:
+            return ol_mod.LockConfig.parse(xml_text)
+        except Exception:  # noqa: BLE001 - malformed config never blocks IO
+            return None
+
+    def _apply_object_lock(self, ctx, opts):
+        """Validate x-amz-object-lock-* headers / apply the bucket default
+        retention to a new write (ref ParseObjectLockHeaders +
+        default-retention in PutObjectHandler)."""
+        from ..bucket import objectlock as ol_mod
+
+        try:
+            explicit = ol_mod.extract_lock_headers(ctx.headers)
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        cfg = self._lock_config(ctx.bucket)
+        if explicit:
+            if cfg is None or not cfg.enabled:
+                raise S3Error(
+                    "InvalidRequest",
+                    "Bucket is missing ObjectLockConfiguration",
+                )
+            opts.user_defined.update(explicit)
+        elif cfg is not None:
+            opts.user_defined.update(cfg.default_retention_meta())
+
+    def _enforce_retention(self, ctx, bucket: str, object_: str,
+                           version_id: str):
+        """Refuse deleting a retained/held version
+        (ref enforceRetentionBypassForDelete)."""
+        from ..bucket import objectlock as ol_mod
+
+        try:
+            oi = self.ol.get_object_info(
+                bucket, object_,
+                ObjectOptions(version_id=version_id,
+                              versioned=bool(version_id)),
+            )
+        except StorageError:
+            return  # missing/marker: nothing to retain
+        bypass = (
+            ctx.headers.get(ol_mod.HDR_BYPASS_GOVERNANCE, "").lower()
+            == "true"
+        )
+        reason = ol_mod.check_deletable(oi.user_defined, bypass)
+        if reason is not None:
+            raise S3Error("AccessDenied", reason)
 
     # ---------- replication hooks (ref cmd/bucket-replication.go) ----------
 
@@ -500,6 +559,23 @@ class S3ApiHandlers:
         for key, vid in objects:
             try:
                 opts = self._opts_for(ctx.bucket, {"versionId": vid})
+                # The bulk path destroys data exactly like the single
+                # DELETE, so it enforces retention/legal hold identically
+                # (ref DeleteMultipleObjectsHandler ->
+                # enforceRetentionBypassForDelete per object).
+                try:
+                    if vid:
+                        self._enforce_retention(ctx, ctx.bucket, key, vid)
+                    elif not opts.versioned:
+                        self._enforce_retention(ctx, ctx.bucket, key, "")
+                except S3Error as s3e:
+                    e = ET.SubElement(root, "Error")
+                    ET.SubElement(e, "Key").text = key
+                    if vid:
+                        ET.SubElement(e, "VersionId").text = vid
+                    ET.SubElement(e, "Code").text = s3e.api.code
+                    ET.SubElement(e, "Message").text = str(s3e)
+                    continue
                 self.ol.delete_object(ctx.bucket, key, opts)
                 if not quiet:
                     d = ET.SubElement(root, "Deleted")
@@ -614,9 +690,123 @@ class S3ApiHandlers:
         )
 
     def bucket_object_lock(self, ctx) -> Response:
+        # Object lock requires versioning (WORM versions) and a valid
+        # config (ref PutBucketObjectLockConfigHandler).
+        def _validate():
+            if not self.bm.get(ctx.bucket).versioning_enabled:
+                raise S3Error(
+                    "InvalidBucketState",
+                    "Versioning must be 'Enabled' on the bucket to apply "
+                    "an Object Lock configuration.",
+                )
+            from ..bucket import objectlock as ol_mod
+
+            try:
+                ol_mod.LockConfig.parse(ctx.body.decode())
+            except ET.ParseError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            except ValueError as exc:
+                raise S3Error("InvalidArgument", str(exc)) from exc
+
         return self._xml_subresource(
-            ctx, "object_lock_xml", "ObjectLockConfigurationNotFoundError"
+            ctx, "object_lock_xml", "ObjectLockConfigurationNotFoundError",
+            pre_put=_validate,
         )
+
+    # ---------- object retention / legal hold (ref cmd/object-handlers.go
+    # PutObjectRetentionHandler / PutObjectLegalHoldHandler) ----------
+
+    def _lock_target_info(self, ctx):
+        vid = ctx.qdict.get("versionId", "")
+        opts = ObjectOptions(version_id=vid,
+                             versioned=self.bm.get(ctx.bucket)
+                             .versioning_enabled)
+        try:
+            return self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+
+    def object_retention(self, ctx) -> Response:
+        from ..bucket import objectlock as ol_mod
+
+        self._check_bucket(ctx.bucket)
+        oi = self._lock_target_info(ctx)
+        if ctx.method == "GET":
+            mode, until = ol_mod.retention_state(oi.user_defined)
+            if not mode:
+                raise S3Error("NoSuchObjectLockConfiguration")
+            return Response(
+                200, {"Content-Type": "application/xml"},
+                ol_mod.retention_xml(mode, ol_mod.iso8601_utc(until)),
+            )
+        cfg = self._lock_config(ctx.bucket)
+        if cfg is None or not cfg.enabled:
+            raise S3Error("InvalidRequest",
+                          "Bucket is missing ObjectLockConfiguration")
+        try:
+            mode, until_iso = ol_mod.parse_retention_body(ctx.body)
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        # Tightening is always allowed; loosening COMPLIANCE is never
+        # allowed, loosening GOVERNANCE needs the bypass header
+        # (ref objectlock FilterObjectLockMetadata + retention checks).
+        old_mode, old_until = ol_mod.retention_state(oi.user_defined)
+        import time as _time
+
+        if old_mode and old_until > _time.time():
+            shortens = ol_mod.parse_iso8601(until_iso) < old_until
+            bypass = (
+                ctx.headers.get(ol_mod.HDR_BYPASS_GOVERNANCE, "").lower()
+                == "true"
+            )
+            if old_mode == ol_mod.MODE_COMPLIANCE and (
+                    shortens or mode != ol_mod.MODE_COMPLIANCE):
+                raise S3Error("AccessDenied",
+                              "COMPLIANCE retention cannot be loosened")
+            if old_mode == ol_mod.MODE_GOVERNANCE and shortens and not bypass:
+                raise S3Error("AccessDenied",
+                              "governance retention shortening requires "
+                              "bypass")
+        try:
+            self.ol.update_object_metadata(
+                ctx.bucket, ctx.object, oi.version_id or "",
+                {ol_mod.META_MODE: mode, ol_mod.META_RETAIN_UNTIL: until_iso},
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(200)
+
+    def object_legal_hold(self, ctx) -> Response:
+        from ..bucket import objectlock as ol_mod
+
+        self._check_bucket(ctx.bucket)
+        oi = self._lock_target_info(ctx)
+        if ctx.method == "GET":
+            status = "ON" if ol_mod.legal_hold_on(oi.user_defined) else "OFF"
+            if ol_mod.META_LEGAL_HOLD not in oi.user_defined:
+                raise S3Error("NoSuchObjectLockConfiguration")
+            return Response(200, {"Content-Type": "application/xml"},
+                            ol_mod.legal_hold_xml(status))
+        cfg = self._lock_config(ctx.bucket)
+        if cfg is None or not cfg.enabled:
+            raise S3Error("InvalidRequest",
+                          "Bucket is missing ObjectLockConfiguration")
+        try:
+            status = ol_mod.parse_legal_hold_body(ctx.body)
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        try:
+            self.ol.update_object_metadata(
+                ctx.bucket, ctx.object, oi.version_id or "",
+                {ol_mod.META_LEGAL_HOLD: status},
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(200)
 
     def bucket_replication(self, ctx) -> Response:
         # Replication requires versioning on the source bucket so deletes
@@ -666,6 +856,11 @@ class S3ApiHandlers:
             raise S3Error("EntityTooLarge")
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        self._apply_object_lock(ctx, opts)
+        try:
+            self.quota.check(ctx.bucket, size)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
         repl_rule = self._repl_rule(ctx.bucket, ctx.object)
         incoming_replica = (
             opts.user_defined.get("x-amz-meta-mtpu-replication") == "replica"
@@ -731,10 +926,27 @@ class S3ApiHandlers:
             raise from_object_error(exc) from exc
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         directive = ctx.headers.get("x-amz-metadata-directive", "COPY")
+        from ..bucket import objectlock as ol_mod
+
         if directive == "REPLACE":
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
-            opts.user_defined = dict(src_info.user_defined)
+            # Retention/hold NEVER copies from the source version — the
+            # destination's protection comes from this request's headers
+            # or the bucket default (AWS semantics).
+            opts.user_defined = {
+                k: v for k, v in src_info.user_defined.items()
+                if k not in (ol_mod.META_MODE, ol_mod.META_RETAIN_UNTIL,
+                             ol_mod.META_LEGAL_HOLD)
+            }
+        # A copy writes a new object/version: it honors lock headers /
+        # the bucket default retention and the hard quota exactly like a
+        # streaming PUT (ref CopyObjectHandler lock+quota wiring).
+        self._apply_object_lock(ctx, opts)
+        try:
+            self.quota.check(ctx.bucket, src_info.size)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
         self_copy = (sbucket, sobject) == (ctx.bucket, ctx.object)
         if self_copy and not vid and directive != "REPLACE":
             # AWS rejects untargeted self-copy without changed metadata
@@ -881,8 +1093,13 @@ class S3ApiHandlers:
             headers["X-Amz-Replication-Status"] = (
                 oi.user_defined[REPL_STATUS_KEY]
             )
+        from ..bucket import objectlock as ol_mod
+
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
+                headers[k] = v
+            elif k in (ol_mod.META_MODE, ol_mod.META_RETAIN_UNTIL,
+                       ol_mod.META_LEGAL_HOLD):
                 headers[k] = v
             elif k in _REMEMBERED_HEADERS and k != "content-type":
                 headers[k.title()] = v
@@ -984,6 +1201,17 @@ class S3ApiHandlers:
     def delete_object(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
         opts = self._opts_for(ctx.bucket, ctx.qdict)
+        # Retention/legal-hold enforcement: a versionId-targeted delete
+        # destroys that version; an untargeted delete on an UNVERSIONED
+        # bucket destroys the only copy. Untargeted versioned deletes lay
+        # a marker and never destroy data, so they pass
+        # (ref enforceRetentionForDeletion / checkRequestAuthType wiring
+        # in DeleteObjectHandler).
+        vid = ctx.qdict.get("versionId", "")
+        if vid:
+            self._enforce_retention(ctx, ctx.bucket, ctx.object, vid)
+        elif not opts.versioned:
+            self._enforce_retention(ctx, ctx.bucket, ctx.object, "")
         headers = {}
         try:
             oi = self.ol.delete_object(ctx.bucket, ctx.object, opts)
@@ -1017,6 +1245,9 @@ class S3ApiHandlers:
             raise S3Error("InvalidArgument", ctx.object)
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        # Multipart objects get the same lock treatment as single PUTs
+        # (ref NewMultipartUploadHandler lock-header wiring).
+        self._apply_object_lock(ctx, opts)
         try:
             upload_id = self.ol.new_multipart_upload(
                 ctx.bucket, ctx.object, opts
@@ -1052,6 +1283,12 @@ class S3ApiHandlers:
             raise S3Error("MissingContentLength")
         if size > MAX_PART_SIZE:
             raise S3Error("EntityTooLarge")
+        # Per-part quota admission (ref PutObjectPartHandler's
+        # enforceBucketQuotaHard): multipart must not be a quota bypass.
+        try:
+            self.quota.check(ctx.bucket, size)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
         part_opts = ObjectOptions(
             want_md5_hex=self._parse_content_md5(ctx.headers)
         )

@@ -68,6 +68,8 @@ _ACTIONS = {
     "bucket_notification": "s3:GetBucketNotification",
     "put_object": "s3:PutObject",
     "get_object": "s3:GetObject",
+    "object_retention": "s3:GetObjectRetention",
+    "object_legal_hold": "s3:GetObjectLegalHold",
     "head_object": "s3:GetObject",
     "delete_object": "s3:DeleteObject",
     "new_multipart_upload": "s3:PutObject",
@@ -86,6 +88,8 @@ _MUTATING_SUBRESOURCE_ACTIONS = {
     "bucket_object_lock": "s3:PutBucketObjectLockConfiguration",
     "bucket_replication": "s3:PutReplicationConfiguration",
     "bucket_notification": "s3:PutBucketNotification",
+    "object_retention": "s3:PutObjectRetention",
+    "object_legal_hold": "s3:PutObjectLegalHold",
 }
 
 
@@ -220,12 +224,20 @@ def route(ctx: RequestContext) -> str:
     if m == "GET":
         if "uploadId" in q:
             return "list_object_parts"
+        if "retention" in q:
+            return "object_retention"
+        if "legal-hold" in q:
+            return "object_legal_hold"
         return "get_object"
     if m == "HEAD":
         return "head_object"
     if m == "PUT":
         if "partNumber" in q and "uploadId" in q:
             return "put_object_part"
+        if "retention" in q:
+            return "object_retention"
+        if "legal-hold" in q:
+            return "object_legal_hold"
         return "put_object"
     if m == "POST":
         if "uploads" in q:
@@ -255,7 +267,7 @@ class S3Server:
                  notify=None, region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
-                 sse_config=None):
+                 sse_config=None, quota=None):
         from ..replication import ReplicationPool
 
         self.repl_pool = ReplicationPool(
@@ -264,7 +276,7 @@ class S3Server:
         self.handlers = S3ApiHandlers(
             object_layer, bucket_meta, iam, notify,
             config=config_sys.config if config_sys is not None else None,
-            sse_config=sse_config, repl_pool=self.repl_pool,
+            sse_config=sse_config, repl_pool=self.repl_pool, quota=quota,
         )
         self.admin = AdminHandlers(
             object_layer, iam, config_sys=config_sys, metrics=metrics,

@@ -15,10 +15,14 @@ kicks the MRF healer so the stale disk catches up within one interval.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 
-_probe_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="mtpu-probe")
+# A hung probe (e.g. RPC into a partitioned network) must never block
+# probing OTHER disks, so each probe gets its own daemon thread — at most
+# one in flight per disk slot, so leakage is bounded by disk count, not
+# unbounded like a shared fixed pool that hung probes would exhaust.
+PROBE_TIMEOUT_S = 20.0
 
 
 def _probe(disk) -> bool:
@@ -47,9 +51,9 @@ class DiskMonitor:
         # (id(set), slot) -> disk object pulled from that slot.
         self._offline: dict[tuple[int, int], object] = {}
         self._fails: dict[tuple[int, int], int] = {}
-        # key -> completed probe result; key in _pending = probe in flight.
+        # key -> completed probe result; _pending[key] = probe start time.
         self._results: dict[tuple[int, int], bool] = {}
-        self._pending: set[tuple[int, int]] = set()
+        self._pending: dict[tuple[int, int], float] = {}
         self._state_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -57,17 +61,24 @@ class DiskMonitor:
 
     def _submit_probe(self, key: tuple[int, int], disk) -> None:
         with self._state_lock:
-            if key in self._pending:
-                return  # previous probe still hung — counts as no news
-            self._pending.add(key)
+            started = self._pending.get(key)
+            if started is not None:
+                # Previous probe still in flight. Hung past the deadline
+                # counts as a failed probe each sweep (feeding the offline
+                # threshold) but we never stack a second thread per slot.
+                if time.monotonic() - started > PROBE_TIMEOUT_S:
+                    self._results[key] = False
+                return
+            self._pending[key] = time.monotonic()
 
         def run():
             ok = _probe(disk)
             with self._state_lock:
                 self._results[key] = ok
-                self._pending.discard(key)
+                self._pending.pop(key, None)
 
-        _probe_pool.submit(run)
+        threading.Thread(target=run, daemon=True,
+                         name="mtpu-probe").start()
 
     # -- one sweep (exposed for tests/admin) --
 
@@ -91,8 +102,6 @@ class DiskMonitor:
                         continue
                     self._submit_probe(key, target)
         if wait:
-            import time
-
             deadline = time.monotonic() + 5.0
             while time.monotonic() < deadline:
                 with self._state_lock:

@@ -199,13 +199,17 @@ class MultipartMixin:
             except Exception as exc:  # noqa: BLE001 - reduced below
                 rename_errs[i] = exc
         if len(renamed) < write_quorum:
-            # Leave the renamed shards in place: for a part re-upload they
-            # may now be the only >=k consistent copy (the old shards they
-            # replaced are gone) — deleting them would destroy the part
-            # outright. The journal keeps the OLD etag, so a retry or a
-            # complete with the old etag surfaces InvalidPart rather than
-            # silent loss.
+            # Leave the renamed shards in place (deleting them could
+            # destroy the only >=k copies of a re-uploaded part), but the
+            # part is now a MIX of old and new shard generations across
+            # disks — so invalidate its journal entry: a subsequent
+            # complete must fail InvalidPart instead of assembling mixed
+            # shards into a corrupt object. The client's failed upload
+            # means "retry this part" either way.
             _drop_tmp()
+            if any(p.number == part_number for p in fi.parts):
+                self._journal_remove_part(upload_path, part_number,
+                                          write_quorum)
             err = reduce_write_quorum_errs(
                 rename_errs, OBJECT_OP_IGNORED_ERRS, write_quorum
             )
@@ -240,6 +244,33 @@ class MultipartMixin:
             raise err
         return PartInfo(part_number=part_number, etag=etag, size=total,
                         actual_size=total, mod_time_ns=time.time_ns())
+
+    def _journal_remove_part(self, upload_path: str, part_number: int,
+                             write_quorum: int) -> None:
+        """Best-effort removal of a part from every disk's upload journal
+        (a failed re-upload left its shard files in a mixed state)."""
+
+        def drop(i):
+            if self.disks[i] is None:
+                return
+            try:
+                f = self.disks[i].read_version(SYSTEM_META_BUCKET, upload_path)
+                f.parts = [p for p in f.parts if p.number != part_number]
+                f.metadata.pop(
+                    f"x-mtpu-internal-part-etag-{part_number}", None
+                )
+                f.erasure.checksums = [
+                    c for c in f.erasure.checksums
+                    if c.part_number != part_number
+                ]
+                self.disks[i].write_metadata(
+                    SYSTEM_META_BUCKET, upload_path, f
+                )
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+        with self._ns_lock.write(f"{SYSTEM_META_BUCKET}/{upload_path}"):
+            list(_mp_pool.map(drop, range(len(self.disks))))
 
     def list_object_parts(self, bucket: str, object_: str, upload_id: str,
                           part_marker: int = 0, max_parts: int = 1000) -> list[PartInfo]:

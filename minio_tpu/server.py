@@ -159,12 +159,25 @@ class Server:
         # --- HTTP front-end ---
         from .crypto import SSEConfig
 
+        from .bucket.quota import BucketQuotaSys
+
+        def _scanner_usage() -> dict:
+            return {
+                b: u.objects_size
+                for b, u in self.scanner.usage.buckets_usage.items()
+            }
+
         self.s3 = S3Server(
             self.object_layer, self.iam, self.bucket_meta,
             notify=self.notifier, region=region, host=address, port=port,
             metrics=self.metrics, trace=self.trace,
             config_sys=self.config_sys,
             sse_config=SSEConfig(self.root_password),
+            # Quota admission reads the scanner's usage accounting, never
+            # a live walk on the PUT path (ref BucketQuotaSys 1s-TTL
+            # cache over loadDataUsageFromBackend).
+            quota=BucketQuotaSys(self.object_layer, self.bucket_meta,
+                                 usage_fn=_scanner_usage),
         )
         self.started_ns = time.time_ns()
 
@@ -196,10 +209,13 @@ class Server:
         return new_uuid()
 
     def start(self):
-        if self.mode == "erasure" and self._enable_scanner:
+        if self.mode == "erasure":
+            # Disk liveness + MRF heal are correctness features, not
+            # scanner load — they run regardless of enable_scanner.
             self.mrf.start()
-            self.scanner.start()
             self.disk_monitor.start()
+            if self._enable_scanner:
+                self.scanner.start()
         self.s3.start()
         return self
 

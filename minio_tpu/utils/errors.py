@@ -118,6 +118,11 @@ class ErrBadDigest(StorageError):
     SHA256/MD5 mismatch, /root/reference/pkg/hash/reader.go)."""
 
 
+class ErrQuotaExceeded(StorageError):
+    """Hard bucket quota would be exceeded (ref: BucketQuotaExceeded,
+    cmd/bucket-quota.go:check)."""
+
+
 class ErrOperationTimedOut(StorageError):
     """Namespace-lock acquisition timed out (ref: OperationTimedOut,
     cmd/typed-errors.go) — surfaces as a retriable 503 instead of a

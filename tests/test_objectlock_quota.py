@@ -1,0 +1,258 @@
+"""Object-lock retention/legal-hold enforcement + bucket quota
+(ref cmd/bucket-object-lock.go, pkg/bucket/object/lock,
+cmd/bucket-quota.go): the stored XML must actually gate the delete and
+put paths."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.api import S3Server
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+from tests.test_s3_api import Client
+
+VX = ('<VersioningConfiguration xmlns='
+      '"http://s3.amazonaws.com/doc/2006-03-01/">'
+      "<Status>Enabled</Status></VersioningConfiguration>")
+
+LOCK_XML = (
+    '<ObjectLockConfiguration xmlns='
+    '"http://s3.amazonaws.com/doc/2006-03-01/">'
+    "<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+    "{rule}</ObjectLockConfiguration>"
+)
+RULE_COMPLIANCE_1D = (
+    "<Rule><DefaultRetention><Mode>COMPLIANCE</Mode>"
+    "<Days>1</Days></DefaultRetention></Rule>"
+)
+RULE_GOVERNANCE_1D = (
+    "<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>"
+    "<Days>1</Days></DefaultRetention></Rule>"
+)
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="5ba52d31-4f2e-4d69-92f5-926a51824ed1",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    srv = S3Server(ol, IAMSys("tpuadmin", "tpuadmin-secret-key"),
+                   BucketMetadataSys(ol)).start()
+    yield Client(srv)
+    srv.stop()
+
+
+def _mk_locked_bucket(cl, bucket, rule):
+    assert cl.request("PUT", f"/{bucket}")[0] == 200
+    assert cl.request("PUT", f"/{bucket}", query=[("versioning", "")],
+                      body=VX.encode())[0] == 200
+    st, _, body = cl.request(
+        "PUT", f"/{bucket}", query=[("object-lock", "")],
+        body=LOCK_XML.format(rule=rule).encode(),
+    )
+    assert st == 200, body
+
+
+def test_lock_config_requires_versioning(cl):
+    assert cl.request("PUT", "/nolock")[0] == 200
+    st, _, body = cl.request(
+        "PUT", "/nolock", query=[("object-lock", "")],
+        body=LOCK_XML.format(rule="").encode(),
+    )
+    assert st == 409 and b"InvalidBucketState" in body
+
+
+def test_compliance_default_retention_blocks_delete(cl):
+    _mk_locked_bucket(cl, "wormc", RULE_COMPLIANCE_1D)
+    st, h, _ = cl.request("PUT", "/wormc/locked", body=b"keep me")
+    assert st == 200
+    vid = h["x-amz-version-id"]
+    # retention metadata surfaced on HEAD
+    st, h, _ = cl.request("HEAD", "/wormc/locked")
+    assert h.get("x-amz-object-lock-mode") == "COMPLIANCE"
+    # targeted delete refused, even with governance bypass
+    st, _, body = cl.request("DELETE", "/wormc/locked",
+                             query=[("versionId", vid)])
+    assert st == 403 and b"AccessDenied" in body
+    st, _, _ = cl.request(
+        "DELETE", "/wormc/locked", query=[("versionId", vid)],
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert st == 403
+    # untargeted delete lays a marker: allowed, data survives
+    assert cl.request("DELETE", "/wormc/locked")[0] == 204
+    st, _, got = cl.request("GET", "/wormc/locked",
+                            query=[("versionId", vid)])
+    assert st == 200 and got == b"keep me"
+
+
+def test_governance_delete_needs_bypass(cl):
+    _mk_locked_bucket(cl, "wormg", RULE_GOVERNANCE_1D)
+    st, h, _ = cl.request("PUT", "/wormg/gov", body=b"governed")
+    vid = h["x-amz-version-id"]
+    st, _, _ = cl.request("DELETE", "/wormg/gov",
+                          query=[("versionId", vid)])
+    assert st == 403
+    st, _, _ = cl.request(
+        "DELETE", "/wormg/gov", query=[("versionId", vid)],
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert st == 204
+    assert cl.request("GET", "/wormg/gov",
+                      query=[("versionId", vid)])[0] == 404
+
+
+def test_legal_hold_blocks_delete_until_lifted(cl):
+    _mk_locked_bucket(cl, "wormh", rule="")
+    st, h, _ = cl.request(
+        "PUT", "/wormh/held", body=b"on hold",
+        headers={"x-amz-object-lock-legal-hold": "ON"})
+    assert st == 200
+    vid = h["x-amz-version-id"]
+    st, _, body = cl.request("DELETE", "/wormh/held",
+                             query=[("versionId", vid)])
+    assert st == 403 and b"legal hold" in body
+    # read the hold, then lift it via the subresource
+    st, _, body = cl.request("GET", "/wormh/held",
+                             query=[("legal-hold", "")])
+    assert st == 200 and b"ON" in body
+    st, _, _ = cl.request(
+        "PUT", "/wormh/held", query=[("legal-hold", "")],
+        body=b'<LegalHold><Status>OFF</Status></LegalHold>')
+    assert st == 200
+    assert cl.request("DELETE", "/wormh/held",
+                      query=[("versionId", vid)])[0] == 204
+
+
+def test_retention_subresource_roundtrip_and_tighten_rules(cl):
+    _mk_locked_bucket(cl, "wormr", rule="")
+    st, h, _ = cl.request("PUT", "/wormr/obj", body=b"r")
+    assert st == 200
+    # no retention yet
+    st, _, _ = cl.request("GET", "/wormr/obj", query=[("retention", "")])
+    assert st == 404
+    until = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600))
+    ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>")
+    assert cl.request("PUT", "/wormr/obj", query=[("retention", "")],
+                      body=ret.encode())[0] == 200
+    st, _, body = cl.request("GET", "/wormr/obj", query=[("retention", "")])
+    assert st == 200 and b"GOVERNANCE" in body and until.encode() in body
+    # shortening GOVERNANCE without bypass is refused
+    sooner = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                           time.gmtime(time.time() + 60))
+    ret2 = (f"<Retention><Mode>GOVERNANCE</Mode>"
+            f"<RetainUntilDate>{sooner}</RetainUntilDate></Retention>")
+    st, _, _ = cl.request("PUT", "/wormr/obj", query=[("retention", "")],
+                          body=ret2.encode())
+    assert st == 403
+    st, _, _ = cl.request(
+        "PUT", "/wormr/obj", query=[("retention", "")], body=ret2.encode(),
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert st == 200
+
+
+def test_lock_headers_require_bucket_lock_config(cl):
+    assert cl.request("PUT", "/plain")[0] == 200
+    until = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                          time.gmtime(time.time() + 3600))
+    st, _, body = cl.request(
+        "PUT", "/plain/obj", body=b"x",
+        headers={"x-amz-object-lock-mode": "COMPLIANCE",
+                 "x-amz-object-lock-retain-until-date": until})
+    assert st == 400 and b"ObjectLockConfiguration" in body
+
+
+def test_unversioned_permanent_delete_enforces_retention(cl):
+    """Even without versioning (lock config normally requires it, but a
+    retained version can exist after config changes), the permanent
+    delete path checks retention metadata."""
+    _mk_locked_bucket(cl, "wormu", RULE_COMPLIANCE_1D)
+    st, h, _ = cl.request("PUT", "/wormu/perm", body=b"z")
+    vid = h["x-amz-version-id"]
+    st, _, _ = cl.request("DELETE", "/wormu/perm",
+                          query=[("versionId", vid)])
+    assert st == 403
+
+
+def test_bulk_delete_enforces_retention(cl):
+    """POST ?delete must not be a retention bypass: locked versions come
+    back as per-key AccessDenied errors in the DeleteResult."""
+    _mk_locked_bucket(cl, "wormb", RULE_COMPLIANCE_1D)
+    st, h, _ = cl.request("PUT", "/wormb/bulk1", body=b"l1")
+    vid = h["x-amz-version-id"]
+    body = (
+        '<Delete xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        f"<Object><Key>bulk1</Key><VersionId>{vid}</VersionId></Object>"
+        "</Delete>"
+    ).encode()
+    st, _, resp = cl.request("POST", "/wormb", query=[("delete", "")],
+                             body=body)
+    assert st == 200
+    assert b"<Error>" in resp and b"AccessDenied" in resp
+    # the version survived
+    assert cl.request("GET", "/wormb/bulk1",
+                      query=[("versionId", vid)])[0] == 200
+
+
+def test_multipart_inherits_default_retention(cl):
+    """Objects assembled via multipart carry the bucket default retention
+    (no multipart bypass of object lock)."""
+    _mk_locked_bucket(cl, "wormm", RULE_COMPLIANCE_1D)
+    st, _, body = cl.request("POST", "/wormm/mpobj", query=[("uploads", "")])
+    assert st == 200
+    import xml.etree.ElementTree as ET
+
+    upload_id = ""
+    for el in ET.fromstring(body).iter():
+        if el.tag.endswith("UploadId"):
+            upload_id = el.text
+    part = b"p" * 1024
+    st, h, _ = cl.request("PUT", "/wormm/mpobj",
+                          query=[("partNumber", "1"),
+                                 ("uploadId", upload_id)], body=part)
+    assert st == 200
+    etag = h["ETag"].strip('"')
+    done = (
+        "<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+        f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+    ).encode()
+    st, h, body = cl.request("POST", "/wormm/mpobj",
+                             query=[("uploadId", upload_id)], body=done)
+    assert st == 200, body
+    st, h, _ = cl.request("HEAD", "/wormm/mpobj")
+    assert h.get("x-amz-object-lock-mode") == "COMPLIANCE"
+
+
+def test_hard_quota_rejects_put_over_limit(cl):
+    assert cl.request("PUT", "/qbucket")[0] == 200
+    st, _, body = cl.request(
+        "PUT", "/minio/admin/v3/set-bucket-quota",
+        query=[("bucket", "qbucket")],
+        body=json.dumps({"quota": 256 * 1024, "quotatype": "hard"}).encode(),
+    )
+    assert st == 200, body
+    # admin read-back
+    st, _, body = cl.request("GET", "/minio/admin/v3/get-bucket-quota",
+                             query=[("bucket", "qbucket")])
+    assert st == 200 and json.loads(body)["quota"] == 256 * 1024
+    # under the limit: ok
+    assert cl.request("PUT", "/qbucket/a", body=b"x" * (100 * 1024))[0] == 200
+    # would cross the limit: rejected
+    time.sleep(1.1)  # quota usage cache TTL
+    st, _, body = cl.request("PUT", "/qbucket/b", body=b"y" * (200 * 1024))
+    assert st == 409 and b"QuotaExceeded" in body
+    # clearing the quota re-admits
+    st, _, _ = cl.request("PUT", "/minio/admin/v3/set-bucket-quota",
+                          query=[("bucket", "qbucket")], body=b"")
+    assert st == 200
+    assert cl.request("PUT", "/qbucket/b",
+                      body=b"y" * (200 * 1024))[0] == 200

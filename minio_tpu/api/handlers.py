@@ -44,6 +44,10 @@ class Response:
     status: int = 200
     headers: dict = field(default_factory=dict)
     body: bytes = b""
+    # Streaming mode: callable(dst) that writes the body to dst. Headers
+    # (incl. Content-Length) must be final before streaming starts;
+    # mid-stream failures abort the connection (the status line is gone).
+    body_stream: object = None
 
     @classmethod
     def xml(cls, root: ET.Element, status: int = 200,
@@ -55,6 +59,11 @@ class Response:
         h = {"Content-Type": "application/xml"}
         h.update(headers or {})
         return cls(status, h, body)
+
+
+class _NullSink:
+    def write(self, b) -> int:
+        return len(b)
 
 
 def _xml_root(tag: str) -> ET.Element:
@@ -877,24 +886,14 @@ class S3ApiHandlers:
 
         want_md5_hex = self._parse_content_md5(ctx.headers)
         if transforms.transforms_active(ctx.headers, self.config, ctx.object):
-            plaintext = reader.read(size)
-            if want_md5_hex:
-                # Stored bytes are encrypted/compressed, so the layer-level
-                # check can't see the declared digest: verify the plaintext
-                # here, before anything is written.
-                import hashlib
-
-                if hashlib.md5(plaintext).hexdigest() != want_md5_hex:
-                    raise S3Error("BadDigest")
-            stored, meta_updates, resp_extra = (
-                transforms.apply_put_transforms(
-                    ctx.headers, self.config, self.sse_config,
-                    ctx.bucket, ctx.object, plaintext,
-                )
+            # Streaming transform chain (md5-verify -> compress ->
+            # encrypt): no stage holds the object; a bad plaintext digest
+            # aborts the encode stream before commit.
+            reader, size, resp_extra = transforms.build_put_stream(
+                ctx.headers, self.config, self.sse_config,
+                ctx.bucket, ctx.object, reader, size, opts.user_defined,
+                want_md5_hex=want_md5_hex,
             )
-            opts.user_defined.update(meta_updates)
-            reader = io.BytesIO(stored)
-            size = len(stored)
         else:
             # Verified inside the object layer during the encode stream,
             # BEFORE commit (ref hash.NewReader wired at
@@ -928,26 +927,37 @@ class S3ApiHandlers:
         directive = ctx.headers.get("x-amz-metadata-directive", "COPY")
         from ..bucket import objectlock as ol_mod
 
+        self_copy = (sbucket, sobject) == (ctx.bucket, ctx.object)
         if directive == "REPLACE":
             opts.user_defined = extract_user_metadata(ctx.headers)
         else:
-            # Retention/hold NEVER copies from the source version — the
+            # Retention/hold NEVER copies from the source version (the
             # destination's protection comes from this request's headers
-            # or the bucket default (AWS semantics).
+            # or the bucket default, AWS semantics) and neither do the
+            # internal transform/replication markers — except on a
+            # self-copy, where the stored bytes (and their path-bound
+            # sealed key) are reused verbatim.
+            drop = (ol_mod.META_MODE, ol_mod.META_RETAIN_UNTIL,
+                    ol_mod.META_LEGAL_HOLD)
             opts.user_defined = {
                 k: v for k, v in src_info.user_defined.items()
-                if k not in (ol_mod.META_MODE, ol_mod.META_RETAIN_UNTIL,
-                             ol_mod.META_LEGAL_HOLD)
+                if k not in drop and (
+                    self_copy or not k.startswith("x-mtpu-internal-")
+                )
             }
         # A copy writes a new object/version: it honors lock headers /
         # the bucket default retention and the hard quota exactly like a
-        # streaming PUT (ref CopyObjectHandler lock+quota wiring).
+        # streaming PUT (ref CopyObjectHandler lock+quota wiring). The
+        # quota charge is the LOGICAL size — a compressed source can
+        # expand at the destination.
+        from . import transforms as _tfm
+
         self._apply_object_lock(ctx, opts)
         try:
-            self.quota.check(ctx.bucket, src_info.size)
+            self.quota.check(ctx.bucket, _tfm.actual_object_size(
+                src_info.user_defined, src_info.size))
         except StorageError as exc:
             raise from_object_error(exc) from exc
-        self_copy = (sbucket, sobject) == (ctx.bucket, ctx.object)
         if self_copy and not vid and directive != "REPLACE":
             # AWS rejects untargeted self-copy without changed metadata
             # regardless of bucket versioning (ref cpSrcDstSame,
@@ -977,14 +987,24 @@ class S3ApiHandlers:
             from ..replication.pool import PENDING, REPL_STATUS_KEY
 
             opts.user_defined[REPL_STATUS_KEY] = PENDING
+        from . import transforms
+
+        src_transformed = transforms.is_transformed(src_info.user_defined)
+        copy_sse_headers: dict | None = None
         if self_copy:
             # Versioned self-copy (new version of the same key) or a
             # versionId restore: the source read must COMPLETE before the
             # destination put takes the same write lock. Spool through a
             # temp file, not memory — a multi-GiB restore must not be an
-            # unbounded allocation.
+            # unbounded allocation. Stored bytes are reused verbatim
+            # (same path, so a sealed SSE key stays valid) — the internal
+            # transform markers must travel with them.
             import tempfile
 
+            if src_transformed:
+                for k, v in src_info.user_defined.items():
+                    if k.startswith("x-mtpu-internal-"):
+                        opts.user_defined.setdefault(k, v)
             with tempfile.TemporaryFile() as spool:
                 try:
                     self.ol.get_object(sbucket, sobject, spool,
@@ -996,6 +1016,54 @@ class S3ApiHandlers:
                 try:
                     oi = self.ol.put_object(
                         ctx.bucket, ctx.object, spool, size, opts
+                    )
+                except StorageError as exc:
+                    raise from_object_error(exc) from exc
+        elif src_transformed or transforms.transforms_active(
+                ctx.headers, self.config, ctx.object):
+            # Encrypted/compressed source going to a DIFFERENT key (the
+            # sealed object key is bound to the source path, so stored
+            # bytes cannot be reused), or a plain source whose COPY
+            # request demands destination transforms: decode the logical
+            # stream (spooled, bounded RSS) and apply the destination's
+            # transform chain (ref CopyObject re-encryption,
+            # cmd/object-handlers.go + encryption-v1.go rotate/copy).
+            import tempfile
+
+            src_headers = dict(ctx.headers)
+            # Copy-source SSE-C headers address the SOURCE decryption.
+            for suffix in ("algorithm", "key", "key-md5"):
+                v = ctx.headers.get(
+                    "x-amz-copy-source-server-side-encryption-customer-"
+                    + suffix, "")
+                if v:
+                    src_headers[
+                        "x-amz-server-side-encryption-customer-" + suffix
+                    ] = v
+            with tempfile.SpooledTemporaryFile(max_size=8 << 20) as spool:
+                chain, closers, _ = transforms.build_get_chain(
+                    src_info.user_defined, src_headers, self.sse_config,
+                    sbucket, sobject, spool,
+                )
+                try:
+                    self.ol.get_object(sbucket, sobject, chain,
+                                       opts=src_opts)
+                except StorageError as exc:
+                    raise from_object_error(exc) from exc
+                for c in closers:
+                    c.close()
+                size = spool.tell()
+                spool.seek(0)
+                reader, stored_size, copy_sse_headers = (
+                    transforms.build_put_stream(
+                        ctx.headers, self.config, self.sse_config,
+                        ctx.bucket, ctx.object, spool, size,
+                        opts.user_defined,
+                    )
+                )
+                try:
+                    oi = self.ol.put_object(
+                        ctx.bucket, ctx.object, reader, stored_size, opts
                     )
                 except StorageError as exc:
                     raise from_object_error(exc) from exc
@@ -1015,15 +1083,15 @@ class S3ApiHandlers:
             rvid = oi.version_id if oi.version_id != "null" else ""
             self._schedule_replication(ctx.bucket, ctx.object, rvid, "put")
         self._event("s3:ObjectCreated:Copy", ctx.bucket, oi=oi)
-        return self._copy_result(oi)
+        return self._copy_result(oi, copy_sse_headers)
 
     @staticmethod
-    def _copy_result(oi) -> Response:
-        """CopyObjectResult XML + version header (shared epilogue)."""
+    def _copy_result(oi, extra_headers: dict | None = None) -> Response:
+        """CopyObjectResult XML + version/SSE headers (shared epilogue)."""
         root = _xml_root("CopyObjectResult")
         ET.SubElement(root, "LastModified").text = iso8601(oi.mod_time_ns)
         ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
-        headers = {}
+        headers = dict(extra_headers or {})
         if oi.version_id and oi.version_id != "null":
             headers["x-amz-version-id"] = oi.version_id
         return Response.xml(root, headers=headers)
@@ -1123,45 +1191,48 @@ class S3ApiHandlers:
         from . import transforms
 
         resp_extra: dict = {}
-        if transforms.is_transformed(oi.user_defined):
-            # Transformed objects: fetch stored bytes, invert the
-            # pipeline, then apply the range on the logical view
-            # (ref NewGetObjectReader decrypt/decompress stack).
-            try:
-                stored = self.ol.get_object_bytes(
-                    ctx.bucket, ctx.object, opts=opts
-                )
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
-            data_full, resp_extra = transforms.apply_get_transforms(
+        transformed = transforms.is_transformed(oi.user_defined)
+        logical_size = transforms.actual_object_size(oi.user_defined, oi.size)
+        rng = parse_range(ctx.headers.get("range", ""), logical_size)
+        offset, length = (rng if rng else (0, logical_size))
+        if transformed:
+            # Streaming decrypt/decompress writer chain onto the socket
+            # (ref NewGetObjectReader, cmd/object-api-utils.go:595): the
+            # object never materializes server-side. Key validation
+            # happens NOW, before the status line goes out. Ranged reads
+            # decode the stream and window it server-side (bounded RSS;
+            # full-object IO — package-aligned seeks are a future step).
+            probe, _, resp_extra = transforms.build_get_chain(
                 oi.user_defined, ctx.headers, self.sse_config,
-                ctx.bucket, ctx.object, stored,
+                ctx.bucket, ctx.object, _NullSink(),
             )
-            logical_size = len(data_full)
-            rng = parse_range(ctx.headers.get("range", ""), logical_size)
-            offset, length = (rng if rng else (0, logical_size))
-            data = data_full[offset:offset + length]
-        else:
-            logical_size = oi.size
-            rng = parse_range(ctx.headers.get("range", ""), oi.size)
-            offset, length = (rng if rng else (0, oi.size))
-            try:
-                data = self.ol.get_object_bytes(
-                    ctx.bucket, ctx.object, offset=offset, length=length,
-                    opts=opts,
+            del probe
+
+            def stream(dst, _opts=opts):
+                chain, closers, _ = transforms.build_get_chain(
+                    oi.user_defined, ctx.headers, self.sse_config,
+                    ctx.bucket, ctx.object, dst,
+                    offset=offset, length=length,
                 )
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
+                self.ol.get_object(ctx.bucket, ctx.object, chain,
+                                   opts=_opts)
+                for c in closers:
+                    c.close()
+        else:
+            def stream(dst, _opts=opts):
+                self.ol.get_object(ctx.bucket, ctx.object, dst,
+                                   offset=offset, length=length,
+                                   opts=_opts)
         headers = self._object_headers(ctx, oi)
         headers.update(resp_extra)
-        headers["Content-Length"] = str(len(data))
+        headers["Content-Length"] = str(length)
         self._event("s3:ObjectAccessed:Get", ctx.bucket, oi=oi)
         if rng:
             headers["Content-Range"] = (
                 f"bytes {offset}-{offset + length - 1}/{logical_size}"
             )
-            return Response(206, headers, data)
-        return Response(200, headers, data)
+            return Response(206, headers, body_stream=stream)
+        return Response(200, headers, body_stream=stream)
 
     def head_object(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)

@@ -520,6 +520,9 @@ class S3Server:
             headers.setdefault("Server", "MinIO-TPU")
             headers["x-amz-request-id"] = ctx.request_id
             body = resp.body if ctx.method != "HEAD" else b""
+            streaming = resp.body_stream is not None and ctx.method != "HEAD"
+            if streaming and "Content-Length" not in headers:
+                raise RuntimeError("streaming response needs Content-Length")
             if "Content-Length" not in headers or ctx.method == "HEAD":
                 headers["Content-Length"] = headers.get(
                     "Content-Length", str(len(resp.body))
@@ -529,7 +532,15 @@ class S3Server:
             for k, v in headers.items():
                 h.send_header(k, v)
             h.end_headers()
-            if body:
+            if streaming:
+                try:
+                    resp.body_stream(h.wfile)
+                except Exception:  # noqa: BLE001 - status already sent
+                    # Mid-stream failure: the body falls short of the
+                    # declared Content-Length; sever the connection so
+                    # the client can't mistake the stump for the object.
+                    h.close_connection = True
+            elif body:
                 h.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass

@@ -246,14 +246,18 @@ def sign_v4_request(secret: str, access_key: str, method: str, host: str,
                     path: str, query: list[tuple[str, str]] | None = None,
                     headers: dict | None = None, payload: bytes = b"",
                     region: str = "us-east-1",
-                    now: datetime.datetime | None = None) -> dict:
+                    now: datetime.datetime | None = None,
+                    payload_hash: str | None = None) -> dict:
     """Sign a request with SigV4 headers; returns the full header dict
-    (client side — used by tests and the storage-REST client)."""
+    (client side — used by tests and the storage-REST client).
+    `payload_hash` lets callers stream file-like bodies: pass the
+    precomputed hex sha256 instead of the materialized bytes."""
     query = query or []
     headers = dict(headers or {})
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(payload).hexdigest()
     headers.setdefault("Host", host)
     headers["X-Amz-Date"] = amz_date
     headers["X-Amz-Content-Sha256"] = payload_hash

@@ -61,61 +61,321 @@ def transforms_active(headers: dict, config, object_name: str) -> bool:
     )
 
 
-def apply_put_transforms(headers: dict, config, sse_config, bucket: str,
-                         object_: str, plaintext: bytes):
-    """compress -> encrypt. Returns (stored_bytes, meta_updates,
-    response_headers)."""
-    meta: dict = {}
-    data = plaintext
-    if should_compress(config, object_, headers.get("content-type", "")):
-        compressed = zlib.compress(data, level=1)
-        # Store compressed only when it actually helps (ref skips
-        # incompressible data via S2's framing; we skip whole-object).
-        if len(compressed) < len(data):
-            meta[META_COMPRESSION] = CODEC
-            meta[META_UNCOMPRESSED_SIZE] = str(len(data))
-            meta[META_COMPRESSED_SIZE] = str(len(compressed))
-            data = compressed
-    try:
-        data, sse_meta, resp = ssemod.encrypt_request(
-            headers, bucket, object_, data, sse_config
-        )
-    except ssemod.SSEError as exc:
-        raise S3Error(
-            exc.code if exc.code in ("AccessDenied", "NotImplemented")
-            else "InvalidArgument",
-            str(exc),
-        ) from exc
-    meta.update(sse_meta)
-    return data, meta, resp
-
-
-def apply_get_transforms(stored_meta: dict, headers: dict, sse_config,
-                         bucket: str, object_: str, stored: bytes):
-    """decrypt -> decompress. Returns (plaintext, response_headers)."""
-    try:
-        data, resp = ssemod.decrypt_response(
-            stored_meta, headers, bucket, object_, stored, sse_config
-        )
-    except ssemod.SSEError as exc:
-        raise S3Error(
-            exc.code if exc.code in ("AccessDenied", "NotImplemented")
-            else "InvalidRequest",
-            str(exc),
-        ) from exc
-    codec = stored_meta.get(META_COMPRESSION, "")
-    if codec:
-        if codec != CODEC:
-            raise S3Error("InternalError", f"unknown codec {codec!r}")
-        try:
-            data = zlib.decompress(data)
-        except zlib.error as exc:
-            raise S3Error("InternalError", f"decompress: {exc}") from exc
-    return data, resp
-
-
 def is_transformed(meta: dict) -> bool:
     return bool(meta.get(META_COMPRESSION)) or ssemod.is_encrypted(meta)
+
+
+# ---------------------------------------------------------------------------
+# Streaming pipeline (ref newS2CompressReader cmd/object-api-utils.go:925 and
+# the DARE reader stack in encryption-v1.go): PUT wraps the request body in
+# reader stages (md5-verify -> compress -> encrypt), GET wraps the response
+# sink in writer stages (decrypt -> decompress -> range window), so no stage
+# ever materializes the object.
+#
+# Contract with the object layer: put_object snapshots opts.user_defined
+# AFTER fully consuming the reader, so the EOF hooks below may record the
+# actual/uncompressed sizes into that dict as the stream finishes.
+# ---------------------------------------------------------------------------
+
+_STREAM_CHUNK = 1 << 20
+
+
+class Md5VerifyReader:
+    """Passthrough reader that verifies the PLAINTEXT md5 at EOF — the
+    inline hash.Reader check for transformed bodies (pre-transform bytes
+    are what Content-MD5 declares)."""
+
+    def __init__(self, src, want_hex: str):
+        import hashlib
+
+        self._src = src
+        self._md5 = hashlib.md5()
+        self._want = want_hex
+        self._checked = False
+
+    def read(self, n: int = -1) -> bytes:
+        buf = self._src.read(n)
+        if buf:
+            self._md5.update(buf)
+        elif not self._checked:
+            self._checked = True
+            if self._md5.hexdigest() != self._want:
+                raise S3Error("BadDigest")
+        return buf
+
+
+class CompressReader:
+    """Streaming zlib compressor. Config filters decide eligibility up
+    front; actual compressibility is decided by TEST-COMPRESSING the
+    first chunk (the streaming stand-in for the reference skipping
+    incompressible data via S2's framing) — an incompressible stream
+    passes through unmarked instead of growing on disk and paying
+    decompress CPU on every GET. Output size is unknown until EOF
+    (callers pass size=-1 downstream); sizes land in `meta_sink` at
+    EOF."""
+
+    def __init__(self, src, meta_sink: dict):
+        self._src = src
+        self._c = zlib.compressobj(1)
+        self._buf = bytearray()
+        self._eof = False
+        self._plain = 0
+        self._out = 0
+        self._meta = meta_sink
+        self._mode = ""  # "" undecided | "zlib" | "raw"
+
+    def _decide(self, first_chunk: bytes):
+        probe = zlib.compress(first_chunk, 1)
+        if len(probe) >= int(len(first_chunk) * 0.99):
+            self._mode = "raw"
+        else:
+            self._mode = "zlib"
+
+    def read(self, n: int = -1) -> bytes:
+        while (n < 0 or len(self._buf) < n) and not self._eof:
+            chunk = self._src.read(_STREAM_CHUNK)
+            if chunk and not self._mode:
+                self._decide(chunk)
+            if not chunk:
+                self._eof = True
+                if self._mode == "zlib":
+                    tail = self._c.flush()
+                    self._buf += tail
+                    self._out += len(tail)
+                    self._meta[META_COMPRESSION] = CODEC
+                    self._meta[META_UNCOMPRESSED_SIZE] = str(self._plain)
+                    self._meta[META_COMPRESSED_SIZE] = str(self._out)
+                break
+            self._plain += len(chunk)
+            if self._mode == "raw":
+                self._buf += chunk
+            else:
+                comp = self._c.compress(chunk)
+                self._buf += comp
+                self._out += len(comp)
+        if n < 0:
+            out, self._buf = bytes(self._buf), bytearray()
+            return out
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+class EncryptReader:
+    """Streaming package encryptor (64 KiB plaintext -> nonce||ct||tag
+    packages, sequence bound into the AAD). Records the pre-encryption
+    size into `meta_sink` at EOF."""
+
+    def __init__(self, src, object_key: bytes, meta_sink: dict):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        self._src = src
+        self._aes = AESGCM(object_key)
+        self._buf = bytearray()
+        self._pending = bytearray()
+        self._eof = False
+        self._seq = 0
+        self._plain = 0
+        self._meta = meta_sink
+        self._emitted_any = False
+
+    def _emit(self, chunk: bytes):
+        import os as _os
+        import struct as _struct
+
+        nonce = _os.urandom(12)
+        aad = _struct.pack("<Q", self._seq)
+        self._buf += nonce + self._aes.encrypt(nonce, chunk, aad)
+        self._seq += 1
+        self._emitted_any = True
+
+    def read(self, n: int = -1) -> bytes:
+        while (n < 0 or len(self._buf) < n) and not self._eof:
+            chunk = self._src.read(_STREAM_CHUNK)
+            if chunk:
+                self._plain += len(chunk)
+                self._pending += chunk
+                while len(self._pending) >= ssemod.PACKAGE_SIZE:
+                    self._emit(bytes(self._pending[:ssemod.PACKAGE_SIZE]))
+                    del self._pending[:ssemod.PACKAGE_SIZE]
+                continue
+            self._eof = True
+            if self._pending or not self._emitted_any:
+                self._emit(bytes(self._pending))
+                self._pending.clear()
+            self._meta[ssemod.META_ACTUAL_SIZE] = str(self._plain)
+        if n < 0:
+            out, self._buf = bytes(self._buf), bytearray()
+            return out
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def build_put_stream(headers: dict, config, sse_config, bucket: str,
+                     object_: str, reader, size: int, user_defined: dict,
+                     want_md5_hex: str = ""):
+    """Wrap `reader` in the streaming transform chain.
+
+    Returns (reader, stored_size_or_-1, response_headers). Static
+    metadata (SSE algorithm/sealed key) goes into `user_defined` now;
+    size metadata is recorded there by the EOF hooks while the object
+    layer drains the stream (before it snapshots the metadata)."""
+    if want_md5_hex:
+        reader = Md5VerifyReader(reader, want_md5_hex)
+    compressing = should_compress(
+        config, object_, headers.get("content-type", "")
+    )
+    if compressing:
+        reader = CompressReader(reader, user_defined)
+    try:
+        object_key, sse_meta, resp = ssemod.setup_encryption(
+            headers, bucket, object_, sse_config
+        )
+    except ssemod.SSEError as exc:
+        raise _sse_s3error(exc, "InvalidArgument") from exc
+    if object_key is not None:
+        user_defined.update(sse_meta)
+        reader = EncryptReader(reader, object_key, user_defined)
+    # ALWAYS unknown-length: a consumer that read exactly a precomputed
+    # stored size would never pull the source's EOF, and the EOF hooks
+    # (size metadata, Content-MD5 verdict) would silently not run.
+    return reader, -1, resp
+
+
+def _sse_s3error(exc: "ssemod.SSEError", default_code: str) -> S3Error:
+    return S3Error(
+        exc.code if exc.code in ("AccessDenied", "NotImplemented")
+        else default_code,
+        str(exc),
+    )
+
+
+class DecryptWriter:
+    """Streaming package decryptor: buffers one encrypted package at a
+    time, writes plaintext through."""
+
+    def __init__(self, dst, object_key: bytes):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        self._dst = dst
+        self._aes = AESGCM(object_key)
+        self._buf = bytearray()
+        self._seq = 0
+
+    def _package_size(self) -> int:
+        return ssemod.PACKAGE_SIZE + ssemod.PACKAGE_OVERHEAD
+
+    def _decrypt_one(self, package: bytes):
+        import struct as _struct
+
+        from cryptography.exceptions import InvalidTag
+
+        nonce, body = package[:12], package[12:]
+        try:
+            plain = self._aes.decrypt(
+                nonce, body, _struct.pack("<Q", self._seq)
+            )
+        except InvalidTag as exc:
+            raise S3Error(
+                "AccessDenied", f"SSE package {self._seq} auth failure"
+            ) from exc
+        self._seq += 1
+        self._dst.write(plain)
+
+    def write(self, data) -> int:
+        self._buf += data
+        psize = self._package_size()
+        while len(self._buf) > psize:
+            # Keep at least one full package buffered: the FINAL package
+            # may be short, and only close() knows the stream ended.
+            self._decrypt_one(bytes(self._buf[:psize]))
+            del self._buf[:psize]
+        return len(data)
+
+    def close(self):
+        if not self._buf:
+            return
+        psize = self._package_size()
+        while len(self._buf) > psize:
+            self._decrypt_one(bytes(self._buf[:psize]))
+            del self._buf[:psize]
+        if len(self._buf) < ssemod.PACKAGE_OVERHEAD:
+            raise S3Error("InvalidRequest", "truncated SSE stream")
+        self._decrypt_one(bytes(self._buf))
+        self._buf.clear()
+
+
+class DecompressWriter:
+    """Streaming zlib inflater."""
+
+    def __init__(self, dst):
+        self._dst = dst
+        self._d = zlib.decompressobj()
+
+    def write(self, data) -> int:
+        self._dst.write(self._d.decompress(bytes(data)))
+        return len(data)
+
+    def close(self):
+        tail = self._d.flush()
+        if tail:
+            self._dst.write(tail)
+
+
+class RangeWriter:
+    """Pass only the [offset, offset+length) window of the logical stream
+    through to dst (ranged GET over a transformed object decodes the
+    stream server-side but ships only the window)."""
+
+    def __init__(self, dst, offset: int, length: int):
+        self._dst = dst
+        self._skip = offset
+        self._left = length
+
+    def write(self, data) -> int:
+        n = len(data)
+        data = memoryview(data)
+        if self._skip:
+            drop = min(self._skip, len(data))
+            self._skip -= drop
+            data = data[drop:]
+        if self._left > 0 and len(data):
+            take = data[:self._left]
+            self._dst.write(take)
+            self._left -= len(take)
+        return n
+
+
+def build_get_chain(stored_meta: dict, headers: dict, sse_config,
+                    bucket: str, object_: str, dst,
+                    offset: int = 0, length: int = -1):
+    """Build the decrypt->decompress->range writer chain onto `dst`.
+
+    Returns (writer, closers, response_headers). All key validation
+    happens HERE (before any byte streams) so auth failures surface as
+    proper error responses, not mid-stream aborts."""
+    closers = []
+    if length >= 0:
+        dst = RangeWriter(dst, offset, length)
+    if stored_meta.get(META_COMPRESSION):
+        if stored_meta[META_COMPRESSION] != CODEC:
+            raise S3Error(
+                "InternalError",
+                f"unknown codec {stored_meta[META_COMPRESSION]!r}",
+            )
+        dst = DecompressWriter(dst)
+        closers.append(dst)
+    try:
+        object_key, resp = ssemod.resolve_decryption_key(
+            stored_meta, headers, bucket, object_, sse_config
+        )
+    except ssemod.SSEError as exc:
+        raise _sse_s3error(exc, "InvalidRequest") from exc
+    if object_key is not None:
+        dst = DecryptWriter(dst, object_key)
+        closers.insert(0, dst)
+    return dst, closers, resp
 
 
 def actual_object_size(meta: dict, stored_size: int) -> int:

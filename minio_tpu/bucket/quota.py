@@ -22,8 +22,9 @@ class BucketQuotaSys:
     def __init__(self, object_layer, bucket_meta, usage_fn=None):
         self.ol = object_layer
         self.bm = bucket_meta
-        # usage_fn() -> {bucket: size_bytes}; falls back to a live walk
-        # (TTL-cached) when no scanner feeds us.
+        # usage_fn() -> {bucket: size_bytes} | None (None = no usage
+        # feed available YET, e.g. scanner disabled or not run); falls
+        # back to a live TTL-cached walk in that case.
         self.usage_fn = usage_fn
         self._cache: dict[str, tuple[float, int]] = {}
         self._lock = threading.Lock()
@@ -48,8 +49,9 @@ class BucketQuotaSys:
             hit = self._cache.get(bucket)
             if hit is not None and now - hit[0] < self.TTL_S:
                 return hit[1]
-        if self.usage_fn is not None:
-            size = int(self.usage_fn().get(bucket, 0))
+        usage = self.usage_fn() if self.usage_fn is not None else None
+        if usage is not None:
+            size = int(usage.get(bucket, 0))
         else:
             # Fallback for scanner-less deployments (tests, embedded use):
             # a TTL-cached walk. A truncated listing means usage is

@@ -4,14 +4,14 @@ cmd/encryption-v1.go, cmd/crypto/."""
 from .sse import (
     SSEConfig,
     SSEError,
-    decrypt_response,
-    encrypt_request,
     is_encrypted,
     parse_ssec_key,
+    resolve_decryption_key,
+    setup_encryption,
     wants_sse_s3,
 )
 
 __all__ = [
-    "SSEConfig", "SSEError", "decrypt_response", "encrypt_request",
-    "is_encrypted", "parse_ssec_key", "wants_sse_s3",
+    "SSEConfig", "SSEError", "is_encrypted", "parse_ssec_key",
+    "resolve_decryption_key", "setup_encryption", "wants_sse_s3",
 ]

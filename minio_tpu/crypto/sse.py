@@ -14,7 +14,6 @@ from __future__ import annotations
 import base64
 import hashlib
 import os
-import struct
 
 from cryptography.exceptions import InvalidTag
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
@@ -105,43 +104,6 @@ def unseal_object_key(sealed_b64: str, kek_source: bytes, bucket: str,
         ) from exc
 
 
-def encrypt_data(object_key: bytes, plaintext: bytes) -> bytes:
-    """Package-chunked AES-256-GCM encrypt."""
-    aes = AESGCM(object_key)
-    out = bytearray()
-    for seq, off in enumerate(range(0, len(plaintext), PACKAGE_SIZE)):
-        chunk = plaintext[off:off + PACKAGE_SIZE]
-        nonce = os.urandom(12)
-        aad = struct.pack("<Q", seq)
-        out += nonce + aes.encrypt(nonce, chunk, aad)
-    if not plaintext:
-        nonce = os.urandom(12)
-        out += nonce + aes.encrypt(nonce, b"", struct.pack("<Q", 0))
-    return bytes(out)
-
-
-def decrypt_data(object_key: bytes, ciphertext: bytes) -> bytes:
-    aes = AESGCM(object_key)
-    out = bytearray()
-    seq = 0
-    off = 0
-    enc_package = PACKAGE_SIZE + PACKAGE_OVERHEAD
-    while off < len(ciphertext):
-        package = ciphertext[off:off + enc_package]
-        if len(package) < PACKAGE_OVERHEAD:
-            raise SSEError("InvalidRequest", "truncated SSE package")
-        nonce, body = package[:12], package[12:]
-        try:
-            out += aes.decrypt(nonce, body, struct.pack("<Q", seq))
-        except InvalidTag as exc:
-            raise SSEError(
-                "AccessDenied", f"SSE package {seq} auth failure"
-            ) from exc
-        off += enc_package
-        seq += 1
-    return bytes(out)
-
-
 def encrypted_size(plain_size: int) -> int:
     packages = max(1, -(-plain_size // PACKAGE_SIZE))
     return plain_size + packages * PACKAGE_OVERHEAD
@@ -158,18 +120,20 @@ class SSEConfig:
         ).digest()
 
 
-def encrypt_request(headers: dict, bucket: str, object_: str,
-                    plaintext: bytes, sse_config: SSEConfig | None):
-    """Apply SSE if requested. Returns (stored_bytes, metadata_updates,
-    response_headers) — metadata carries the sealed key + markers."""
+def setup_encryption(headers: dict, bucket: str, object_: str,
+                     sse_config: SSEConfig | None):
+    """Resolve the requested SSE mode for a new write.
+
+    Returns (object_key | None, metadata_updates, response_headers);
+    object_key is None when no SSE was requested. The caller feeds the
+    key to a streaming encryptor (api/transforms.EncryptReader)."""
     ssec_key = parse_ssec_key(headers)
     use_s3 = wants_sse_s3(headers)
     if ssec_key is None and not use_s3:
-        return plaintext, {}, {}
+        return None, {}, {}
     if ssec_key is not None and use_s3:
         raise SSEError("InvalidRequest", "SSE-C and SSE-S3 both requested")
     object_key = os.urandom(32)
-    ciphertext = encrypt_data(object_key, plaintext)
     if ssec_key is not None:
         meta = {
             META_ALGORITHM: ALGO_SSEC,
@@ -177,7 +141,6 @@ def encrypt_request(headers: dict, bucket: str, object_: str,
                 object_key, ssec_key, bucket, object_
             ),
             META_KEY_MD5: headers.get(HDR_SSEC_KEY_MD5, ""),
-            META_ACTUAL_SIZE: str(len(plaintext)),
         }
         resp = {
             HDR_SSEC_ALGO: "AES256",
@@ -191,20 +154,22 @@ def encrypt_request(headers: dict, bucket: str, object_: str,
             META_SEALED_KEY: seal_object_key(
                 object_key, sse_config.master_key, bucket, object_
             ),
-            META_ACTUAL_SIZE: str(len(plaintext)),
         }
         resp = {HDR_SSE: "AES256"}
-    return ciphertext, meta, resp
+    return object_key, meta, resp
 
 
-def decrypt_response(stored_meta: dict, headers: dict, bucket: str,
-                     object_: str, ciphertext: bytes,
-                     sse_config: SSEConfig | None):
-    """Inverse of encrypt_request. Returns (plaintext, response_headers).
-    Raises when the object is SSE-C and the request lacks the right key."""
+def resolve_decryption_key(stored_meta: dict, headers: dict, bucket: str,
+                           object_: str, sse_config: SSEConfig | None):
+    """Validate the request against a stored object's SSE metadata and
+    unseal its object key.
+
+    Returns (object_key | None, response_headers); None when the object
+    is not encrypted. Raises SSEError on missing/wrong keys — callers
+    run this BEFORE streaming so failures are proper error responses."""
     algo = stored_meta.get(META_ALGORITHM, "")
     if not algo:
-        return ciphertext, {}
+        return None, {}
     sealed = stored_meta.get(META_SEALED_KEY, "")
     if algo == ALGO_SSEC:
         ssec_key = parse_ssec_key(headers)
@@ -228,7 +193,7 @@ def decrypt_response(stored_meta: dict, headers: dict, bucket: str,
         resp = {HDR_SSE: "AES256"}
     else:
         raise SSEError("InvalidRequest", f"unknown SSE algorithm {algo!r}")
-    return decrypt_data(object_key, ciphertext), resp
+    return object_key, resp
 
 
 def is_encrypted(meta: dict) -> bool:

@@ -143,17 +143,22 @@ class FSObjects:
         total = 0
         try:
             with open(tmp, "wb") as f:
-                while total < size:
-                    chunk = reader.read(min(1 << 20, size - total))
+                # size < 0: unknown-length stream (transform chains);
+                # read to EOF.
+                while size < 0 or total < size:
+                    want = (1 << 20) if size < 0 else min(1 << 20,
+                                                          size - total)
+                    chunk = reader.read(want)
                     if not chunk:
                         break
                     md5.update(chunk)
                     f.write(chunk)
                     total += len(chunk)
-            if total != size:
+            if size >= 0 and total != size:
                 from ..utils.errors import ErrLessData
 
                 raise ErrLessData(f"read {total} of {size}")
+            size = total
         except BaseException:
             # reader.read may raise (e.g. body-hash verification): never
             # leave the staged file behind.

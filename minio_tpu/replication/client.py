@@ -34,13 +34,35 @@ class S3Client:
 
     def _request(self, method: str, path: str,
                  query: list[tuple[str, str]] | None = None,
-                 headers: dict | None = None, body: bytes = b""):
+                 headers: dict | None = None, body=b""):
         query = query or []
         qs = urllib.parse.urlencode(query)
         url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        headers = dict(headers or {})
+        payload_hash = None
+        if not isinstance(body, (bytes, bytearray)):
+            # File-like body: hash it in chunks for the signature, then
+            # stream it over the wire — replication never materializes
+            # the object (http.client streams file-likes with a set
+            # Content-Length).
+            import hashlib
+
+            pos = body.tell()
+            h = hashlib.sha256()
+            n = 0
+            while True:
+                chunk = body.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                n += len(chunk)
+            body.seek(pos)
+            payload_hash = h.hexdigest()
+            headers["Content-Length"] = str(n)
         headers = sign_v4_request(
             self.secret_key, self.access_key, method, self.endpoint,
-            path, query, dict(headers or {}), body, region=self.region,
+            path, query, headers, body if payload_hash is None else b"",
+            region=self.region, payload_hash=payload_hash,
         )
         conn = http.client.HTTPConnection(self.endpoint, timeout=self.timeout)
         try:
@@ -53,8 +75,9 @@ class S3Client:
 
     # --- object ops ---
 
-    def put_object(self, bucket: str, key: str, data: bytes,
+    def put_object(self, bucket: str, key: str, data,
                    metadata: dict | None = None) -> dict:
+        """`data` is bytes or a seekable file-like (streamed)."""
         headers = dict(metadata or {})
         st, h, body = self._request("PUT", f"/{bucket}/{key}",
                                     headers=headers, body=data)

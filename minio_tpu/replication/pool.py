@@ -190,35 +190,45 @@ class ReplicationPool:
             return
 
         if task.op == "put":
+            import tempfile
+
             opts = ObjectOptions(version_id=task.version_id)
-            data = self.ol.get_object_bytes(task.bucket, task.object,
-                                            opts=opts)
             info = self.ol.get_object_info(task.bucket, task.object, opts)
             from ..api import transforms
 
-            if transforms.is_transformed(info.user_defined):
-                # Stored bytes are encrypted/compressed: invert to the
-                # logical object before shipping (the target applies its
-                # own transforms). SSE-C can't be inverted without the
-                # client key -> raises -> FAILED, like the reference.
-                data, _ = transforms.apply_get_transforms(
-                    info.user_defined, {}, self.sse_config,
-                    task.bucket, task.object, data,
-                )
-            headers = {
-                k: v for k, v in info.user_defined.items()
-                if k.startswith("x-amz-meta-")
-            }
-            if info.content_type:
-                headers["Content-Type"] = info.content_type
-            # Mark the copy as a replica so the target doesn't re-replicate
-            # (ref ReplicationStatusReplica).
-            headers["x-amz-meta-mtpu-replication"] = "replica"
-            for t in matched:
-                self._client_for(t).put_object(
-                    t.target_bucket or task.bucket, task.object, data,
-                    metadata=headers,
-                )
+            # Spool the LOGICAL object through a temp file (disk-backed
+            # past 8 MiB): replication of a large/encrypted object never
+            # holds it in memory. SSE-C can't be inverted without the
+            # client key -> raises -> FAILED, like the reference.
+            with tempfile.SpooledTemporaryFile(max_size=8 << 20) as spool:
+                if transforms.is_transformed(info.user_defined):
+                    chain, closers, _ = transforms.build_get_chain(
+                        info.user_defined, {}, self.sse_config,
+                        task.bucket, task.object, spool,
+                    )
+                    self.ol.get_object(task.bucket, task.object, chain,
+                                       opts=opts)
+                    for c in closers:
+                        c.close()
+                else:
+                    self.ol.get_object(task.bucket, task.object, spool,
+                                       opts=opts)
+                spool.seek(0)
+                headers = {
+                    k: v for k, v in info.user_defined.items()
+                    if k.startswith("x-amz-meta-")
+                }
+                if info.content_type:
+                    headers["Content-Type"] = info.content_type
+                # Mark the copy as a replica so the target doesn't
+                # re-replicate (ref ReplicationStatusReplica).
+                headers["x-amz-meta-mtpu-replication"] = "replica"
+                for t in matched:
+                    spool.seek(0)
+                    self._client_for(t).put_object(
+                        t.target_bucket or task.bucket, task.object, spool,
+                        metadata=headers,
+                    )
             self._mark(task, COMPLETED)
             self.stats["completed"] += 1
         elif task.op in ("delete", "delete-marker"):

@@ -161,7 +161,13 @@ class Server:
 
         from .bucket.quota import BucketQuotaSys
 
-        def _scanner_usage() -> dict:
+        def _scanner_usage():
+            # None until the scanner has produced a usage snapshot (FS
+            # mode / scanner disabled / first cycle pending): the quota
+            # system then uses its bounded fallback walk instead of
+            # treating every bucket as empty.
+            if not self.scanner.usage.last_update_ns:
+                return None
             return {
                 b: u.objects_size
                 for b, u in self.scanner.usage.buckets_usage.items()

@@ -941,9 +941,7 @@ class S3ApiHandlers:
                     ol_mod.META_LEGAL_HOLD)
             opts.user_defined = {
                 k: v for k, v in src_info.user_defined.items()
-                if k not in drop and (
-                    self_copy or not k.startswith("x-mtpu-internal-")
-                )
+                if k not in drop and not k.startswith("x-mtpu-internal-")
             }
         # A copy writes a new object/version: it honors lock headers /
         # the bucket default retention and the hard quota exactly like a
@@ -967,7 +965,18 @@ class S3ApiHandlers:
                 "This copy request is illegal because it is being made "
                 "to the same object without changing metadata.",
             )
-        if self_copy and not vid and not opts.versioned:
+        from . import transforms
+
+        # The destination's transform chain applies when this request
+        # asks for one (SSE/compression headers or filters) — and a
+        # transformed source always re-encodes on a cross-key copy, since
+        # its sealed key is bound to the source path.
+        src_transformed = transforms.is_transformed(src_info.user_defined)
+        dest_transforms = transforms.transforms_active(
+            ctx.headers, self.config, ctx.object
+        )
+        if self_copy and not vid and not opts.versioned and \
+                not dest_transforms:
             # Unversioned REPLACE self-copy: metadata-only update — never
             # re-put the bytes, which would deadlock the writer lock
             # against its own locked source read (srcInfo.metadataOnly).
@@ -987,49 +996,13 @@ class S3ApiHandlers:
             from ..replication.pool import PENDING, REPL_STATUS_KEY
 
             opts.user_defined[REPL_STATUS_KEY] = PENDING
-        from . import transforms
-
-        src_transformed = transforms.is_transformed(src_info.user_defined)
         copy_sse_headers: dict | None = None
-        if self_copy:
-            # Versioned self-copy (new version of the same key) or a
-            # versionId restore: the source read must COMPLETE before the
-            # destination put takes the same write lock. Spool through a
-            # temp file, not memory — a multi-GiB restore must not be an
-            # unbounded allocation. Stored bytes are reused verbatim
-            # (same path, so a sealed SSE key stays valid) — the internal
-            # transform markers must travel with them.
-            import tempfile
-
-            if src_transformed:
-                for k, v in src_info.user_defined.items():
-                    if k.startswith("x-mtpu-internal-"):
-                        opts.user_defined.setdefault(k, v)
-            with tempfile.TemporaryFile() as spool:
-                try:
-                    self.ol.get_object(sbucket, sobject, spool,
-                                       opts=src_opts)
-                except StorageError as exc:
-                    raise from_object_error(exc) from exc
-                size = spool.tell()
-                spool.seek(0)
-                try:
-                    oi = self.ol.put_object(
-                        ctx.bucket, ctx.object, spool, size, opts
-                    )
-                except StorageError as exc:
-                    raise from_object_error(exc) from exc
-        elif src_transformed or transforms.transforms_active(
-                ctx.headers, self.config, ctx.object):
-            # Encrypted/compressed source going to a DIFFERENT key (the
-            # sealed object key is bound to the source path, so stored
-            # bytes cannot be reused), or a plain source whose COPY
-            # request demands destination transforms: decode the logical
-            # stream (spooled, bounded RSS) and apply the destination's
-            # transform chain (ref CopyObject re-encryption,
-            # cmd/object-handlers.go + encryption-v1.go rotate/copy).
-            import tempfile
-
+        if src_transformed or dest_transforms or self_copy:
+            # Decode the logical stream into a spool (bounded RSS; also
+            # satisfies the self-copy rule that the source read COMPLETES
+            # before the destination put takes the same write lock), then
+            # apply the destination's transform chain (ref CopyObject
+            # re-encryption, cmd/object-handlers.go + encryption-v1.go).
             src_headers = dict(ctx.headers)
             # Copy-source SSE-C headers address the SOURCE decryption.
             for suffix in ("algorithm", "key", "key-md5"):
@@ -1040,27 +1013,26 @@ class S3ApiHandlers:
                     src_headers[
                         "x-amz-server-side-encryption-customer-" + suffix
                     ] = v
-            with tempfile.SpooledTemporaryFile(max_size=8 << 20) as spool:
-                chain, closers, _ = transforms.build_get_chain(
+            try:
+                spool = transforms.decode_to_spool(
+                    self.ol, sbucket, sobject, src_opts,
                     src_info.user_defined, src_headers, self.sse_config,
-                    sbucket, sobject, spool,
                 )
-                try:
-                    self.ol.get_object(sbucket, sobject, chain,
-                                       opts=src_opts)
-                except StorageError as exc:
-                    raise from_object_error(exc) from exc
-                for c in closers:
-                    c.close()
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            with spool:
+                spool.seek(0, io.SEEK_END)
                 size = spool.tell()
                 spool.seek(0)
-                reader, stored_size, copy_sse_headers = (
-                    transforms.build_put_stream(
-                        ctx.headers, self.config, self.sse_config,
-                        ctx.bucket, ctx.object, spool, size,
-                        opts.user_defined,
+                reader, stored_size = spool, size
+                if dest_transforms:
+                    reader, stored_size, copy_sse_headers = (
+                        transforms.build_put_stream(
+                            ctx.headers, self.config, self.sse_config,
+                            ctx.bucket, ctx.object, spool, size,
+                            opts.user_defined,
+                        )
                     )
-                )
                 try:
                     oi = self.ol.put_object(
                         ctx.bucket, ctx.object, reader, stored_size, opts
@@ -1233,6 +1205,91 @@ class S3ApiHandlers:
             )
             return Response(206, headers, body_stream=stream)
         return Response(200, headers, body_stream=stream)
+
+    def select_object_content(self, ctx) -> Response:
+        """SelectObjectContent: SQL over one CSV/JSON object, response
+        framed as an AWS event stream (ref pkg/s3select/select.go +
+        SelectObjectContentHandler, cmd/object-handlers.go:97)."""
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            oi = self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        from ..s3select import eventstream
+        from ..s3select.engine import SelectRequest, run_select
+        from ..s3select.sql import SQLError
+
+        try:
+            req = SelectRequest.from_xml(ctx.body)
+        except SQLError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+
+        from . import transforms
+
+        import tempfile
+
+        # Materialize the LOGICAL stream into a disk-backed spool, scan
+        # it in column batches, and spool the framed result messages the
+        # same way — neither the input nor a giant SELECT * result ever
+        # sits in memory.
+        out_spool = tempfile.SpooledTemporaryFile(max_size=8 << 20)
+        max_payload = (128 << 10) - 512
+
+        def emit(chunk: bytes):
+            for off in range(0, len(chunk), max_payload):
+                out_spool.write(eventstream.records_message(
+                    chunk[off:off + max_payload]
+                ))
+
+        try:
+            try:
+                in_spool = transforms.decode_to_spool(
+                    self.ol, ctx.bucket, ctx.object, opts,
+                    oi.user_defined, ctx.headers, self.sse_config,
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            with in_spool:
+                in_spool.seek(0, io.SEEK_END)
+                logical = in_spool.tell()
+                in_spool.seek(0)
+                try:
+                    stats = run_select(req, in_spool, emit)
+                except SQLError as exc:
+                    raise S3Error("InvalidArgument", str(exc)) from exc
+                except (ValueError, UnicodeDecodeError) as exc:
+                    raise S3Error("InvalidRequest",
+                                  f"malformed input: {exc}") from exc
+            out_spool.write(eventstream.stats_message(
+                oi.size, logical, stats["returned"]
+            ))
+            out_spool.write(eventstream.end_message())
+        except BaseException:
+            out_spool.close()
+            raise
+        total = out_spool.tell()
+        out_spool.seek(0)
+        self._event("s3:ObjectAccessed:Get", ctx.bucket, oi=oi)
+
+        def stream(dst, _spool=out_spool):
+            try:
+                while True:
+                    chunk = _spool.read(1 << 20)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            finally:
+                _spool.close()
+
+        return Response(
+            200,
+            {"Content-Type": "application/octet-stream",
+             "Content-Length": str(total)},
+            body_stream=stream,
+        )
 
     def head_object(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)

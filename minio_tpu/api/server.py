@@ -70,6 +70,7 @@ _ACTIONS = {
     "get_object": "s3:GetObject",
     "object_retention": "s3:GetObjectRetention",
     "object_legal_hold": "s3:GetObjectLegalHold",
+    "select_object_content": "s3:GetObject",
     "head_object": "s3:GetObject",
     "delete_object": "s3:DeleteObject",
     "new_multipart_upload": "s3:PutObject",
@@ -244,6 +245,8 @@ def route(ctx: RequestContext) -> str:
             return "new_multipart_upload"
         if "uploadId" in q:
             return "complete_multipart_upload"
+        if "select" in q and q.get("select-type") == "2":
+            return "select_object_content"
         raise S3Error("MethodNotAllowed", f"POST {ctx.object}")
     if m == "DELETE":
         if "uploadId" in q:

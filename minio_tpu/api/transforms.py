@@ -124,9 +124,14 @@ class CompressReader:
         self._meta = meta_sink
         self._mode = ""  # "" undecided | "zlib" | "raw"
 
+    _PROBE_BYTES = 64 << 10
+
     def _decide(self, first_chunk: bytes):
-        probe = zlib.compress(first_chunk, 1)
-        if len(probe) >= int(len(first_chunk) * 0.99):
+        # Probe a small prefix only — the real compressobj re-does this
+        # work if zlib wins, so keep the throwaway pass cheap.
+        probe_src = first_chunk[:self._PROBE_BYTES]
+        probe = zlib.compress(probe_src, 1)
+        if len(probe) >= int(len(probe_src) * 0.99):
             self._mode = "raw"
         else:
             self._mode = "zlib"
@@ -241,6 +246,32 @@ def build_put_stream(headers: dict, config, sse_config, bucket: str,
     # stored size would never pull the source's EOF, and the EOF hooks
     # (size metadata, Content-MD5 verdict) would silently not run.
     return reader, -1, resp
+
+
+def decode_to_spool(ol, bucket: str, object_: str, opts, stored_meta: dict,
+                    headers: dict, sse_config, max_memory: int = 8 << 20):
+    """Materialize an object's LOGICAL stream into a SpooledTemporaryFile
+    (disk-backed past `max_memory`): the shared decode step of copy,
+    select, and replication. Returns the spool positioned at 0; caller
+    owns closing it. Plain objects stream straight through."""
+    import tempfile
+
+    spool = tempfile.SpooledTemporaryFile(max_size=max_memory)
+    try:
+        if is_transformed(stored_meta):
+            chain, closers, _ = build_get_chain(
+                stored_meta, headers, sse_config, bucket, object_, spool,
+            )
+            ol.get_object(bucket, object_, chain, opts=opts)
+            for c in closers:
+                c.close()
+        else:
+            ol.get_object(bucket, object_, spool, opts=opts)
+    except BaseException:
+        spool.close()
+        raise
+    spool.seek(0)
+    return spool
 
 
 def _sse_s3error(exc: "ssemod.SSEError", default_code: str) -> S3Error:

@@ -200,7 +200,15 @@ class FSObjects:
         self-copy) — the FS analog of updateObjectMeta. Returns the new
         mod time ns when replace_user_meta stamped one, else None."""
         meta = self._load_meta(bucket, object_)
-        user = {} if replace_user_meta else dict(meta.get("meta") or {})
+        if replace_user_meta:
+            # Drop ONLY client metadata; internal markers (sealed SSE
+            # key, compression) describe the stored bytes and must
+            # survive a metadata REPLACE (parity with the erasure
+            # backend's _update_object_metadata).
+            user = {k: v for k, v in (meta.get("meta") or {}).items()
+                    if not k.startswith("x-amz-meta-")}
+        else:
+            user = dict(meta.get("meta") or {})
         user.update(updates)
         meta["meta"] = user
         new_mod_time = None

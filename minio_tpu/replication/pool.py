@@ -190,8 +190,6 @@ class ReplicationPool:
             return
 
         if task.op == "put":
-            import tempfile
-
             opts = ObjectOptions(version_id=task.version_id)
             info = self.ol.get_object_info(task.bucket, task.object, opts)
             from ..api import transforms
@@ -200,20 +198,10 @@ class ReplicationPool:
             # past 8 MiB): replication of a large/encrypted object never
             # holds it in memory. SSE-C can't be inverted without the
             # client key -> raises -> FAILED, like the reference.
-            with tempfile.SpooledTemporaryFile(max_size=8 << 20) as spool:
-                if transforms.is_transformed(info.user_defined):
-                    chain, closers, _ = transforms.build_get_chain(
-                        info.user_defined, {}, self.sse_config,
-                        task.bucket, task.object, spool,
-                    )
-                    self.ol.get_object(task.bucket, task.object, chain,
-                                       opts=opts)
-                    for c in closers:
-                        c.close()
-                else:
-                    self.ol.get_object(task.bucket, task.object, spool,
-                                       opts=opts)
-                spool.seek(0)
+            with transforms.decode_to_spool(
+                self.ol, task.bucket, task.object, opts,
+                info.user_defined, {}, self.sse_config,
+            ) as spool:
                 headers = {
                     k: v for k, v in info.user_defined.items()
                     if k.startswith("x-amz-meta-")

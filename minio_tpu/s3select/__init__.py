@@ -1,0 +1,23 @@
+"""S3 Select: SQL queries over CSV/JSON objects with AWS event-stream
+framed responses — the TPU-native counterpart of the reference's
+pkg/s3select (select.go, sql/, csv/, json/, message.go).
+
+Redesign: the reference interprets SQL per record (row-at-a-time Go
+evaluator); here records are decoded into COLUMNS per batch and the
+WHERE clause evaluates as vectorized numpy masks over whole batches —
+the same batched-columnar shape a TPU/jnp backend needs (predicate masks
+are elementwise kernels; swap np->jnp to offload giant scans).
+"""
+
+from .engine import SelectRequest, run_select
+from .eventstream import (
+    end_message,
+    error_message,
+    records_message,
+    stats_message,
+)
+
+__all__ = [
+    "SelectRequest", "run_select",
+    "records_message", "stats_message", "end_message", "error_message",
+]

@@ -1,0 +1,508 @@
+"""Columnar S3 Select executor (ref pkg/s3select/select.go + csv/ +
+json/ readers and sql/evaluate.go).
+
+Redesign vs the reference: instead of a per-record interpreter, input
+decodes into COLUMN batches (numpy object/float arrays) and the WHERE
+clause evaluates once per batch as vectorized masks. Numeric
+comparisons run on float64 arrays — the exact elementwise-kernel shape
+a jnp/TPU backend accelerates; swapping np->jnp on the mask math is the
+designed extension point for giant scans.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+import json
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .sql import Query, SQLError, parse
+
+BATCH_ROWS = 8192
+
+
+@dataclass
+class SelectRequest:
+    """Parsed SelectObjectContentRequest."""
+
+    expression: str
+    input_format: str = "csv"          # csv | json
+    file_header_info: str = "NONE"     # USE | IGNORE | NONE
+    field_delimiter: str = ","
+    record_delimiter: str = "\n"
+    quote_character: str = '"'
+    json_type: str = "LINES"           # LINES | DOCUMENT
+    output_format: str = "csv"
+    output_field_delimiter: str = ","
+    output_record_delimiter: str = "\n"
+
+    @classmethod
+    def from_xml(cls, body: bytes) -> "SelectRequest":
+        import xml.etree.ElementTree as ET
+
+        root = ET.fromstring(body)
+
+        def find(path):
+            for el in root.iter():
+                if el.tag.endswith(path):
+                    return el
+            return None
+
+        expr_el = find("Expression")
+        if expr_el is None or not (expr_el.text or "").strip():
+            raise SQLError("missing Expression")
+        req = cls(expression=expr_el.text.strip())
+        etype = find("ExpressionType")
+        if etype is not None and (etype.text or "").strip().upper() != "SQL":
+            raise SQLError("ExpressionType must be SQL")
+        inser = find("InputSerialization")
+        if inser is not None:
+            for el in inser.iter():
+                tag = el.tag.rsplit("}", 1)[-1]
+                if tag == "JSON":
+                    req.input_format = "json"
+                    for sub in el:
+                        if sub.tag.endswith("Type"):
+                            req.json_type = (sub.text or "LINES").upper()
+                elif tag == "FileHeaderInfo":
+                    req.file_header_info = (el.text or "NONE").upper()
+                elif tag == "FieldDelimiter":
+                    req.field_delimiter = el.text or ","
+                elif tag == "RecordDelimiter":
+                    req.record_delimiter = el.text or "\n"
+                elif tag == "QuoteCharacter":
+                    req.quote_character = el.text or '"'
+        outser = find("OutputSerialization")
+        if outser is not None:
+            for el in outser.iter():
+                tag = el.tag.rsplit("}", 1)[-1]
+                if tag == "JSON":
+                    req.output_format = "json"
+                elif tag == "FieldDelimiter":
+                    req.output_field_delimiter = el.text or ","
+                elif tag == "RecordDelimiter":
+                    req.output_record_delimiter = el.text or "\n"
+        return req
+
+
+@dataclass
+class _Batch:
+    """One decoded batch: column name -> object ndarray of strings
+    (None = missing/null). Positional _N names always present for CSV."""
+
+    columns: dict
+    n: int
+
+
+# ---------------------------------------------------------------------------
+# input decoding
+# ---------------------------------------------------------------------------
+
+def _csv_batches(stream, req: SelectRequest):
+    text = io.TextIOWrapper(stream, encoding="utf-8", newline="")
+    reader = _csv.reader(
+        text, delimiter=req.field_delimiter, quotechar=req.quote_character,
+    )
+    header: list[str] | None = None
+    if req.file_header_info in ("USE", "IGNORE"):
+        header = next(reader, None)
+        if req.file_header_info == "IGNORE":
+            header = None
+    rows: list[list[str]] = []
+    for row in reader:
+        if not row:
+            continue
+        rows.append(row)
+        if len(rows) >= BATCH_ROWS:
+            yield _rows_to_batch(rows, header)
+            rows = []
+    if rows:
+        yield _rows_to_batch(rows, header)
+
+
+def _rows_to_batch(rows: list[list[str]], header: list[str] | None) -> _Batch:
+    width = max(len(r) for r in rows)
+    cols = {}
+    for j in range(width):
+        arr = np.array(
+            [r[j] if j < len(r) else None for r in rows], dtype=object
+        )
+        cols[f"_{j + 1}"] = arr
+        if header is not None and j < len(header):
+            cols[header[j].strip().lower()] = arr
+    return _Batch(columns=cols, n=len(rows))
+
+
+def _json_batches(stream, req: SelectRequest):
+    text = io.TextIOWrapper(stream, encoding="utf-8")
+    records: list[dict] = []
+    if req.json_type == "DOCUMENT":
+        doc = json.load(text)
+        records = doc if isinstance(doc, list) else [doc]
+        yield from _dicts_to_batches(records)
+        return
+    batch: list[dict] = []
+    for line in text:
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        batch.append(obj if isinstance(obj, dict) else {"_1": obj})
+        if len(batch) >= BATCH_ROWS:
+            yield from _dicts_to_batches(batch)
+            batch = []
+    if batch:
+        yield from _dicts_to_batches(batch)
+
+
+def _dicts_to_batches(records: list[dict]):
+    keys: list[str] = []
+    for r in records:
+        for k in r:
+            if k.lower() not in keys:
+                keys.append(k.lower())
+    cols = {}
+    lowered = [{k.lower(): v for k, v in r.items()} for r in records]
+    for k in keys:
+        cols[k] = np.array(
+            [_jsonval(r.get(k)) for r in lowered], dtype=object
+        )
+    yield _Batch(columns=cols, n=len(records))
+
+
+def _jsonval(v):
+    if v is None or isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v) if isinstance(v, float) else str(v)
+    return json.dumps(v)
+
+
+# ---------------------------------------------------------------------------
+# vectorized evaluation
+# ---------------------------------------------------------------------------
+
+def _col(batch: _Batch, name: str) -> np.ndarray:
+    arr = batch.columns.get(name)
+    if arr is None:
+        return np.full(batch.n, None, dtype=object)
+    return arr
+
+
+def _as_float(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(values float64, ok mask) — unparseable/missing rows are NaN+False.
+    This is the hot columnar kernel (jnp-able)."""
+    vals = np.empty(len(arr), dtype=np.float64)
+    ok = np.empty(len(arr), dtype=bool)
+    for i, v in enumerate(arr):  # object-dtype walk; np.char can't parse
+        try:
+            vals[i] = float(v)
+            ok[i] = True
+        except (TypeError, ValueError):
+            vals[i] = np.nan
+            ok[i] = False
+    return vals, ok
+
+
+_CMP_NUM = {
+    "=": np.equal, "!=": np.not_equal, "<": np.less,
+    "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
+}
+
+
+def _cmp(op: str, left, right, batch: _Batch) -> np.ndarray:
+    lv = _operand_values(left, batch)
+    rv = _operand_values(right, batch)
+    numeric = (
+        _is_numeric_literal(left) or _is_numeric_literal(right)
+    )
+    if numeric:
+        lf, lok = _to_float(lv, batch.n)
+        rf, rok = _to_float(rv, batch.n)
+        with np.errstate(invalid="ignore"):
+            m = _CMP_NUM[op](lf, rf)
+        return m & lok & rok
+    ls = _to_str(lv, batch.n)
+    rs = _to_str(rv, batch.n)
+    valid = np.array([a is not None for a in ls], dtype=bool) & \
+        np.array([b is not None for b in rs], dtype=bool)
+    if op in ("=", "!="):
+        eq = np.array([a == b for a, b in zip(ls, rs)], dtype=bool)
+        return (eq if op == "=" else ~eq) & valid
+    keyed = np.array(
+        [(a is not None and b is not None) and _str_cmp(op, a, b)
+         for a, b in zip(ls, rs)], dtype=bool,
+    )
+    return keyed & valid
+
+
+def _str_cmp(op: str, a: str, b: str) -> bool:
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+
+
+def _operand_values(term, batch: _Batch):
+    kind = term[0]
+    if kind == "col":
+        return ("arr", _col(batch, term[1]))
+    return ("lit", term[1])
+
+
+def _is_numeric_literal(term) -> bool:
+    return term[0] == "lit" and isinstance(term[1], (int, float)) \
+        and not isinstance(term[1], bool)
+
+
+def _to_float(val, n: int) -> tuple[np.ndarray, np.ndarray]:
+    kind, v = val
+    if kind == "lit":
+        try:
+            f = float(v)
+            return np.full(n, f), np.ones(n, dtype=bool)
+        except (TypeError, ValueError):
+            return np.full(n, np.nan), np.zeros(n, dtype=bool)
+    return _as_float(v)
+
+
+def _to_str(val, n: int) -> list:
+    kind, v = val
+    if kind == "lit":
+        return [None if v is None else str(v)] * n
+    return list(v)
+
+
+def _like_regex(pattern: str) -> re.Pattern:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def eval_where(expr, batch: _Batch) -> np.ndarray:
+    """Vectorized boolean mask for one batch."""
+    kind = expr[0]
+    if kind == "and":
+        return eval_where(expr[1], batch) & eval_where(expr[2], batch)
+    if kind == "or":
+        return eval_where(expr[1], batch) | eval_where(expr[2], batch)
+    if kind == "not":
+        return ~eval_where(expr[1], batch)
+    if kind == "cmp":
+        return _cmp(expr[1], expr[2], expr[3], batch)
+    if kind == "like":
+        rx = _like_regex(expr[2])
+        vals = _to_str(_operand_values(expr[1], batch), batch.n)
+        return np.array(
+            [v is not None and rx.match(v) is not None for v in vals],
+            dtype=bool,
+        )
+    if kind == "in":
+        vals = _to_str(_operand_values(expr[1], batch), batch.n)
+        opts = {str(o) for o in expr[2]}
+        num_opts = set()
+        for o in expr[2]:
+            if isinstance(o, (int, float)) and not isinstance(o, bool):
+                num_opts.add(float(o))
+        out = np.zeros(batch.n, dtype=bool)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            if v in opts:
+                out[i] = True
+            elif num_opts:
+                try:
+                    out[i] = float(v) in num_opts
+                except ValueError:
+                    pass
+        return out
+    if kind == "between":
+        lo = _cmp(">=", expr[1], expr[2], batch)
+        hi = _cmp("<=", expr[1], expr[3], batch)
+        return lo & hi
+    if kind == "isnull":
+        vals = _operand_values(expr[1], batch)
+        if vals[0] == "lit":
+            isnull = np.full(batch.n, vals[1] is None, dtype=bool)
+        else:
+            isnull = np.array([v is None for v in vals[1]], dtype=bool)
+        return ~isnull if expr[2] else isnull
+    if kind == "lit":
+        return np.full(batch.n, bool(expr[1]), dtype=bool)
+    raise SQLError(f"unsupported WHERE node {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _AggState:
+    count: int = 0
+    sum: float = 0.0
+    min: float | None = None
+    max: float | None = None
+    seen: int = 0
+
+
+class _CountingReader(io.RawIOBase):
+    """Byte-counting raw reader (TextIOWrapper-compatible) feeding the
+    BytesProcessed stat."""
+
+    def __init__(self, src):
+        super().__init__()
+        self._src = src
+        self.count = 0
+
+    def readinto(self, b) -> int:
+        data = self._src.read(len(b))
+        n = len(data)
+        b[:n] = data
+        self.count += n
+        return n
+
+    def readable(self) -> bool:
+        return True
+
+
+def run_select(req: SelectRequest, stream, emit) -> dict:
+    """Run the query over `stream`, calling emit(chunk_bytes) per output
+    chunk. Returns {"processed": n_bytes, "returned": n_bytes}."""
+    query = parse(req.expression)
+    counting = _CountingReader(stream)
+    batches = (_csv_batches(counting, req) if req.input_format == "csv"
+               else _json_batches(counting, req))
+
+    returned = 0
+    emitted_rows = 0
+    agg_states = [
+        _AggState() for p in query.projections if p and p[0] == "agg"
+    ] if query.aggregate else []
+
+    def out_rows(batch: _Batch, mask: np.ndarray):
+        nonlocal returned, emitted_rows
+        idx = np.nonzero(mask)[0]
+        if query.limit is not None:
+            room = query.limit - emitted_rows
+            if room <= 0:
+                return False
+            idx = idx[:room]
+        if len(idx) == 0:
+            return True
+        if query.star:
+            width = 0
+            while f"_{width + 1}" in batch.columns:
+                width += 1
+            names = [f"_{j + 1}" for j in range(width)] or \
+                list(batch.columns)
+        else:
+            names = [p[1] for p in query.projections]
+        cols = [_col(batch, nm) for nm in names]
+        buf = io.StringIO()
+        if req.output_format == "json":
+            keys = _output_keys(query, names)
+            for i in idx:
+                rec = {k: (None if cols[j][i] is None else cols[j][i])
+                       for j, k in enumerate(keys)}
+                buf.write(json.dumps(rec))
+                buf.write(req.output_record_delimiter)
+        else:
+            w = _csv.writer(
+                buf, delimiter=req.output_field_delimiter,
+                lineterminator=req.output_record_delimiter,
+                quotechar='"',
+            )
+            for i in idx:
+                w.writerow(["" if cols[j][i] is None else cols[j][i]
+                            for j in range(len(cols))])
+        chunk = buf.getvalue().encode()
+        returned += len(chunk)
+        emitted_rows += len(idx)
+        emit(chunk)
+        return query.limit is None or emitted_rows < query.limit
+
+    for batch in batches:
+        mask = (eval_where(query.where, batch) if query.where is not None
+                else np.ones(batch.n, dtype=bool))
+        if query.aggregate:
+            _accumulate(query, batch, mask, agg_states)
+        else:
+            if not out_rows(batch, mask):
+                break
+
+    if query.aggregate:
+        chunk = _agg_output(req, query, agg_states)
+        returned += len(chunk)
+        emit(chunk)
+    return {"returned": returned, "processed": counting.count}
+
+
+def _output_keys(query: Query, names: list[str]) -> list[str]:
+    if query.star:
+        return names
+    out = []
+    for p in query.projections:
+        alias = p[2] if p[0] == "col" else p[3]
+        out.append(alias or (p[1] if p[0] == "col" else p[1]))
+    return out
+
+
+def _accumulate(query: Query, batch: _Batch, mask: np.ndarray,
+                states: list[_AggState]):
+    for p, st in zip(query.projections, states):
+        _, fn, col, _alias = p
+        if fn == "count" and col is None:
+            st.count += int(mask.sum())
+            continue
+        arr = _col(batch, col)
+        vals, ok = _as_float(arr)
+        sel = mask & ok
+        nonnull = mask & np.array([v is not None for v in arr], dtype=bool)
+        st.count += int(nonnull.sum())
+        if sel.any():
+            sub = vals[sel]
+            st.sum += float(sub.sum())
+            st.seen += int(sel.sum())
+            mn, mx = float(sub.min()), float(sub.max())
+            st.min = mn if st.min is None else min(st.min, mn)
+            st.max = mx if st.max is None else max(st.max, mx)
+
+
+def _fmt_num(x: float) -> str:
+    return str(int(x)) if float(x).is_integer() else repr(float(x))
+
+
+def _agg_output(req: SelectRequest, query: Query,
+                states: list[_AggState]) -> bytes:
+    vals = []
+    for p, st in zip(query.projections, states):
+        _, fn, col, alias = p
+        if fn == "count":
+            vals.append((alias or "count", str(st.count)))
+        elif fn == "sum":
+            vals.append((alias or "sum", _fmt_num(st.sum)))
+        elif fn == "avg":
+            vals.append((alias or "avg",
+                         _fmt_num(st.sum / st.seen) if st.seen else ""))
+        elif fn == "min":
+            vals.append((alias or "min",
+                         _fmt_num(st.min) if st.min is not None else ""))
+        elif fn == "max":
+            vals.append((alias or "max",
+                         _fmt_num(st.max) if st.max is not None else ""))
+    if req.output_format == "json":
+        return (json.dumps({k: v for k, v in vals})
+                + req.output_record_delimiter).encode()
+    buf = io.StringIO()
+    w = _csv.writer(buf, delimiter=req.output_field_delimiter,
+                    lineterminator=req.output_record_delimiter)
+    w.writerow([v for _, v in vals])
+    return buf.getvalue().encode()

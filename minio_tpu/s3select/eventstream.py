@@ -1,0 +1,113 @@
+"""AWS event-stream framing for SelectObjectContent responses
+(ref pkg/s3select/message.go and the documented frame layout:
+prelude[total_len u32 | headers_len u32 | crc32(prelude)] + headers +
+payload + crc32(message)). Header values are all type-7 strings."""
+
+from __future__ import annotations
+
+import binascii
+import struct
+
+
+def _header(name: str, value: str) -> bytes:
+    nb = name.encode()
+    vb = value.encode()
+    return bytes([len(nb)]) + nb + b"\x07" + struct.pack(">H", len(vb)) + vb
+
+
+def message(headers: list[tuple[str, str]], payload: bytes = b"") -> bytes:
+    hdr = b"".join(_header(n, v) for n, v in headers)
+    total = 4 + 4 + 4 + len(hdr) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(hdr))
+    out = prelude + struct.pack(">I", binascii.crc32(prelude)) + hdr + payload
+    return out + struct.pack(">I", binascii.crc32(out))
+
+
+def records_message(payload: bytes) -> bytes:
+    return message(
+        [(":message-type", "event"),
+         (":content-type", "application/octet-stream"),
+         (":event-type", "Records")],
+        payload,
+    )
+
+
+def _stats_xml(tag: str, scanned: int, processed: int, returned: int) -> bytes:
+    return (
+        f'<?xml version="1.0" encoding="UTF-8"?><{tag}>'
+        f"<BytesScanned>{scanned}</BytesScanned>"
+        f"<BytesProcessed>{processed}</BytesProcessed>"
+        f"<BytesReturned>{returned}</BytesReturned></{tag}>"
+    ).encode()
+
+
+def stats_message(scanned: int, processed: int, returned: int) -> bytes:
+    return message(
+        [(":message-type", "event"), (":content-type", "text/xml"),
+         (":event-type", "Stats")],
+        _stats_xml("Stats", scanned, processed, returned),
+    )
+
+
+def progress_message(scanned: int, processed: int, returned: int) -> bytes:
+    return message(
+        [(":message-type", "event"), (":content-type", "text/xml"),
+         (":event-type", "Progress")],
+        _stats_xml("Progress", scanned, processed, returned),
+    )
+
+
+def cont_message() -> bytes:
+    return message(
+        [(":message-type", "event"), (":event-type", "Cont")]
+    )
+
+
+def end_message() -> bytes:
+    return message(
+        [(":message-type", "event"), (":event-type", "End")]
+    )
+
+
+def error_message(code: str, description: str) -> bytes:
+    return message(
+        [(":message-type", "error"), (":error-code", code),
+         (":error-message", description)]
+    )
+
+
+# --- decoding (tests/clients) ---
+
+def decode_messages(raw: bytes) -> list[dict]:
+    """Parse a concatenated event-stream buffer into
+    [{"headers": {...}, "payload": bytes}] (validates both CRCs)."""
+    out = []
+    off = 0
+    while off < len(raw):
+        total, hlen = struct.unpack_from(">II", raw, off)
+        pcrc, = struct.unpack_from(">I", raw, off + 8)
+        if binascii.crc32(raw[off:off + 8]) != pcrc:
+            raise ValueError("prelude crc mismatch")
+        hdr_end = off + 12 + hlen
+        headers = {}
+        p = off + 12
+        while p < hdr_end:
+            nlen = raw[p]
+            p += 1
+            name = raw[p:p + nlen].decode()
+            p += nlen
+            vtype = raw[p]
+            p += 1
+            if vtype != 7:
+                raise ValueError(f"unsupported header type {vtype}")
+            vlen, = struct.unpack_from(">H", raw, p)
+            p += 2
+            headers[name] = raw[p:p + vlen].decode()
+            p += vlen
+        payload = raw[hdr_end:off + total - 4]
+        mcrc, = struct.unpack_from(">I", raw, off + total - 4)
+        if binascii.crc32(raw[off:off + total - 4]) != mcrc:
+            raise ValueError("message crc mismatch")
+        out.append({"headers": headers, "payload": payload})
+        off += total
+    return out

@@ -1,0 +1,331 @@
+"""S3 Select SQL subset: tokenizer + recursive-descent parser
+(ref pkg/s3select/sql/parser.go, which uses a participle grammar; same
+language surface, plain Python).
+
+Supported:
+  SELECT * | proj[, proj...] FROM S3Object[.*] [alias] [WHERE expr]
+      [LIMIT n]
+  proj  := column | aggregate [AS alias]
+  agg   := COUNT(*) | COUNT(col) | SUM(col) | AVG(col) | MIN(col)
+           | MAX(col)
+  col   := name | "quoted name" | _N | alias.name
+  expr  := comparisons (= != <> < <= > >=), LIKE, IN (...),
+           BETWEEN a AND b, IS [NOT] NULL, AND, OR, NOT, parentheses
+  lit   := 'string' | number | TRUE | FALSE | NULL
+
+AST is plain tuples (engine.py pattern-matches on the first element):
+  ("col", name) ("lit", value) ("cmp", op, l, r) ("and", a, b)
+  ("or", a, b) ("not", e) ("like", col, pat) ("in", col, [lits])
+  ("between", col, lo, hi) ("isnull", col, negated)
+Aggregates: ("agg", fn, col_or_None).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class SQLError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+        (?P<number>-?\d+(?:\.\d+)?)
+      | (?P<string>'(?:[^']|'')*')
+      | (?P<qident>"(?:[^"]|"")*")
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\.|\*)
+    )""",
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "limit", "and", "or", "not", "like", "in",
+    "between", "is", "null", "as", "true", "false", "count", "sum", "avg",
+    "min", "max", "escape",
+}
+
+_AGGS = {"count", "sum", "avg", "min", "max"}
+
+
+@dataclass
+class Query:
+    projections: list  # [("col", name, alias)] / [("agg", fn, col, alias)]
+    star: bool = False
+    where: tuple | None = None
+    limit: int | None = None
+    alias: str = ""
+    aggregate: bool = False
+    columns: list = field(default_factory=list)  # every referenced column
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise SQLError(f"bad token at {text[pos:pos + 20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        val = m.group(kind)
+        if kind == "ident" and val.lower() in _KEYWORDS:
+            out.append(("kw", val.lower()))
+        else:
+            out.append((kind, val))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+        self.columns: list[str] = []
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect_kw(self, word: str):
+        k, v = self.next()
+        if k != "kw" or v != word:
+            raise SQLError(f"expected {word.upper()}, got {v!r}")
+
+    def accept_kw(self, word: str) -> bool:
+        k, v = self.peek()
+        if k == "kw" and v == word:
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, op: str) -> bool:
+        k, v = self.peek()
+        if k == "op" and v == op:
+            self.i += 1
+            return True
+        return False
+
+    # --- terms ---
+
+    def column_name(self, alias: str) -> str:
+        k, v = self.next()
+        if k == "qident":
+            name = v[1:-1].replace('""', '"')
+        elif k == "ident":
+            name = v
+        elif k == "kw":  # keywords are legal column names in practice
+            name = v
+        else:
+            raise SQLError(f"expected column name, got {v!r}")
+        # alias-qualified: s.col
+        if self.accept_op("."):
+            if name.lower() != (alias or "s3object").lower() and \
+                    name.lower() != "s3object":
+                raise SQLError(f"unknown table alias {name!r}")
+            return self.column_name(alias)
+        self.columns.append(name.lower())
+        return name.lower()
+
+    def literal(self):
+        k, v = self.next()
+        if k == "number":
+            return ("lit", float(v) if "." in v else int(v))
+        if k == "string":
+            return ("lit", v[1:-1].replace("''", "'"))
+        if k == "kw" and v == "true":
+            return ("lit", True)
+        if k == "kw" and v == "false":
+            return ("lit", False)
+        if k == "kw" and v == "null":
+            return ("lit", None)
+        raise SQLError(f"expected literal, got {v!r}")
+
+    def operand(self, alias: str):
+        k, v = self.peek()
+        if k in ("number", "string") or (k == "kw" and v in
+                                         ("true", "false", "null")):
+            return self.literal()
+        return ("col", self.column_name(alias))
+
+    # --- expressions ---
+
+    def expr(self, alias: str):
+        left = self.and_expr(alias)
+        while self.accept_kw("or"):
+            left = ("or", left, self.and_expr(alias))
+        return left
+
+    def and_expr(self, alias: str):
+        left = self.not_expr(alias)
+        while self.accept_kw("and"):
+            left = ("and", left, self.not_expr(alias))
+        return left
+
+    def not_expr(self, alias: str):
+        if self.accept_kw("not"):
+            return ("not", self.not_expr(alias))
+        return self.predicate(alias)
+
+    def predicate(self, alias: str):
+        if self.accept_op("("):
+            e = self.expr(alias)
+            if not self.accept_op(")"):
+                raise SQLError("missing )")
+            return e
+        left = self.operand(alias)
+        negate = False
+        if self.accept_kw("not"):
+            negate = True
+        if self.accept_kw("like"):
+            pat = self.literal()
+            if not isinstance(pat[1], str):
+                raise SQLError("LIKE pattern must be a string")
+            e = ("like", left, pat[1])
+        elif self.accept_kw("between"):
+            lo = self.operand(alias)
+            self.expect_kw("and")
+            hi = self.operand(alias)
+            e = ("between", left, lo, hi)
+        elif self.accept_kw("in"):
+            if not self.accept_op("("):
+                raise SQLError("IN needs (")
+            lits = [self.literal()]
+            while self.accept_op(","):
+                lits.append(self.literal())
+            if not self.accept_op(")"):
+                raise SQLError("missing ) after IN list")
+            e = ("in", left, [v for _, v in lits])
+        elif self.accept_kw("is"):
+            neg = self.accept_kw("not")
+            self.expect_kw("null")
+            e = ("isnull", left, neg)
+        else:
+            k, op = self.next()
+            if k != "op" or op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+                raise SQLError(f"expected comparison, got {op!r}")
+            right = self.operand(alias)
+            e = ("cmp", "!=" if op == "<>" else op, left, right)
+        return ("not", e) if negate else e
+
+    # --- statement ---
+
+    def projection(self, alias: str):
+        k, v = self.peek()
+        if k == "kw" and v in _AGGS:
+            fn = self.next()[1]
+            if not self.accept_op("("):
+                raise SQLError(f"{fn.upper()} needs (")
+            if self.accept_op("*"):
+                if fn != "count":
+                    raise SQLError(f"{fn.upper()}(*) unsupported")
+                col = None
+            else:
+                col = self.column_name(alias)
+            if not self.accept_op(")"):
+                raise SQLError("missing )")
+            out = ["agg", fn, col, ""]
+        else:
+            out = ["col", self.column_name(alias), "", ""]
+        if self.accept_kw("as"):
+            k, v = self.next()
+            if k == "qident":
+                v = v[1:-1]
+            out[-1 if out[0] == "agg" else 2] = v
+        return tuple(out[:4] if out[0] == "agg" else out[:3])
+
+    def parse(self) -> Query:
+        self.expect_kw("select")
+        star = self.accept_op("*")
+        projections = []
+        if not star:
+            projections.append(None)  # placeholder; fill after FROM known
+            # Projections may reference the table alias (s.col) declared
+            # AFTER them; tokenize positions now, parse after FROM.
+            proj_start = self.i - 0
+            # skip ahead to FROM to discover the alias
+            depth = 0
+            j = self.i
+            while j < len(self.toks):
+                k, v = self.toks[j]
+                if k == "op" and v == "(":
+                    depth += 1
+                elif k == "op" and v == ")":
+                    depth -= 1
+                elif k == "kw" and v == "from" and depth == 0:
+                    break
+                j += 1
+            else:
+                raise SQLError("missing FROM")
+            from_idx = j
+            alias = self._parse_from_at(from_idx)
+            self.i = proj_start
+            projections = [self.projection(alias)]
+            while self.accept_op(","):
+                projections.append(self.projection(alias))
+            if self.i != from_idx:
+                raise SQLError("unexpected tokens before FROM")
+            self.i = self._from_end
+        else:
+            k, v = self.peek()
+            if k != "kw" or v != "from":
+                raise SQLError("missing FROM")
+            alias = self._parse_from_at(self.i)
+            self.i = self._from_end
+        q = Query(projections=projections, star=star, alias=alias)
+        if self.accept_kw("where"):
+            q.where = self.expr(alias)
+        if self.accept_kw("limit"):
+            k, v = self.next()
+            if k != "number" or "." in v or int(v) < 0:
+                raise SQLError("LIMIT needs a non-negative integer")
+            q.limit = int(v)
+        if self.peek()[0] != "eof":
+            raise SQLError(f"unexpected trailing {self.peek()[1]!r}")
+        q.aggregate = any(p[0] == "agg" for p in q.projections)
+        if q.aggregate and any(p[0] != "agg" for p in q.projections):
+            raise SQLError("cannot mix aggregate and plain projections")
+        q.columns = list(dict.fromkeys(self.columns))
+        return q
+
+    def _parse_from_at(self, idx: int) -> str:
+        """Parse `FROM S3Object[.*] [alias]` starting at token idx;
+        records the end position in self._from_end."""
+        save = self.i
+        self.i = idx
+        self.expect_kw("from")
+        k, v = self.next()
+        if k != "ident" or v.lower() not in ("s3object",):
+            raise SQLError(f"FROM must be S3Object, got {v!r}")
+        # optional .* / ._1 style suffix (JSON documents) — accept and
+        # ignore .* for CSV semantics
+        if self.accept_op("."):
+            if not self.accept_op("*"):
+                k2, v2 = self.next()
+                if k2 not in ("ident", "qident"):
+                    raise SQLError("bad S3Object suffix")
+        alias = ""
+        k, v = self.peek()
+        if k == "ident":
+            alias = v
+            self.i += 1
+        elif k == "kw" and v == "as":
+            self.i += 1
+            k, v = self.next()
+            if k != "ident":
+                raise SQLError("bad alias")
+            alias = v
+        self._from_end = self.i
+        self.i = save
+        return alias
+
+
+def parse(text: str) -> Query:
+    return _Parser(_tokenize(text)).parse()

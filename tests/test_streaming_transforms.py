@@ -295,7 +295,10 @@ def test_192mib_encrypted_put_get_bounded_rss(tmp_path):
     a bucket first — created in-script via the object layer? No: via
     HTTP before measuring. Runs in a subprocess so other tests' RSS
     high-water marks can't mask a regression."""
-    script = _RSS_SCRIPT % {"repo": "/root/repo"}
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _RSS_SCRIPT % {"repo": repo_root}
     # add bucket creation just after server start
     script = script.replace(
         'SIZE = 192 * (1 << 20)',

@@ -5,6 +5,7 @@ cmd/global-heal.go, cmd/admin-heal-ops.go)."""
 
 from .heal import HealSequence, HealState, MRFHealer, heal_erasure_set
 from .monitor import DiskMonitor
+from .tracker import DataUpdateTracker
 from .scanner import (
     DataScanner,
     DataUsageInfo,
@@ -14,6 +15,6 @@ from .scanner import (
 
 __all__ = [
     "DataScanner", "DataUsageInfo", "DynamicSleeper", "parse_lifecycle",
-    "DiskMonitor",
+    "DataUpdateTracker", "DiskMonitor",
     "HealSequence", "HealState", "MRFHealer", "heal_erasure_set",
 ]

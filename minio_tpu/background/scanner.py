@@ -116,9 +116,14 @@ class DataScanner:
     USAGE_PATH = "scanner/data-usage.json"
     META_BUCKET = ".minio.sys"
 
+    # Unchanged buckets are skipped, but a periodic full pass still
+    # covers them so heal sampling and ILM never starve
+    # (ref dataUsageUpdateDirCycles = 16, cmd/data-scanner.go:48).
+    FULL_SCAN_CYCLES = 16
+
     def __init__(self, object_layer, bucket_meta=None, heal_prob: int = HEAL_OBJECT_SELECT_PROB,
                  sleeper: DynamicSleeper | None = None, metrics=None,
-                 logger=None):
+                 logger=None, tracker=None):
         self.ol = object_layer
         self.bm = bucket_meta
         self.heal_prob = max(1, heal_prob)
@@ -126,7 +131,9 @@ class DataScanner:
         self.metrics = metrics
         self.logger = logger
         self.usage = DataUsageInfo()
+        self.tracker = tracker
         self.cycles_completed = 0
+        self.buckets_skipped_last_cycle = 0
         self._counter = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -159,10 +166,42 @@ class DataScanner:
     # --- one cycle ---
 
     def scan_cycle(self) -> DataUsageInfo:
+        full_pass = (
+            self.tracker is None
+            or self.cycles_completed % self.FULL_SCAN_CYCLES == 0
+        )
+        if self.tracker is not None:
+            self.tracker.advance()
+        try:
+            return self._scan_cycle(full_pass)
+        except BaseException:
+            # A failed cycle must not swallow the change marks it
+            # consumed, or the next cycle would skip changed buckets.
+            if self.tracker is not None:
+                self.tracker.restore()
+            raise
+
+    def _scan_cycle(self, full_pass: bool) -> DataUsageInfo:
         usage = DataUsageInfo()
         now_ns = time.time_ns()
+        self.buckets_skipped_last_cycle = 0
         for b in self.ol.list_buckets():
             if b.name.startswith("."):
+                continue
+            # Bloom-gated skip (ref dataUpdateTracker consultation in
+            # scanDataFolder): an unchanged bucket reuses its previous
+            # usage entry with zero per-object work, except on the
+            # periodic full pass.
+            if (not full_pass
+                    and b.name in self.usage.buckets_usage
+                    and not self.tracker.changed_since_last_cycle(b.name)):
+                bu_prev = self.usage.buckets_usage[b.name]
+                usage.buckets_usage[b.name] = bu_prev
+                usage.objects_total_count += bu_prev.objects_count
+                usage.objects_total_size += bu_prev.objects_size
+                self.buckets_skipped_last_cycle += 1
+                if self.metrics is not None:
+                    self.metrics.inc("scanner_buckets_skipped_total")
                 continue
             rules = []
             if self.bm is not None:
@@ -195,6 +234,8 @@ class DataScanner:
         usage.last_update_ns = time.time_ns()
         self.usage = usage
         self.save_usage()
+        if self.tracker is not None:
+            self.tracker.save()
         self.cycles_completed += 1
         if self.metrics is not None:
             self.metrics.inc("scanner_cycles_total")

@@ -1,0 +1,152 @@
+"""Data update tracker: a persisted bloom filter of changed paths that
+lets the scanner skip unchanged subtrees — the equivalent of the
+reference's dataUpdateTracker (/root/reference/cmd/data-update-tracker.go:62,
+willf/bloom-backed, consulted per scan cycle and cycled via peer RPC).
+
+Writes mark their bucket (and optionally bucket/object) into the CURRENT
+filter. At the start of each scan cycle the scanner calls advance():
+current becomes the cycle's SNAPSHOT (what changed since the last scan)
+and a fresh current begins. Bloom false positives only cause extra
+scanning, never a missed change; a lost/corrupt persisted filter
+degrades to "everything changed" (full scan), matching the reference's
+recovery behavior.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+# ~1 Mbit / 7 hashes: <1% false positives up to ~100k distinct paths.
+_BITS = 1 << 20
+_HASHES = 7
+
+
+class _Bloom:
+    def __init__(self, bits: bytes | None = None):
+        self.bits = bytearray(bits) if bits else bytearray(_BITS // 8)
+
+    def _positions(self, key: str):
+        h = hashlib.sha256(key.encode()).digest()
+        a = int.from_bytes(h[:8], "little")
+        b = int.from_bytes(h[8:16], "little") | 1
+        for i in range(_HASHES):
+            yield (a + i * b) % _BITS
+
+    def add(self, key: str):
+        for p in self._positions(key):
+            self.bits[p >> 3] |= 1 << (p & 7)
+
+    def merge(self, other: "_Bloom"):
+        for i, b in enumerate(other.bits):
+            self.bits[i] |= b
+
+    def __contains__(self, key: str) -> bool:
+        return all(
+            self.bits[p >> 3] & (1 << (p & 7)) for p in self._positions(key)
+        )
+
+
+class DataUpdateTracker:
+    """Current + last-cycle bloom filters with .minio.sys persistence."""
+
+    PATH = "scanner/update-tracker.json"
+    META_BUCKET = ".minio.sys"
+
+    def __init__(self, object_layer=None):
+        self._ol = object_layer
+        self._lock = threading.Lock()
+        self._current = _Bloom()
+        self._snapshot: _Bloom | None = None  # None = unknown: scan all
+        self.marks = 0
+
+    # --- write-path hook (cheap; called from the object layer) ---
+
+    def mark(self, bucket: str, object_: str = ""):
+        with self._lock:
+            self._current.add(bucket)
+            if object_:
+                self._current.add(f"{bucket}/{object_}")
+            self.marks += 1
+
+    # --- scanner side ---
+
+    def advance(self):
+        """Start a new cycle: changes recorded so far become the snapshot
+        the scanner consults; new writes land in a fresh filter."""
+        with self._lock:
+            self._snapshot = self._current
+            self._current = _Bloom()
+
+    def restore(self):
+        """Abort the current cycle: fold the consumed snapshot back into
+        the live filter so a failed scan can't swallow change marks (the
+        next advance() re-surfaces them)."""
+        with self._lock:
+            if self._snapshot is not None:
+                self._current.merge(self._snapshot)
+                self._snapshot = None
+
+    def changed_since_last_cycle(self, bucket: str,
+                                 object_: str = "") -> bool:
+        """True when the path may have changed since the previous scan
+        (or when history is unknown — fresh start, lost state)."""
+        with self._lock:
+            if self._snapshot is None:
+                return True
+            key = f"{bucket}/{object_}" if object_ else bucket
+            # Writes during THIS cycle also count: the scanner must not
+            # go stale on a bucket that changed mid-scan.
+            return key in self._snapshot or key in self._current
+
+    # --- persistence (ref dataUpdateTracker .minio.sys blob) ---
+
+    def save(self):
+        if self._ol is None:
+            return
+        import base64
+        import io
+        import zlib
+
+        from ..utils.errors import ErrBucketNotFound, StorageError
+
+        with self._lock:
+            blob = json.dumps({
+                "current": base64.b64encode(
+                    zlib.compress(bytes(self._current.bits))
+                ).decode(),
+            }).encode()
+        try:
+            self._ol.put_object(self.META_BUCKET, self.PATH,
+                                io.BytesIO(blob), len(blob))
+        except ErrBucketNotFound:
+            try:
+                self._ol.make_bucket(self.META_BUCKET)
+                self._ol.put_object(self.META_BUCKET, self.PATH,
+                                    io.BytesIO(blob), len(blob))
+            except StorageError:
+                pass
+        except StorageError:
+            pass
+
+    def load(self):
+        if self._ol is None:
+            return
+        import base64
+        import zlib
+
+        from ..utils.errors import StorageError
+
+        try:
+            raw = self._ol.get_object_bytes(self.META_BUCKET, self.PATH)
+            d = json.loads(raw)
+            bits = zlib.decompress(base64.b64decode(d["current"]))
+            if len(bits) != _BITS // 8:
+                raise ValueError("tracker size mismatch")
+            with self._lock:
+                # Restored marks describe writes before the restart; they
+                # belong to "changed since the last completed scan".
+                self._current = _Bloom(bits)
+        except (StorageError, ValueError, KeyError):
+            pass  # unknown history -> first cycle scans everything

@@ -309,6 +309,18 @@ class ErasureObjects(MultipartMixin):
         list(_obj_pool.map(commit, range(n)))
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
+            # Undo the renames that DID land (ref undoRename /
+            # cmd/erasure-object.go:484): a sub-quorum commit must not
+            # leave a readable object behind on the minority disks.
+            undo_fi = FileInfo(volume=bucket, name=object_,
+                               version_id=version_id)
+            for i, e in enumerate(errs):
+                if e is not None or disks_by_shard[i] is None:
+                    continue
+                try:
+                    disks_by_shard[i].delete_version(bucket, object_, undo_fi)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
             self._cleanup_tmp(disks_by_shard, tmp_id)
             raise err
         # Partial write (quorum met, some disks failed): queue MRF heal

@@ -39,10 +39,16 @@ class ErasureServerPools:
         self._list_gen: dict[str, int] = {}
         self._gen_lock = threading.Lock()
         self._metacache = MetacacheManager()
+        # Optional DataUpdateTracker (background/tracker.py): every write
+        # that invalidates listings also marks the changed bucket so the
+        # scanner can skip unchanged ones (ref dataUpdateTracker hooks).
+        self.update_tracker = None
 
     def _bump_gen(self, bucket: str):
         with self._gen_lock:
             self._list_gen[bucket] = self._list_gen.get(bucket, 0) + 1
+        if self.update_tracker is not None:
+            self.update_tracker.mark(bucket)
 
     # --- pool routing ---
 
@@ -84,12 +90,16 @@ class ErasureServerPools:
     def make_bucket(self, bucket: str, opts: ObjectOptions | None = None):
         for pool in self.pools:
             pool.make_bucket(bucket)
+        if self.update_tracker is not None:
+            self.update_tracker.mark(bucket)
 
     def delete_bucket(self, bucket: str, force: bool = False):
         for pool in self.pools:
             pool.delete_bucket(bucket, force=force)
         self._metacache.invalidate_bucket(bucket)
         self._list_gen.pop(bucket, None)
+        if self.update_tracker is not None:
+            self.update_tracker.mark(bucket)
 
     def bucket_exists(self, bucket: str) -> bool:
         return any(p.bucket_exists(bucket) for p in self.pools)

@@ -143,9 +143,23 @@ class Server:
         self.mrf = MRFHealer(
             self.object_layer, metrics=self.metrics, logger=self.logger
         )
+        # Update tracker (bloom of changed buckets, persisted): writes
+        # mark it via the object layer; the scanner skips unchanged
+        # buckets (ref cmd/data-update-tracker.go).
+        from .background import DataUpdateTracker
+
+        # Only wire a tracker when the object layer actually marks it on
+        # writes (erasure pools do; FSObjects doesn't) — a never-marked
+        # tracker would make the scanner skip every bucket forever.
+        if hasattr(self.object_layer, "update_tracker"):
+            self.update_tracker = DataUpdateTracker(self.object_layer)
+            self.object_layer.update_tracker = self.update_tracker
+        else:
+            self.update_tracker = None
         self.scanner = DataScanner(
             self.object_layer, self.bucket_meta,
             metrics=self.metrics, logger=self.logger,
+            tracker=self.update_tracker,
         )
         # Disk liveness loop (ref monitorAndConnectEndpoints,
         # cmd/erasure-sets.go:282): offline detection + reconnect-driven
@@ -221,6 +235,8 @@ class Server:
             self.mrf.start()
             self.disk_monitor.start()
             if self._enable_scanner:
+                if self.update_tracker is not None:
+                    self.update_tracker.load()
                 self.scanner.start()
         self.s3.start()
         return self

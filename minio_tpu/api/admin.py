@@ -13,6 +13,7 @@ import queue
 import time
 
 from ..iam import Args, Policy
+from ..utils.errors import StorageError
 from .errors import S3Error
 from .handlers import Response
 
@@ -22,7 +23,7 @@ ADMIN_PREFIX = "/minio/admin/v3"
 class AdminHandlers:
     def __init__(self, object_layer, iam, config_sys=None, metrics=None,
                  trace=None, notification=None, lockers=None,
-                 bucket_meta=None, repl_pool=None):
+                 bucket_meta=None, repl_pool=None, tiers=None):
         self.ol = object_layer
         self.iam = iam
         self.config_sys = config_sys
@@ -32,6 +33,7 @@ class AdminHandlers:
         self.lockers = lockers
         self.bm = bucket_meta
         self.repl = repl_pool
+        self.tiers = tiers
         self.started = time.time()
 
     # --- routing ---
@@ -68,6 +70,9 @@ class AdminHandlers:
             ("GET", "replication-stats"): "replication_stats",
             ("PUT", "set-bucket-quota"): "set_bucket_quota",
             ("GET", "get-bucket-quota"): "get_bucket_quota",
+            ("PUT", "add-tier"): "add_tier",
+            ("GET", "list-tiers"): "list_tiers",
+            ("DELETE", "remove-tier"): "remove_tier",
         }
         name = table.get((m, head))
         if name is None:
@@ -102,6 +107,9 @@ class AdminHandlers:
         "remove_remote_target": "admin:SetBucketTarget",
         "set_bucket_quota": "admin:SetBucketQuota",
         "get_bucket_quota": "admin:GetBucketQuota",
+        "add_tier": "admin:SetTier",
+        "list_tiers": "admin:ListTier",
+        "remove_tier": "admin:SetTier",
         "replication_stats": "admin:ReplicationDiff",
     }
 
@@ -382,6 +390,52 @@ class AdminHandlers:
 
     # --- replication targets (ref cmd/admin-bucket-handlers.go
     # --- SetRemoteTargetHandler / ListRemoteTargetsHandler) ---
+
+    # ---------- remote tiers (ref the madmin tier registry / tier admin
+    # handlers behind ILM transitions) ----------
+
+    def add_tier(self, ctx) -> Response:
+        if self.tiers is None:
+            raise S3Error("NotImplemented", "no tier manager")
+        try:
+            d = json.loads(ctx.body)
+            self.tiers.add(
+                d.get("name", ""), d.get("endpoint", ""),
+                d.get("access_key", ""), d.get("secret_key", ""),
+                d.get("bucket", ""), d.get("prefix", ""),
+            )
+        except (ValueError, TypeError, AttributeError) as exc:
+            raise S3Error("InvalidArgument", f"bad tier: {exc}") from exc
+        except StorageError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        return self._json({"status": "ok"})
+
+    def list_tiers(self, ctx) -> Response:
+        if self.tiers is None:
+            raise S3Error("NotImplemented", "no tier manager")
+        return self._json(self.tiers.list())
+
+    def remove_tier(self, ctx) -> Response:
+        if self.tiers is None:
+            raise S3Error("NotImplemented", "no tier manager")
+        name = ctx.qdict.get("name", "")
+        if not name:
+            raise S3Error("InvalidArgument", "name required")
+        # Refuse removing a tier any lifecycle config still points at —
+        # its registry entry is the only copy of the credentials that
+        # make transitioned objects readable (ref: the reference refuses
+        # to remove in-use tiers).
+        if self.bm is not None:
+            for b in self.ol.list_buckets():
+                lc = self.bm.get(b.name).lifecycle_xml or ""
+                if name.upper() in lc.upper():
+                    raise S3Error(
+                        "InvalidArgument",
+                        f"tier {name!r} is referenced by bucket "
+                        f"{b.name!r} lifecycle configuration",
+                    )
+        self.tiers.remove(name)
+        return self._json({"status": "ok"})
 
     # ---------- bucket quota (ref cmd/admin-bucket-handlers.go
     # PutBucketQuotaConfigHandler / GetBucketQuotaConfigHandler) ----------

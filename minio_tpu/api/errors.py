@@ -77,6 +77,7 @@ API_ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("NoSuchCORSConfiguration", "The CORS configuration does not exist.", HTTPStatus.NOT_FOUND),
     _E("NoSuchWebsiteConfiguration", "The specified bucket does not have a website configuration.", HTTPStatus.NOT_FOUND),
     _E("QuotaExceeded", "Bucket quota exceeded.", HTTPStatus.CONFLICT),
+    _E("InvalidObjectState", "The operation is not valid for the current state of the object.", HTTPStatus.FORBIDDEN),
     _E("ServiceUnavailable", "The server is currently unavailable.", HTTPStatus.SERVICE_UNAVAILABLE),
 ]}
 
@@ -131,6 +132,7 @@ def from_object_error(exc: Exception) -> "S3Error":
         (oe.ErrBadDigest, "BadDigest"),
         (oe.ErrOperationTimedOut, "SlowDown"),
         (oe.ErrQuotaExceeded, "QuotaExceeded"),
+        (oe.ErrRemoteTier, "ServiceUnavailable"),
     ]
     for etype, code in mapping:
         if isinstance(exc, etype):

@@ -217,7 +217,8 @@ class S3ApiHandlers:
     """All S3 endpoints bound to an ObjectLayer + subsystems."""
 
     def __init__(self, object_layer, bucket_meta, iam, notify=None,
-                 config=None, sse_config=None, repl_pool=None, quota=None):
+                 config=None, sse_config=None, repl_pool=None, quota=None,
+                 tier_engine=None):
         from ..bucket.quota import BucketQuotaSys
 
         self.ol = object_layer
@@ -228,6 +229,7 @@ class S3ApiHandlers:
         self.sse_config = sse_config
         self.repl = repl_pool
         self.quota = quota or BucketQuotaSys(object_layer, bucket_meta)
+        self.tier_engine = tier_engine
 
     # ---------- object lock helpers (ref cmd/bucket-object-lock.go) -------
 
@@ -1133,16 +1135,19 @@ class S3ApiHandlers:
             headers["X-Amz-Replication-Status"] = (
                 oi.user_defined[REPL_STATUS_KEY]
             )
+        from .. import tier as tiermod
         from ..bucket import objectlock as ol_mod
 
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
             elif k in (ol_mod.META_MODE, ol_mod.META_RETAIN_UNTIL,
-                       ol_mod.META_LEGAL_HOLD):
+                       ol_mod.META_LEGAL_HOLD, tiermod.META_RESTORE):
                 headers[k] = v
             elif k in _REMEMBERED_HEADERS and k != "content-type":
                 headers[k.title()] = v
+        if tiermod.is_transitioned(oi.user_defined):
+            headers["x-amz-storage-class"] = oi.user_defined[tiermod.META_TIER]
         for qk, hk in _RESPONSE_OVERRIDES.items():
             if qk in ctx.qdict:
                 headers[hk] = ctx.qdict[qk]
@@ -1161,12 +1166,66 @@ class S3ApiHandlers:
         if early is not None:
             return early
         from . import transforms
+        from .. import tier as tiermod
 
         resp_extra: dict = {}
         transformed = transforms.is_transformed(oi.user_defined)
         logical_size = transforms.actual_object_size(oi.user_defined, oi.size)
         rng = parse_range(ctx.headers.get("range", ""), logical_size)
         offset, length = (rng if rng else (0, logical_size))
+        if tiermod.is_transitioned(oi.user_defined) and not \
+                tiermod.is_restored(oi.user_defined):
+            # Transitioned object: stored bytes live on the remote tier;
+            # fetch them and run the normal transform inversion (the
+            # sealed key/markers never left the local metadata). The
+            # reference serves tiered objects transparently the same way
+            # (cmd/bucket-lifecycle.go getTransitionedObjectReader).
+            if self.tier_engine is None:
+                raise S3Error("InvalidObjectState",
+                              "object is transitioned and no tier engine "
+                              "is configured")
+            try:
+                spool, tier_name = self.tier_engine.open_remote_spool(
+                    oi.user_defined
+                )
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+            # Validate keys now, before the status line goes out.
+            _probe, _, resp_extra = transforms.build_get_chain(
+                oi.user_defined, ctx.headers, self.sse_config,
+                ctx.bucket, ctx.object, _NullSink(),
+                offset=offset, length=length,
+            )
+            del _probe
+
+            def stream(dst, _spool=spool):
+                try:
+                    chain, closers, _ = transforms.build_get_chain(
+                        oi.user_defined, ctx.headers, self.sse_config,
+                        ctx.bucket, ctx.object, dst,
+                        offset=offset, length=length,
+                    )
+                    while True:
+                        chunk = _spool.read(1 << 20)
+                        if not chunk:
+                            break
+                        chain.write(chunk)
+                    for c in closers:
+                        c.close()
+                finally:
+                    _spool.close()
+
+            headers = self._object_headers(ctx, oi)
+            headers.update(resp_extra)
+            headers["Content-Length"] = str(length)
+            headers["x-amz-storage-class"] = tier_name
+            self._event("s3:ObjectAccessed:Get", ctx.bucket, oi=oi)
+            if rng:
+                headers["Content-Range"] = (
+                    f"bytes {offset}-{offset + length - 1}/{logical_size}"
+                )
+                return Response(206, headers, body_stream=stream)
+            return Response(200, headers, body_stream=stream)
         if transformed:
             # Streaming decrypt/decompress writer chain onto the socket
             # (ref NewGetObjectReader, cmd/object-api-utils.go:595): the
@@ -1290,6 +1349,32 @@ class S3ApiHandlers:
              "Content-Length": str(total)},
             body_stream=stream,
         )
+
+    def restore_object(self, ctx) -> Response:
+        """POST ?restore: materialize a temporary local copy of a
+        transitioned object (ref PostRestoreObjectHandler,
+        cmd/bucket-lifecycle.go:369)."""
+        self._check_bucket(ctx.bucket)
+        if self.tier_engine is None:
+            raise S3Error("NotImplemented", "no tier engine configured")
+        days = 1
+        if ctx.body:
+            try:
+                root = ET.fromstring(ctx.body)
+                for el in root.iter():
+                    if el.tag.endswith("Days"):
+                        days = max(1, int((el.text or "1").strip()))
+            except (ET.ParseError, ValueError) as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+        from ..utils.errors import ErrInvalidArgument
+
+        try:
+            self.tier_engine.restore(ctx.bucket, ctx.object, days)
+        except ErrInvalidArgument as exc:
+            raise S3Error("InvalidObjectState", str(exc)) from exc
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(202)
 
     def head_object(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)

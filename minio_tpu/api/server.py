@@ -71,6 +71,7 @@ _ACTIONS = {
     "object_retention": "s3:GetObjectRetention",
     "object_legal_hold": "s3:GetObjectLegalHold",
     "select_object_content": "s3:GetObject",
+    "restore_object": "s3:RestoreObject",
     "head_object": "s3:GetObject",
     "delete_object": "s3:DeleteObject",
     "new_multipart_upload": "s3:PutObject",
@@ -247,6 +248,8 @@ def route(ctx: RequestContext) -> str:
             return "complete_multipart_upload"
         if "select" in q and q.get("select-type") == "2":
             return "select_object_content"
+        if "restore" in q:
+            return "restore_object"
         raise S3Error("MethodNotAllowed", f"POST {ctx.object}")
     if m == "DELETE":
         if "uploadId" in q:
@@ -270,7 +273,8 @@ class S3Server:
                  notify=None, region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
-                 sse_config=None, quota=None):
+                 sse_config=None, quota=None, tier_engine=None,
+                 tiers=None):
         from ..replication import ReplicationPool
 
         self.repl_pool = ReplicationPool(
@@ -280,11 +284,12 @@ class S3Server:
             object_layer, bucket_meta, iam, notify,
             config=config_sys.config if config_sys is not None else None,
             sse_config=sse_config, repl_pool=self.repl_pool, quota=quota,
+            tier_engine=tier_engine,
         )
         self.admin = AdminHandlers(
             object_layer, iam, config_sys=config_sys, metrics=metrics,
             trace=trace, notification=notification,
-            bucket_meta=bucket_meta, repl_pool=self.repl_pool,
+            bucket_meta=bucket_meta, repl_pool=self.repl_pool, tiers=tiers,
         )
         self.iam = iam
         self.region = region

@@ -81,8 +81,8 @@ class DynamicSleeper:
 
 
 def parse_lifecycle(xml_text: str) -> list[dict]:
-    """Parse ILM rules: Expiration Days/Date on optional prefix filter
-    (subset of pkg/bucket/lifecycle)."""
+    """Parse ILM rules: Expiration Days and Transition Days/StorageClass
+    on an optional prefix filter (subset of pkg/bucket/lifecycle)."""
     if not xml_text:
         return []
     try:
@@ -102,9 +102,13 @@ def parse_lifecycle(xml_text: str) -> list[dict]:
             or rule.findtext(f"{ns}Prefix") or ""
         )
         exp_days = rule.findtext(f"{ns}Expiration/{ns}Days")
+        trans_days = rule.findtext(f"{ns}Transition/{ns}Days")
+        trans_sc = rule.findtext(f"{ns}Transition/{ns}StorageClass") or ""
         rules.append({
             "prefix": prefix,
             "expire_days": int(exp_days) if exp_days else None,
+            "transition_days": int(trans_days) if trans_days else None,
+            "transition_tier": trans_sc,
         })
     return rules
 
@@ -123,7 +127,7 @@ class DataScanner:
 
     def __init__(self, object_layer, bucket_meta=None, heal_prob: int = HEAL_OBJECT_SELECT_PROB,
                  sleeper: DynamicSleeper | None = None, metrics=None,
-                 logger=None, tracker=None):
+                 logger=None, tracker=None, tier_engine=None):
         self.ol = object_layer
         self.bm = bucket_meta
         self.heal_prob = max(1, heal_prob)
@@ -132,6 +136,7 @@ class DataScanner:
         self.logger = logger
         self.usage = DataUsageInfo()
         self.tracker = tracker
+        self.tier_engine = tier_engine
         self.cycles_completed = 0
         self.buckets_skipped_last_cycle = 0
         self._counter = 0
@@ -246,13 +251,13 @@ class DataScanner:
 
     def _apply_lifecycle(self, bucket: str, oi, rules: list[dict],
                          now_ns: int) -> bool:
+        from .. import tier as tiermod
+
+        age_days = (now_ns - oi.mod_time_ns) / 1e9 / 86400
         for r in rules:
-            if r["expire_days"] is None:
-                continue
             if r["prefix"] and not oi.name.startswith(r["prefix"]):
                 continue
-            age_days = (now_ns - oi.mod_time_ns) / 1e9 / 86400
-            if age_days >= r["expire_days"]:
+            if r["expire_days"] is not None and age_days >= r["expire_days"]:
                 try:
                     self.ol.delete_object(bucket, oi.name)
                     if self.metrics is not None:
@@ -261,6 +266,27 @@ class DataScanner:
                 except StorageError as exc:
                     if self.logger is not None:
                         self.logger.log_once_if(exc, f"ilm:{bucket}")
+            if (r.get("transition_days") is not None
+                    and r.get("transition_tier")
+                    and self.tier_engine is not None
+                    and age_days >= r["transition_days"]
+                    and not tiermod.is_transitioned(oi.user_defined)):
+                try:
+                    self.tier_engine.transition(
+                        bucket, oi.name, r["transition_tier"]
+                    )
+                except Exception as exc:  # noqa: BLE001 - retried next cycle
+                    if self.logger is not None:
+                        self.logger.log_once_if(exc, f"tier:{bucket}")
+        # Expired restored copies fall back to metadata-only.
+        if (self.tier_engine is not None
+                and tiermod.is_transitioned(oi.user_defined)):
+            try:
+                self.tier_engine.expire_restored(bucket, oi.name,
+                                                 oi.user_defined)
+            except Exception as exc:  # noqa: BLE001
+                if self.logger is not None:
+                    self.logger.log_once_if(exc, f"tier-expire:{bucket}")
         return False
 
     def _heal_one(self, bucket: str, object_: str):

@@ -255,7 +255,7 @@ class ErasureObjects(MultipartMixin):
                     except Exception:  # noqa: BLE001
                         pass
 
-        mod_time_ns = time.time_ns()
+        mod_time_ns = opts.mod_time_ns or time.time_ns()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         etag = tee.md5_hex()
         if opts.want_md5_hex and etag != opts.want_md5_hex:
@@ -389,6 +389,90 @@ class ErasureObjects(MultipartMixin):
 
         list(_obj_pool.map(do, range(len(self.disks))))
         return new_mod_time
+
+    # ------------------------------------------------------------------
+    # ILM tiering primitives (ref transitionObject / RestoreTransitioned,
+    # cmd/bucket-lifecycle.go:296+): the TierEngine ships stored bytes
+    # to/from the remote tier; these two rewrite local state.
+
+    def transition_object(self, bucket: str, object_: str, version_id: str,
+                          updates: dict,
+                          expected_mod_time_ns: int | None = None) -> None:
+        """Free the version's local shard data, keep its xl.meta with
+        `updates` merged in (a None value deletes the key).
+
+        `expected_mod_time_ns` is the optimistic-concurrency guard for
+        the tier engine: the upload happened OUTSIDE the lock, so if the
+        version changed meanwhile the commit must abort (the uploaded
+        remote blob is stale). Metadata commits BEFORE part deletion —
+        a crash between the two steps leaves orphaned part files, never
+        a version whose data is gone with no tier pointer."""
+        with self._locked_write(bucket, object_):
+            fi, fis, _ = self._read_quorum_file_info(
+                bucket, object_, version_id, read_data=True
+            )
+            if (expected_mod_time_ns is not None
+                    and fi.mod_time_ns != expected_mod_time_ns):
+                raise ErrInvalidArgument(
+                    f"{bucket}/{object_} changed during transition"
+                )
+            new_meta = dict(fi.metadata)
+            for k, v in updates.items():
+                if v is None:
+                    new_meta.pop(k, None)
+                else:
+                    new_meta[k] = v
+
+            committed: list = [False] * len(self.disks)
+
+            def commit_meta(i):
+                disk = self.disks[i]
+                meta = fis[i]
+                if disk is None or meta is None:
+                    return
+                m = FileInfo.from_dict(meta.to_dict())
+                m.volume, m.name = bucket, object_
+                m.metadata = dict(new_meta)
+                m.data = {}
+                try:
+                    disk.update_metadata(bucket, object_, m)
+                    committed[i] = True
+                except Exception:  # noqa: BLE001 - best effort per disk
+                    pass
+
+            def drop_parts(i):
+                disk = self.disks[i]
+                meta = fis[i]
+                if disk is None or meta is None or not committed[i]:
+                    return
+                if meta.data_dir:
+                    for part in meta.parts:
+                        try:
+                            disk.delete(
+                                bucket,
+                                f"{object_}/{meta.data_dir}/part.{part.number}",
+                            )
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
+
+            list(_obj_pool.map(commit_meta, range(len(self.disks))))
+            list(_obj_pool.map(drop_parts, range(len(self.disks))))
+
+    def restore_object(self, bucket: str, object_: str, version_id: str,
+                       reader, size: int, updates: dict) -> None:
+        """Write the version's stored bytes back locally (temporary
+        restore of a transitioned object), preserving its metadata and
+        version id, with `updates` merged in."""
+        fi, _, _ = self._read_quorum_file_info(bucket, object_, version_id)
+        meta = dict(fi.metadata)
+        meta.update(updates)
+        opts = ObjectOptions(
+            version_id=version_id or "",
+            versioned=bool(version_id),
+            user_defined={k: v for k, v in meta.items() if k != "etag"},
+            mod_time_ns=fi.mod_time_ns,
+        )
+        self.put_object(bucket, object_, reader, size, opts)
 
     def _cleanup_tmp(self, disks: list, tmp_id: str):
         for disk in disks:

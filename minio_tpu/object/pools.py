@@ -403,6 +403,33 @@ class ErasureServerPools:
                 last_exc = exc
         raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
 
+    def transition_object(self, bucket, object_, version_id, updates,
+                          expected_mod_time_ns=None):
+        last_exc = None
+        for pool in self.pools:
+            try:
+                out = pool.transition_object(
+                    bucket, object_, version_id, updates,
+                    expected_mod_time_ns=expected_mod_time_ns)
+                self._bump_gen(bucket)
+                return out
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
+
+    def restore_object(self, bucket, object_, version_id, reader, size,
+                       updates):
+        last_exc = None
+        for pool in self.pools:
+            try:
+                out = pool.restore_object(bucket, object_, version_id,
+                                          reader, size, updates)
+                self._bump_gen(bucket)
+                return out
+            except (ErrObjectNotFound, ErrVersionNotFound) as exc:
+                last_exc = exc
+        raise last_exc or ErrObjectNotFound(f"{bucket}/{object_}")
+
     # --- heal ---
 
     def heal_object(self, bucket, object_, version_id="", remove_dangling=False):

@@ -243,6 +243,19 @@ class ErasureSets:
             bucket, object_, version_id, updates, replace_user_meta
         )
 
+    def transition_object(self, bucket, object_, version_id, updates,
+                          expected_mod_time_ns=None):
+        return self.get_hashed_set(object_).transition_object(
+            bucket, object_, version_id, updates,
+            expected_mod_time_ns=expected_mod_time_ns,
+        )
+
+    def restore_object(self, bucket, object_, version_id, reader, size,
+                       updates):
+        return self.get_hashed_set(object_).restore_object(
+            bucket, object_, version_id, reader, size, updates
+        )
+
     def heal_object(self, bucket, object_, version_id="", remove_dangling=False):
         return self.get_hashed_set(object_).heal_object(
             bucket, object_, version_id, remove_dangling

@@ -21,6 +21,10 @@ class ObjectOptions:
     delete_marker: bool = False
     no_lock: bool = False
     part_number: int = 0
+    # Preserve/override the commit mod time (0 = stamp now). Restores of
+    # transitioned objects keep the original Last-Modified (AWS restore
+    # does not touch it).
+    mod_time_ns: int = 0
     # Expected hex MD5 of the incoming bytes (from Content-MD5). Verified
     # against the streamed digest BEFORE commit so a mismatch aborts with
     # no object left behind (ref pkg/hash/reader.go wired at

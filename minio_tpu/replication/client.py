@@ -93,6 +93,33 @@ class S3Client:
             raise S3Error(st, body)
         return body, h
 
+    def get_object_to(self, bucket: str, key: str, dst,
+                      version_id: str = "") -> dict:
+        """Stream a GET body into `dst` in 1 MiB chunks (never holds the
+        object in memory); returns the response headers."""
+        query = [("versionId", version_id)] if version_id else []
+        qs = urllib.parse.urlencode(query)
+        path = f"/{bucket}/{key}"
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        headers = sign_v4_request(
+            self.secret_key, self.access_key, "GET", self.endpoint,
+            path, query, {}, b"", region=self.region,
+        )
+        conn = http.client.HTTPConnection(self.endpoint, timeout=self.timeout)
+        try:
+            conn.request("GET", url, headers=headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise S3Error(resp.status, resp.read())
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                dst.write(chunk)
+            return dict(resp.getheaders())
+        finally:
+            conn.close()
+
     def head_object(self, bucket: str, key: str,
                     version_id: str = "") -> dict:
         q = [("versionId", version_id)] if version_id else []

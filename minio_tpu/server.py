@@ -156,10 +156,19 @@ class Server:
             self.object_layer.update_tracker = self.update_tracker
         else:
             self.update_tracker = None
+        # Remote tiers + the ILM transition engine (ref
+        # cmd/bucket-lifecycle.go transitionState).
+        from .tier import TierConfigMgr, TierEngine
+
+        self.tiers = TierConfigMgr(self.object_layer)
+        self.tier_engine = TierEngine(
+            self.object_layer, self.tiers, metrics=self.metrics,
+            logger=self.logger,
+        ) if hasattr(self.object_layer, "transition_object") else None
         self.scanner = DataScanner(
             self.object_layer, self.bucket_meta,
             metrics=self.metrics, logger=self.logger,
-            tracker=self.update_tracker,
+            tracker=self.update_tracker, tier_engine=self.tier_engine,
         )
         # Disk liveness loop (ref monitorAndConnectEndpoints,
         # cmd/erasure-sets.go:282): offline detection + reconnect-driven
@@ -198,6 +207,7 @@ class Server:
             # cache over loadDataUsageFromBackend).
             quota=BucketQuotaSys(self.object_layer, self.bucket_meta,
                                  usage_fn=_scanner_usage),
+            tier_engine=self.tier_engine, tiers=self.tiers,
         )
         self.started_ns = time.time_ns()
 
@@ -234,6 +244,9 @@ class Server:
             # scanner load — they run regardless of enable_scanner.
             self.mrf.start()
             self.disk_monitor.start()
+            # Tier configs gate READS of transitioned objects — load
+            # them regardless of whether the scanner runs.
+            self.tiers.load()
             if self._enable_scanner:
                 if self.update_tracker is not None:
                     self.update_tracker.load()

@@ -1,0 +1,245 @@
+"""Remote tier management + object transition/restore — the ILM tiering
+half of the reference's lifecycle engine
+(/root/reference/cmd/bucket-lifecycle.go:109-369 transitionState /
+transitionObject / PostRestoreObjectHandler, tier registry in
+cmd/tier.go-era config).
+
+Design: a tier is a named remote S3 target (reusing the replication
+S3Client). Transition ships the object's STORED bytes (post
+compression/SSE — the sealed key and markers stay in the LOCAL
+metadata, so the remote tier never sees plaintext or keys) to
+`<prefix>/<bucket>/<object>/<uuid>`, then drops the local shard data
+while keeping the xl.meta version with a transition marker. GET serves
+transitioned objects by streaming the stored bytes back from the tier
+through the normal transform inversion; POST ?restore materializes a
+temporary local copy with an expiry the scanner enforces.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+from .storage.fileinfo import new_uuid
+from .utils.errors import ErrInvalidArgument, StorageError
+
+# Internal metadata keys on a transitioned version
+META_TIER = "x-mtpu-internal-transition-tier"
+META_TIER_KEY = "x-mtpu-internal-transition-key"
+META_RESTORE = "x-amz-restore"
+
+TIERS_PATH = "config/tiers.json"
+META_BUCKET = ".minio.sys"
+
+
+class TierConfigMgr:
+    """Named remote tiers, persisted under .minio.sys (ref the madmin
+    tier registry)."""
+
+    def __init__(self, object_layer):
+        self._ol = object_layer
+        self._lock = threading.Lock()
+        self._tiers: dict[str, dict] = {}
+
+    def load(self):
+        try:
+            raw = self._ol.get_object_bytes(META_BUCKET, TIERS_PATH)
+            with self._lock:
+                self._tiers = json.loads(raw)
+        except (StorageError, ValueError):
+            pass
+
+    def save(self):
+        from .utils.errors import ErrBucketNotFound
+
+        with self._lock:
+            raw = json.dumps(self._tiers).encode()
+        try:
+            self._ol.put_object(META_BUCKET, TIERS_PATH,
+                                io.BytesIO(raw), len(raw))
+        except ErrBucketNotFound:
+            self._ol.make_bucket(META_BUCKET)
+            self._ol.put_object(META_BUCKET, TIERS_PATH,
+                                io.BytesIO(raw), len(raw))
+
+    def add(self, name: str, endpoint: str, access_key: str,
+            secret_key: str, bucket: str, prefix: str = ""):
+        if not name or not endpoint or not bucket:
+            raise ErrInvalidArgument("tier needs name, endpoint, bucket")
+        with self._lock:
+            self._tiers[name.upper()] = {
+                "endpoint": endpoint, "access_key": access_key,
+                "secret_key": secret_key, "bucket": bucket,
+                "prefix": prefix.strip("/"),
+            }
+        self.save()
+
+    def remove(self, name: str):
+        with self._lock:
+            self._tiers.pop(name.upper(), None)
+        self.save()
+
+    def get(self, name: str) -> dict | None:
+        with self._lock:
+            return self._tiers.get(name.upper())
+
+    def list(self) -> dict:
+        with self._lock:
+            return {
+                k: {kk: vv for kk, vv in v.items() if kk != "secret_key"}
+                for k, v in self._tiers.items()
+            }
+
+    def client(self, name: str):
+        from .replication.client import S3Client
+
+        t = self.get(name)
+        if t is None:
+            raise ErrInvalidArgument(f"unknown tier {name!r}")
+        return S3Client(t["endpoint"], t["access_key"], t["secret_key"]), t
+
+
+def remote_key(tier_cfg: dict, bucket: str, object_: str) -> str:
+    prefix = tier_cfg.get("prefix", "")
+    base = f"{bucket}/{object_}/{new_uuid()}"
+    return f"{prefix}/{base}" if prefix else base
+
+
+def is_transitioned(user_defined: dict) -> bool:
+    return bool(user_defined.get(META_TIER))
+
+
+def is_restored(user_defined: dict, now_s: float | None = None) -> bool:
+    """True while a restored copy is live locally."""
+    v = user_defined.get(META_RESTORE, "")
+    if 'ongoing-request="false"' not in v:
+        return False
+    import calendar
+
+    m = v.split('expiry-date="')
+    if len(m) < 2:
+        return False
+    try:
+        expiry = calendar.timegm(time.strptime(
+            m[1].split('"')[0], "%a, %d %b %Y %H:%M:%S %Z"
+        ))
+    except ValueError:
+        return False
+    return (now_s or time.time()) < expiry
+
+
+def restore_header(days: int, now_s: float | None = None) -> str:
+    expiry = (now_s or time.time()) + days * 86400
+    stamp = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(expiry))
+    return f'ongoing-request="false", expiry-date="{stamp}"'
+
+
+class TierEngine:
+    """Transition/fetch/restore over one object layer + tier registry."""
+
+    def __init__(self, object_layer, tiers: TierConfigMgr, metrics=None,
+                 logger=None):
+        self.ol = object_layer
+        self.tiers = tiers
+        self.metrics = metrics
+        self.logger = logger
+
+    @staticmethod
+    def _remote_errors():
+        """Exception types from tier HTTP IO that must surface as the
+        retriable ErrRemoteTier, never a generic 500."""
+        import http.client
+        import socket
+
+        from .replication.client import S3Error as ClientError
+
+        return (ClientError, OSError, socket.timeout,
+                http.client.HTTPException)
+
+    def transition(self, bucket: str, object_: str, tier_name: str):
+        """Move an object's stored bytes to the tier and free local data
+        (ref transitionObject, cmd/bucket-lifecycle.go:296+). The upload
+        happens WITHOUT the object lock; the commit carries the observed
+        mod time so a write that raced the upload aborts the transition
+        (the object stays local, retried next cycle)."""
+        from .object.types import ObjectOptions
+        from .utils.errors import ErrRemoteTier
+
+        client, cfg = self.tiers.client(tier_name)
+        info = self.ol.get_object_info(bucket, object_)
+        if is_transitioned(info.user_defined):
+            return
+        rkey = remote_key(cfg, bucket, object_)
+        import tempfile
+
+        with tempfile.SpooledTemporaryFile(max_size=8 << 20) as spool:
+            self.ol.get_object(bucket, object_, spool,
+                               opts=ObjectOptions())
+            spool.seek(0)
+            try:
+                client.put_object(cfg["bucket"], rkey, spool)
+            except self._remote_errors() as exc:
+                raise ErrRemoteTier(f"tier {tier_name}: {exc}") from exc
+        self.ol.transition_object(
+            bucket, object_, info.version_id or "",
+            {META_TIER: tier_name.upper(), META_TIER_KEY: rkey},
+            expected_mod_time_ns=info.mod_time_ns,
+        )
+        if self.metrics is not None:
+            self.metrics.inc("ilm_transitioned_total")
+
+    def open_remote_spool(self, user_defined: dict, max_memory: int = 8 << 20):
+        """(spool, tier_name) of a transitioned object's stored data —
+        SpooledTemporaryFile positioned at 0, caller closes. Disk-backed
+        past max_memory so huge tiered objects never sit in RAM."""
+        import tempfile
+
+        from .utils.errors import ErrRemoteTier
+
+        tier_name = user_defined.get(META_TIER, "")
+        rkey = user_defined.get(META_TIER_KEY, "")
+        client, cfg = self.tiers.client(tier_name)
+        spool = tempfile.SpooledTemporaryFile(max_size=max_memory)
+        try:
+            try:
+                client.get_object_to(cfg["bucket"], rkey, spool)
+            except self._remote_errors() as exc:
+                raise ErrRemoteTier(f"tier {tier_name}: {exc}") from exc
+            spool.seek(0)
+        except BaseException:
+            spool.close()
+            raise
+        return spool, tier_name
+
+    def restore(self, bucket: str, object_: str, days: int):
+        """Materialize a temporary local copy (ref PostRestoreObject)."""
+        info = self.ol.get_object_info(bucket, object_)
+        if not is_transitioned(info.user_defined):
+            raise ErrInvalidArgument("object is not transitioned")
+        spool, _ = self.open_remote_spool(info.user_defined)
+        with spool:
+            spool.seek(0, io.SEEK_END)
+            size = spool.tell()
+            spool.seek(0)
+            self.ol.restore_object(
+                bucket, object_, info.version_id or "", spool,
+                size, {META_RESTORE: restore_header(days)},
+            )
+        if self.metrics is not None:
+            self.metrics.inc("ilm_restored_total")
+
+    def expire_restored(self, bucket: str, object_: str,
+                        user_defined: dict) -> bool:
+        """Drop an expired restored copy back to metadata-only."""
+        if not is_transitioned(user_defined):
+            return False
+        if META_RESTORE not in user_defined or is_restored(user_defined):
+            return False
+        info = self.ol.get_object_info(bucket, object_)
+        self.ol.transition_object(
+            bucket, object_, info.version_id or "",
+            {META_RESTORE: None},
+        )
+        return True

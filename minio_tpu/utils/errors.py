@@ -123,6 +123,11 @@ class ErrQuotaExceeded(StorageError):
     cmd/bucket-quota.go:check)."""
 
 
+class ErrRemoteTier(StorageError):
+    """Remote tier unreachable / remote blob missing (ref the tiering
+    error paths in cmd/bucket-lifecycle.go) — retriable 503."""
+
+
 class ErrOperationTimedOut(StorageError):
     """Namespace-lock acquisition timed out (ref: OperationTimedOut,
     cmd/typed-errors.go) — surfaces as a retriable 503 instead of a

@@ -198,7 +198,8 @@ def test_parse_lifecycle_and_expiry(tmp_path):
         <Expiration><Days>1</Days></Expiration></Rule>
     </LifecycleConfiguration>"""
     rules = parse_lifecycle(xml_text)
-    assert rules == [{"prefix": "tmp/", "expire_days": 1}]
+    assert rules == [{"prefix": "tmp/", "expire_days": 1,
+                      "transition_days": None, "transition_tier": ""}]
 
     ol, _ = make_layer(tmp_path)
     ol.make_bucket("ilmbkt")

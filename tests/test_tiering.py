@@ -1,0 +1,181 @@
+"""ILM tiering: transition to a remote tier (a second in-process
+cluster), transparent reads of tiered objects, restore + restored-copy
+expiry (ref cmd/bucket-lifecycle.go:109-369)."""
+
+import io
+import time
+
+import pytest
+
+from minio_tpu.api import S3Server
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.crypto import SSEConfig
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.tier import TierConfigMgr, TierEngine, is_transitioned
+from minio_tpu import tier as tiermod
+from tests.test_s3_api import Client
+
+AK, SK = "tpuadmin", "tpuadmin-secret-key"
+
+
+def _mk_cluster(tmp_path, tag, tier_engine=None, tiers=None):
+    disks = [LocalStorage(str(tmp_path / f"{tag}{i}"), endpoint=f"{tag}{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4,
+        deployment_id=f"{tag * 8}-{tag * 4}-{tag * 4}-{tag * 4}-{tag * 12}",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    srv = S3Server(ol, IAMSys(AK, SK), BucketMetadataSys(ol),
+                   sse_config=SSEConfig("root"),
+                   tier_engine=tier_engine, tiers=tiers).start()
+    return ol, srv
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """(local_ol, local_client, engine, remote_ol): local cluster tiered
+    to a second cluster named COLD."""
+    remote_ol, remote_srv = _mk_cluster(tmp_path, "b")
+    remote_ol.make_bucket("coldstore")
+    local_ol, _tmp = None, None
+    tiers = None
+    # build local with tier mgr wired
+    disks = [LocalStorage(str(tmp_path / f"a{i}"), endpoint=f"a{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="aaaaaaaa-aaaa-aaaa-aaaa-aaaaaaaaaaaa",
+        pool_index=0,
+    )
+    sets.init_format()
+    local_ol = ErasureServerPools([sets])
+    tiers = TierConfigMgr(local_ol)
+    engine = TierEngine(local_ol, tiers)
+    local_srv = S3Server(local_ol, IAMSys(AK, SK),
+                         BucketMetadataSys(local_ol),
+                         sse_config=SSEConfig("root"),
+                         tier_engine=engine, tiers=tiers).start()
+    tiers.add("COLD", remote_srv.endpoint, AK, SK, "coldstore",
+              prefix="tiered")
+    yield local_ol, Client(local_srv), engine, remote_ol
+    local_srv.stop()
+    remote_srv.stop()
+
+
+def test_transition_and_transparent_get(stack):
+    ol, cl, engine, remote_ol = stack
+    assert cl.request("PUT", "/data")[0] == 200
+    body = b"cold data " * 50000  # ~500 KiB
+    assert cl.request("PUT", "/data/archive.bin", body=body)[0] == 200
+
+    engine.transition("data", "archive.bin", "COLD")
+
+    info = ol.get_object_info("data", "archive.bin")
+    assert is_transitioned(info.user_defined)
+    # local shard data is gone (metadata-only version) but remote has it
+    remote_keys = [o.name for o in
+                   remote_ol.list_objects("coldstore").objects]
+    assert any(k.startswith("tiered/data/archive.bin/")
+               for k in remote_keys)
+    # transparent GET serves from the tier
+    st, h, got = cl.request("GET", "/data/archive.bin")
+    assert st == 200 and got == body
+    assert h.get("x-amz-storage-class") == "COLD"
+    # HEAD shows the tier storage class
+    st, h, _ = cl.request("HEAD", "/data/archive.bin")
+    assert h.get("x-amz-storage-class") == "COLD"
+    # ranged read through the tier
+    st, _, got = cl.request("GET", "/data/archive.bin",
+                            headers={"Range": "bytes=10-99"})
+    assert st == 206 and got == body[10:100]
+
+
+def test_transition_encrypted_object_keeps_keys_local(stack):
+    ol, cl, engine, remote_ol = stack
+    assert cl.request("PUT", "/data")[0] == 200
+    body = b"secret cold data" * 10000
+    st, _, _ = cl.request("PUT", "/data/enc.bin", body=body,
+                          headers={"x-amz-server-side-encryption": "AES256"})
+    assert st == 200
+    engine.transition("data", "enc.bin", "COLD")
+    # remote copy is ciphertext, not plaintext
+    remote_keys = [o.name for o in
+                   remote_ol.list_objects("coldstore").objects]
+    key = next(k for k in remote_keys if "/enc.bin/" in k)
+    raw = remote_ol.get_object_bytes("coldstore", key)
+    assert body[:64] not in raw
+    # but the local GET decrypts transparently
+    st, _, got = cl.request("GET", "/data/enc.bin")
+    assert st == 200 and got == body
+
+
+def test_restore_and_expiry(stack):
+    ol, cl, engine, remote_ol = stack
+    assert cl.request("PUT", "/data")[0] == 200
+    body = b"restore me" * 20000
+    assert cl.request("PUT", "/data/r.bin", body=body)[0] == 200
+    engine.transition("data", "r.bin", "COLD")
+
+    # restore over HTTP
+    st, _, resp = cl.request(
+        "POST", "/data/r.bin", query=[("restore", "")],
+        body=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+    assert st == 202, resp
+    info = ol.get_object_info("data", "r.bin")
+    assert 'ongoing-request="false"' in info.user_defined["x-amz-restore"]
+    assert tiermod.is_restored(info.user_defined)
+    # restored copy serves locally (HEAD carries x-amz-restore)
+    st, h, got = cl.request("GET", "/data/r.bin")
+    assert st == 200 and got == body
+    assert "x-amz-restore" in h
+
+    # force-expire the restored copy, then the engine drops it back
+    ol.update_object_metadata(
+        "data", "r.bin", "",
+        {tiermod.META_RESTORE: tiermod.restore_header(days=1).replace(
+            time.strftime("%Y", time.gmtime()), "2001", 1)},
+    )
+    info = ol.get_object_info("data", "r.bin")
+    assert not tiermod.is_restored(info.user_defined)
+    assert engine.expire_restored("data", "r.bin", info.user_defined)
+    info = ol.get_object_info("data", "r.bin")
+    assert tiermod.META_RESTORE not in info.user_defined
+    # still transparently readable from the tier after expiry
+    st, _, got = cl.request("GET", "/data/r.bin")
+    assert st == 200 and got == body
+
+
+def test_scanner_applies_transition_rule(stack, tmp_path):
+    ol, cl, engine, remote_ol = stack
+    from minio_tpu.background.scanner import DataScanner
+    from minio_tpu.bucket import BucketMetadataSys
+
+    bm = BucketMetadataSys(ol)
+    ol.make_bucket("auto")
+    body = b"auto tier" * 1000
+    ol.put_object("auto", "old.bin", io.BytesIO(body), len(body))
+    bm.update("auto", "lifecycle_xml", (
+        '<LifecycleConfiguration><Rule><Status>Enabled</Status>'
+        '<Filter><Prefix></Prefix></Filter>'
+        '<Transition><Days>0</Days><StorageClass>COLD</StorageClass>'
+        '</Transition></Rule></LifecycleConfiguration>'
+    ))
+    scanner = DataScanner(ol, bucket_meta=bm, tier_engine=engine)
+    scanner.scan_cycle()
+    info = ol.get_object_info("auto", "old.bin")
+    assert is_transitioned(info.user_defined)
+
+
+def test_admin_tier_endpoints(stack):
+    _, cl, _, _ = stack
+    import json as _json
+
+    st, _, body = cl.request("GET", "/minio/admin/v3/list-tiers")
+    assert st == 200
+    tiers = _json.loads(body)
+    assert "COLD" in tiers and "secret_key" not in tiers["COLD"]

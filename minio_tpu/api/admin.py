@@ -70,6 +70,10 @@ class AdminHandlers:
             ("GET", "replication-stats"): "replication_stats",
             ("PUT", "set-bucket-quota"): "set_bucket_quota",
             ("GET", "get-bucket-quota"): "get_bucket_quota",
+            ("POST", "start-profiling"): "start_profiling",
+            ("GET", "download-profiling"): "download_profiling",
+            ("GET", "audit-log"): "audit_log",
+            ("GET", "healthinfo"): "health_info",
             ("PUT", "add-tier"): "add_tier",
             ("GET", "list-tiers"): "list_tiers",
             ("DELETE", "remove-tier"): "remove_tier",
@@ -107,6 +111,10 @@ class AdminHandlers:
         "remove_remote_target": "admin:SetBucketTarget",
         "set_bucket_quota": "admin:SetBucketQuota",
         "get_bucket_quota": "admin:GetBucketQuota",
+        "start_profiling": "admin:Profiling",
+        "download_profiling": "admin:Profiling",
+        "audit_log": "admin:ServerTrace",
+        "health_info": "admin:OBDInfo",
         "add_tier": "admin:SetTier",
         "list_tiers": "admin:ListTier",
         "remove_tier": "admin:SetTier",
@@ -390,6 +398,94 @@ class AdminHandlers:
 
     # --- replication targets (ref cmd/admin-bucket-handlers.go
     # --- SetRemoteTargetHandler / ListRemoteTargetsHandler) ---
+
+    # ---------- profiling / audit / health bundle (ref
+    # cmd/admin-handlers.go:466 StartProfiling, cmd/healthinfo.go,
+    # cmd/logger audit) ----------
+
+    _prof_lock = __import__("threading").Lock()
+
+    def start_profiling(self, ctx) -> Response:
+        from ..observability.profiler import SamplingProfiler
+
+        with self._prof_lock:
+            if getattr(self, "_profiler", None) is not None \
+                    and self._profiler.running:
+                raise S3Error("InvalidRequest", "profiling already running")
+            self._profiler = SamplingProfiler().start()
+        return self._json({"status": "profiling started"})
+
+    def download_profiling(self, ctx) -> Response:
+        with self._prof_lock:
+            prof = getattr(self, "_profiler", None)
+            if prof is None:
+                raise S3Error("InvalidRequest", "profiling is not running")
+            self._profiler = None
+        report = prof.stop_and_report()
+        return Response(200, {"Content-Type": "text/plain"},
+                        report.encode())
+
+    def audit_log(self, ctx) -> Response:
+        audit = getattr(self, "audit", None)
+        if audit is None:
+            return self._json([])
+        try:
+            n = int(ctx.qdict.get("n", "100"))
+        except ValueError:
+            n = 100
+        return self._json(audit.recent(max(1, min(n, 1024))))
+
+    def health_info(self, ctx) -> Response:
+        """OBD-style bundle: host + per-disk facts in one JSON blob."""
+        import os as _os
+        import platform
+        import sys as _sys
+
+        mem_total = mem_avail = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        mem_total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        mem_avail = int(line.split()[1]) * 1024
+        except OSError:
+            pass
+        disks = []
+        for pool_i, pool in enumerate(getattr(self.ol, "pools", [])):
+            for d in pool.disks:
+                if d is None:
+                    disks.append({"pool": pool_i, "state": "offline"})
+                    continue
+                t0 = time.monotonic_ns()
+                try:
+                    info = d.disk_info()
+                    disks.append({
+                        "pool": pool_i, "endpoint": info.endpoint,
+                        "total": info.total, "free": info.free,
+                        "used": info.used, "state": "ok",
+                        "latency_us": (time.monotonic_ns() - t0) // 1000,
+                    })
+                except Exception as exc:  # noqa: BLE001
+                    disks.append({
+                        "pool": pool_i, "state": f"error: {exc}",
+                    })
+        versions = {"python": platform.python_version()}
+        for mod in ("numpy", "jax"):
+            m = _sys.modules.get(mod)
+            if m is not None:
+                versions[mod] = getattr(m, "__version__", "?")
+        return self._json({
+            "host": {
+                "cpus": _os.cpu_count(),
+                "mem_total": mem_total,
+                "mem_available": mem_avail,
+                "platform": platform.platform(),
+                "uptime_s": round(time.time() - self.started, 1),
+            },
+            "versions": versions,
+            "disks": disks,
+        })
 
     # ---------- remote tiers (ref the madmin tier registry / tier admin
     # handlers behind ILM transitions) ----------

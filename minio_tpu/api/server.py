@@ -291,6 +291,12 @@ class S3Server:
             trace=trace, notification=notification,
             bucket_meta=bucket_meta, repl_pool=self.repl_pool, tiers=tiers,
         )
+        from ..observability.audit import AuditLogger
+
+        self.audit = AuditLogger.from_config(
+            config_sys.config if config_sys is not None else None
+        )
+        self.admin.audit = self.audit
         self.iam = iam
         self.region = region
         self.metrics = metrics
@@ -389,12 +395,24 @@ class S3Server:
         from .sts import handle_sts, is_sts_request
 
         if is_sts_request(ctx):
+            # The OIDC federation flows are UNSIGNED — the bearer token
+            # IS the credential (ref sts-handlers WebIdentity/
+            # ClientGrants use noAuth); AssumeRole requires a signature.
+            # Branch on the PARSED Action, never on substring sniffing.
+            form = dict(urllib.parse.parse_qsl(
+                ctx.body.decode(errors="replace")
+            ))
+            if form.get("Action") in ("AssumeRoleWithWebIdentity",
+                                      "AssumeRoleWithClientGrants"):
+                return handle_sts(ctx, self.iam, "",
+                                  config=self.handlers.config)
             auth_result = authenticate(
                 self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
             )
             if auth_result.is_anonymous:
                 raise S3Error("AccessDenied", "STS requires signature")
-            return handle_sts(ctx, self.iam, auth_result.access_key)
+            return handle_sts(ctx, self.iam, auth_result.access_key,
+                              config=self.handlers.config)
         # Admin plane (streaming bodies are an S3-data-plane mechanism;
         # the admin plane rejects them rather than parse chunk framing)
         if ctx.path.startswith(ADMIN_PREFIX):
@@ -478,8 +496,31 @@ class S3Server:
                 "api": name, "method": ctx.method, "path": ctx.path,
                 "request_id": ctx.request_id,
             })
+        import time as _time
+
+        t0 = _time.monotonic_ns()
         handler = getattr(self.handlers, name)
-        resp = handler(ctx)
+        status_code = 500
+        try:
+            resp = handler(ctx)
+            status_code = resp.status
+        except S3Error as exc:
+            status_code = exc.api.status
+            raise
+        finally:
+            if self.audit is not None:
+                # One structured entry per API call, DENIED/FAILED calls
+                # included — those are what audit exists to capture
+                # (ref logger.AuditLog records error responses too).
+                self.audit.log(
+                    api=name, bucket=ctx.bucket, object_=ctx.object,
+                    status_code=status_code,
+                    duration_ns=_time.monotonic_ns() - t0,
+                    remote_host=ctx.headers.get("host", ""),
+                    request_id=ctx.request_id,
+                    user_agent=ctx.headers.get("user-agent", ""),
+                    access_key=getattr(auth_result, "access_key", ""),
+                )
         if self.metrics is not None:
             self.metrics.inc(
                 "s3_responses_total", api=name, status=str(resp.status)

@@ -1,12 +1,21 @@
-"""STS: temporary credentials via AssumeRole — behavioral parity with
-the reference's cmd/sts-handlers.go:149 (AssumeRole with SigV4-signed
-POST form body, optional inline session Policy, DurationSeconds), minus
-the OIDC/LDAP federation flows (identity_openid / identity_ldap config
-gates exist; their token exchanges need an external IdP).
+"""STS: temporary credentials — behavioral parity with the reference's
+cmd/sts-handlers.go: AssumeRole (:149, SigV4-signed POST form body,
+optional inline session Policy, DurationSeconds) plus the OIDC
+federation flows AssumeRoleWithWebIdentity / AssumeRoleWithClientGrants
+(:324+). This runtime has no egress, so instead of fetching the
+provider's JWKS from config_url, keys come from the identity_openid
+config inline: `jwks` (a standard JWKS JSON document, RSA keys) or
+`hmac_secret` (HS256 shared secret); the policy claim (`claim_name`,
+default "policy") names the IAM policies attached to the temp creds.
 """
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 
@@ -19,6 +28,83 @@ MIN_DURATION_S = 900
 MAX_DURATION_S = 7 * 24 * 3600
 
 
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _verify_jwt(token: str, openid_cfg) -> dict:
+    """Validate an OIDC id token against the configured keys; returns the
+    claims. Raises S3Error on any failure (expired, bad signature,
+    audience mismatch) — the reference delegates this to the provider's
+    JWKS (cmd/sts-handlers.go WebIdentity validation)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise S3Error("AccessDenied", "malformed web identity token")
+    try:
+        header = json.loads(_b64url_decode(parts[0]))
+        claims = json.loads(_b64url_decode(parts[1]))
+        sig = _b64url_decode(parts[2])
+    except (ValueError, json.JSONDecodeError) as exc:
+        raise S3Error("AccessDenied", "malformed web identity token") from exc
+    signing_input = f"{parts[0]}.{parts[1]}".encode()
+    alg = header.get("alg", "")
+    ok = False
+    if alg == "HS256":
+        secret = openid_cfg.get("hmac_secret", "")
+        if secret:
+            want = hmac_mod.new(secret.encode(), signing_input,
+                                hashlib.sha256).digest()
+            ok = hmac_mod.compare_digest(want, sig)
+    elif alg == "RS256":
+        jwks_raw = openid_cfg.get("jwks", "")
+        if jwks_raw:
+            ok = _verify_rs256(signing_input, sig, jwks_raw,
+                               header.get("kid"))
+    else:
+        raise S3Error("AccessDenied", f"unsupported JWT alg {alg!r}")
+    if not ok:
+        raise S3Error("AccessDenied", "web identity token signature invalid")
+    exp = claims.get("exp")
+    if not isinstance(exp, (int, float)) or exp <= time.time():
+        raise S3Error("AccessDenied", "web identity token expired")
+    client_id = openid_cfg.get("client_id", "")
+    if client_id:
+        aud = claims.get("aud", "")
+        auds = aud if isinstance(aud, list) else [aud]
+        if client_id not in auds and claims.get("azp") != client_id:
+            raise S3Error("AccessDenied", "token audience mismatch")
+    return claims
+
+
+def _verify_rs256(signing_input: bytes, sig: bytes, jwks_raw: str,
+                  kid: str | None) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives.asymmetric.rsa import (
+        RSAPublicNumbers,
+    )
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    try:
+        jwks = json.loads(jwks_raw)
+    except ValueError:
+        return False
+    for key in jwks.get("keys", []):
+        if key.get("kty") != "RSA":
+            continue
+        if kid and key.get("kid") and key["kid"] != kid:
+            continue
+        try:
+            n = int.from_bytes(_b64url_decode(key["n"]), "big")
+            e = int.from_bytes(_b64url_decode(key["e"]), "big")
+            pub = RSAPublicNumbers(e, n).public_key()
+            pub.verify(sig, signing_input, padding.PKCS1v15(), SHA256())
+            return True
+        except (InvalidSignature, ValueError, KeyError):
+            continue
+    return False
+
+
 def is_sts_request(ctx) -> bool:
     """POST / with a form body carrying Action=AssumeRole*."""
     if ctx.method != "POST" or ctx.bucket:
@@ -27,19 +113,18 @@ def is_sts_request(ctx) -> bool:
     return "x-www-form-urlencoded" in ctype
 
 
-def handle_sts(ctx, iam: IAMSys, access_key: str) -> Response:
+def handle_sts(ctx, iam: IAMSys, access_key: str,
+               config=None) -> Response:
     form = dict(urllib.parse.parse_qsl(ctx.body.decode()))
     action = form.get("Action", "")
+    if action in ("AssumeRoleWithWebIdentity",
+                  "AssumeRoleWithClientGrants"):
+        return _handle_federated(ctx, iam, form, action, config)
     if action != "AssumeRole":
         raise S3Error("NotImplemented", f"STS action {action!r}")
     if form.get("Version") != STS_VERSION:
         raise S3Error("InvalidArgument", "missing STS Version")
-    try:
-        duration = int(form.get("DurationSeconds", "3600"))
-    except ValueError as exc:
-        raise S3Error("InvalidArgument", "DurationSeconds") from exc
-    if not MIN_DURATION_S <= duration <= MAX_DURATION_S:
-        raise S3Error("InvalidArgument", f"DurationSeconds {duration}")
+    duration = _parse_duration(form)
     session_policy = None
     if form.get("Policy"):
         try:
@@ -52,9 +137,62 @@ def handle_sts(ctx, iam: IAMSys, access_key: str) -> Response:
         parent_user=access_key, duration_s=duration,
         session_policy=session_policy,
     )
-    root = ET.Element("AssumeRoleResponse")
+    return _creds_response(ctx, cred)
+
+
+def _parse_duration(form: dict) -> int:
+    try:
+        duration = int(form.get("DurationSeconds", "3600"))
+    except ValueError as exc:
+        raise S3Error("InvalidArgument", "DurationSeconds") from exc
+    if not MIN_DURATION_S <= duration <= MAX_DURATION_S:
+        raise S3Error("InvalidArgument", f"DurationSeconds {duration}")
+    return duration
+
+
+def _handle_federated(ctx, iam: IAMSys, form: dict, action: str,
+                      config) -> Response:
+    """AssumeRoleWithWebIdentity / ClientGrants (ref
+    cmd/sts-handlers.go:324,441): UNSIGNED requests carrying an OIDC
+    token; the policy claim selects the attached IAM policies."""
+    if form.get("Version") != STS_VERSION:
+        raise S3Error("InvalidArgument", "missing STS Version")
+    openid = config.get("identity_openid") if config is not None else None
+    if openid is None or not (openid.get("jwks")
+                              or openid.get("hmac_secret")):
+        raise S3Error("NotImplemented",
+                      "identity_openid is not configured")
+    token = form.get("WebIdentityToken") or form.get("Token") or ""
+    if not token:
+        raise S3Error("InvalidArgument", "missing token")
+    claims = _verify_jwt(token, openid)
+    duration = _parse_duration(form)
+    # Token exp is a HARD bound on the credential lifetime (ref
+    # sts-handlers) — a nearly-expired token mints nearly-expired creds.
+    duration = min(duration, int(claims["exp"] - time.time()))
+    if duration <= 0:
+        raise S3Error("AccessDenied", "web identity token expired")
+    claim_name = openid.get("claim_name") or "policy"
+    policy_claim = claims.get(claim_name, "")
+    if isinstance(policy_claim, str):
+        policy_names = [p.strip() for p in policy_claim.split(",")
+                        if p.strip()]
+    else:
+        policy_names = [str(p) for p in policy_claim]
+    if not policy_names:
+        raise S3Error("AccessDenied",
+                      f"token lacks the {claim_name!r} policy claim")
+    cred = iam.new_federated_credentials(
+        subject=str(claims.get("sub", "")), duration_s=duration,
+        policy_names=policy_names,
+    )
+    return _creds_response(ctx, cred, action=action)
+
+
+def _creds_response(ctx, cred, action: str = "AssumeRole") -> Response:
+    root = ET.Element(f"{action}Response")
     root.set("xmlns", "https://sts.amazonaws.com/doc/2011-06-15/")
-    result = ET.SubElement(root, "AssumeRoleResult")
+    result = ET.SubElement(root, f"{action}Result")
     creds = ET.SubElement(result, "Credentials")
     ET.SubElement(creds, "AccessKeyId").text = cred.access_key
     ET.SubElement(creds, "SecretAccessKey").text = cred.secret_key

@@ -40,7 +40,7 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "cache": {"drives": "", "expiry": "90", "quota": "80", "exclude": ""},
     "compression": {"enable": "off", "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin", "mime_types": "text/*,application/json,application/xml"},
     "etcd": {"endpoints": "", "path_prefix": ""},
-    "identity_openid": {"config_url": "", "client_id": ""},
+    "identity_openid": {"config_url": "", "client_id": "", "jwks": "", "hmac_secret": "", "claim_name": "policy"},
     "identity_ldap": {"server_addr": "", "user_dn_search_base_dn": ""},
     "policy_opa": {"url": "", "auth_token": ""},
     "kms_kes": {"endpoint": "", "key_name": ""},

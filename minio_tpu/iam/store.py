@@ -26,6 +26,7 @@ class Credentials:
     expiration_ns: int = 0  # 0 = never
     parent_user: str = ""   # set for service accounts / STS creds
     groups: list = field(default_factory=list)
+    description: str = ""   # e.g. "oidc:<sub>" for federated creds
 
     def is_expired(self) -> bool:
         return self.expiration_ns > 0 and time.time_ns() > self.expiration_ns
@@ -240,6 +241,27 @@ class IAMSys:
                 # Session policies RESTRICT (intersect with) the parent's
                 # permissions; is_allowed requires parent AND session.
                 self.policies[f"sts-{access}"] = session_policy
+            return c
+
+    def new_federated_credentials(self, subject: str, duration_s: int,
+                                  policy_names: list[str]) -> Credentials:
+        """Temp credentials for an EXTERNAL identity (OIDC WebIdentity /
+        ClientGrants, ref cmd/sts-handlers.go:324+): no parent IAM user —
+        authorization comes solely from the policies the token's claim
+        names, attached to the temp access key."""
+        with self._lock:
+            access, secret = generate_credentials()
+            token = secrets.token_urlsafe(32)
+            c = Credentials(
+                access, secret, session_token=token,
+                expiration_ns=time.time_ns() + duration_s * 10 ** 9,
+                parent_user="",
+            )
+            # claims note for admin listing
+            c.description = f"oidc:{subject}"
+            self.sts[access] = c
+            if policy_names:
+                self.user_policy[access] = list(policy_names)
             return c
 
     # --- groups ---

@@ -1,0 +1,90 @@
+"""Audit logging: one structured JSON entry per API request, shipped to
+a webhook target and kept in a local ring for admin retrieval — the
+reference's logger.AuditLog + cmd/logger/target/http
+(cmd/object-handlers.go:1396, audit entries mirror madmin.AuditEntry)."""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from collections import deque
+
+
+class AuditLogger:
+    RING = 1024
+    QUEUE = 10_000
+
+    def __init__(self, webhook_endpoint: str = "", auth_token: str = ""):
+        self._ring: deque[dict] = deque(maxlen=self.RING)
+        self._lock = threading.Lock()
+        self._endpoint = webhook_endpoint
+        self._token = auth_token
+        self._q: queue.Queue | None = None
+        self.dropped = 0
+        if webhook_endpoint:
+            self._q = queue.Queue(maxsize=self.QUEUE)
+            threading.Thread(target=self._ship, daemon=True,
+                             name="mtpu-audit").start()
+
+    @classmethod
+    def from_config(cls, config) -> "AuditLogger":
+        kvs = config.get("audit_webhook") if config is not None else None
+        if kvs is not None and kvs.get("enable") == "on":
+            return cls(kvs.get("endpoint", ""), kvs.get("auth_token", ""))
+        return cls()
+
+    def log(self, *, api: str, bucket: str, object_: str, status_code: int,
+            duration_ns: int, remote_host: str, request_id: str,
+            user_agent: str = "", access_key: str = ""):
+        entry = {
+            "version": "1",
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "trigger": "incoming",
+            "api": {
+                "name": api, "bucket": bucket, "object": object_,
+                "statusCode": status_code,
+                "timeToResponseNs": duration_ns,
+            },
+            "remotehost": remote_host,
+            "requestID": request_id,
+            "userAgent": user_agent,
+            "accessKey": access_key,
+        }
+        with self._lock:
+            self._ring.append(entry)
+        if self._q is not None:
+            try:
+                self._q.put_nowait(entry)
+            except queue.Full:
+                self.dropped += 1
+
+    def recent(self, n: int = 100) -> list[dict]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def _ship(self):
+        import http.client
+        import urllib.parse
+
+        u = urllib.parse.urlparse(
+            self._endpoint if "//" in self._endpoint
+            else f"http://{self._endpoint}"
+        )
+        conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                    else http.client.HTTPConnection)
+        while True:
+            entry = self._q.get()
+            try:
+                conn = conn_cls(u.netloc, timeout=5)
+                headers = {"Content-Type": "application/json"}
+                if self._token:
+                    headers["Authorization"] = f"Bearer {self._token}"
+                conn.request("POST", u.path or "/",
+                             body=json.dumps(entry).encode(),
+                             headers=headers)
+                conn.getresponse().read()
+                conn.close()
+            except Exception:  # noqa: BLE001 - the shipper must survive
+                self.dropped += 1

@@ -1,0 +1,82 @@
+"""Sampling profiler for the threaded server — the role of the
+reference's profiling admin surface (StartProfilingHandler /
+DownloadProfilingData, cmd/admin-handlers.go:466-553, which wraps Go's
+pprof). cProfile only instruments the calling thread, so this samples
+sys._current_frames() across ALL threads (py-spy style): cheap, safe to
+run in production, and the aggregate stacks point at the same hot paths
+a tracing profiler would."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+class SamplingProfiler:
+    MAX_DURATION_S = 600.0  # an undownloaded profile must not run forever
+
+    def __init__(self, interval_s: float = 0.005):
+        self.interval_s = interval_s
+        self._stacks: Counter = Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.started_ns = 0
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("profiler already running")
+        self._stop.clear()
+        self._stacks.clear()
+        self._samples = 0
+        self.started_ns = time.time_ns()
+
+        def loop():
+            me = threading.get_ident()
+            deadline = time.monotonic() + self.MAX_DURATION_S
+            while not self._stop.wait(self.interval_s):
+                if time.monotonic() > deadline:
+                    break
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = []
+                    f = frame
+                    depth = 0
+                    while f is not None and depth < 24:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                            f"{f.f_lineno}:{code.co_name}"
+                        )
+                        f = f.f_back
+                        depth += 1
+                    self._stacks[tuple(reversed(stack))] += 1
+                self._samples += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mtpu-profiler")
+        self._thread.start()
+        return self
+
+    def stop_and_report(self, top: int = 50) -> str:
+        """Stop sampling; render the most-sampled stacks (collapsed
+        format: 'frame;frame;... count', flamegraph-compatible)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        dur_s = (time.time_ns() - self.started_ns) / 1e9
+        lines = [
+            f"# sampling profile: {self._samples} samples over "
+            f"{dur_s:.1f}s @ {self.interval_s * 1000:.0f}ms",
+        ]
+        for stack, count in self._stacks.most_common(top):
+            lines.append(";".join(stack) + f" {count}")
+        return "\n".join(lines) + "\n"
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None

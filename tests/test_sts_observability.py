@@ -1,0 +1,180 @@
+"""STS OIDC federation (AssumeRoleWithWebIdentity/ClientGrants, ref
+cmd/sts-handlers.go:324+), sampling profiler, audit log, and the OBD
+health bundle."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.api import S3Server
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.config.config import ConfigSys
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+from tests.test_s3_api import Client
+
+HMAC_SECRET = "oidc-shared-secret"
+
+
+def _jwt(claims: dict, secret: str = HMAC_SECRET, alg: str = "HS256") -> str:
+    def enc(d):
+        return base64.urlsafe_b64encode(
+            json.dumps(d).encode()
+        ).rstrip(b"=").decode()
+
+    head = enc({"alg": alg, "typ": "JWT"})
+    body = enc(claims)
+    sig = hmac.new(secret.encode(), f"{head}.{body}".encode(),
+                   hashlib.sha256).digest()
+    return f"{head}.{body}." + base64.urlsafe_b64encode(
+        sig).rstrip(b"=").decode()
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="5ba52d31-4f2e-4d69-92f5-926a51824ee6",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    config_sys = ConfigSys(ol)
+    config_sys.config.set_kv(
+        "identity_openid", hmac_secret=HMAC_SECRET, client_id="mtpu-app",
+    )
+    from minio_tpu.iam import Policy
+
+    iam = IAMSys("tpuadmin", "tpuadmin-secret-key")
+    iam.set_policy("readonly-data", Policy.parse(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:GetObject", "s3:ListBucket"],
+                       "Resource": ["arn:aws:s3:::*"]}],
+    })))
+    server = S3Server(ol, iam, BucketMetadataSys(ol),
+                      config_sys=config_sys).start()
+    cl = Client(server)
+    assert cl.request("PUT", "/stsdata")[0] == 200
+    assert cl.request("PUT", "/stsdata/doc", body=b"federated read")[0] == 200
+    yield server, cl
+    server.stop()
+
+
+def _sts_request(server, form: dict):
+    import http.client
+    import urllib.parse
+
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    body = urllib.parse.urlencode(form)
+    conn.request("POST", "/", body=body, headers={
+        "Content-Type": "application/x-www-form-urlencoded",
+        "Content-Length": str(len(body)),
+    })
+    r = conn.getresponse()
+    data = r.read()
+    conn.close()
+    return r.status, data
+
+
+def test_web_identity_flow(srv):
+    server, cl = srv
+    token = _jwt({
+        "sub": "user@idp", "aud": "mtpu-app",
+        "exp": int(time.time()) + 3600, "policy": "readonly-data",
+    })
+    st, body = _sts_request(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": token, "DurationSeconds": "900",
+    })
+    assert st == 200, body
+    root = ET.fromstring(body)
+    ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+    ak = root.findtext(f".//{ns}AccessKeyId")
+    sk = root.findtext(f".//{ns}SecretAccessKey")
+    tok = root.findtext(f".//{ns}SessionToken")
+    assert ak and sk and tok
+    # temp creds can read (policy allows) ...
+    fed = Client(server, access=ak, secret=sk)
+    st, _, got = fed.request("GET", "/stsdata/doc")
+    assert st == 200 and got == b"federated read"
+    # ... but not write
+    st, _, _ = fed.request("PUT", "/stsdata/nope", body=b"x")
+    assert st == 403
+
+
+def test_web_identity_rejections(srv):
+    server, _ = srv
+    good = {"sub": "u", "aud": "mtpu-app",
+            "exp": int(time.time()) + 600, "policy": "readonly-data"}
+    # wrong signature
+    st, body = _sts_request(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": _jwt(good, secret="wrong"),
+    })
+    assert st == 403
+    # expired
+    st, _ = _sts_request(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": _jwt({**good, "exp": int(time.time()) - 10}),
+    })
+    assert st == 403
+    # audience mismatch
+    st, _ = _sts_request(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": _jwt({**good, "aud": "other-app"}),
+    })
+    assert st == 403
+    # no policy claim
+    st, _ = _sts_request(server, {
+        "Action": "AssumeRoleWithClientGrants", "Version": "2011-06-15",
+        "Token": _jwt({k: v for k, v in good.items() if k != "policy"}),
+    })
+    assert st == 403
+
+
+def test_client_grants_flow(srv):
+    server, _ = srv
+    token = _jwt({"sub": "svc", "aud": "mtpu-app",
+                  "exp": int(time.time()) + 600,
+                  "policy": "readonly-data"})
+    st, body = _sts_request(server, {
+        "Action": "AssumeRoleWithClientGrants", "Version": "2011-06-15",
+        "Token": token,
+    })
+    assert st == 200
+    assert b"ClientGrantsResult" in body
+
+
+def test_profiling_and_healthinfo_and_audit(srv):
+    server, cl = srv
+    st, _, _ = cl.request("POST", "/minio/admin/v3/start-profiling")
+    assert st == 200
+    # generate some load while the sampler runs
+    for i in range(10):
+        cl.request("PUT", f"/stsdata/p{i}", body=b"x" * 20000)
+    time.sleep(0.1)
+    st, _, report = cl.request("GET", "/minio/admin/v3/download-profiling")
+    assert st == 200
+    assert report.startswith(b"# sampling profile:")
+    # audit ring captured the API calls
+    st, _, body = cl.request("GET", "/minio/admin/v3/audit-log")
+    assert st == 200
+    entries = json.loads(body)
+    assert any(e["api"]["name"] == "put_object" for e in entries)
+    assert all(e["requestID"] for e in entries)
+    # health bundle
+    st, _, body = cl.request("GET", "/minio/admin/v3/healthinfo")
+    assert st == 200
+    info = json.loads(body)
+    assert info["host"]["cpus"] >= 1
+    assert len(info["disks"]) == 4
+    assert all(d["state"] == "ok" for d in info["disks"])

@@ -1169,6 +1169,9 @@ class S3ApiHandlers:
         from .. import tier as tiermod
 
         resp_extra: dict = {}
+        # Cache layer (object/cache.py) reuses this info instead of
+        # re-reading the metadata quorum.
+        opts.cached_info = oi
         transformed = transforms.is_transformed(oi.user_defined)
         logical_size = transforms.actual_object_size(oi.user_defined, oi.size)
         rng = parse_range(ctx.headers.get("range", ""), logical_size)

@@ -356,6 +356,9 @@ class S3Server:
             h.command, urllib.parse.unquote(parsed.path), query,
             dict(h.headers), body_reader, content_length,
         )
+        import time as _time
+
+        t0 = _time.monotonic_ns()
         try:
             resp = self._process(ctx)
         except S3Error as exc:
@@ -369,6 +372,22 @@ class S3Server:
             resp = Response(
                 api.status, {"Content-Type": "application/xml"},
                 error_xml(api, ctx.path, ctx.request_id, str(exc)),
+            )
+        if self.audit is not None and not ctx.path.startswith(
+                "/minio/health/"):
+            # Single audit choke point: every response — including auth
+            # DENIALS, which raise before any handler runs — gets an
+            # entry (ref logger.AuditLog records error responses too).
+            self.audit.log(
+                api=getattr(ctx, "api_name", "") or
+                f"{ctx.method} {ctx.path}",
+                bucket=ctx.bucket, object_=ctx.object,
+                status_code=resp.status,
+                duration_ns=_time.monotonic_ns() - t0,
+                remote_host=ctx.headers.get("host", ""),
+                request_id=ctx.request_id,
+                user_agent=ctx.headers.get("user-agent", ""),
+                access_key=getattr(ctx, "access_key", ""),
             )
         self._write(h, ctx, resp)
 
@@ -417,6 +436,7 @@ class S3Server:
         # the admin plane rejects them rather than parse chunk framing)
         if ctx.path.startswith(ADMIN_PREFIX):
             name = self.admin.route(ctx)
+            ctx.api_name = f"admin:{name}"
             auth_result = authenticate(
                 self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
             )
@@ -440,6 +460,7 @@ class S3Server:
             # traversal here would bypass the bucket/object guards above.
             raise S3Error("NoSuchUpload", upload_id[:64])
         name = route(ctx)
+        ctx.api_name = name
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
         auth_result = authenticate(
@@ -477,6 +498,7 @@ class S3Server:
                     self.iam, src_policy, auth_result, "s3:GetObject",
                     sbucket, sobject,
                 )
+        ctx.access_key = auth_result.access_key
         if auth_result.auth == AUTH_STREAMING:
             self._wrap_streaming_body(ctx, auth_result)
         elif auth_result.content_sha256 not in ("", sign.UNSIGNED_PAYLOAD):
@@ -496,31 +518,8 @@ class S3Server:
                 "api": name, "method": ctx.method, "path": ctx.path,
                 "request_id": ctx.request_id,
             })
-        import time as _time
-
-        t0 = _time.monotonic_ns()
         handler = getattr(self.handlers, name)
-        status_code = 500
-        try:
-            resp = handler(ctx)
-            status_code = resp.status
-        except S3Error as exc:
-            status_code = exc.api.status
-            raise
-        finally:
-            if self.audit is not None:
-                # One structured entry per API call, DENIED/FAILED calls
-                # included — those are what audit exists to capture
-                # (ref logger.AuditLog records error responses too).
-                self.audit.log(
-                    api=name, bucket=ctx.bucket, object_=ctx.object,
-                    status_code=status_code,
-                    duration_ns=_time.monotonic_ns() - t0,
-                    remote_host=ctx.headers.get("host", ""),
-                    request_id=ctx.request_id,
-                    user_agent=ctx.headers.get("user-agent", ""),
-                    access_key=getattr(auth_result, "access_key", ""),
-                )
+        resp = handler(ctx)
         if self.metrics is not None:
             self.metrics.inc(
                 "s3_responses_total", api=name, status=str(resp.status)

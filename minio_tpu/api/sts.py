@@ -115,7 +115,9 @@ def is_sts_request(ctx) -> bool:
 
 def handle_sts(ctx, iam: IAMSys, access_key: str,
                config=None) -> Response:
-    form = dict(urllib.parse.parse_qsl(ctx.body.decode()))
+    form = dict(urllib.parse.parse_qsl(
+        ctx.body.decode(errors="replace")
+    ))
     action = form.get("Action", "")
     if action in ("AssumeRoleWithWebIdentity",
                   "AssumeRoleWithClientGrants"):

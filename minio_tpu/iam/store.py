@@ -175,11 +175,21 @@ class IAMSys:
                 self.group_members = d.get("members", {})
 
     def _persist_mappings(self):
+        # Temp (STS) access keys never persist: their mappings die with
+        # the credential, not with the store.
         self.store.save("policy-mappings.json", json.dumps({
-            "users": self.user_policy,
+            "users": {k: v for k, v in self.user_policy.items()
+                      if k not in self.sts},
             "groups": self.group_policy,
             "members": self.group_members,
         }).encode())
+
+    def _prune_expired_sts_locked(self):
+        dead = [k for k, c in self.sts.items() if c.is_expired()]
+        for k in dead:
+            self.sts.pop(k, None)
+            self.user_policy.pop(k, None)
+            self.policies.pop(f"sts-{k}", None)
 
     # --- user management (ref cmd/admin-handlers-users.go surface) ---
 
@@ -250,6 +260,7 @@ class IAMSys:
         authorization comes solely from the policies the token's claim
         names, attached to the temp access key."""
         with self._lock:
+            self._prune_expired_sts_locked()
             access, secret = generate_credentials()
             token = secrets.token_urlsafe(32)
             c = Credentials(

@@ -1,0 +1,293 @@
+"""Disk cache: a read-through cache layered over the ObjectLayer — the
+equivalent of the reference's cacheObjects/diskCache
+(/root/reference/cmd/disk-cache.go:88,216,749 and
+cmd/disk-cache-backend.go: atime-based GC between low/high watermarks,
+ETag-validated hits, write-around semantics).
+
+Design deltas, by intent:
+- Cache entries are plain files `<dir>/<sha(bucket/object)>.{data,json}`
+  (the reference nests per-entry dirs with its own cache.json metadata) —
+  one data file + one metadata sidecar keeps eviction O(1 unlink).
+- Population is synchronous on miss (the object bytes are already in
+  hand from the backend read); the reference streams through a pipe.
+- GC: when usage crosses the quota high watermark, least-recently-USED
+  entries (tracked in the sidecar, not filesystem atime — noatime mounts
+  are the norm) are purged down to the low watermark.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from ..utils.errors import StorageError
+
+LOW_WATERMARK = 0.8   # of quota (ref cacheenv low_watermark default 80)
+HIGH_WATERMARK = 0.9
+
+
+class DiskCache:
+    """One cache directory with a byte quota."""
+
+    def __init__(self, cache_dir: str, quota_bytes: int):
+        self.dir = cache_dir
+        self.quota = quota_bytes
+        os.makedirs(cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._usage = 0
+        self.hits = 0
+        self.misses = 0
+        for name in os.listdir(cache_dir):
+            if name.endswith(".data"):
+                try:
+                    self._usage += os.path.getsize(
+                        os.path.join(cache_dir, name))
+                except OSError:
+                    pass
+
+    def _paths(self, bucket: str, object_: str) -> tuple[str, str]:
+        h = hashlib.sha256(f"{bucket}/{object_}".encode()).hexdigest()
+        base = os.path.join(self.dir, h)
+        return base + ".data", base + ".json"
+
+    def get(self, bucket: str, object_: str, etag: str) -> bytes | None:
+        """Cached stored-bytes when present AND the backend etag still
+        matches (ref cacheObjects etag revalidation)."""
+        data_p, meta_p = self._paths(bucket, object_)
+        try:
+            with open(meta_p) as f:
+                meta = json.load(f)
+            if meta.get("etag") != etag:
+                self._evict(bucket, object_)
+                return None
+            with open(data_p, "rb") as f:
+                data = f.read()
+            meta["used_ns"] = time.time_ns()
+            tmp = meta_p + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, meta_p)
+            except OSError:
+                pass  # LRU freshness is best-effort
+            with self._lock:
+                self.hits += 1
+            return data
+        except (OSError, ValueError):
+            with self._lock:
+                self.misses += 1
+            return None
+
+    def put(self, bucket: str, object_: str, etag: str, data: bytes):
+        """Populate (write-around for the backend; only reads cache)."""
+        if len(data) > self.quota:
+            return
+        data_p, meta_p = self._paths(bucket, object_)
+        try:
+            old = os.path.getsize(data_p)
+        except OSError:
+            old = 0
+        delta = len(data) - old
+        with self._lock:
+            if self._usage + delta > self.quota * HIGH_WATERMARK:
+                self._gc_locked(delta)
+            if self._usage + delta > self.quota:
+                return
+            self._usage += delta
+        tmp = data_p + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, data_p)
+            mtmp = meta_p + ".tmp"
+            with open(mtmp, "w") as f:
+                json.dump({
+                    "bucket": bucket, "object": object_, "etag": etag,
+                    "size": len(data), "used_ns": time.time_ns(),
+                }, f)
+            os.replace(mtmp, meta_p)
+        except OSError:
+            with self._lock:
+                self._usage -= delta
+
+    def _evict(self, bucket: str, object_: str):
+        data_p, meta_p = self._paths(bucket, object_)
+        try:
+            size = os.path.getsize(data_p)
+            os.unlink(data_p)
+            with self._lock:
+                self._usage -= size
+        except OSError:
+            pass
+        try:
+            os.unlink(meta_p)
+        except OSError:
+            pass
+
+    def invalidate(self, bucket: str, object_: str):
+        self._evict(bucket, object_)
+
+    def _gc_locked(self, incoming: int):
+        """Purge least-recently-used entries down to the low watermark
+        (caller holds the lock; ref diskCache purge between watermarks)."""
+        target = int(self.quota * LOW_WATERMARK)
+        entries = []
+        for name in os.listdir(self.dir):
+            if not name.endswith(".json"):
+                continue
+            p = os.path.join(self.dir, name)
+            try:
+                with open(p) as f:
+                    m = json.load(f)
+                entries.append((m.get("used_ns", 0), m.get("size", 0),
+                                name[:-5]))
+            except (OSError, ValueError):
+                continue
+        entries.sort()
+        for _, size, base in entries:
+            if self._usage + incoming <= target:
+                break
+            for suffix in (".data", ".json"):
+                try:
+                    os.unlink(os.path.join(self.dir, base + suffix))
+                except OSError:
+                    pass
+            self._usage -= size
+
+    @property
+    def usage(self) -> int:
+        with self._lock:
+            return self._usage
+
+
+class CacheObjectLayer:
+    """ObjectLayer decorator: read-through on get_object/get_object_bytes,
+    write-around with invalidation on mutations; everything else passes
+    straight to the backend (ref cacheObjects, cmd/disk-cache.go:88)."""
+
+    # Objects above this size are never cached (keeps the cache effective
+    # for the hot small-object set; ref maxCacheFileSize-style gating).
+    MAX_CACHE_OBJECT = 32 << 20
+
+    def __init__(self, backend, cache: DiskCache,
+                 exclude: list[str] | None = None):
+        self._backend = backend
+        self.cache = cache
+        self._exclude = [p.strip() for p in (exclude or []) if p.strip()]
+
+    def __getattr__(self, name):
+        return getattr(self._backend, name)
+
+    def _cacheable(self, bucket: str, object_: str) -> bool:
+        if bucket.startswith("."):
+            return False
+        import fnmatch
+
+        for pat in self._exclude:
+            if fnmatch.fnmatch(f"{bucket}/{object_}", pat) or \
+                    fnmatch.fnmatch(object_, pat):
+                return False
+        return True
+
+    # --- read-through ---
+
+    def get_object(self, bucket, object_, writer, offset=0, length=-1,
+                   opts=None):
+        version_id = getattr(opts, "version_id", "") if opts else ""
+        if version_id or not self._cacheable(bucket, object_):
+            return self._backend.get_object(bucket, object_, writer,
+                                            offset, length, opts)
+        # The API handler already did the quorum metadata read; reuse it
+        # instead of doubling metadata IO on the hot path.
+        info = getattr(opts, "cached_info", None) if opts else None
+        if info is None:
+            info = self._backend.get_object_info(bucket, object_, opts)
+        if info.size > self.MAX_CACHE_OBJECT:
+            return self._backend.get_object(bucket, object_, writer,
+                                            offset, length, opts)
+        data = self.cache.get(bucket, object_, info.etag)
+        if data is None:
+            import io
+
+            buf = io.BytesIO()
+            self._backend.get_object(bucket, object_, buf, opts=opts)
+            data = buf.getvalue()
+            self.cache.put(bucket, object_, info.etag, data)
+        end = len(data) if length < 0 else min(len(data), offset + length)
+        writer.write(data[offset:end])
+        return info
+
+    def get_object_bytes(self, bucket, object_, offset=0, length=-1,
+                         opts=None) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        self.get_object(bucket, object_, buf, offset, length, opts)
+        return buf.getvalue()
+
+    # --- write-around + invalidation ---
+
+    def put_object(self, bucket, object_, reader, size, opts=None):
+        out = self._backend.put_object(bucket, object_, reader, size, opts)
+        self.cache.invalidate(bucket, object_)
+        return out
+
+    def delete_object(self, bucket, object_, opts=None):
+        out = self._backend.delete_object(bucket, object_, opts)
+        self.cache.invalidate(bucket, object_)
+        return out
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts,
+                                  opts=None):
+        out = self._backend.complete_multipart_upload(
+            bucket, object_, upload_id, parts, opts
+        )
+        self.cache.invalidate(bucket, object_)
+        return out
+
+    def update_object_metadata(self, bucket, object_, version_id, updates,
+                               replace_user_meta=False):
+        out = self._backend.update_object_metadata(
+            bucket, object_, version_id, updates, replace_user_meta
+        )
+        self.cache.invalidate(bucket, object_)
+        return out
+
+    def transition_object(self, bucket, object_, version_id, updates,
+                          expected_mod_time_ns=None):
+        out = self._backend.transition_object(
+            bucket, object_, version_id, updates,
+            expected_mod_time_ns=expected_mod_time_ns,
+        )
+        self.cache.invalidate(bucket, object_)
+        return out
+
+
+def build_cache_layer(backend, config) -> "CacheObjectLayer | None":
+    """Wrap `backend` when the cache config subsystem is enabled
+    (ref newServerCacheObjects gated on cache drives)."""
+    if config is None:
+        return None
+    kvs = config.get("cache")
+    drives = [d.strip() for d in kvs.get("drives", "").split(",")
+              if d.strip()]
+    if not drives:
+        return None
+    try:
+        quota_pct = int(kvs.get("quota", "80"))
+    except ValueError:
+        quota_pct = 80
+    import shutil
+
+    os.makedirs(drives[0], exist_ok=True)
+    total = shutil.disk_usage(drives[0]).total
+    quota = total * max(1, min(quota_pct, 100)) // 100
+    exclude = [e for e in kvs.get("exclude", "").split(",") if e.strip()]
+    try:
+        cache = DiskCache(drives[0], quota)
+    except OSError:
+        return None
+    return CacheObjectLayer(backend, cache, exclude)

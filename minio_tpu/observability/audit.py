@@ -74,17 +74,27 @@ class AuditLogger:
         )
         conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
                     else http.client.HTTPConnection)
+        conn = None
         while True:
             entry = self._q.get()
             try:
-                conn = conn_cls(u.netloc, timeout=5)
+                if conn is None:
+                    conn = conn_cls(u.netloc, timeout=5)
                 headers = {"Content-Type": "application/json"}
                 if self._token:
                     headers["Authorization"] = f"Bearer {self._token}"
                 conn.request("POST", u.path or "/",
                              body=json.dumps(entry).encode(),
                              headers=headers)
-                conn.getresponse().read()
-                conn.close()
+                resp = conn.getresponse()
+                resp.read()
+                if not 200 <= resp.status < 300:
+                    self.dropped += 1
             except Exception:  # noqa: BLE001 - the shipper must survive
                 self.dropped += 1
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = None

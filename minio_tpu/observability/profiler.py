@@ -79,4 +79,4 @@ class SamplingProfiler:
 
     @property
     def running(self) -> bool:
-        return self._thread is not None
+        return self._thread is not None and self._thread.is_alive()

@@ -131,6 +131,14 @@ class Server:
             self.object_layer, secret=self.root_password
         )
         self.config_sys.load()
+        # Optional disk cache in front of the API's object layer (the
+        # background services keep the raw layer, like the reference's
+        # cacheObjects wrapping only the served ObjectLayer).
+        from .object.cache import build_cache_layer
+
+        self.cache_layer = build_cache_layer(
+            self.object_layer, self.config_sys.config
+        )
         region = self.config_sys.config.get("region")["name"]
         targets = targets_from_config(self.config_sys.config, region)
         self.notifier = EventNotifier(
@@ -197,7 +205,8 @@ class Server:
             }
 
         self.s3 = S3Server(
-            self.object_layer, self.iam, self.bucket_meta,
+            self.cache_layer or self.object_layer, self.iam,
+            self.bucket_meta,
             notify=self.notifier, region=region, host=address, port=port,
             metrics=self.metrics, trace=self.trace,
             config_sys=self.config_sys,

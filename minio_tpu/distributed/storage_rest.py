@@ -53,6 +53,7 @@ class StorageRESTServer:
             "rename_data", "list_versions", "read_file", "append_file",
             "create_file", "read_file_stream", "rename_file", "check_parts",
             "check_file", "delete", "verify_file", "write_all", "read_all",
+            "stat_info_file",
         ):
             self.rpc.register(name, getattr(self, f"_h_{name}"))
 
@@ -271,6 +272,27 @@ class StorageRESTServer:
     def _h_read_all(self, args, body):
         data = self._disk(args).read_all(args["volume"], args["path"])
         return {"n": len(data)}, io.BytesIO(data)
+
+    def _h_stat_info_file(self, args, body):
+        st = self._disk(args).stat_info_file(args["volume"], args["path"])
+        return {"size": st.st_size, "mod_time_ns": st.st_mtime_ns}
+
+
+class _RemoteStat:
+    """os.stat_result analog for remote files (size + mtime are what the
+    object layer consumes; ref StatInfoFile returns StatInfo{Size,ModTime},
+    cmd/storage-rest-client.go). Mtime crosses the wire in nanoseconds —
+    the repo-wide convention (st_mtime_ns everywhere, e.g. object/fs.py)."""
+
+    __slots__ = ("st_size", "st_mtime_ns")
+
+    def __init__(self, size: int, mtime_ns: int):
+        self.st_size = size
+        self.st_mtime_ns = mtime_ns
+
+    @property
+    def st_mtime(self) -> float:
+        return self.st_mtime_ns / 1e9
 
 
 class _RemoteWriter:
@@ -515,7 +537,8 @@ class RemoteStorage(StorageAPI):
                    msgpack.packb(_fi_pack(fi), use_bin_type=True))
 
     def stat_info_file(self, volume: str, path: str):
-        raise NotImplementedError
+        d = self._call("stat_info_file", {"volume": volume, "path": path})
+        return _RemoteStat(d["size"], d["mod_time_ns"])
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         self._call("write_all", {"volume": volume, "path": path}, bytes(data))

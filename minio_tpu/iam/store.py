@@ -239,6 +239,7 @@ class IAMSys:
     def new_sts_credentials(self, parent_user: str, duration_s: int = 3600,
                             session_policy: Policy | None = None) -> Credentials:
         with self._lock:
+            self._prune_expired_sts_locked()
             access, secret = generate_credentials()
             token = secrets.token_urlsafe(32)
             c = Credentials(

@@ -30,7 +30,12 @@ HIGH_WATERMARK = 0.9
 
 
 class DiskCache:
-    """One cache directory with a byte quota."""
+    """One cache directory with a byte quota.
+
+    All accounting lives in an in-memory LRU index (base-hash →
+    [used_ns, size]) mirrored by the on-disk sidecars, so GC never scans
+    the directory or parses JSON under the lock; the sidecars exist only
+    to rebuild the index across restarts."""
 
     def __init__(self, cache_dir: str, quota_bytes: int):
         self.dir = cache_dir
@@ -40,23 +45,42 @@ class DiskCache:
         self._usage = 0
         self.hits = 0
         self.misses = 0
+        # base -> [used_ns, size]; rebuilt from sidecars that still have
+        # their data file. Orphans of either kind are deleted.
+        self._index: dict[str, list] = {}
         for name in os.listdir(cache_dir):
-            if name.endswith(".data"):
+            if not name.endswith(".json"):
+                continue
+            base = name[:-5]
+            p = os.path.join(cache_dir, name)
+            try:
+                size = os.path.getsize(os.path.join(cache_dir,
+                                                    base + ".data"))
+                with open(p) as f:
+                    m = json.load(f)
+                self._index[base] = [m.get("used_ns", 0), size]
+                self._usage += size
+            except (OSError, ValueError):
                 try:
-                    self._usage += os.path.getsize(
-                        os.path.join(cache_dir, name))
+                    os.unlink(p)  # orphan sidecar
+                except OSError:
+                    pass
+        for name in os.listdir(cache_dir):
+            if name.endswith(".data") and name[:-5] not in self._index:
+                try:
+                    os.unlink(os.path.join(cache_dir, name))
                 except OSError:
                     pass
 
-    def _paths(self, bucket: str, object_: str) -> tuple[str, str]:
+    def _paths(self, bucket: str, object_: str) -> tuple[str, str, str]:
         h = hashlib.sha256(f"{bucket}/{object_}".encode()).hexdigest()
         base = os.path.join(self.dir, h)
-        return base + ".data", base + ".json"
+        return base + ".data", base + ".json", h
 
     def get(self, bucket: str, object_: str, etag: str) -> bytes | None:
         """Cached stored-bytes when present AND the backend etag still
         matches (ref cacheObjects etag revalidation)."""
-        data_p, meta_p = self._paths(bucket, object_)
+        data_p, meta_p, base = self._paths(bucket, object_)
         try:
             with open(meta_p) as f:
                 meta = json.load(f)
@@ -65,16 +89,22 @@ class DiskCache:
                 return None
             with open(data_p, "rb") as f:
                 data = f.read()
-            meta["used_ns"] = time.time_ns()
+            now = time.time_ns()
+            with self._lock:
+                self.hits += 1
+                ent = self._index.get(base)
+                if ent is not None:
+                    ent[0] = now
+            # Persist LRU freshness best-effort; never recreates a GC'd
+            # entry because the index (not the sidecar) is authoritative.
+            meta["used_ns"] = now
             tmp = meta_p + ".tmp"
             try:
                 with open(tmp, "w") as f:
                     json.dump(meta, f)
                 os.replace(tmp, meta_p)
             except OSError:
-                pass  # LRU freshness is best-effort
-            with self._lock:
-                self.hits += 1
+                pass
             return data
         except (OSError, ValueError):
             with self._lock:
@@ -85,18 +115,16 @@ class DiskCache:
         """Populate (write-around for the backend; only reads cache)."""
         if len(data) > self.quota:
             return
-        data_p, meta_p = self._paths(bucket, object_)
-        try:
-            old = os.path.getsize(data_p)
-        except OSError:
-            old = 0
-        delta = len(data) - old
+        data_p, meta_p, base = self._paths(bucket, object_)
         with self._lock:
+            old = self._index.get(base, (0, 0))[1]
+            delta = len(data) - old
             if self._usage + delta > self.quota * HIGH_WATERMARK:
                 self._gc_locked(delta)
             if self._usage + delta > self.quota:
                 return
             self._usage += delta
+            self._index[base] = [time.time_ns(), len(data)]
         tmp = data_p + ".tmp"
         try:
             with open(tmp, "wb") as f:
@@ -110,52 +138,46 @@ class DiskCache:
                 }, f)
             os.replace(mtmp, meta_p)
         except OSError:
+            # Partial failure (ENOSPC is the usual cause): remove the
+            # whole entry — data file, sidecar, temps — so no orphan
+            # .data survives invisible to eviction, then un-account it.
+            for p in (tmp, meta_p + ".tmp", data_p, meta_p):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
             with self._lock:
-                self._usage -= delta
+                self._index.pop(base, None)
+                self._usage = max(0, self._usage - (old + delta))
 
     def _evict(self, bucket: str, object_: str):
-        data_p, meta_p = self._paths(bucket, object_)
-        try:
-            size = os.path.getsize(data_p)
-            os.unlink(data_p)
-            with self._lock:
-                self._usage -= size
-        except OSError:
-            pass
-        try:
-            os.unlink(meta_p)
-        except OSError:
-            pass
+        _, _, base = self._paths(bucket, object_)
+        with self._lock:
+            self._remove_locked(base)
 
     def invalidate(self, bucket: str, object_: str):
         self._evict(bucket, object_)
 
+    def _remove_locked(self, base: str):
+        """Unlink one entry's files and un-account it (lock held)."""
+        ent = self._index.pop(base, None)
+        for suffix in (".data", ".json"):
+            try:
+                os.unlink(os.path.join(self.dir, base + suffix))
+            except OSError:
+                pass
+        if ent is not None:
+            self._usage = max(0, self._usage - ent[1])
+
     def _gc_locked(self, incoming: int):
         """Purge least-recently-used entries down to the low watermark
-        (caller holds the lock; ref diskCache purge between watermarks)."""
+        (caller holds the lock; ref diskCache purge between watermarks).
+        Pure in-memory selection — no directory scan, no JSON parsing."""
         target = int(self.quota * LOW_WATERMARK)
-        entries = []
-        for name in os.listdir(self.dir):
-            if not name.endswith(".json"):
-                continue
-            p = os.path.join(self.dir, name)
-            try:
-                with open(p) as f:
-                    m = json.load(f)
-                entries.append((m.get("used_ns", 0), m.get("size", 0),
-                                name[:-5]))
-            except (OSError, ValueError):
-                continue
-        entries.sort()
-        for _, size, base in entries:
+        for base in sorted(self._index, key=lambda b: self._index[b][0]):
             if self._usage + incoming <= target:
                 break
-            for suffix in (".data", ".json"):
-                try:
-                    os.unlink(os.path.join(self.dir, base + suffix))
-                except OSError:
-                    pass
-            self._usage -= size
+            self._remove_locked(base)
 
     @property
     def usage(self) -> int:

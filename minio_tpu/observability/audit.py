@@ -77,24 +77,31 @@ class AuditLogger:
         conn = None
         while True:
             entry = self._q.get()
-            try:
-                if conn is None:
-                    conn = conn_cls(u.netloc, timeout=5)
-                headers = {"Content-Type": "application/json"}
-                if self._token:
-                    headers["Authorization"] = f"Bearer {self._token}"
-                conn.request("POST", u.path or "/",
-                             body=json.dumps(entry).encode(),
-                             headers=headers)
-                resp = conn.getresponse()
-                resp.read()
-                if not 200 <= resp.status < 300:
-                    self.dropped += 1
-            except Exception:  # noqa: BLE001 - the shipper must survive
-                self.dropped += 1
+            # Two attempts: a reused keep-alive connection is routinely
+            # closed by the server after an idle gap, so the first send
+            # after quiet time fails benignly — retry once on a fresh
+            # connection before counting the entry dropped.
+            for attempt in range(2):
                 try:
-                    if conn is not None:
-                        conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
-                conn = None
+                    if conn is None:
+                        conn = conn_cls(u.netloc, timeout=5)
+                    headers = {"Content-Type": "application/json"}
+                    if self._token:
+                        headers["Authorization"] = f"Bearer {self._token}"
+                    conn.request("POST", u.path or "/",
+                                 body=json.dumps(entry).encode(),
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    resp.read()
+                    if not 200 <= resp.status < 300:
+                        self.dropped += 1
+                    break
+                except Exception:  # noqa: BLE001 - the shipper must survive
+                    try:
+                        if conn is not None:
+                            conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    conn = None
+                    if attempt == 1:
+                        self.dropped += 1

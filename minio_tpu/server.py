@@ -84,6 +84,10 @@ class Server:
             "MTPU_ROOT_PASSWORD", "minioadmin"
         )
 
+        # Metrics come up first so the storage layer can record per-op
+        # counters from the very first format read.
+        self.metrics = Metrics()
+
         # --- object layer from endpoint layout (ref newObjectLayer) ---
         if fs_mode or (
             len(endpoint_args) == 1
@@ -97,8 +101,13 @@ class Server:
             )
             pools = []
             for pi, endpoints in enumerate(layout["pools"]):
+                # Every disk is wrapped in the per-op metrics/disk-id
+                # decorator (ref xl-storage-disk-id-check.go).
+                from .storage.diskcheck import MetricsDisk
+
                 disks = [
-                    LocalStorage(ep, endpoint=ep) for ep in endpoints
+                    MetricsDisk(LocalStorage(ep, endpoint=ep), self.metrics)
+                    for ep in endpoints
                 ]
                 es = ErasureSets(
                     disks, layout["set_drive_count"],
@@ -118,7 +127,6 @@ class Server:
             self.mode = "erasure"
 
         # --- subsystems (ref initAllSubsystems) ---
-        self.metrics = Metrics()
         self.trace = TraceHub()
         self.logger = Logger()
         self.iam = IAMSys(

@@ -1,0 +1,112 @@
+"""Per-op metrics + disk-id validation decorator over StorageAPI — the
+analog of the reference's xlStorageDiskIDCheck wrapper
+(/root/reference/cmd/xl-storage-disk-id-check.go: every StorageAPI call
+is counted + timed per operation, and the disk's identity is re-verified
+so a swapped/stale disk surfaces as errDiskNotFound instead of silently
+serving the wrong data).
+
+The wrapper is a transparent proxy: any StorageAPI implementation (local
+or remote) can be wrapped, and callers keep using the same 34-method
+surface. Metrics land in the shared registry as
+  mtpu_disk_ops_total{op=...,disk=...}
+  mtpu_disk_op_errors_total{op=...,disk=...}
+  mtpu_disk_op_seconds{op=...}            (histogram)
+mirroring the reference's storageMetric counters
+(cmd/xl-storage-disk-id-check.go:33-75).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.errors import ErrDiskNotFound
+
+# The ops that get counted/timed (the reference enumerates the same set
+# as storageMetric constants).
+_TIMED_OPS = frozenset({
+    "disk_info", "make_vol", "make_vol_bulk", "list_vols", "stat_vol",
+    "delete_vol", "list_dir", "walk_dir", "delete_version",
+    "delete_versions", "write_metadata", "update_metadata", "read_version",
+    "rename_data", "list_versions", "read_file", "append_file",
+    "create_file", "read_file_stream", "create_file_writer", "rename_file",
+    "check_parts", "check_file", "delete", "verify_file", "stat_info_file",
+    "write_all", "read_all",
+})
+
+# Identity/liveness ops pass through without the disk-id gate (they are
+# what the gate itself uses; ref DiskInfo/GetDiskID skip the check too).
+_PASSTHROUGH = frozenset({
+    "is_online", "is_local", "hostname", "endpoint", "get_disk_id",
+    "set_disk_id", "close",
+})
+
+_ID_CHECK_INTERVAL_S = 5.0
+
+
+class MetricsDisk:
+    """Transparent StorageAPI proxy adding per-op metrics and periodic
+    disk-id re-validation (ref checkDiskStale,
+    cmd/xl-storage-disk-id-check.go:404-419)."""
+
+    def __init__(self, disk, metrics=None, expected_disk_id: str = ""):
+        self._disk = disk
+        self._metrics = metrics
+        self._expected_id = expected_disk_id
+        self._last_check = 0.0
+
+    # --- identity passthrough ---
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._disk, name)
+        if name in _PASSTHROUGH or name not in _TIMED_OPS:
+            return attr
+        wrapped = self._wrap(name, attr)
+        # Cache so subsequent lookups skip __getattr__.
+        self.__dict__[name] = wrapped
+        return wrapped
+
+    def _wrap(self, op: str, fn):
+        def call(*args, **kwargs):
+            self._check_id()
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                if self._metrics is not None:
+                    self._metrics.inc(
+                        "disk_op_errors_total", op=op,
+                        disk=self._disk.endpoint(),
+                    )
+                raise
+            finally:
+                if self._metrics is not None:
+                    self._metrics.inc(
+                        "disk_ops_total", op=op, disk=self._disk.endpoint()
+                    )
+                    self._metrics.observe(
+                        "disk_op_seconds", time.perf_counter() - t0, op=op
+                    )
+        call.__name__ = op
+        return call
+
+    def _check_id(self):
+        """Re-verify the wrapped disk still carries the expected id. A
+        replaced/reformatted disk changes id → all ops fail DiskNotFound
+        until the heal/format machinery re-admits it (ref errDiskStale)."""
+        if not self._expected_id:
+            return
+        now = time.monotonic()
+        if now - self._last_check < _ID_CHECK_INTERVAL_S:
+            return
+        self._last_check = now
+        actual = self._disk.get_disk_id()
+        if actual and actual != self._expected_id:
+            raise ErrDiskNotFound(
+                f"disk id changed: have {actual}, want {self._expected_id}"
+            )
+
+    def unwrap(self):
+        return self._disk
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"MetricsDisk({self._disk!r})"

@@ -76,12 +76,15 @@ def parse_server_endpoints(args: list[str],
     """args (each possibly with ellipses) -> layout dict:
     {pools: [[endpoint,...]], set_drive_count: N}.
 
-    Each arg is one pool (the reference treats each ellipses arg set as a
-    pool, cmd/endpoint-ellipses.go CreateServerEndpoints)."""
-    pools = []
-    for arg in args:
-        endpoints = expand(arg)
-        pools.append(endpoints)
+    Each ellipses arg is one pool (the reference treats each ellipses arg
+    set as a pool, cmd/endpoint-ellipses.go CreateServerEndpoints). Plain
+    args without ellipses form a SINGLE pool together — the reference's
+    legacy path (`minio server /d1 /d2 /d3 /d4` is one 4-drive set,
+    cmd/endpoint-ellipses.go:30-49 GetAllSets when ellipses absent)."""
+    if not has_ellipses(*args):
+        pools = [list(args)]
+    else:
+        pools = [expand(arg) for arg in args]
     counts = [len(p) for p in pools]
     if set_drive_count is not None:
         # Custom size must divide EVERY pool, not just the first.

@@ -23,7 +23,7 @@ ADMIN_PREFIX = "/minio/admin/v3"
 class AdminHandlers:
     def __init__(self, object_layer, iam, config_sys=None, metrics=None,
                  trace=None, notification=None, lockers=None,
-                 bucket_meta=None, repl_pool=None, tiers=None):
+                 bucket_meta=None, repl_pool=None, tiers=None, logger=None):
         self.ol = object_layer
         self.iam = iam
         self.config_sys = config_sys
@@ -34,6 +34,7 @@ class AdminHandlers:
         self.bm = bucket_meta
         self.repl = repl_pool
         self.tiers = tiers
+        self.logger = logger
         self.started = time.time()
 
     # --- routing ---
@@ -68,11 +69,13 @@ class AdminHandlers:
             ("GET", "list-remote-targets"): "list_remote_targets",
             ("DELETE", "remove-remote-target"): "remove_remote_target",
             ("GET", "replication-stats"): "replication_stats",
+            ("GET", "bandwidth"): "bandwidth_report",
             ("PUT", "set-bucket-quota"): "set_bucket_quota",
             ("GET", "get-bucket-quota"): "get_bucket_quota",
             ("POST", "start-profiling"): "start_profiling",
             ("GET", "download-profiling"): "download_profiling",
             ("GET", "audit-log"): "audit_log",
+            ("GET", "console"): "console_log",
             ("GET", "healthinfo"): "health_info",
             ("PUT", "add-tier"): "add_tier",
             ("GET", "list-tiers"): "list_tiers",
@@ -114,11 +117,13 @@ class AdminHandlers:
         "start_profiling": "admin:Profiling",
         "download_profiling": "admin:Profiling",
         "audit_log": "admin:ServerTrace",
+        "console_log": "admin:ConsoleLog",
         "health_info": "admin:OBDInfo",
         "add_tier": "admin:SetTier",
         "list_tiers": "admin:ListTier",
         "remove_tier": "admin:SetTier",
         "replication_stats": "admin:ReplicationDiff",
+        "bandwidth_report": "admin:BandwidthMonitor",
     }
 
     def authorize(self, auth_result, name: str):
@@ -210,6 +215,11 @@ class AdminHandlers:
     def metrics_snapshot(self, ctx) -> Response:
         if self.metrics is None:
             return Response(200, {"Content-Type": "text/plain"}, b"")
+        collector = getattr(self, "collector", None)
+        if collector is not None:
+            # Snapshot gauges are computed at scrape time from live
+            # subsystems (ref cmd/metrics-v2.go handler-side collection).
+            collector.collect()
         return Response(
             200, {"Content-Type": "text/plain; version=0.0.4"},
             self.metrics.render_prometheus().encode(),
@@ -364,10 +374,20 @@ class AdminHandlers:
 
     def trace_poll(self, ctx) -> Response:
         """Bounded poll of the trace bus (the reference streams chunked
-        JSON; a poll window keeps the HTTP layer simple)."""
+        JSON; a poll window keeps the HTTP layer simple). With a peer
+        mesh attached, remote nodes' buses are polled CONCURRENTLY and
+        merged time-ordered (ref `mc admin trace` pulling
+        peerRESTMethodTrace from every node)."""
         if self.trace is None:
             return self._json([])
         wait_s = min(float(ctx.qdict.get("wait", "2")), 10.0)
+        peer_future = None
+        if self.notification is not None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=1)
+            peer_future = pool.submit(self.notification.trace_poll, wait_s)
+            pool.shutdown(wait=False)
         q = self.trace.subscribe()
         out = []
         deadline = time.time() + wait_s
@@ -379,6 +399,12 @@ class AdminHandlers:
                     break
         finally:
             self.trace.unsubscribe(q)
+        if peer_future is not None:
+            try:
+                out.extend(peer_future.result(timeout=wait_s + 5))
+                out.sort(key=lambda e: e.get("time_ns", 0))
+            except Exception:  # noqa: BLE001 - peers down: local only
+                pass
         return self._json(out)
 
     def service_action(self, ctx) -> Response:
@@ -413,7 +439,12 @@ class AdminHandlers:
                     and self._profiler.running:
                 raise S3Error("InvalidRequest", "profiling already running")
             self._profiler = SamplingProfiler().start()
-        return self._json({"status": "profiling started"})
+        status = {"status": "profiling started"}
+        if self.notification is not None:
+            # Mesh-wide: every node starts its own sampler (ref
+            # NotificationSys.StartProfiling, cmd/notification.go:287).
+            status["peers"] = self.notification.start_profiling()
+        return self._json(status)
 
     def download_profiling(self, ctx) -> Response:
         with self._prof_lock:
@@ -422,8 +453,31 @@ class AdminHandlers:
                 raise S3Error("InvalidRequest", "profiling is not running")
             self._profiler = None
         report = prof.stop_and_report()
+        if self.notification is not None:
+            # Per-node reports keyed by endpoint (the reference zips
+            # per-node pprof files, DownloadProfilingData).
+            bundle = {"local": report}
+            bundle.update(self.notification.download_profiling())
+            return self._json(bundle)
         return Response(200, {"Content-Type": "text/plain"},
                         report.encode())
+
+    def console_log(self, ctx) -> Response:
+        """Recent structured log entries, mesh-wide when peers are
+        attached (ref `mc admin console` over peer /log,
+        cmd/consolelogger.go)."""
+        try:
+            n = int(ctx.qdict.get("n", "100"))
+        except ValueError:
+            n = 100
+        n = max(1, min(n, 1024))
+        entries = []
+        if self.logger is not None:
+            entries = [dict(e, node="local") for e in self.logger.recent(n)]
+        if self.notification is not None:
+            entries.extend(self.notification.console_log(n))
+            entries.sort(key=lambda e: e.get("time", ""))
+        return self._json(entries[-n:])
 
     def audit_log(self, ctx) -> Response:
         audit = getattr(self, "audit", None)
@@ -633,3 +687,15 @@ class AdminHandlers:
         if self.repl is None:
             return self._json({})
         return self._json(dict(self.repl.stats))
+
+    def bandwidth_report(self, ctx) -> Response:
+        """Per-bucket/target outbound bandwidth (ref madmin
+        BucketBandwidthReport via admin BandwidthMonitor route)."""
+        if self.repl is None:
+            return self._json({"bucketStats": {}})
+        report = self.repl.bandwidth.report()
+        buckets = ctx.qdict.get("buckets", "")
+        if buckets:
+            wanted = set(b for b in buckets.split(",") if b)
+            report = {b: v for b, v in report.items() if b in wanted}
+        return self._json({"bucketStats": report})

@@ -274,7 +274,7 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
                  sse_config=None, quota=None, tier_engine=None,
-                 tiers=None):
+                 tiers=None, logger=None):
         from ..replication import ReplicationPool
 
         self.repl_pool = ReplicationPool(
@@ -290,6 +290,7 @@ class S3Server:
             object_layer, iam, config_sys=config_sys, metrics=metrics,
             trace=trace, notification=notification,
             bucket_meta=bucket_meta, repl_pool=self.repl_pool, tiers=tiers,
+            logger=logger,
         )
         from ..observability.audit import AuditLogger
 
@@ -359,20 +360,52 @@ class S3Server:
         import time as _time
 
         t0 = _time.monotonic_ns()
+        if self.metrics is not None:
+            self.metrics.inc_gauge("s3_requests_inflight")
+        err_code = ""
         try:
             resp = self._process(ctx)
         except S3Error as exc:
+            err_code = exc.api.code
             resp = Response(
                 exc.api.status,
                 {"Content-Type": "application/xml"},
                 error_xml(exc.api, ctx.path, ctx.request_id, exc.detail),
             )
         except Exception as exc:  # noqa: BLE001 — render as InternalError
+            err_code = "InternalError"
             api = API_ERRORS["InternalError"]
             resp = Response(
                 api.status, {"Content-Type": "application/xml"},
                 error_xml(api, ctx.path, ctx.request_id, str(exc)),
             )
+        if self.metrics is not None:
+            api_name = getattr(ctx, "api_name", "") or "unknown"
+            self.metrics.inc_gauge("s3_requests_inflight", -1)
+            self.metrics.observe(
+                "s3_request_seconds",
+                (_time.monotonic_ns() - t0) / 1e9, api=api_name,
+            )
+            if ctx.content_length:
+                self.metrics.inc("s3_rx_bytes_total", ctx.content_length)
+            # Streaming responses (GETs — the dominant tx path) carry no
+            # body buffer; their size is the declared Content-Length.
+            if resp.body_stream is not None:
+                try:
+                    tx = int(resp.headers.get("Content-Length", "0") or 0)
+                except ValueError:
+                    tx = 0
+            else:
+                tx = len(resp.body)
+            if tx:
+                self.metrics.inc("s3_tx_bytes_total", tx)
+            if err_code:
+                self.metrics.inc(
+                    "s3_errors_total", api=api_name, code=err_code
+                )
+                if err_code in ("AccessDenied", "SignatureDoesNotMatch",
+                                "InvalidAccessKeyId"):
+                    self.metrics.inc("s3_auth_failures_total", code=err_code)
         if self.audit is not None and not ctx.path.startswith(
                 "/minio/health/"):
             # Single audit choke point: every response — including auth

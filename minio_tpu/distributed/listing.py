@@ -1,0 +1,137 @@
+"""Cross-node metacache listing coordination — the distributed analog of
+the reference's peer-managed metacache (cmd/metacache-server-pool.go:59,
+cmd/metacache-bucket.go, peerRESTMethodGetMetacacheListing /
+UpdateMetacacheListing).
+
+The reference makes one node the manager of each bucket's listings so
+that concurrent ListObjects calls from different nodes share ONE
+resumable walk instead of each walking every disk. Here the same idea,
+re-shaped for this runtime's generation-based caches:
+
+- Each (bucket, prefix) listing has a deterministic OWNER node (hash of
+  the listing path over the sorted node set — sipHashMod's role for
+  objects, applied to listings).
+- A page request on a non-owner node is proxied to the owner over the
+  peer control plane (`list_page`), so the owner's ListingCache serves
+  every node's pages and each disk is still walked only once per
+  generation, cluster-wide.
+- If the owner is unreachable the node serves the page from its own
+  local cache (availability over shared-walk efficiency — same
+  degradation the reference takes when the cache owner is down).
+- Mutations anywhere broadcast a batched `bump_listing_gen` to peers so
+  every node's generation counter moves and stale caches die at the
+  next page (the reference leans on bloom-filter cycles + time windows;
+  a 50 ms batch window gives cross-node read-your-writes instead).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+from .rest import RPCError
+
+BATCH_WINDOW_S = 0.05
+
+
+class ListingCoordinator:
+    """Routes metacache page requests to the listing's owner node and
+    propagates mutation-driven generation bumps to peers."""
+
+    def __init__(self, object_layer, self_endpoint: str, peers: dict):
+        """peers: {endpoint: PeerClient} for every OTHER node."""
+        self.ol = object_layer
+        self.self_endpoint = self_endpoint
+        self.peers = dict(peers)
+        self._nodes = sorted([self_endpoint, *peers])
+        # stats (exported for tests/metrics)
+        self.local_pages = 0
+        self.remote_pages = 0
+        self.fallback_pages = 0
+        # mutation broadcast batcher
+        self._dirty: set[str] = set()
+        self._dirty_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._broadcast_loop, daemon=True, name="mtpu-listgen"
+        )
+        self._thread.start()
+
+    # --- ownership ---
+
+    def owner_of(self, bucket: str, prefix: str) -> str:
+        h = zlib.crc32(f"{bucket}/{prefix}".encode())
+        return self._nodes[h % len(self._nodes)]
+
+    # --- paging ---
+
+    def page(self, bucket: str, prefix: str, gen: int, marker: str,
+             count: int, stream_factory):
+        owner = self.owner_of(bucket, prefix)
+        if owner == self.self_endpoint:
+            self.local_pages += 1
+            return self.ol._metacache.page(
+                bucket, prefix, gen, marker, count, stream_factory
+            )
+        peer = self.peers[owner]
+        try:
+            # The caller's generation rides along so the owner's view is
+            # at least as fresh as the caller's — without it, a write on
+            # this node followed by an immediate list could be served
+            # from an owner cache built before the write (the 50 ms bump
+            # broadcast may not have landed yet).
+            res = peer.call("list_page", {
+                "bucket": bucket, "prefix": prefix,
+                "marker": marker, "count": str(count), "gen": str(gen),
+            })
+            self.remote_pages += 1
+            return (
+                [(n, b) for n, b in res["entries"]],
+                bool(res["exhausted"]),
+            )
+        except RPCError:
+            # Owner down: serve from the local cache (reference behavior:
+            # fall back to a locally-managed listing).
+            self.fallback_pages += 1
+            return self.ol._metacache.page(
+                bucket, prefix, gen, marker, count, stream_factory
+            )
+
+    # --- mutation propagation ---
+
+    def notify_mutation(self, bucket: str):
+        """Called by the object layer on every listing-invalidating
+        write; batched into one broadcast per window."""
+        with self._dirty_lock:
+            self._dirty.add(bucket)
+        self._wake.set()
+
+    def flush(self):
+        """Synchronously broadcast pending bumps (tests/shutdown)."""
+        self._drain()
+
+    def _drain(self):
+        with self._dirty_lock:
+            dirty, self._dirty = self._dirty, set()
+        for bucket in dirty:
+            for peer in self.peers.values():
+                try:
+                    peer.call("bump_listing_gen", {"bucket": bucket})
+                except RPCError:
+                    continue  # peer will rebuild its cache on reconnect
+
+    def _broadcast_loop(self):
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            self._stop.wait(BATCH_WINDOW_S)  # batch window
+            self._drain()
+
+    def close(self):
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2)
+        self._drain()

@@ -21,17 +21,23 @@ class PeerRESTServer:
 
     def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0,
                  bucket_meta=None, iam=None, object_layer=None,
-                 lockers=None, trace=None):
+                 lockers=None, trace=None, logger=None):
         self.bucket_meta = bucket_meta
         self.iam = iam
         self.object_layer = object_layer
         self.lockers = lockers
         self.trace = trace
+        self.logger = logger
+        self._profiler = None
+        self._prof_lock = __import__("threading").Lock()
         self.started_ns = time.time_ns()
         self.rpc = RPCServer(PEER_PREFIX, secret, host, port)
         for name in ("ping", "load_bucket_metadata", "delete_bucket_metadata",
                      "load_user", "load_policy", "server_info",
-                     "local_storage_info", "get_locks", "signal_service"):
+                     "local_storage_info", "get_locks", "signal_service",
+                     "list_page", "bump_listing_gen",
+                     "trace_poll", "start_profiling", "download_profiling",
+                     "console_log"):
             self.rpc.register(name, getattr(self, f"_h_{name}"))
 
     def start(self):
@@ -111,6 +117,97 @@ class PeerRESTServer:
         # restart/stop signaling is a host-process concern; recorded only.
         return {"signal": args.get("signal", ""), "accepted": True}
 
+    # --- metacache coordination (ref peerRESTMethodGetMetacacheListing;
+    # --- see distributed/listing.py for the design) ---
+
+    def _h_list_page(self, args, body):
+        """Serve one listing page from THIS node's metacache — called by
+        peers for listings this node owns."""
+        ol = self.object_layer
+        if ol is None or not hasattr(ol, "_metacache"):
+            raise RuntimeError("no listing-capable object layer")
+        bucket, prefix = args["bucket"], args.get("prefix", "")
+        marker, count = args.get("marker", ""), int(args["count"])
+        from ..object.metacache import StaleListingCache
+
+        # Advance to at least the caller's generation: a node that just
+        # wrote must never get a page older than its own write.
+        caller_gen = int(args.get("gen", "0"))
+        with ol._gen_lock:
+            if ol._list_gen.get(bucket, 0) < caller_gen:
+                ol._list_gen[bucket] = caller_gen
+        while True:
+            gen = ol._list_gen.get(bucket, 0)
+            factory = ol._merged_stream_factory(bucket, prefix)
+            try:
+                entries, exhausted = ol._metacache.page(
+                    bucket, prefix, gen, marker, count, factory
+                )
+                break
+            except StaleListingCache:
+                continue  # raced an invalidation; retry at the new gen
+        return {
+            "entries": [[n, bytes(b)] for n, b in entries],
+            "exhausted": exhausted,
+        }
+
+    def _h_bump_listing_gen(self, args, body):
+        """A peer mutated this bucket: move the local listing generation
+        so caches built before the write die at the next page."""
+        ol = self.object_layer
+        if ol is not None and hasattr(ol, "invalidate_listings"):
+            ol.invalidate_listings(args["bucket"])
+        return {}
+
+    # --- observability fan-in (ref peerRESTMethodTrace,
+    # --- NotificationSys.StartProfiling cmd/notification.go:287,
+    # --- peer /log console stream cmd/peer-rest-common.go:57) ---
+
+    def _h_trace_poll(self, args, body):
+        """Bounded poll of THIS node's trace bus for a mesh-wide
+        `mc admin trace` (the reference streams; a poll window keeps the
+        RPC plane request/response)."""
+        if self.trace is None:
+            return {"entries": []}
+        import queue as _queue
+
+        wait_s = min(float(args.get("wait", "1")), 10.0)
+        q = self.trace.subscribe()
+        out = []
+        deadline = time.time() + wait_s
+        try:
+            while time.time() < deadline and len(out) < 1000:
+                try:
+                    out.append(q.get(
+                        timeout=max(0.05, deadline - time.time())))
+                except _queue.Empty:
+                    break
+        finally:
+            self.trace.unsubscribe(q)
+        return {"entries": out}
+
+    def _h_start_profiling(self, args, body):
+        from ..observability.profiler import SamplingProfiler
+
+        with self._prof_lock:
+            if self._profiler is not None and self._profiler.running:
+                return {"status": "already running"}
+            self._profiler = SamplingProfiler().start()
+        return {"status": "started"}
+
+    def _h_download_profiling(self, args, body):
+        with self._prof_lock:
+            prof, self._profiler = self._profiler, None
+        if prof is None:
+            return {"report": "", "running": False}
+        return {"report": prof.stop_and_report(), "running": True}
+
+    def _h_console_log(self, args, body):
+        if self.logger is None:
+            return {"entries": []}
+        n = max(1, min(int(args.get("n", "100")), 1024))
+        return {"entries": self.logger.recent(n)}
+
 
 class PeerClient:
     """RPC client for one peer (ref cmd/peer-rest-client.go)."""
@@ -135,13 +232,21 @@ class NotificationSys:
         self.peers = peers
 
     def _broadcast(self, method: str, args: dict | None = None) -> list:
-        out = []
-        for p in self.peers:
+        """Call every peer CONCURRENTLY (the reference fans out with one
+        goroutine per peer; serial calls would stack trace-poll waits)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not self.peers:
+            return []
+
+        def one(p):
             try:
-                out.append(p.call(method, args))
+                return p.call(method, args)
             except RPCError as exc:
-                out.append(exc)
-        return out
+                return exc
+
+        with ThreadPoolExecutor(max_workers=min(8, len(self.peers))) as ex:
+            return list(ex.map(one, self.peers))
 
     def load_bucket_metadata(self, bucket: str):
         self._broadcast("load_bucket_metadata", {"bucket": bucket})
@@ -169,6 +274,50 @@ class NotificationSys:
             r for r in self._broadcast("get_locks")
             if not isinstance(r, Exception)
         ]
+
+    # --- observability fan-out (ref NotificationSys.StartProfiling,
+    # --- DownloadProfilingData, peer trace subscribe) ---
+
+    def trace_poll(self, wait_s: float = 1.0) -> list[dict]:
+        """Merged trace entries from every peer's bus, time-ordered."""
+        entries: list[dict] = []
+        for r in self._broadcast("trace_poll", {"wait": str(wait_s)}):
+            if not isinstance(r, Exception):
+                entries.extend(r.get("entries", []))
+        entries.sort(key=lambda e: e.get("time_ns", 0))
+        return entries
+
+    def start_profiling(self) -> dict:
+        out = {}
+        for p, r in zip(self.peers, self._broadcast("start_profiling")):
+            out[p.endpoint] = (
+                r.get("status") if not isinstance(r, Exception) else str(r)
+            )
+        return out
+
+    def download_profiling(self) -> dict:
+        """Per-node profile reports (the reference zips per-node pprof
+        files, cmd/notification.go DownloadProfilingData)."""
+        out = {}
+        for p, r in zip(self.peers, self._broadcast("download_profiling")):
+            if isinstance(r, Exception):
+                out[p.endpoint] = f"error: {r}"
+            elif r.get("running"):
+                out[p.endpoint] = r.get("report", "")
+        return out
+
+    def console_log(self, n: int = 100) -> list[dict]:
+        entries: list[dict] = []
+        for p, r in zip(self.peers,
+                        self._broadcast("console_log", {"n": str(n)})):
+            if isinstance(r, Exception):
+                continue
+            for e in r.get("entries", []):
+                e = dict(e)
+                e["node"] = p.endpoint
+                entries.append(e)
+        entries.sort(key=lambda e: e.get("time", ""))
+        return entries
 
 
 class BootstrapServer:

@@ -117,13 +117,21 @@ class DiskCache:
             return
         data_p, meta_p, base = self._paths(bucket, object_)
         with self._lock:
-            old = self._index.get(base, (0, 0))[1]
-            delta = len(data) - old
-            if self._usage + delta > self.quota * HIGH_WATERMARK:
-                self._gc_locked(delta)
-            if self._usage + delta > self.quota:
+            # Logically retire the old entry FIRST so GC can neither pick
+            # it as a victim nor double-subtract its size.
+            ent = self._index.pop(base, None)
+            old = ent[1] if ent else 0
+            self._usage -= old
+            if self._usage + len(data) > self.quota * HIGH_WATERMARK:
+                self._gc_locked(len(data))
+            if self._usage + len(data) > self.quota:
+                # Rejected: the old files are still on disk — restore
+                # their accounting.
+                if ent is not None:
+                    self._index[base] = ent
+                    self._usage += old
                 return
-            self._usage += delta
+            self._usage += len(data)
             self._index[base] = [time.time_ns(), len(data)]
         tmp = data_p + ".tmp"
         try:
@@ -148,7 +156,7 @@ class DiskCache:
                     pass
             with self._lock:
                 self._index.pop(base, None)
-                self._usage = max(0, self._usage - (old + delta))
+                self._usage = max(0, self._usage - len(data))
 
     def _evict(self, bucket: str, object_: str):
         _, _, base = self._paths(bucket, object_)

@@ -43,12 +43,36 @@ class ErasureServerPools:
         # that invalidates listings also marks the changed bucket so the
         # scanner can skip unchanged ones (ref dataUpdateTracker hooks).
         self.update_tracker = None
+        # Optional cross-node ListingCoordinator (distributed/listing.py):
+        # when set, pages route to the listing's owner node and mutations
+        # broadcast generation bumps to peers.
+        self.listing_coordinator = None
 
     def _bump_gen(self, bucket: str):
         with self._gen_lock:
             self._list_gen[bucket] = self._list_gen.get(bucket, 0) + 1
         if self.update_tracker is not None:
             self.update_tracker.mark(bucket)
+        if self.listing_coordinator is not None:
+            self.listing_coordinator.notify_mutation(bucket)
+
+    def invalidate_listings(self, bucket: str):
+        """Peer-driven generation bump (a remote node mutated `bucket`).
+        No tracker mark, no re-broadcast — just kill local caches."""
+        with self._gen_lock:
+            self._list_gen[bucket] = self._list_gen.get(bucket, 0) + 1
+
+    def _page(self, bucket: str, prefix: str, gen: int, marker: str,
+              count: int, stream_factory):
+        """One metacache page, routed through the cross-node coordinator
+        when configured (owner-node shared walks), else node-local."""
+        if self.listing_coordinator is not None:
+            return self.listing_coordinator.page(
+                bucket, prefix, gen, marker, count, stream_factory
+            )
+        return self._metacache.page(
+            bucket, prefix, gen, marker, count, stream_factory
+        )
 
     # --- pool routing ---
 
@@ -217,7 +241,7 @@ class ErasureServerPools:
             # Over-fetch: delimiter roll-up and delete markers consume
             # entries without emitting keys.
             try:
-                entries, exhausted = self._metacache.page(
+                entries, exhausted = self._page(
                     bucket, prefix, gen, cursor, max_keys + 1, stream_factory
                 )
             except StaleListingCache:
@@ -283,7 +307,7 @@ class ErasureServerPools:
         truncated = False
         while not truncated:
             try:
-                entries, exhausted = self._metacache.page(
+                entries, exhausted = self._page(
                     bucket, prefix, gen, cursor, max_keys + 1, stream_factory
                 )
             except StaleListingCache:

@@ -1,0 +1,127 @@
+"""Bandwidth monitoring + throttling for replication targets — the
+equivalent of the reference's pkg/bandwidth (Monitor with per-bucket
+measurement, throttle readers capping bytes/s per remote target) and the
+admin BandwidthMonitor endpoint (cmd/admin-router.go).
+
+Accounting: a sliding 2 s window of (timestamp, bytes) samples per
+(bucket, target-arn) gives the current rate; totals accumulate forever.
+Throttling: a token bucket refilled at the configured limit; account()
+sleeps until enough tokens exist, so wrapping a reader paces the whole
+transfer without chunk-size tuning.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+WINDOW_S = 2.0
+
+
+class _Flow:
+    """One (bucket, arn) flow: measurement + optional token bucket."""
+
+    def __init__(self, limit_bps: int = 0):
+        self.limit_bps = limit_bps
+        self.total = 0
+        self.samples: deque[tuple[float, int]] = deque()
+        self._tokens = float(limit_bps)
+        self._last_refill = time.monotonic()
+        self.lock = threading.Lock()
+
+    def account(self, n: int):
+        """Record n bytes; block as needed to honor the limit."""
+        with self.lock:
+            now = time.monotonic()
+            self.total += n
+            self.samples.append((now, n))
+            cutoff = now - WINDOW_S
+            while self.samples and self.samples[0][0] < cutoff:
+                self.samples.popleft()
+            if self.limit_bps <= 0:
+                return
+            # token bucket: capacity = 1s worth of budget
+            self._tokens = min(
+                float(self.limit_bps),
+                self._tokens + (now - self._last_refill) * self.limit_bps,
+            )
+            self._last_refill = now
+            self._tokens -= n
+            deficit = -self._tokens
+        if deficit > 0:
+            time.sleep(deficit / self.limit_bps)
+
+    def current_bps(self) -> float:
+        with self.lock:
+            now = time.monotonic()
+            cutoff = now - WINDOW_S
+            while self.samples and self.samples[0][0] < cutoff:
+                self.samples.popleft()
+            if not self.samples:
+                return 0.0
+            span = max(now - self.samples[0][0], 1e-3)
+            return sum(n for _, n in self.samples) / span
+
+
+class ThrottledReader:
+    """Wrap a readable stream; every read is accounted (and paced when
+    the flow has a limit) — ref pkg/bandwidth MonitoredReader."""
+
+    def __init__(self, stream, flow: _Flow, chunk: int = 1 << 20):
+        self._stream = stream
+        self._flow = flow
+        self._chunk = chunk
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = self._chunk  # never account one giant burst
+        data = self._stream.read(n)
+        if data:
+            self._flow.account(len(data))
+        return data
+
+    def seek(self, *a, **k):
+        return self._stream.seek(*a, **k)
+
+    def tell(self):
+        return self._stream.tell()
+
+
+class BandwidthMonitor:
+    """Registry of flows keyed by (bucket, target-arn)."""
+
+    def __init__(self):
+        self._flows: dict[tuple[str, str], _Flow] = {}
+        self._lock = threading.Lock()
+
+    def set_limit(self, bucket: str, arn: str, limit_bps: int):
+        self._flow(bucket, arn).limit_bps = int(limit_bps)
+
+    def _flow(self, bucket: str, arn: str) -> _Flow:
+        key = (bucket, arn)
+        with self._lock:
+            f = self._flows.get(key)
+            if f is None:
+                f = self._flows[key] = _Flow()
+            return f
+
+    def monitor(self, stream, bucket: str, arn: str) -> ThrottledReader:
+        return ThrottledReader(stream, self._flow(bucket, arn))
+
+    def account(self, bucket: str, arn: str, n: int):
+        self._flow(bucket, arn).account(n)
+
+    def report(self) -> dict:
+        """madmin BucketBandwidthReport shape: bucket → arn → rates."""
+        out: dict = {}
+        with self._lock:
+            items = list(self._flows.items())
+        for (bucket, arn), f in items:
+            out.setdefault(bucket, {})[arn] = {
+                "limitInBytesPerSecond": f.limit_bps,
+                "currentBandwidthInBytesPerSecond": round(
+                    f.current_bps(), 2),
+                "totalBytes": f.total,
+            }
+        return out

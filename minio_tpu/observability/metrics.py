@@ -44,6 +44,13 @@ class Metrics:
         with self._mu:
             self._gauges.setdefault(name, {})[_label_key(labels)] = value
 
+    def inc_gauge(self, name: str, delta: float = 1.0, **labels):
+        """Additive gauge update (in-flight style up/down counters)."""
+        with self._mu:
+            series = self._gauges.setdefault(name, {})
+            key = _label_key(labels)
+            series[key] = series.get(key, 0.0) + delta
+
     def observe(self, name: str, value: float, **labels):
         with self._mu:
             series = self._hists.setdefault(name, {})

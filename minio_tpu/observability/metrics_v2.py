@@ -1,0 +1,242 @@
+"""Metrics v2: the typed descriptor catalog + scrape-time collector —
+the equivalent of the reference's ~60 metric descriptors in
+cmd/metrics-v2.go (API latencies, S3 request/error classes, per-disk IO,
+heal counters, replication bytes, scanner progress, bucket usage, node
+resources) rendered at /minio/v2/metrics/{cluster,node}.
+
+Two kinds of series:
+- **Event-driven** counters/histograms recorded where they happen
+  (request dispatch, disk ops via MetricsDisk, scanner, heal, events).
+- **Snapshot gauges** populated by `MetricsCollector.collect()` at
+  scrape time from the live subsystems (usage, disks, replication,
+  cache, process) — the reference does the same: most v2 metrics are
+  computed in the handler from global state, not accumulated.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# Descriptor catalog: (name, type, help). Mirrors the reference families
+# (cmd/metrics-v2.go getNodeMetrics/getClusterMetrics descriptor lists);
+# names keep the mtpu_ namespace prefix applied by the registry.
+DESCRIPTORS: list[tuple[str, str, str]] = [
+    # --- S3 API plane ---
+    ("s3_requests_total", "counter", "Total S3 requests by API"),
+    ("s3_responses_total", "counter", "S3 responses by API and status"),
+    ("s3_errors_total", "counter", "S3 error responses by API and code"),
+    ("s3_request_seconds", "histogram", "S3 request latency by API"),
+    ("s3_requests_inflight", "gauge", "S3 requests currently in flight"),
+    ("s3_rx_bytes_total", "counter", "Bytes received in S3 request bodies"),
+    ("s3_tx_bytes_total", "counter", "Bytes sent in S3 response bodies"),
+    ("s3_auth_failures_total", "counter", "Rejected signatures/policies"),
+    # --- per-disk storage ---
+    ("disk_ops_total", "counter", "Storage ops by op and disk"),
+    ("disk_op_errors_total", "counter", "Failed storage ops by op/disk"),
+    ("disk_op_seconds", "histogram", "Storage op latency by op"),
+    ("disk_total_bytes", "gauge", "Disk capacity by disk"),
+    ("disk_free_bytes", "gauge", "Disk free space by disk"),
+    ("disk_used_bytes", "gauge", "Disk used space by disk"),
+    ("disk_online", "gauge", "1 when the disk is online"),
+    ("disks_offline_count", "gauge", "Offline disks in the deployment"),
+    ("disk_offline_total", "counter", "Disk offline transitions"),
+    ("disk_reconnect_total", "counter", "Disk reconnect events"),
+    # --- erasure/heal ---
+    ("heal_objects_total", "counter", "Objects healed by trigger"),
+    ("heal_failures_total", "counter", "Object heal failures"),
+    ("mrf_healed_total", "counter", "MRF queue entries healed"),
+    ("mrf_pending", "gauge", "MRF entries awaiting heal"),
+    # --- scanner / ILM / usage ---
+    ("scanner_cycles_total", "counter", "Completed scanner cycles"),
+    ("scanner_objects_total", "counter", "Objects visited by the scanner"),
+    ("scanner_heal_checks_total", "counter", "Scanner deep heal checks"),
+    ("scanner_buckets_skipped_total", "counter",
+     "Buckets skipped via the update tracker"),
+    ("ilm_expired_total", "counter", "Objects expired by lifecycle"),
+    ("ilm_transitioned_total", "counter", "Objects tiered by lifecycle"),
+    ("ilm_restored_total", "counter", "Objects restored from tiers"),
+    ("usage_last_activity_ns", "gauge", "Scanner usage snapshot age"),
+    ("bucket_usage_total_bytes", "gauge", "Bucket logical size"),
+    ("bucket_usage_object_count", "gauge", "Bucket object count"),
+    ("usage_total_bytes", "gauge", "Deployment logical size"),
+    ("usage_object_total", "gauge", "Deployment object count"),
+    ("usage_bucket_total", "gauge", "Number of buckets"),
+    # --- replication / bandwidth ---
+    ("replication_queued_total", "counter", "Replication tasks queued"),
+    ("replication_completed_total", "counter", "Replication successes"),
+    ("replication_failed_total", "counter", "Replication failures"),
+    ("replication_retried_total", "counter", "Replication retries"),
+    ("replication_pending", "gauge", "Replication tasks in queue"),
+    ("replication_bandwidth_bytes_total", "counter",
+     "Bytes shipped to replication targets"),
+    ("replication_bandwidth_limit_bytes", "gauge",
+     "Configured byte/s limit per bucket/target"),
+    ("replication_bandwidth_current_bytes", "gauge",
+     "Current byte/s per bucket/target"),
+    # --- events / notifications ---
+    ("events_sent_total", "counter", "Notification events delivered"),
+    ("events_errors_total", "counter", "Notification delivery errors"),
+    ("events_dropped_total", "counter", "Notification events dropped"),
+    # --- disk cache ---
+    ("cache_hits_total", "counter", "Disk cache hits"),
+    ("cache_misses_total", "counter", "Disk cache misses"),
+    ("cache_usage_bytes", "gauge", "Disk cache bytes used"),
+    ("cache_quota_bytes", "gauge", "Disk cache quota"),
+    # --- IAM / STS ---
+    ("iam_users", "gauge", "IAM users"),
+    ("iam_policies", "gauge", "Canned policies"),
+    ("iam_sts_credentials", "gauge", "Live STS credentials"),
+    # --- node / process ---
+    ("node_uptime_seconds", "gauge", "Process uptime"),
+    ("node_threads", "gauge", "Live threads (goroutine analog)"),
+    ("node_rss_bytes", "gauge", "Resident set size"),
+    ("node_open_fds", "gauge", "Open file descriptors"),
+    ("node_cpu_seconds_total", "gauge", "Process CPU time"),
+]
+
+
+def describe_all(metrics) -> None:
+    for name, _type, help_text in DESCRIPTORS:
+        metrics.describe(name, help_text)
+
+
+class MetricsCollector:
+    """Populates snapshot gauges from live subsystems at scrape time.
+    Attach the pieces that exist; everything is optional."""
+
+    def __init__(self, metrics, object_layer=None, scanner=None,
+                 repl_pool=None, cache=None, iam=None, mrf=None):
+        self.metrics = metrics
+        self.ol = object_layer
+        self.scanner = scanner
+        self.repl = repl_pool
+        self.cache = cache
+        self.iam = iam
+        self.mrf = mrf
+        self.started = time.time()
+        describe_all(metrics)
+
+    def collect(self):
+        m = self.metrics
+        self._collect_disks(m)
+        self._collect_usage(m)
+        self._collect_replication(m)
+        self._collect_cache(m)
+        self._collect_iam(m)
+        self._collect_node(m)
+
+    def _collect_disks(self, m):
+        if self.ol is None:
+            return
+        offline = 0
+        for pool in getattr(self.ol, "pools", []):
+            for d in pool.disks:
+                if d is None:
+                    offline += 1
+                    continue
+                ep = d.endpoint()
+                try:
+                    online = d.is_online()
+                except Exception:  # noqa: BLE001
+                    online = False
+                m.set_gauge("disk_online", 1.0 if online else 0.0, disk=ep)
+                if not online:
+                    offline += 1
+                    continue
+                try:
+                    di = d.disk_info()
+                except Exception:  # noqa: BLE001
+                    continue
+                m.set_gauge("disk_total_bytes", di.total, disk=ep)
+                m.set_gauge("disk_free_bytes", di.free, disk=ep)
+                m.set_gauge("disk_used_bytes", di.used, disk=ep)
+        m.set_gauge("disks_offline_count", offline)
+
+    def _collect_usage(self, m):
+        if self.scanner is None:
+            return
+        usage = getattr(self.scanner, "usage", None)
+        if usage is None or not usage.last_update_ns:
+            return
+        m.set_gauge("usage_last_activity_ns",
+                    time.time_ns() - usage.last_update_ns)
+        m.set_gauge("usage_total_bytes", usage.objects_total_size)
+        m.set_gauge("usage_object_total", usage.objects_total_count)
+        m.set_gauge("usage_bucket_total", len(usage.buckets_usage))
+        for bucket, bu in usage.buckets_usage.items():
+            m.set_gauge("bucket_usage_total_bytes", bu.objects_size,
+                        bucket=bucket)
+            m.set_gauge("bucket_usage_object_count", bu.objects_count,
+                        bucket=bucket)
+
+    def _collect_replication(self, m):
+        if self.repl is None:
+            return
+        stats = self.repl.stats
+        for key, metric in (
+            ("queued", "replication_queued_total"),
+            ("completed", "replication_completed_total"),
+            ("failed", "replication_failed_total"),
+            ("retried", "replication_retried_total"),
+        ):
+            # Mirror pool counters into the registry (set as gauges to
+            # avoid double-counting with repeated scrapes).
+            m.set_gauge(metric, stats.get(key, 0))
+        m.set_gauge(
+            "replication_pending",
+            len(self.repl._queue) + len(self.repl._retry),
+        )
+        for bucket, flows in self.repl.bandwidth.report().items():
+            for arn, f in flows.items():
+                m.set_gauge("replication_bandwidth_limit_bytes",
+                            f["limitInBytesPerSecond"],
+                            bucket=bucket, target=arn)
+                m.set_gauge("replication_bandwidth_current_bytes",
+                            f["currentBandwidthInBytesPerSecond"],
+                            bucket=bucket, target=arn)
+
+    def _collect_cache(self, m):
+        cache_layer = self.cache
+        if cache_layer is None:
+            return
+        cache = getattr(cache_layer, "cache", None)
+        if cache is None:
+            return
+        m.set_gauge("cache_hits_total", cache.hits)
+        m.set_gauge("cache_misses_total", cache.misses)
+        m.set_gauge("cache_usage_bytes", cache.usage)
+        m.set_gauge("cache_quota_bytes", cache.quota)
+
+    def _collect_iam(self, m):
+        if self.iam is None:
+            return
+        try:
+            m.set_gauge("iam_users", len(self.iam.users))
+            m.set_gauge("iam_policies", len(self.iam.policies))
+            m.set_gauge("iam_sts_credentials", len(self.iam.sts))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _collect_node(self, m):
+        m.set_gauge("node_uptime_seconds", time.time() - self.started)
+        m.set_gauge("node_threads", threading.active_count())
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        m.set_gauge("node_rss_bytes",
+                                    int(line.split()[1]) * 1024)
+                        break
+        except OSError:
+            pass
+        try:
+            m.set_gauge("node_open_fds", len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        try:
+            t = os.times()
+            m.set_gauge("node_cpu_seconds_total", t.user + t.system)
+        except OSError:
+            pass

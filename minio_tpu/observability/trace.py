@@ -35,12 +35,19 @@ class TraceHub:
 
 class Logger:
     """Structured JSON logger with once-per-error dedup
-    (ref cmd/logger LogIf + logonce.go)."""
+    (ref cmd/logger LogIf + logonce.go) and a bounded console ring so
+    `mc admin console`-style consumers can pull recent entries per node
+    (ref cmd/consolelogger.go:35-160 HTTPConsoleLoggerSys)."""
+
+    RING = 512
 
     def __init__(self, stream=None):
+        from collections import deque
+
         self._stream = stream or sys.stderr
         self._mu = threading.Lock()
         self._seen: dict[str, float] = {}
+        self._ring: "deque[dict]" = deque(maxlen=self.RING)
 
     def log(self, level: str, message: str, **fields):
         entry = {
@@ -50,7 +57,12 @@ class Logger:
         }
         entry.update(fields)
         with self._mu:
+            self._ring.append(entry)
             self._stream.write(json.dumps(entry) + "\n")
+
+    def recent(self, n: int = 100) -> list[dict]:
+        with self._mu:
+            return list(self._ring)[-n:]
 
     def info(self, message: str, **fields):
         self.log("INFO", message, **fields)

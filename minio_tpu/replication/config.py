@@ -101,12 +101,16 @@ class ReplicationTarget:
     secret_key: str = ""
     target_bucket: str = ""
     region: str = "us-east-1"
+    # Outbound byte/s cap for this target; 0 = unlimited (ref
+    # madmin.BucketTarget.BandwidthLimit, enforced via pkg/bandwidth).
+    bandwidth_limit: int = 0
 
     def to_dict(self) -> dict:
         return {
             "arn": self.arn, "endpoint": self.endpoint,
             "access_key": self.access_key, "secret_key": self.secret_key,
             "target_bucket": self.target_bucket, "region": self.region,
+            "bandwidth_limit": self.bandwidth_limit,
         }
 
     @classmethod
@@ -114,7 +118,8 @@ class ReplicationTarget:
         return cls(**{k: d.get(k, "") for k in (
             "arn", "endpoint", "access_key", "secret_key",
             "target_bucket",
-        )}, region=d.get("region", "us-east-1"))
+        )}, region=d.get("region", "us-east-1"),
+            bandwidth_limit=int(d.get("bandwidth_limit", 0) or 0))
 
 
 def load_targets(raw_json: str) -> list[ReplicationTarget]:

@@ -66,6 +66,11 @@ class ReplicationPool:
         self.stats = {"queued": 0, "completed": 0, "failed": 0,
                       "retried": 0, "dropped": 0}
         self._inflight = 0
+        # Per-(bucket, target) outbound accounting + throttling
+        # (ref pkg/bandwidth Monitor wired into replication).
+        from ..observability.bandwidth import BandwidthMonitor
+
+        self.bandwidth = BandwidthMonitor()
 
     def start(self) -> "ReplicationPool":
         for t in self._threads:
@@ -211,8 +216,21 @@ class ReplicationPool:
                 # Mark the copy as a replica so the target doesn't
                 # re-replicate (ref ReplicationStatusReplica).
                 headers["x-amz-meta-mtpu-replication"] = "replica"
+                spool.seek(0, 2)
+                nbytes = spool.tell()
                 for t in matched:
                     spool.seek(0)
+                    # Unconditional: clearing a limit (back to 0) must
+                    # actually lift the throttle on the live flow.
+                    self.bandwidth.set_limit(
+                        task.bucket, t.arn, t.bandwidth_limit
+                    )
+                    # Account/pace per transfer, not per read: the client
+                    # walks the body twice (signature hash + send), so a
+                    # wrapping reader would double-count. The token
+                    # bucket still enforces the average byte/s cap
+                    # across successive transfers (ref pkg/bandwidth).
+                    self.bandwidth.account(task.bucket, t.arn, nbytes)
                     self._client_for(t).put_object(
                         t.target_bucket or task.bucket, task.object, spool,
                         metadata=headers,

@@ -225,6 +225,18 @@ class Server:
             quota=BucketQuotaSys(self.object_layer, self.bucket_meta,
                                  usage_fn=_scanner_usage),
             tier_engine=self.tier_engine, tiers=self.tiers,
+            logger=self.logger,
+        )
+        # Scrape-time gauge collector over every live subsystem (the
+        # reference computes most v2 metrics in the handler from global
+        # state; ref cmd/metrics-v2.go).
+        from .observability.metrics_v2 import MetricsCollector
+
+        self.s3.admin.collector = MetricsCollector(
+            self.metrics, object_layer=self.object_layer,
+            scanner=self.scanner, repl_pool=self.s3.repl_pool,
+            cache=self.cache_layer, iam=self.iam,
+            mrf=self.mrf,
         )
         self.started_ns = time.time_ns()
 

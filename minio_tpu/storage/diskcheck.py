@@ -53,6 +53,7 @@ class MetricsDisk:
         self._metrics = metrics
         self._expected_id = expected_disk_id
         self._last_check = 0.0
+        self._stale = False
 
     # --- identity passthrough ---
 
@@ -95,12 +96,20 @@ class MetricsDisk:
         until the heal/format machinery re-admits it (ref errDiskStale)."""
         if not self._expected_id:
             return
+        if self._stale:
+            # Latched: once a swap is detected EVERY op fails until the
+            # disk is re-admitted (ref errDiskStale semantics) — a
+            # per-interval check must not let ops through in between.
+            raise ErrDiskNotFound(
+                f"stale disk: expected id {self._expected_id}"
+            )
         now = time.monotonic()
         if now - self._last_check < _ID_CHECK_INTERVAL_S:
             return
         self._last_check = now
         actual = self._disk.get_disk_id()
         if actual and actual != self._expected_id:
+            self._stale = True
             raise ErrDiskNotFound(
                 f"disk id changed: have {actual}, want {self._expected_id}"
             )

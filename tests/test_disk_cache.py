@@ -99,3 +99,48 @@ def test_versioned_reads_bypass_cache(stack):
     # targeted version reads never touch the cache
     assert ol.get_object_bytes("cb", "v", opts=opts) == b"ver"
     assert cache.usage == before
+
+
+def test_overwrite_gc_does_not_double_subtract(tmp_path):
+    """Re-putting the LRU victim itself while GC fires must not corrupt
+    usage accounting (regression: old size subtracted twice)."""
+    import time
+
+    from minio_tpu.object.cache import DiskCache
+
+    cache = DiskCache(str(tmp_path / "c"), quota_bytes=1000)
+    cache.put("b", "A", "e1", b"a" * 500)
+    time.sleep(0.002)
+    cache.put("b", "B", "e2", b"b" * 400)
+    # Overwrite A (the LRU entry) with a bigger body: crosses the high
+    # watermark, GC runs, and A itself must not be double-counted.
+    cache.put("b", "A", "e3", b"c" * 520)
+    # Usage equals the sum of sizes of entries actually indexed.
+    with cache._lock:
+        indexed = sum(e[1] for e in cache._index.values())
+    assert cache.usage == indexed
+    assert cache.usage >= 0
+
+
+def test_stale_disk_latches(tmp_path):
+    """After a detected disk swap, EVERY subsequent op fails — not just
+    one per check interval."""
+    import pytest
+
+    from minio_tpu.observability.metrics import Metrics
+    from minio_tpu.storage.diskcheck import MetricsDisk
+    from minio_tpu.storage.local import LocalStorage
+    from minio_tpu.utils.errors import ErrDiskNotFound
+
+    disk = LocalStorage(str(tmp_path / "d"), endpoint="d")
+    disk.make_vol(".minio.sys")
+    disk.set_disk_id("good-id")
+    w = MetricsDisk(disk, Metrics(), expected_disk_id="good-id")
+    w.make_vol("v")
+    disk.set_disk_id("swapped-id")
+    w._last_check = -1e9
+    with pytest.raises(ErrDiskNotFound):
+        w.write_all("v", "x", b"1")
+    # Immediately after (within the 5s window): still refused.
+    with pytest.raises(ErrDiskNotFound):
+        w.read_all("v", "x")

@@ -1,0 +1,139 @@
+"""Cross-node metacache coordination: owner-routed listing pages over
+the peer plane, mutation-driven generation broadcast, and owner-down
+fallback (ref cmd/metacache-server-pool.go:59, metacache-bucket.go,
+peerRESTMethodGetMetacacheListing)."""
+
+import io
+
+import pytest
+
+from minio_tpu.distributed.listing import ListingCoordinator
+from minio_tpu.distributed.peer import PeerClient, PeerRESTServer
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage.local import LocalStorage
+
+SECRET = "listing-secret"
+DEP_ID = "11111111-2222-3333-4444-555555555555"
+
+
+def _mk_node(tmp_path, fresh: bool) -> ErasureServerPools:
+    """One 'node': its own ErasureServerPools over the SHARED disk dirs
+    (two processes of one deployment see the same drives)."""
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    sets = ErasureSets(disks, 4, deployment_id=DEP_ID, pool_index=0)
+    if fresh:
+        sets.init_format()
+    else:
+        sets.load_format()
+    return ErasureServerPools([sets])
+
+
+@pytest.fixture()
+def mesh(tmp_path):
+    ol_a = _mk_node(tmp_path, fresh=True)
+    ol_a.make_bucket("shared")
+    ol_b = _mk_node(tmp_path, fresh=False)
+
+    srv_a = PeerRESTServer(SECRET, object_layer=ol_a).start()
+    srv_b = PeerRESTServer(SECRET, object_layer=ol_b).start()
+    ep_a, ep_b = srv_a.endpoint, srv_b.endpoint
+
+    coord_a = ListingCoordinator(
+        ol_a, ep_a, {ep_b: PeerClient(ep_b, SECRET)}
+    )
+    coord_b = ListingCoordinator(
+        ol_b, ep_b, {ep_a: PeerClient(ep_a, SECRET)}
+    )
+    ol_a.listing_coordinator = coord_a
+    ol_b.listing_coordinator = coord_b
+    yield ol_a, ol_b, coord_a, coord_b, srv_a, srv_b
+    coord_a.close()
+    coord_b.close()
+    srv_a.stop()
+    srv_b.stop()
+
+
+def _put(ol, bucket, key, payload=b"x" * 1024):
+    ol.put_object(bucket, key, io.BytesIO(payload), len(payload))
+
+
+def test_owner_is_deterministic_and_shared(mesh):
+    ol_a, ol_b, coord_a, coord_b, *_ = mesh
+    assert coord_a.owner_of("shared", "") == coord_b.owner_of("shared", "")
+    assert coord_a._nodes == coord_b._nodes
+
+
+def test_non_owner_proxies_to_owner(mesh):
+    ol_a, ol_b, coord_a, coord_b, *_ = mesh
+    for i in range(5):
+        _put(ol_a, "shared", f"obj-{i}")
+    coord_a.flush()
+
+    owner = coord_a.owner_of("shared", "")
+    if owner == coord_a.self_endpoint:
+        owner_coord, other_ol, other_coord = coord_a, ol_b, coord_b
+    else:
+        owner_coord, other_ol, other_coord = coord_b, ol_a, coord_a
+
+    res = other_ol.list_objects("shared")
+    assert [o.name for o in res.objects] == [f"obj-{i}" for i in range(5)]
+    assert other_coord.remote_pages >= 1
+    assert other_coord.fallback_pages == 0
+    # The owner's cache served the walk exactly once cluster-wide: a
+    # second listing from the other node pages the SAME owner cache.
+    res2 = other_ol.list_objects("shared")
+    assert [o.name for o in res2.objects] == [o.name for o in res.objects]
+
+
+def test_mutation_on_non_owner_visible_through_owner(mesh):
+    ol_a, ol_b, coord_a, coord_b, *_ = mesh
+    _put(ol_a, "shared", "first")
+    coord_a.flush()
+    assert [o.name for o in ol_b.list_objects("shared").objects] == ["first"]
+
+    # Write through the OTHER node; its gen bump must reach the owner so
+    # the owner's cached walk is rebuilt.
+    _put(ol_b, "shared", "second")
+    coord_b.flush()
+    names_a = [o.name for o in ol_a.list_objects("shared").objects]
+    names_b = [o.name for o in ol_b.list_objects("shared").objects]
+    assert names_a == names_b == ["first", "second"]
+
+
+def test_owner_down_falls_back_to_local(mesh):
+    ol_a, ol_b, coord_a, coord_b, srv_a, srv_b = mesh
+    _put(ol_a, "shared", "k1")
+    coord_a.flush()
+
+    owner = coord_a.owner_of("shared", "")
+    # Kill the owner's peer server; the non-owner must still list.
+    if owner == coord_a.self_endpoint:
+        srv_a.stop()
+        victim_ol, victim_coord = ol_b, coord_b
+    else:
+        srv_b.stop()
+        victim_ol, victim_coord = ol_a, coord_a
+    res = victim_ol.list_objects("shared")
+    assert [o.name for o in res.objects] == ["k1"]
+    assert victim_coord.fallback_pages >= 1
+
+
+def test_paged_listing_through_coordinator(mesh):
+    ol_a, ol_b, coord_a, coord_b, *_ = mesh
+    keys = [f"p/{i:03d}" for i in range(25)]
+    for k in keys:
+        _put(ol_a, "shared", k, payload=b"v")
+    coord_a.flush()
+
+    got, marker = [], ""
+    while True:
+        res = ol_b.list_objects("shared", marker=marker, max_keys=7)
+        got.extend(o.name for o in res.objects)
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert got == keys

@@ -1,0 +1,107 @@
+"""Metrics v2: descriptor catalog, scrape-time collector, and the
+request-pipeline instrumentation (latency histogram, rx/tx bytes, error
+classes, in-flight gauge) — ref cmd/metrics-v2.go."""
+
+import tempfile
+
+import pytest
+
+from minio_tpu.observability.metrics import Metrics
+from minio_tpu.observability.metrics_v2 import (
+    DESCRIPTORS,
+    MetricsCollector,
+)
+
+
+def test_descriptor_catalog_size():
+    """Parity bar: the reference ships ~60 typed descriptors."""
+    assert len(DESCRIPTORS) >= 55
+    names = [d[0] for d in DESCRIPTORS]
+    assert len(names) == len(set(names))
+
+
+def test_collector_node_gauges():
+    m = Metrics()
+    MetricsCollector(m).collect()
+    text = m.render_prometheus()
+    assert "mtpu_node_uptime_seconds" in text
+    assert "mtpu_node_threads" in text
+    assert "mtpu_node_rss_bytes" in text
+    # described series carry HELP lines
+    assert "# HELP mtpu_node_uptime_seconds Process uptime" in text
+
+
+@pytest.fixture(scope="module")
+def server():
+    import http.client
+    import urllib.parse
+
+    from minio_tpu.server import Server
+
+    root = tempfile.mkdtemp()
+    srv = Server(
+        [f"{root}/disk{{1...4}}"], port=0,
+        root_user="metak", root_password="metricsecret",
+        enable_scanner=False,
+    ).start()
+    from minio_tpu.api.sign import sign_v4_request
+
+    def req(method, path, body=b"", query=None):
+        query = query or []
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        h = sign_v4_request("metricsecret", "metak", method, srv.endpoint,
+                            path, query, {}, body)
+        conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+        try:
+            conn.request(method, url, body=body, headers=h)
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+    yield srv, req
+    srv.stop()
+
+
+def test_request_pipeline_metrics(server):
+    srv, req = server
+    assert req("PUT", "/mbkt")[0] == 200
+    assert req("PUT", "/mbkt/obj", body=b"metrics!")[0] == 200
+    assert req("GET", "/mbkt/obj")[0] == 200
+    st, _ = req("GET", "/mbkt/missing")
+    assert st == 404
+
+    st, body = req("GET", "/minio/v2/metrics/node")
+    assert st == 200
+    text = body.decode()
+    assert "mtpu_s3_request_seconds_count" in text
+    assert "mtpu_s3_rx_bytes_total" in text
+    assert "mtpu_s3_tx_bytes_total" in text
+    assert 'mtpu_s3_errors_total{api="get_object",code="NoSuchKey"}' in text
+    assert "mtpu_s3_requests_inflight" in text
+    # collector gauges from live subsystems
+    assert 'mtpu_disk_online{disk=' in text
+    assert "mtpu_iam_users" in text
+    assert "mtpu_replication_pending" in text
+
+
+def test_auth_failure_metric(server):
+    srv, req = server
+    import http.client
+    import urllib.parse
+
+    from minio_tpu.api.sign import sign_v4_request
+
+    # Sign with the WRONG secret: a clean SignatureDoesNotMatch.
+    h = sign_v4_request("wrong-secret", "metak", "GET", srv.endpoint,
+                        "/mbkt/obj", [], {}, b"")
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=10)
+    try:
+        conn.request("GET", urllib.parse.quote("/mbkt/obj"), headers=h)
+        conn.getresponse().read()
+    finally:
+        conn.close()
+    st, body = req("GET", "/minio/v2/metrics/node")
+    assert st == 200
+    assert "mtpu_s3_auth_failures_total" in body.decode()

@@ -52,6 +52,8 @@ class AdminHandlers:
             ("PUT", "set-config-kv"): "set_config_kv",
             ("DELETE", "del-config-kv"): "del_config_kv",
             ("GET", "help-config-kv"): "help_config_kv",
+            ("GET", "list-config-history-kv"): "list_config_history",
+            ("PUT", "restore-config-history-kv"): "restore_config_history",
             ("GET", "list-users"): "list_users",
             ("PUT", "add-user"): "add_user",
             ("DELETE", "remove-user"): "remove_user",
@@ -96,6 +98,8 @@ class AdminHandlers:
         "set_config_kv": "admin:ConfigUpdate",
         "del_config_kv": "admin:ConfigUpdate",
         "help_config_kv": "admin:ConfigUpdate",
+        "list_config_history": "admin:ConfigUpdate",
+        "restore_config_history": "admin:ConfigUpdate",
         "list_users": "admin:ListUsers",
         "add_user": "admin:CreateUser",
         "remove_user": "admin:DeleteUser",
@@ -265,6 +269,47 @@ class AdminHandlers:
         from ..config import HELP
 
         return self._json(HELP)
+
+    def list_config_history(self, ctx) -> Response:
+        """History entries newest-first, optionally with the decrypted
+        KV payloads (ref ListConfigHistoryKVHandler)."""
+        if self.config_sys is None:
+            raise S3Error("NotImplemented", "config system not wired")
+        names = sorted(self.config_sys.history(), reverse=True)
+        try:
+            count = int(ctx.qdict.get("count", "10"))
+        except ValueError:
+            count = 10
+        out = []
+        for name in names[:max(1, min(count, 100))]:
+            entry = {"restoreId": name}
+            if ctx.qdict.get("with-data") == "true":
+                try:
+                    entry["kv"] = json.loads(
+                        self.config_sys.history_get(name)
+                    )
+                except Exception:  # noqa: BLE001 - unreadable entry
+                    entry["error"] = "unreadable"
+            out.append(entry)
+        return self._json(out)
+
+    def restore_config_history(self, ctx) -> Response:
+        """Roll the live config back to a history entry (ref
+        RestoreConfigHistoryKVHandler)."""
+        if self.config_sys is None:
+            raise S3Error("NotImplemented", "config system not wired")
+        restore_id = ctx.qdict.get("restoreId", "")
+        if not restore_id:
+            raise S3Error("InvalidArgument", "restoreId required")
+        from ..utils.errors import StorageError
+
+        try:
+            self.config_sys.restore(restore_id)
+        except ValueError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        except StorageError as exc:
+            raise S3Error("NoSuchKey", f"config history: {exc}") from exc
+        return self._json({"restored": restore_id})
 
     # --- users / policies ---
 

@@ -28,6 +28,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--set-drive-count", type=int, default=None,
         help="drives per erasure set (default: auto by GCD)",
     )
+    srv.add_argument(
+        "--storage-address", default=None, metavar="HOST:PORT",
+        help="this node's storage-plane address for multi-node "
+             "topologies with http:// endpoints (peer plane binds "
+             "PORT+1)",
+    )
     srv.add_argument("--quiet", action="store_true")
     return p
 
@@ -40,6 +46,7 @@ def main(argv: list[str] | None = None) -> int:
         server = Server(
             args.endpoints, address=args.address, port=args.port,
             fs_mode=args.fs, set_drive_count=args.set_drive_count,
+            storage_address=args.storage_address,
         ).start()
         if not args.quiet:
             print(f"minio-tpu {server.mode} mode")

@@ -208,8 +208,13 @@ class ConfigSys:
     def save(self, keep_history: bool = True):
         blob = self._seal(self.config.to_json())
         if keep_history:
+            # Nanosecond suffix: rapid successive saves (mc admin config
+            # set twice in one second) must not overwrite history.
             ts = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
-            self._put(f"{HISTORY_PREFIX}/{ts}.kv", blob)
+            self._put(
+                f"{HISTORY_PREFIX}/{ts}.{time.time_ns() % 10**9:09d}.kv",
+                blob,
+            )
         self._put(CONFIG_PATH, blob)
 
     def _put(self, path: str, blob: bytes):
@@ -233,3 +238,22 @@ class ConfigSys:
         except StorageError:
             return []
         return [o.name.rsplit("/", 1)[1] for o in res.objects]
+
+    def history_get(self, name: str) -> bytes:
+        """Decrypted JSON of one history entry (ref
+        readServerConfigHistory, cmd/config-common.go)."""
+        if "/" in name or ".." in name:
+            raise ValueError(f"invalid history id {name!r}")
+        blob = self._ol.get_object_bytes(
+            META_BUCKET, f"{HISTORY_PREFIX}/{name}"
+        )
+        return self._unseal(blob)
+
+    def restore(self, name: str):
+        """Make a history entry the live config (ref
+        RestoreConfigHistoryKVHandler, cmd/admin-handlers-config-kv.go).
+        The pre-restore config is itself kept in history."""
+        raw = self.history_get(name)
+        cfg = Config.from_json(raw)
+        self.config = cfg
+        self.save(keep_history=True)

@@ -8,6 +8,7 @@ cmd/bootstrap-peer-server.go (verifyServerSystemConfig).
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from .rest import RPCClient, RPCError, RPCServer
@@ -29,7 +30,7 @@ class PeerRESTServer:
         self.trace = trace
         self.logger = logger
         self._profiler = None
-        self._prof_lock = __import__("threading").Lock()
+        self._prof_lock = threading.Lock()
         self.started_ns = time.time_ns()
         self.rpc = RPCServer(PEER_PREFIX, secret, host, port)
         for name in ("ping", "load_bucket_metadata", "delete_bucket_metadata",

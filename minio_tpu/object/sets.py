@@ -110,6 +110,8 @@ class ErasureSets:
     def load_format(self):
         """Load format from disks, agree by quorum on deployment id
         (ref waitForFormatErasure/quorum logic in prepare-storage.go)."""
+        from ..utils.errors import StorageError
+
         ids: dict[str, int] = {}
         algos: dict[str, int] = {}
         for disk in self.disks:
@@ -118,6 +120,11 @@ class ErasureSets:
             try:
                 doc = read_format(disk)
             except (ErrUnformattedDisk, ErrCorruptedFormat):
+                continue
+            except StorageError:
+                # Unreachable disk (node down): format quorum forms from
+                # the reachable ones (ref loadFormatErasureAll tolerating
+                # offline disks under quorum).
                 continue
             ids[doc["id"]] = ids.get(doc["id"], 0) + 1
             algo = doc["xl"].get("distributionAlgo", DIST_ALGO_SIPMOD)

@@ -75,7 +75,16 @@ class ThrottledReader:
 
     def read(self, n: int = -1) -> bytes:
         if n is None or n < 0:
-            n = self._chunk  # never account one giant burst
+            # read-all contract: drain to EOF, but account (and pace)
+            # chunk-by-chunk so one call never bursts past the limit.
+            parts = []
+            while True:
+                chunk = self._stream.read(self._chunk)
+                if not chunk:
+                    break
+                self._flow.account(len(chunk))
+                parts.append(chunk)
+            return b"".join(parts)
         data = self._stream.read(n)
         if data:
             self._flow.account(len(data))

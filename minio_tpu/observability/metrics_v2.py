@@ -116,6 +116,7 @@ class MetricsCollector:
         self.iam = iam
         self.mrf = mrf
         self.started = time.time()
+        self._disk_scan_at = 0.0
         describe_all(metrics)
 
     def collect(self):
@@ -125,11 +126,21 @@ class MetricsCollector:
         self._collect_replication(m)
         self._collect_cache(m)
         self._collect_iam(m)
+        self._collect_mrf(m)
         self._collect_node(m)
+
+    # Remote-disk stats are RPCs; bound how often a scrape pays them so
+    # a hung peer can stall at most one scrape per window (the reference
+    # serves disk metrics from the monitor's cached probe state).
+    DISK_SCAN_INTERVAL_S = 10.0
 
     def _collect_disks(self, m):
         if self.ol is None:
             return
+        now = time.monotonic()
+        if now - self._disk_scan_at < self.DISK_SCAN_INTERVAL_S:
+            return  # previous gauges stay in the registry
+        self._disk_scan_at = now
         offline = 0
         for pool in getattr(self.ol, "pools", []):
             for d in pool.disks:
@@ -218,6 +229,16 @@ class MetricsCollector:
             m.set_gauge("iam_sts_credentials", len(self.iam.sts))
         except Exception:  # noqa: BLE001
             pass
+
+    def _collect_mrf(self, m):
+        """Heal backlog: entries sitting in per-set MRF queues."""
+        if self.ol is None:
+            return
+        pending = 0
+        for pool in getattr(self.ol, "pools", []):
+            for es in getattr(pool, "sets", []):
+                pending += len(getattr(es, "_mrf", ()))
+        m.set_gauge("mrf_pending", pending)
 
     def _collect_node(self, m):
         m.set_gauge("node_uptime_seconds", time.time() - self.started)

@@ -67,14 +67,34 @@ def bitrot_self_test():
         raise RuntimeError("bitrot self-test failed: highwayhash")
 
 
+def _split_url(ep: str) -> tuple[str, str]:
+    """'http://host:port/path' -> ('host:port', '/path')."""
+    import urllib.parse
+
+    u = urllib.parse.urlsplit(ep)
+    return u.netloc, u.path
+
+
 class Server:
-    """One assembled minio-tpu server process."""
+    """One assembled minio-tpu server process.
+
+    Multi-node topology (ref registerDistErasureRouters +
+    newErasureServerPools): endpoints given as URLs
+    (`http://host:port/path`) split into local disks (netloc ==
+    `storage_address`, served to peers over the storage REST plane bound
+    at that address) and remote disks (RemoteStorage clients). The peer
+    control plane binds at storage port + 1 on every node by convention.
+    Internode RPC is authenticated with the root credential (the
+    reference signs internode requests the same way)."""
+
+    FORMAT_WAIT_S = 30.0
 
     def __init__(self, endpoint_args: list[str], address: str = "127.0.0.1",
                  port: int = 9000, root_user: str | None = None,
                  root_password: str | None = None, fs_mode: bool = False,
                  set_drive_count: int | None = None,
-                 enable_scanner: bool = True):
+                 enable_scanner: bool = True,
+                 storage_address: str | None = None):
         erasure_self_test()
         bitrot_self_test()
         self.root_user = root_user or os.environ.get(
@@ -87,11 +107,16 @@ class Server:
         # Metrics come up first so the storage layer can record per-op
         # counters from the very first format read.
         self.metrics = Metrics()
+        self.storage_server = None
+        self.peer_server = None
+        self.notification = None
+        self._listing_coordinator = None
 
         # --- object layer from endpoint layout (ref newObjectLayer) ---
         if fs_mode or (
             len(endpoint_args) == 1
             and not ellipses.has_ellipses(endpoint_args[0])
+            and "://" not in endpoint_args[0]
         ):
             self.object_layer = FSObjects(endpoint_args[0])
             self.mode = "fs"
@@ -99,22 +124,38 @@ class Server:
             layout = ellipses.parse_server_endpoints(
                 endpoint_args, set_drive_count
             )
+            all_eps = [ep for pool in layout["pools"] for ep in pool]
+            distributed = any("://" in ep for ep in all_eps)
+            from .storage.diskcheck import MetricsDisk
+
+            if distributed:
+                mk_disk = self._start_storage_plane(
+                    all_eps, storage_address
+                )
+            else:
+                def mk_disk(ep):
+                    return MetricsDisk(
+                        LocalStorage(ep, endpoint=ep), self.metrics
+                    )
             pools = []
             for pi, endpoints in enumerate(layout["pools"]):
                 # Every disk is wrapped in the per-op metrics/disk-id
                 # decorator (ref xl-storage-disk-id-check.go).
-                from .storage.diskcheck import MetricsDisk
-
-                disks = [
-                    MetricsDisk(LocalStorage(ep, endpoint=ep), self.metrics)
-                    for ep in endpoints
-                ]
+                disks = [mk_disk(ep) for ep in endpoints]
                 es = ErasureSets(
                     disks, layout["set_drive_count"],
                     deployment_id=self._deployment_id(disks),
                     pool_index=pi,
                 )
-                if self._any_formatted(disks):
+                if distributed:
+                    # Only the node owning the FIRST endpoint formats a
+                    # fresh deployment; everyone else waits for the
+                    # format to appear (ref waitForFormatErasure).
+                    leader = (
+                        _split_url(all_eps[0])[0] == storage_address
+                    )
+                    self._format_distributed(es, leader)
+                elif self._any_formatted(disks):
                     # Existing deployment: format must load; never
                     # reformat over data (a new deployment_id would
                     # reshuffle sipHash placement and orphan every
@@ -212,12 +253,17 @@ class Server:
                 for b, u in self.scanner.usage.buckets_usage.items()
             }
 
+        # Peer mesh before the S3 front-end so admin fan-out endpoints
+        # see the mesh from the first request.
+        if self.storage_server is not None:
+            self._start_peer_mesh()
+
         self.s3 = S3Server(
             self.cache_layer or self.object_layer, self.iam,
             self.bucket_meta,
             notify=self.notifier, region=region, host=address, port=port,
             metrics=self.metrics, trace=self.trace,
-            config_sys=self.config_sys,
+            config_sys=self.config_sys, notification=self.notification,
             sse_config=SSEConfig(self.root_password),
             # Quota admission reads the scanner's usage accounting, never
             # a live walk on the PUT path (ref BucketQuotaSys 1s-TTL
@@ -239,6 +285,114 @@ class Server:
             mrf=self.mrf,
         )
         self.started_ns = time.time_ns()
+
+    # --- distributed plumbing ---
+
+    def _start_storage_plane(self, all_eps: list[str],
+                             storage_address: str | None):
+        """Serve this node's disks to the mesh BEFORE the object layer
+        initializes (ref registerDistErasureRouters running ahead of
+        newObjectLayer), and return the local/remote disk factory."""
+        from .distributed.storage_rest import (
+            RemoteStorage,
+            StorageRESTServer,
+        )
+        from .storage.diskcheck import MetricsDisk
+
+        if storage_address is None:
+            raise ValueError(
+                "URL endpoints need storage_address=host:port naming "
+                "this node's storage plane"
+            )
+        if any("://" not in ep for ep in all_eps):
+            raise ValueError("cannot mix URL and plain path endpoints")
+        secret = self.root_password
+        local_disks = []
+        for ep in all_eps:
+            netloc, path = _split_url(ep)
+            if netloc == storage_address:
+                local_disks.append(LocalStorage(path, endpoint=ep))
+        if not local_disks:
+            raise ValueError(
+                f"no endpoint matches this node ({storage_address})"
+            )
+        shost, sport = storage_address.rsplit(":", 1)
+        self.storage_server = StorageRESTServer(
+            local_disks, secret, shost, int(sport)
+        ).start()
+        self._storage_address = storage_address
+        self._cluster_nodes = sorted(
+            {_split_url(ep)[0] for ep in all_eps}
+        )
+        local_by_ep = {d.endpoint(): d for d in local_disks}
+
+        def mk_disk(ep):
+            if ep in local_by_ep:
+                return MetricsDisk(local_by_ep[ep], self.metrics)
+            netloc, _ = _split_url(ep)
+            return MetricsDisk(
+                RemoteStorage(netloc, ep, secret), self.metrics
+            )
+
+        return mk_disk
+
+    def _format_distributed(self, es, leader: bool):
+        """Fresh-deployment format with cross-node coordination: the
+        leader formats (retrying while peers' storage planes come up);
+        followers poll until the format lands on their local disks."""
+        deadline = time.monotonic() + self.FORMAT_WAIT_S
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                if self._any_formatted(es.disks):
+                    es.load_format()
+                    return
+                if leader:
+                    es.init_format()
+                    return
+            except Exception as exc:  # noqa: BLE001 - peers still booting
+                last_err = exc
+            time.sleep(0.2)
+        raise RuntimeError(
+            f"format coordination timed out after {self.FORMAT_WAIT_S}s: "
+            f"{last_err}"
+        )
+
+    def _start_peer_mesh(self):
+        """Peer control plane + cross-node listing coordination
+        (ref peer-rest-server + metacache-server-pool)."""
+        from .distributed.listing import ListingCoordinator
+        from .distributed.peer import (
+            NotificationSys,
+            PeerClient,
+            PeerRESTServer,
+        )
+
+        secret = self.root_password
+        shost, sport = self._storage_address.rsplit(":", 1)
+        self.peer_server = PeerRESTServer(
+            secret, shost, int(sport) + 1,
+            bucket_meta=self.bucket_meta, iam=self.iam,
+            object_layer=self.object_layer, trace=self.trace,
+            logger=self.logger,
+        ).start()
+
+        def peer_addr(node: str) -> str:
+            h, p = node.rsplit(":", 1)
+            return f"{h}:{int(p) + 1}"
+
+        others = [
+            n for n in self._cluster_nodes if n != self._storage_address
+        ]
+        peer_clients = {
+            peer_addr(n): PeerClient(peer_addr(n), secret) for n in others
+        }
+        self.notification = NotificationSys(list(peer_clients.values()))
+        self._listing_coordinator = ListingCoordinator(
+            self.object_layer, peer_addr(self._storage_address),
+            peer_clients,
+        )
+        self.object_layer.listing_coordinator = self._listing_coordinator
 
     @staticmethod
     def _any_formatted(disks) -> bool:
@@ -289,6 +443,12 @@ class Server:
         self.mrf.stop()
         self.disk_monitor.stop()
         self.notifier.close()
+        if self._listing_coordinator is not None:
+            self._listing_coordinator.close()
+        if self.peer_server is not None:
+            self.peer_server.stop()
+        if self.storage_server is not None:
+            self.storage_server.stop()
 
     @property
     def endpoint(self) -> str:

@@ -96,14 +96,20 @@ class MetricsDisk:
         until the heal/format machinery re-admits it (ref errDiskStale)."""
         if not self._expected_id:
             return
+        now = time.monotonic()
         if self._stale:
-            # Latched: once a swap is detected EVERY op fails until the
-            # disk is re-admitted (ref errDiskStale semantics) — a
-            # per-interval check must not let ops through in between.
+            # Latched: every op fails while the id mismatches (ref
+            # errDiskStale semantics) — but re-probe once per interval so
+            # reinstalling the CORRECT disk self-heals without a process
+            # restart.
+            if now - self._last_check >= _ID_CHECK_INTERVAL_S:
+                self._last_check = now
+                if self._disk.get_disk_id() == self._expected_id:
+                    self._stale = False
+                    return
             raise ErrDiskNotFound(
                 f"stale disk: expected id {self._expected_id}"
             )
-        now = time.monotonic()
         if now - self._last_check < _ID_CHECK_INTERVAL_S:
             return
         self._last_check = now

@@ -144,3 +144,8 @@ def test_stale_disk_latches(tmp_path):
     # Immediately after (within the 5s window): still refused.
     with pytest.raises(ErrDiskNotFound):
         w.read_all("v", "x")
+    # Reinstalling the CORRECT disk self-heals at the next probe window.
+    disk.set_disk_id("good-id")
+    w._last_check = -1e9
+    w.write_all("v", "x", b"recovered")
+    assert w.read_all("v", "x") == b"recovered"

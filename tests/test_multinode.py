@@ -1,0 +1,213 @@
+"""True multi-node topology: two server processes (in-process here),
+each owning half the drives of one erasure set, serving each other's
+disks over the storage REST plane — the analog of
+`minio server http://host{1...2}/export` (ref cmd/endpoint-ellipses.go,
+registerDistErasureRouters, waitForFormatErasure coordination)."""
+
+import http.client
+import json
+import socket
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.api.sign import sign_v4_request
+from minio_tpu.server import Server
+
+AK, SK = "mnroot", "mnroot-secret"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def req(srv, method, path, query=None, body=b"", headers=None):
+    query = query or []
+    qs = urllib.parse.urlencode(query)
+    url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+    h = sign_v4_request(SK, AK, method, srv.endpoint, path, query,
+                        dict(headers or {}), body)
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+    try:
+        conn.request(method, url, body=body, headers=h)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """Two nodes, one 4-drive erasure set: drives 1-2 on node A,
+    3-4 on node B. Endpoint list is IDENTICAL on both nodes."""
+    tmp = tmp_path_factory.mktemp("multinode")
+    # Two free ports for the storage planes (peer planes bind port+1,
+    # so leave gaps).
+    pa, pb = _free_port(), _free_port()
+    while abs(pa - pb) < 2 or pb == pa + 1 or pa == pb + 1:
+        pb = _free_port()
+    addr_a, addr_b = f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"
+    eps = [
+        f"http://{addr_a}{tmp}/a1",
+        f"http://{addr_a}{tmp}/a2",
+        f"http://{addr_b}{tmp}/b1",
+        f"http://{addr_b}{tmp}/b2",
+    ]
+    servers: dict[str, Server] = {}
+    errors: dict[str, Exception] = {}
+
+    def boot(name, storage_addr):
+        try:
+            servers[name] = Server(
+                list(eps), port=0, root_user=AK, root_password=SK,
+                enable_scanner=False, storage_address=storage_addr,
+            ).start()
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors[name] = exc
+
+    # Both constructors run concurrently: each needs the other's storage
+    # plane for format coordination (exactly the real boot sequence).
+    ta = threading.Thread(target=boot, args=("a", addr_a))
+    tb = threading.Thread(target=boot, args=("b", addr_b))
+    ta.start()
+    tb.start()
+    ta.join(60)
+    tb.join(60)
+    assert not errors, errors
+    yield servers["a"], servers["b"]
+    servers["a"].stop()
+    servers["b"].stop()
+
+
+def test_both_nodes_erasure_mode(cluster):
+    a, b = cluster
+    assert a.mode == b.mode == "erasure"
+    # One deployment: both agree on the id.
+    ia = a.object_layer.pools[0].deployment_id
+    ib = b.object_layer.pools[0].deployment_id
+    assert ia == ib
+
+
+def test_cross_node_put_get(cluster):
+    a, b = cluster
+    assert req(a, "PUT", "/shared")[0] == 200
+    body = b"written-via-A, read-via-B" * 100
+    assert req(a, "PUT", "/shared/cross.bin", body=body)[0] == 200
+    # Node B serves the same object: its reads hit A's disks remotely.
+    st, _, got = req(b, "GET", "/shared/cross.bin")
+    assert st == 200 and got == body
+    # And the reverse direction.
+    body2 = b"written-via-B" * 64
+    assert req(b, "PUT", "/shared/rev.bin", body=body2)[0] == 200
+    st, _, got = req(a, "GET", "/shared/rev.bin")
+    assert st == 200 and got == body2
+
+
+def test_cross_node_listing_coordinated(cluster):
+    a, b = cluster
+    assert req(a, "PUT", "/listbkt")[0] == 200
+    for i in range(6):
+        srv = a if i % 2 == 0 else b
+        assert req(srv, "PUT", f"/listbkt/k{i}", body=b"x")[0] == 200
+    # Flush the batched generation broadcasts deterministically.
+    a._listing_coordinator.flush()
+    b._listing_coordinator.flush()
+    for srv in (a, b):
+        st, _, raw = req(srv, "GET", "/listbkt")
+        assert st == 200
+        import re
+
+        keys = re.findall(rb"<Key>([^<]+)</Key>", raw)
+        assert keys == [f"k{i}".encode() for i in range(6)], (
+            srv.endpoint, keys)
+    # At least one side proxied pages to the listing owner.
+    assert (
+        a._listing_coordinator.remote_pages
+        + b._listing_coordinator.remote_pages
+    ) >= 1
+
+
+def test_degraded_write_with_node_down(cluster, tmp_path):
+    """Kill node B's storage plane: node A keeps serving at write quorum
+    (2 data + 2 parity over 4 disks tolerates 2 lost shards for reads;
+    writes need quorum on A's 2 disks + failures tolerated)."""
+    a, b = cluster
+    assert req(a, "PUT", "/degraded")[0] == 200
+    body = b"pre-outage" * 50
+    assert req(a, "PUT", "/degraded/pre.bin", body=body)[0] == 200
+    b.storage_server.stop()
+    try:
+        # Reads of existing objects survive on k=2 local shards.
+        st, _, got = req(a, "GET", "/degraded/pre.bin")
+        assert st == 200 and got == body
+    finally:
+        # Restart B's storage plane on the same address for later tests.
+        from minio_tpu.distributed.storage_rest import StorageRESTServer
+
+        disks = list(b.storage_server.disks.values())
+        host, port = b._storage_address.rsplit(":", 1)
+        b.storage_server = StorageRESTServer(
+            disks, SK, host, int(port)
+        ).start()
+
+
+def test_admin_sees_mesh(cluster):
+    a, _ = cluster
+    st, _, raw = req(a, "GET", "/minio/admin/v3/info")
+    assert st == 200
+    # The peer mesh is wired: server info carries peer entries.
+    assert a.notification is not None
+    infos = a.notification.server_info()
+    assert len(infos) == 1  # the other node
+
+
+def test_degraded_single_node_restart(tmp_path):
+    """A one-node restart of a two-node deployment serves reads from
+    its k local shards while the other node stays down (format quorum
+    forms from reachable disks; ref loadFormatErasureAll tolerance)."""
+    pa, pb = _free_port(), _free_port()
+    while abs(pa - pb) < 2:
+        pb = _free_port()
+    eps = [
+        f"http://127.0.0.1:{pa}{tmp_path}/a1",
+        f"http://127.0.0.1:{pa}{tmp_path}/a2",
+        f"http://127.0.0.1:{pb}{tmp_path}/b1",
+        f"http://127.0.0.1:{pb}{tmp_path}/b2",
+    ]
+    servers = {}
+
+    def boot(name, addr):
+        servers[name] = Server(
+            list(eps), port=0, root_user=AK, root_password=SK,
+            enable_scanner=False, storage_address=addr,
+        ).start()
+
+    ts = [
+        threading.Thread(target=boot, args=("a", f"127.0.0.1:{pa}")),
+        threading.Thread(target=boot, args=("b", f"127.0.0.1:{pb}")),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    a, b = servers["a"], servers["b"]
+    body = b"survives-restart" * 100
+    assert req(a, "PUT", "/restartbkt")[0] == 200
+    assert req(a, "PUT", "/restartbkt/obj", body=body)[0] == 200
+    a.stop()
+    b.stop()
+    # Boot ONLY node A: B's disks are unreachable, reads still work.
+    a2 = Server(
+        list(eps), port=0, root_user=AK, root_password=SK,
+        enable_scanner=False, storage_address=f"127.0.0.1:{pa}",
+    ).start()
+    try:
+        st, _, got = req(a2, "GET", "/restartbkt/obj")
+        assert st == 200 and got == body
+    finally:
+        a2.stop()

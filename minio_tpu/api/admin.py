@@ -23,7 +23,8 @@ ADMIN_PREFIX = "/minio/admin/v3"
 class AdminHandlers:
     def __init__(self, object_layer, iam, config_sys=None, metrics=None,
                  trace=None, notification=None, lockers=None,
-                 bucket_meta=None, repl_pool=None, tiers=None, logger=None):
+                 bucket_meta=None, repl_pool=None, tiers=None, logger=None,
+                 kms=None):
         self.ol = object_layer
         self.iam = iam
         self.config_sys = config_sys
@@ -35,6 +36,7 @@ class AdminHandlers:
         self.repl = repl_pool
         self.tiers = tiers
         self.logger = logger
+        self.kms = kms
         self.started = time.time()
 
     # --- routing ---
@@ -79,6 +81,8 @@ class AdminHandlers:
             ("GET", "audit-log"): "audit_log",
             ("GET", "console"): "console_log",
             ("GET", "healthinfo"): "health_info",
+            ("GET", "kms"): "kms_status",
+            ("POST", "kms"): "kms_create_key",
             ("PUT", "add-tier"): "add_tier",
             ("GET", "list-tiers"): "list_tiers",
             ("DELETE", "remove-tier"): "remove_tier",
@@ -123,6 +127,8 @@ class AdminHandlers:
         "audit_log": "admin:ServerTrace",
         "console_log": "admin:ConsoleLog",
         "health_info": "admin:OBDInfo",
+        "kms_status": "admin:KMSKeyStatus",
+        "kms_create_key": "admin:KMSCreateKey",
         "add_tier": "admin:SetTier",
         "list_tiers": "admin:ListTier",
         "remove_tier": "admin:SetTier",
@@ -456,8 +462,19 @@ class AdminHandlers:
         action = ctx.qdict.get("action", "")
         if action not in ("restart", "stop"):
             raise S3Error("InvalidArgument", f"action {action!r}")
-        # Signal recorded; process supervision is the operator's domain.
-        return self._json({"action": action, "accepted": True})
+        # Deliver to the process owner (Server.wait unblocks; the CLI
+        # re-execs on restart / exits on stop — ref cmd/service.go
+        # serviceSignalCh + restartProcess).
+        cb = getattr(self, "service_cb", None)
+        delivered = False
+        if cb is not None:
+            import threading as _threading
+
+            # Async: the response must reach the client before the
+            # process begins tearing the listener down.
+            _threading.Timer(0.2, cb, args=(action,)).start()
+            delivered = True
+        return self._json({"action": action, "accepted": delivered})
 
     def account_info(self, ctx) -> Response:
         buckets = []
@@ -732,6 +749,37 @@ class AdminHandlers:
         if self.repl is None:
             return self._json({})
         return self._json(dict(self.repl.stats))
+
+    # --- KMS (ref KMSKeyStatusHandler, cmd/admin-handlers.go + KES
+    # --- CreateKey; LocalKMS backs the same surface) ---
+
+    def kms_status(self, ctx) -> Response:
+        if self.kms is None:
+            raise S3Error("NotImplemented", "KMS not configured")
+        if ctx.path.rstrip("/").endswith("/key/list"):
+            return self._json({"keys": self.kms.list_keys()})
+        key_id = ctx.qdict.get("key-id", "")
+        status = self.kms.status()
+        if key_id:
+            keys = [k for k in status["keys"] if k["keyName"] == key_id]
+            if not keys:
+                raise S3Error("NoSuchKey", f"kms key {key_id}")
+            status["keys"] = keys
+        return self._json(status)
+
+    def kms_create_key(self, ctx) -> Response:
+        if self.kms is None:
+            raise S3Error("NotImplemented", "KMS not configured")
+        key_id = ctx.qdict.get("key-id", "")
+        if not key_id:
+            raise S3Error("InvalidArgument", "key-id required")
+        from ..crypto.kms import KMSError
+
+        try:
+            self.kms.create_key(key_id)
+        except KMSError as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
+        return self._json({"created": key_id})
 
     def bandwidth_report(self, ctx) -> Response:
         """Per-bucket/target outbound bandwidth (ref madmin

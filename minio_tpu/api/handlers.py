@@ -1411,6 +1411,12 @@ class S3ApiHandlers:
                 )
             elif oi.user_defined.get(ssemod.META_ALGORITHM) == ssemod.ALGO_SSES3:
                 headers[ssemod.HDR_SSE] = "AES256"
+            elif (oi.user_defined.get(ssemod.META_ALGORITHM)
+                  == ssemod.ALGO_SSEKMS):
+                headers[ssemod.HDR_SSE] = "aws:kms"
+                headers[ssemod.HDR_SSE_KMS_ID] = oi.user_defined.get(
+                    ssemod.META_KMS_KEY_ID, ""
+                )
         self._event("s3:ObjectAccessed:Head", ctx.bucket, oi=oi)
         return Response(200, headers)
 

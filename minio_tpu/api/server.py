@@ -291,7 +291,12 @@ class S3Server:
             trace=trace, notification=notification,
             bucket_meta=bucket_meta, repl_pool=self.repl_pool, tiers=tiers,
             logger=logger,
+            kms=getattr(sse_config, "kms", None),
         )
+        from .web import WebHandlers
+
+        self.web = WebHandlers(object_layer, iam, bucket_meta,
+                               region=region)
         from ..observability.audit import AuditLogger
 
         self.audit = AuditLogger.from_config(
@@ -302,6 +307,18 @@ class S3Server:
         self.region = region
         self.metrics = metrics
         self.trace = trace
+        # Service control callback (restart/stop via `mc admin service`);
+        # the process owner (Server/CLI) supplies the behavior
+        # (ref cmd/service.go serviceSignalCh).
+        self.service_cb = None
+        self.admin.service_cb = lambda action: (
+            self.service_cb(action) if self.service_cb else None
+        )
+        # CORS origin policy from the api config subsystem
+        # (ref cmd/generic-handlers.go CorsHandler + api cors_allow_origin).
+        kvs = config_sys.config.get("api") if config_sys is not None else {}
+        self.cors_origin = (kvs.get("cors_allow_origin", "*") or "*") \
+            if hasattr(kvs, "get") else "*"
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -314,6 +331,7 @@ class S3Server:
                 outer._handle(self)
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+            do_OPTIONS = _dispatch
 
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
@@ -424,7 +442,40 @@ class S3Server:
             )
         self._write(h, ctx, resp)
 
+    def _cors_allow(self, request_origin: str) -> str | None:
+        """Match the request Origin against the configured allow-list
+        (comma-separated, wildcards allowed) and echo ONE origin — a
+        comma-joined multi-origin header is invalid and browsers reject
+        it (ref generic-handlers CorsHandler AllowedOriginsFn)."""
+        conf = self.cors_origin
+        if conf == "*":
+            return "*"
+        if not request_origin:
+            return None
+        import fnmatch
+
+        for pat in (o.strip() for o in conf.split(",")):
+            if pat and fnmatch.fnmatch(request_origin, pat):
+                return request_origin
+        return None
+
     def _process(self, ctx: RequestContext) -> Response:
+        # CORS preflight: answered before auth (browsers send OPTIONS
+        # unauthenticated; ref CrossDomainPolicy/CorsHandler filters).
+        if ctx.method == "OPTIONS":
+            headers = {
+                "Access-Control-Allow-Methods":
+                    "GET, PUT, POST, DELETE, HEAD",
+                "Access-Control-Allow-Headers": "*",
+                "Access-Control-Max-Age": "3600",
+                "Content-Length": "0",
+            }
+            allow = self._cors_allow(ctx.headers.get("origin", ""))
+            if allow:
+                headers["Access-Control-Allow-Origin"] = allow
+                if allow != "*":
+                    headers["Vary"] = "Origin"
+            return Response(200, headers)
         _reserved_metadata_check(ctx)
         # Health endpoints: unauthenticated, GET/HEAD only
         # (ref cmd/healthcheck-router.go)
@@ -477,6 +528,12 @@ class S3Server:
                 raise S3Error("NotImplemented", "streaming admin request")
             self.admin.authorize(auth_result, name)
             return getattr(self.admin, name)(ctx)
+        # Web console plane: JSON-RPC + token-authed upload/download
+        # (ref cmd/web-router.go; token auth is its own scheme, so this
+        # branches before the SigV4 data plane).
+        if self.web.handles(ctx.path):
+            ctx.api_name = "web"
+            return self.web.dispatch(ctx)
         # Central name guards for every S3 data-plane route: internal
         # metadata buckets are unreachable regardless of policy, and
         # object names are validated once here so no handler can be
@@ -599,6 +656,11 @@ class S3Server:
             headers.setdefault("X-Content-Type-Options", "nosniff")
             headers.setdefault("X-Xss-Protection", "1; mode=block")
             headers.setdefault("Server", "MinIO-TPU")
+            allow = self._cors_allow(ctx.headers.get("origin", ""))
+            if allow:
+                headers.setdefault("Access-Control-Allow-Origin", allow)
+                if allow != "*":
+                    headers.setdefault("Vary", "Origin")
             headers["x-amz-request-id"] = ctx.request_id
             body = resp.body if ctx.method != "HEAD" else b""
             streaming = resp.body_stream is not None and ctx.method != "HEAD"

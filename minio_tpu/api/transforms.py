@@ -20,7 +20,11 @@ from .errors import S3Error
 META_COMPRESSION = "x-mtpu-internal-compression"
 META_COMPRESSED_SIZE = "x-mtpu-internal-compressed-size"
 META_UNCOMPRESSED_SIZE = "x-mtpu-internal-uncompressed-size"
-CODEC = "zlib"
+# Shipping codec: S2-style framed snappy with a native C block engine
+# (ops/s2.py, native/snappy.c — the reference's klauspost/compress/s2
+# role). "zlib" is read-compatible for objects written by older builds.
+CODEC = "s2"
+LEGACY_CODECS = ("zlib",)
 
 _EXCLUDED_EXTS = (".gz", ".bz2", ".rar", ".zip", ".7z", ".xz", ".mp4",
                   ".mkv", ".mov", ".jpg", ".png", ".gif")
@@ -54,7 +58,7 @@ def transforms_active(headers: dict, config, object_name: str) -> bool:
     """True when the PUT body needs buffering for transform work."""
     if ssemod.parse_ssec_key(headers) is not None:
         return True
-    if ssemod.wants_sse_s3(headers):
+    if ssemod.wants_sse_s3(headers) or ssemod.wants_sse_kms(headers):
         return True
     return should_compress(
         config, object_name, headers.get("content-type", "")
@@ -105,36 +109,47 @@ class Md5VerifyReader:
 
 
 class CompressReader:
-    """Streaming zlib compressor. Config filters decide eligibility up
-    front; actual compressibility is decided by TEST-COMPRESSING the
-    first chunk (the streaming stand-in for the reference skipping
-    incompressible data via S2's framing) — an incompressible stream
-    passes through unmarked instead of growing on disk and paying
-    decompress CPU on every GET. Output size is unknown until EOF
-    (callers pass size=-1 downstream); sizes land in `meta_sink` at
-    EOF."""
+    """Streaming S2/snappy-framed compressor (ops/s2.py; native C block
+    engine). Config filters decide eligibility up front; actual
+    compressibility is decided by TEST-COMPRESSING the first chunk — a
+    thoroughly incompressible stream passes through UNMARKED instead of
+    paying frame overhead + decompress CPU on every GET (the framing's
+    per-chunk uncompressed escape still guards mixed content). Output
+    size is unknown until EOF (callers pass size=-1 downstream); sizes
+    land in `meta_sink` at EOF."""
 
     def __init__(self, src, meta_sink: dict):
+        from ..ops import s2
+
+        self._s2 = s2
         self._src = src
-        self._c = zlib.compressobj(1)
         self._buf = bytearray()
+        self._pending = bytearray()
         self._eof = False
         self._plain = 0
         self._out = 0
         self._meta = meta_sink
-        self._mode = ""  # "" undecided | "zlib" | "raw"
+        self._mode = ""  # "" undecided | "s2" | "raw"
 
     _PROBE_BYTES = 64 << 10
 
     def _decide(self, first_chunk: bytes):
-        # Probe a small prefix only — the real compressobj re-does this
-        # work if zlib wins, so keep the throwaway pass cheap.
         probe_src = first_chunk[:self._PROBE_BYTES]
-        probe = zlib.compress(probe_src, 1)
+        probe = self._s2.compress_block(probe_src)
         if len(probe) >= int(len(probe_src) * 0.99):
             self._mode = "raw"
         else:
-            self._mode = "zlib"
+            self._mode = "s2"
+            self._buf += self._s2.STREAM_ID
+            self._out += len(self._s2.STREAM_ID)
+
+    def _emit_frames(self, final: bool):
+        step = self._s2.CHUNK
+        while len(self._pending) >= step or (final and self._pending):
+            frame = self._s2.frame_chunk(bytes(self._pending[:step]))
+            del self._pending[:step]
+            self._buf += frame
+            self._out += len(frame)
 
     def read(self, n: int = -1) -> bytes:
         while (n < 0 or len(self._buf) < n) and not self._eof:
@@ -143,10 +158,8 @@ class CompressReader:
                 self._decide(chunk)
             if not chunk:
                 self._eof = True
-                if self._mode == "zlib":
-                    tail = self._c.flush()
-                    self._buf += tail
-                    self._out += len(tail)
+                if self._mode == "s2":
+                    self._emit_frames(final=True)
                     self._meta[META_COMPRESSION] = CODEC
                     self._meta[META_UNCOMPRESSED_SIZE] = str(self._plain)
                     self._meta[META_COMPRESSED_SIZE] = str(self._out)
@@ -155,9 +168,8 @@ class CompressReader:
             if self._mode == "raw":
                 self._buf += chunk
             else:
-                comp = self._c.compress(chunk)
-                self._buf += comp
-                self._out += len(comp)
+                self._pending += chunk
+                self._emit_frames(final=False)
         if n < 0:
             out, self._buf = bytes(self._buf), bytearray()
             return out
@@ -338,18 +350,40 @@ class DecryptWriter:
 
 
 class DecompressWriter:
-    """Streaming zlib inflater."""
+    """Streaming inflater for the stored codec: S2-framed snappy (the
+    shipping codec) or legacy zlib objects from older builds."""
 
-    def __init__(self, dst):
+    def __init__(self, dst, codec: str = CODEC):
         self._dst = dst
-        self._d = zlib.decompressobj()
+        self._codec = codec
+        if codec == "zlib":
+            self._d = zlib.decompressobj()
+        else:
+            from ..ops import s2
+
+            self._d = s2.FrameDecoder()
 
     def write(self, data) -> int:
-        self._dst.write(self._d.decompress(bytes(data)))
+        if self._codec == "zlib":
+            self._dst.write(self._d.decompress(bytes(data)))
+        else:
+            try:
+                self._d.feed(bytes(data))
+            except ValueError as exc:
+                raise S3Error("InternalError", str(exc)) from exc
+            out = self._d.decoded()
+            if out:
+                self._dst.write(out)
         return len(data)
 
     def close(self):
-        tail = self._d.flush()
+        if self._codec == "zlib":
+            tail = self._d.flush()
+        else:
+            try:
+                tail = self._d.finish()
+            except ValueError as exc:
+                raise S3Error("InternalError", str(exc)) from exc
         if tail:
             self._dst.write(tail)
 
@@ -390,12 +424,12 @@ def build_get_chain(stored_meta: dict, headers: dict, sse_config,
     if length >= 0:
         dst = RangeWriter(dst, offset, length)
     if stored_meta.get(META_COMPRESSION):
-        if stored_meta[META_COMPRESSION] != CODEC:
+        codec = stored_meta[META_COMPRESSION]
+        if codec != CODEC and codec not in LEGACY_CODECS:
             raise S3Error(
-                "InternalError",
-                f"unknown codec {stored_meta[META_COMPRESSION]!r}",
+                "InternalError", f"unknown codec {codec!r}"
             )
-        dst = DecompressWriter(dst)
+        dst = DecompressWriter(dst, codec)
         closers.append(dst)
     try:
         object_key, resp = ssemod.resolve_decryption_key(

@@ -1,0 +1,291 @@
+"""Web console RPC: the browser-facing JSON-RPC plane — behavioral
+parity with the reference's web handlers (cmd/web-handlers.go:
+web.Login issuing a JWT, ListBuckets/ListObjects for the UI,
+MakeBucket/DeleteBucket/RemoveObject, presigned share links, and the
+/minio/upload / /minio/download byte paths authenticated by the web
+token instead of SigV4).
+
+Protocol: JSON-RPC 2.0 POSTs at /minio/webrpc, methods namespaced
+`web.*` like the reference (pkg/rpc). Tokens are HMAC-signed
+{sub, exp} blobs keyed off the account's secret — the reference signs
+JWTs with the credential secret the same way (cmd/jwt.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import io
+import json
+import time
+
+from .errors import S3Error
+from .handlers import Response
+
+WEBRPC_PATH = "/minio/webrpc"
+UPLOAD_PREFIX = "/minio/upload/"
+DOWNLOAD_PREFIX = "/minio/download/"
+
+TOKEN_TTL_S = 24 * 3600
+
+
+def _sign_token(access_key: str, secret_key: str,
+                ttl_s: int = TOKEN_TTL_S) -> str:
+    payload = json.dumps({
+        "sub": access_key, "exp": time.time() + ttl_s,
+    }).encode()
+    b64 = base64.urlsafe_b64encode(payload).decode().rstrip("=")
+    sig = hmac.new(
+        secret_key.encode(), b64.encode(), hashlib.sha256
+    ).hexdigest()
+    return f"{b64}.{sig}"
+
+
+def _verify_token(token: str, iam) -> str:
+    """Returns the authenticated access key, or raises S3Error."""
+    try:
+        b64, sig = token.split(".", 1)
+        pad = b64 + "=" * (-len(b64) % 4)
+        payload = json.loads(base64.urlsafe_b64decode(pad))
+        access_key = payload["sub"]
+    except Exception as exc:
+        raise S3Error("AccessDenied", "malformed web token") from exc
+    creds = iam.get_credentials(access_key)
+    if creds is None:
+        raise S3Error("AccessDenied", "unknown web session account")
+    want = hmac.new(
+        creds.secret_key.encode(), b64.encode(), hashlib.sha256
+    ).hexdigest()
+    if not hmac.compare_digest(want, sig):
+        raise S3Error("AccessDenied", "bad web token signature")
+    if payload.get("exp", 0) < time.time():
+        raise S3Error("AccessDenied", "web session expired")
+    return access_key
+
+
+class WebHandlers:
+    """JSON-RPC dispatcher + the token-authed byte paths."""
+
+    def __init__(self, object_layer, iam, bucket_meta, region="us-east-1"):
+        self.ol = object_layer
+        self.iam = iam
+        self.bm = bucket_meta
+        self.region = region
+
+    # --- entry points (wired from the S3 server dispatch) ---
+
+    def handles(self, path: str) -> bool:
+        return (path == WEBRPC_PATH
+                or path.startswith(UPLOAD_PREFIX)
+                or path.startswith(DOWNLOAD_PREFIX))
+
+    def dispatch(self, ctx) -> Response:
+        if ctx.path == WEBRPC_PATH:
+            return self._rpc(ctx)
+        if ctx.path.startswith(UPLOAD_PREFIX):
+            return self._upload(ctx)
+        return self._download(ctx)
+
+    # --- JSON-RPC plane ---
+
+    _METHODS = {
+        "web.Login": "_m_login",
+        "web.ServerInfo": "_m_server_info",
+        "web.ListBuckets": "_m_list_buckets",
+        "web.MakeBucket": "_m_make_bucket",
+        "web.DeleteBucket": "_m_delete_bucket",
+        "web.ListObjects": "_m_list_objects",
+        "web.RemoveObject": "_m_remove_object",
+        "web.PresignedGet": "_m_presigned_get",
+    }
+
+    def _rpc(self, ctx) -> Response:
+        if ctx.method != "POST":
+            raise S3Error("MethodNotAllowed", ctx.method)
+        try:
+            req = json.loads(ctx.body or b"{}")
+            method = req["method"]
+            params = req.get("params", {})
+            rpc_id = req.get("id")
+        except (ValueError, KeyError) as exc:
+            raise S3Error("InvalidRequest", "malformed JSON-RPC") from exc
+        name = self._METHODS.get(method)
+        if name is None:
+            return self._rpc_error(rpc_id, -32601, f"unknown {method}")
+        # Every method except Login needs a valid token.
+        access_key = None
+        if method != "web.Login":
+            token = ctx.headers.get("authorization", "")
+            token = token.removeprefix("Bearer ").strip()
+            access_key = _verify_token(token, self.iam)
+        try:
+            result = getattr(self, name)(params, access_key)
+        except S3Error:
+            raise
+        except Exception as exc:  # noqa: BLE001 - rpc-shaped failure
+            return self._rpc_error(rpc_id, -32000, str(exc))
+        return Response(200, {"Content-Type": "application/json"},
+                        json.dumps({
+                            "jsonrpc": "2.0", "id": rpc_id,
+                            "result": result,
+                        }).encode())
+
+    @staticmethod
+    def _rpc_error(rpc_id, code: int, message: str) -> Response:
+        return Response(200, {"Content-Type": "application/json"},
+                        json.dumps({
+                            "jsonrpc": "2.0", "id": rpc_id,
+                            "error": {"code": code, "message": message},
+                        }).encode())
+
+    # --- methods (ref web-handlers.go Login/ListBuckets/...) ---
+
+    def _m_login(self, params, _):
+        user = params.get("username", "")
+        password = params.get("password", "")
+        creds = self.iam.get_credentials(user)
+        if creds is None or creds.secret_key != password:
+            raise S3Error("AccessDenied", "invalid login")
+        return {"token": _sign_token(user, password),
+                "uiVersion": "mtpu-web-1"}
+
+    def _m_server_info(self, params, access_key):
+        import platform
+
+        return {
+            "MinioVersion": "minio-tpu/0.1",
+            "MinioPlatform": platform.system(),
+            "user": access_key,
+        }
+
+    def _m_list_buckets(self, params, access_key):
+        out = []
+        for b in self.ol.list_buckets():
+            if b.name.startswith("."):
+                continue
+            if not self._allowed(access_key, "s3:ListBucket", b.name):
+                continue
+            out.append({"name": b.name, "creationDate": b.created_ns})
+        return {"buckets": out}
+
+    def _m_make_bucket(self, params, access_key):
+        bucket = params.get("bucketName", "")
+        self._authorize(access_key, "s3:CreateBucket", bucket)
+        from .handlers import valid_bucket_name
+
+        if not valid_bucket_name(bucket):
+            raise S3Error("InvalidBucketName", bucket)
+        self.ol.make_bucket(bucket)
+        return {}
+
+    def _m_delete_bucket(self, params, access_key):
+        bucket = params.get("bucketName", "")
+        self._authorize(access_key, "s3:DeleteBucket", bucket)
+        self.ol.delete_bucket(bucket)
+        return {}
+
+    def _m_list_objects(self, params, access_key):
+        bucket = params.get("bucketName", "")
+        prefix = params.get("prefix", "")
+        self._authorize(access_key, "s3:ListBucket", bucket)
+        res = self.ol.list_objects(bucket, prefix=prefix, delimiter="/",
+                                   marker=params.get("marker", ""))
+        return {
+            "objects": [
+                {"name": o.name, "size": o.size, "etag": o.etag,
+                 "lastModified": o.mod_time_ns}
+                for o in res.objects
+            ],
+            "prefixes": list(res.prefixes),
+            "isTruncated": res.is_truncated,
+            "nextMarker": res.next_marker,
+        }
+
+    def _m_remove_object(self, params, access_key):
+        bucket = params.get("bucketName", "")
+        objects = params.get("objects", [])
+        self._authorize(access_key, "s3:DeleteObject", bucket)
+        for obj in objects:
+            self._guard_names(bucket, obj)
+            self.ol.delete_object(bucket, obj)
+        return {}
+
+    def _m_presigned_get(self, params, access_key):
+        """Shareable presigned GET URL (ref web.PresignedGet)."""
+        bucket = params.get("bucketName", "")
+        object_ = params.get("objectName", "")
+        expiry = min(int(params.get("expiry", 604800)), 604800)
+        self._authorize(access_key, "s3:GetObject", bucket, object_)
+        creds = self.iam.get_credentials(access_key)
+        from .sign import presign_v4
+
+        host = params.get("host", "")
+        qs = presign_v4(
+            creds.secret_key, access_key, "GET", host,
+            f"/{bucket}/{object_}", region=self.region, expires=expiry,
+        )
+        return {"url": f"http://{host}/{bucket}/{object_}?{qs}"}
+
+    # --- byte paths ---
+
+    def _upload(self, ctx) -> Response:
+        access_key = _verify_token(
+            ctx.headers.get("authorization", "").removeprefix("Bearer ")
+            .strip(), self.iam,
+        )
+        bucket, _, object_ = ctx.path[len(UPLOAD_PREFIX):].partition("/")
+        if not bucket or not object_:
+            raise S3Error("InvalidArgument", "upload path")
+        self._authorize(access_key, "s3:PutObject", bucket, object_)
+        size = ctx.content_length or 0
+        data = ctx.body_reader.read(size)
+        oi = self.ol.put_object(bucket, object_, io.BytesIO(data), size)
+        return Response(200, {"ETag": f'"{oi.etag}"'})
+
+    def _download(self, ctx) -> Response:
+        token = dict(ctx.query).get("token", "")
+        access_key = _verify_token(token, self.iam)
+        bucket, _, object_ = ctx.path[len(DOWNLOAD_PREFIX):].partition("/")
+        self._authorize(access_key, "s3:GetObject", bucket, object_)
+        buf = io.BytesIO()
+        self.ol.get_object(bucket, object_, buf)
+        data = buf.getvalue()
+        return Response(200, {
+            "Content-Type": "application/octet-stream",
+            "Content-Disposition":
+                f'attachment; filename="{object_.rsplit("/", 1)[-1]}"',
+            "Content-Length": str(len(data)),
+        }, data)
+
+    # --- authz ---
+
+    @staticmethod
+    def _guard_names(bucket: str, object_: str = ""):
+        """Same central guards as the S3 data plane: internal metadata
+        buckets are unreachable regardless of policy, and object names
+        can't carry traversal segments (server.py _process invariant —
+        the web plane must not be a side door around it)."""
+        from .handlers import valid_object_name
+        from .server import _check_reserved_bucket
+
+        if bucket:
+            _check_reserved_bucket(bucket)
+        if object_ and not valid_object_name(object_):
+            raise S3Error("InvalidArgument",
+                          f"invalid object name {object_!r}")
+
+    def _allowed(self, access_key: str, action: str, bucket: str,
+                 object_: str = "") -> bool:
+        from ..iam.policy import Args
+
+        return self.iam.is_allowed(Args(
+            account=access_key, action=action,
+            bucket=bucket, object=object_,
+        ))
+
+    def _authorize(self, access_key: str, action: str, bucket: str,
+                   object_: str = ""):
+        self._guard_names(bucket, object_)
+        if not self._allowed(access_key, action, bucket, object_):
+            raise S3Error("AccessDenied", f"{action} {bucket}/{object_}")

@@ -53,9 +53,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"S3 endpoint: http://{server.endpoint}")
             print(f"RootUser: {server.root_user}")
         try:
-            server.wait()
+            action = server.wait()
         finally:
             server.stop()
+        if action == "restart":
+            # In-place re-exec with the same argv (ref cmd/service.go
+            # restartProcess).
+            import os
+
+            os.execv(sys.executable,
+                     [sys.executable, "-m", "minio_tpu", *sys.argv[1:]])
         return 0
     return 1
 

@@ -30,12 +30,18 @@ META_ACTUAL_SIZE = "x-mtpu-internal-actual-size"
 
 ALGO_SSEC = "SSE-C"
 ALGO_SSES3 = "SSE-S3"
+ALGO_SSEKMS = "SSE-KMS"
+
+META_KMS_KEY_ID = "x-mtpu-internal-sse-kms-key-id"
+META_KMS_CONTEXT = "x-mtpu-internal-sse-kms-context"
 
 # Request headers (AWS SSE-C + SSE header names, lowercased)
 HDR_SSEC_ALGO = "x-amz-server-side-encryption-customer-algorithm"
 HDR_SSEC_KEY = "x-amz-server-side-encryption-customer-key"
 HDR_SSEC_KEY_MD5 = "x-amz-server-side-encryption-customer-key-md5"
 HDR_SSE = "x-amz-server-side-encryption"
+HDR_SSE_KMS_ID = "x-amz-server-side-encryption-aws-kms-key-id"
+HDR_SSE_KMS_CONTEXT = "x-amz-server-side-encryption-context"
 HDR_SSEC_COPY_ALGO = (
     "x-amz-copy-source-server-side-encryption-customer-algorithm"
 )
@@ -75,6 +81,24 @@ def wants_sse_s3(headers: dict) -> bool:
     return headers.get(HDR_SSE, "") == "AES256"
 
 
+def wants_sse_kms(headers: dict) -> bool:
+    return headers.get(HDR_SSE, "") == "aws:kms"
+
+
+def _parse_kms_context(headers: dict) -> dict:
+    """x-amz-server-side-encryption-context: base64(JSON) per AWS."""
+    raw = headers.get(HDR_SSE_KMS_CONTEXT, "")
+    if not raw:
+        return {}
+    try:
+        ctx = __import__("json").loads(base64.b64decode(raw))
+        if not isinstance(ctx, dict):
+            raise ValueError("context must be a JSON object")
+        return {str(k): str(v) for k, v in ctx.items()}
+    except Exception as exc:
+        raise SSEError("InvalidArgument", "bad KMS context") from exc
+
+
 def _kek(key: bytes, bucket: str, object_: str) -> bytes:
     """Key-encryption key bound to the object path (ref key.go Seal uses
     bucket/object as context)."""
@@ -110,14 +134,21 @@ def encrypted_size(plain_size: int) -> int:
 
 
 class SSEConfig:
-    """Server-side master key for SSE-S3 (the reference wires KES/Vault;
-    here the master key derives from operator-provided secret material,
-    cmd/crypto/key.go GenerateKey semantics)."""
+    """Server-side key material: the SSE-S3 master key plus the KMS used
+    for SSE-KMS data keys (the reference wires KES/Vault; here LocalKMS
+    derives from operator secret material, cmd/crypto/key.go +
+    pkg/kms)."""
 
-    def __init__(self, master_secret: str):
+    def __init__(self, master_secret: str, kms=None,
+                 default_kms_key: str = ""):
         self.master_key = hashlib.sha256(
             b"mtpu-sse-master\x00" + master_secret.encode()
         ).digest()
+        if kms is None:
+            from .kms import LocalKMS
+
+            kms = LocalKMS(master_secret, default_kms_key)
+        self.kms = kms
 
 
 def setup_encryption(headers: dict, bucket: str, object_: str,
@@ -129,10 +160,40 @@ def setup_encryption(headers: dict, bucket: str, object_: str,
     key to a streaming encryptor (api/transforms.EncryptReader)."""
     ssec_key = parse_ssec_key(headers)
     use_s3 = wants_sse_s3(headers)
-    if ssec_key is None and not use_s3:
+    use_kms = wants_sse_kms(headers)
+    if ssec_key is None and not use_s3 and not use_kms:
         return None, {}, {}
-    if ssec_key is not None and use_s3:
+    if ssec_key is not None and (use_s3 or use_kms):
         raise SSEError("InvalidRequest", "SSE-C and SSE-S3 both requested")
+    if use_kms:
+        # SSE-KMS: the data key comes from (and is sealed by) the KMS,
+        # with the encryption context bound into the seal
+        # (ref cmd/encryption-v1.go newEncryptMetadata kms.GenerateKey).
+        if sse_config is None or sse_config.kms is None:
+            raise SSEError("NotImplemented", "KMS not configured")
+        from .kms import KMSError
+
+        key_id = headers.get(HDR_SSE_KMS_ID, "") \
+            or sse_config.kms.default_key_id
+        context = _parse_kms_context(headers)
+        try:
+            object_key, sealed = sse_config.kms.generate_data_key(
+                key_id, context
+            )
+        except KMSError as exc:
+            raise SSEError("InvalidArgument", str(exc)) from exc
+        import json as _json
+
+        meta = {
+            META_ALGORITHM: ALGO_SSEKMS,
+            META_SEALED_KEY: sealed,
+            META_KMS_KEY_ID: key_id,
+            META_KMS_CONTEXT: base64.b64encode(
+                _json.dumps(context, sort_keys=True).encode()
+            ).decode(),
+        }
+        resp = {HDR_SSE: "aws:kms", HDR_SSE_KMS_ID: key_id}
+        return object_key, meta, resp
     object_key = os.urandom(32)
     if ssec_key is not None:
         meta = {
@@ -191,6 +252,25 @@ def resolve_decryption_key(stored_meta: dict, headers: dict, bucket: str,
             sealed, sse_config.master_key, bucket, object_
         )
         resp = {HDR_SSE: "AES256"}
+    elif algo == ALGO_SSEKMS:
+        if sse_config is None or sse_config.kms is None:
+            raise SSEError("NotImplemented", "KMS not configured")
+        from .kms import KMSError
+
+        key_id = stored_meta.get(META_KMS_KEY_ID, "")
+        try:
+            ctx_raw = stored_meta.get(META_KMS_CONTEXT, "")
+            context = __import__("json").loads(
+                base64.b64decode(ctx_raw)
+            ) if ctx_raw else {}
+            object_key = sse_config.kms.decrypt_data_key(
+                key_id, sealed, context
+            )
+        except KMSError as exc:
+            raise SSEError("AccessDenied", str(exc)) from exc
+        except Exception as exc:  # noqa: BLE001 - corrupt context blob
+            raise SSEError("InternalError", "bad KMS metadata") from exc
+        resp = {HDR_SSE: "aws:kms", HDR_SSE_KMS_ID: key_id}
     else:
         raise SSEError("InvalidRequest", f"unknown SSE algorithm {algo!r}")
     return object_key, resp

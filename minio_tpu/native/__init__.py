@@ -13,7 +13,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_DIR, "_build")
-_SOURCES = ["highwayhash.c", "gfapply.c"]
+_SOURCES = ["highwayhash.c", "gfapply.c", "snappy.c"]
 _LIB_NAME = "libmtpu_native.so"
 
 _lock = threading.Lock()
@@ -108,6 +108,22 @@ def load() -> ctypes.CDLL | None:
             u64p, ctypes.c_int, ctypes.c_int, u8p, u8p,
             ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int,
         ]
+        lib.mtpu_snappy_max_compressed.argtypes = [ctypes.c_size_t]
+        lib.mtpu_snappy_max_compressed.restype = ctypes.c_size_t
+        lib.mtpu_snappy_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, u8p,
+        ]
+        lib.mtpu_snappy_compress.restype = ctypes.c_size_t
+        lib.mtpu_snappy_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.mtpu_snappy_uncompressed_length.restype = ctypes.c_int64
+        lib.mtpu_snappy_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, u8p, ctypes.c_size_t,
+        ]
+        lib.mtpu_snappy_decompress.restype = ctypes.c_int64
+        lib.mtpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.mtpu_crc32c.restype = ctypes.c_uint32
         _lib = lib
         return _lib
 

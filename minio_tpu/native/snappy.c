@@ -1,0 +1,252 @@
+/* Snappy block-format codec + CRC32C — the native compression engine
+ * behind the S2-style framed object compression (the reference vendors
+ * klauspost/compress/s2, an assembly-accelerated snappy superset; this
+ * implements the interoperable snappy subset of that format:
+ * varint uncompressed length, then literal/copy tags).
+ *
+ * Exported (ctypes):
+ *   size_t  mtpu_snappy_max_compressed(size_t n);
+ *   size_t  mtpu_snappy_compress(const uint8_t*, size_t, uint8_t*);
+ *   int64_t mtpu_snappy_uncompressed_length(const uint8_t*, size_t);
+ *   int64_t mtpu_snappy_decompress(const uint8_t*, size_t,
+ *                                  uint8_t*, size_t);
+ *   uint32_t mtpu_crc32c(const uint8_t*, size_t);
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ---------------- varint ---------------- */
+
+static size_t put_varint(uint8_t *dst, uint64_t v) {
+    size_t i = 0;
+    while (v >= 0x80) {
+        dst[i++] = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    dst[i++] = (uint8_t)v;
+    return i;
+}
+
+static int64_t get_varint(const uint8_t *src, size_t n, uint64_t *out) {
+    uint64_t v = 0;
+    int shift = 0;
+    size_t i = 0;
+    while (i < n && shift < 64) {
+        uint8_t b = src[i++];
+        v |= (uint64_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return (int64_t)i;
+        }
+        shift += 7;
+    }
+    return -1;
+}
+
+/* ---------------- compression ---------------- */
+
+#define HASH_BITS 14
+#define HASH_SIZE (1u << HASH_BITS)
+#define BLOCK 65536u
+#define MIN_MATCH 4u
+
+static inline uint32_t load32(const uint8_t *p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+    return (v * 0x1e35a7bdu) >> (32 - HASH_BITS);
+}
+
+size_t mtpu_snappy_max_compressed(size_t n) {
+    /* worst case: all literals, one tag per 2^32 run + varint header */
+    return 32 + n + n / 6;
+}
+
+static uint8_t *emit_literal(uint8_t *d, const uint8_t *src, size_t len) {
+    while (len > 0) {
+        size_t run = len;
+        if (run > (1u << 16)) run = 1u << 16; /* keep extras <= 2 bytes */
+        size_t l = run - 1;
+        if (l < 60) {
+            *d++ = (uint8_t)(l << 2);
+        } else if (l < 256) {
+            *d++ = 60 << 2;
+            *d++ = (uint8_t)l;
+        } else {
+            *d++ = 61 << 2;
+            *d++ = (uint8_t)(l & 0xff);
+            *d++ = (uint8_t)(l >> 8);
+        }
+        memcpy(d, src, run);
+        d += run;
+        src += run;
+        len -= run;
+    }
+    return d;
+}
+
+static inline uint8_t *emit_copy_one(uint8_t *d, size_t offset,
+                                     size_t len) {
+    *d++ = (uint8_t)(((len - 1) << 2) | 2);
+    *d++ = (uint8_t)(offset & 0xff);
+    *d++ = (uint8_t)(offset >> 8);
+    return d;
+}
+
+static uint8_t *emit_copy(uint8_t *d, size_t offset, size_t len) {
+    /* 2-byte-offset copies, length 1..64 per tag. Split so the FINAL
+     * tag is always >= 4 bytes: a naive 64-at-a-time loop strands a
+     * 1..3-byte remainder the caller has already consumed (canonical
+     * snappy emitCopy does the same 68/64+60 dance). len >= 4 here. */
+    while (len >= 68) {
+        d = emit_copy_one(d, offset, 64);
+        len -= 64;
+    }
+    if (len > 64) {
+        d = emit_copy_one(d, offset, 60);
+        len -= 60;
+    }
+    return emit_copy_one(d, offset, len); /* 4..64 guaranteed */
+}
+
+size_t mtpu_snappy_compress(const uint8_t *src, size_t n, uint8_t *dst) {
+    uint8_t *d = dst;
+    d += put_varint(d, n);
+    static __thread uint16_t table[HASH_SIZE];
+    size_t base = 0;
+    while (base < n) {
+        size_t block_end = base + BLOCK;
+        if (block_end > n) block_end = n;
+        size_t blen = block_end - base;
+        if (blen < MIN_MATCH + 4) {
+            d = emit_literal(d, src + base, blen);
+            base = block_end;
+            continue;
+        }
+        memset(table, 0, sizeof(table));
+        const uint8_t *b = src + base;
+        size_t pos = 0, lit_start = 0;
+        size_t limit = blen - MIN_MATCH;
+        while (pos <= limit) {
+            uint32_t h = hash32(load32(b + pos));
+            size_t cand = table[h];
+            table[h] = (uint16_t)pos;
+            if (cand < pos && pos - cand <= 0xffff &&
+                load32(b + cand) == load32(b + pos)) {
+                /* extend the match */
+                size_t mlen = MIN_MATCH;
+                while (pos + mlen < blen &&
+                       b[cand + mlen] == b[pos + mlen] && mlen < 0xffff)
+                    mlen++;
+                if (pos > lit_start)
+                    d = emit_literal(d, b + lit_start, pos - lit_start);
+                d = emit_copy(d, pos - cand, mlen);
+                /* seed a couple of hashes inside the match for future
+                 * back-references, then skip past it */
+                size_t seed_end = pos + mlen;
+                size_t s = pos + 1;
+                for (; s + MIN_MATCH <= seed_end && s <= limit && s < pos + 4;
+                     s++)
+                    table[hash32(load32(b + s))] = (uint16_t)s;
+                pos += mlen;
+                lit_start = pos;
+            } else {
+                pos++;
+            }
+        }
+        if (blen > lit_start)
+            d = emit_literal(d, b + lit_start, blen - lit_start);
+        base = block_end;
+    }
+    return (size_t)(d - dst);
+}
+
+/* ---------------- decompression ---------------- */
+
+int64_t mtpu_snappy_uncompressed_length(const uint8_t *src, size_t n) {
+    uint64_t v;
+    if (get_varint(src, n, &v) < 0) return -1;
+    return (int64_t)v;
+}
+
+int64_t mtpu_snappy_decompress(const uint8_t *src, size_t n,
+                               uint8_t *dst, size_t dst_cap) {
+    uint64_t want;
+    int64_t hdr = get_varint(src, n, &want);
+    if (hdr < 0 || want > dst_cap) return -1;
+    size_t i = (size_t)hdr, o = 0;
+    while (i < n) {
+        uint8_t tag = src[i++];
+        uint32_t kind = tag & 3;
+        if (kind == 0) { /* literal */
+            size_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                size_t extra = len - 60; /* 1..4 extra length bytes */
+                if (i + extra > n) return -1;
+                len = 0;
+                for (size_t k = 0; k < extra; k++)
+                    len |= (size_t)src[i + k] << (8 * k);
+                len += 1;
+                i += extra;
+            }
+            if (i + len > n || o + len > dst_cap) return -1;
+            memcpy(dst + o, src + i, len);
+            i += len;
+            o += len;
+        } else {
+            size_t len, off;
+            if (kind == 1) {
+                len = ((tag >> 2) & 7) + 4;
+                if (i >= n) return -1;
+                off = ((size_t)(tag >> 5) << 8) | src[i++];
+            } else if (kind == 2) {
+                len = (tag >> 2) + 1;
+                if (i + 2 > n) return -1;
+                off = (size_t)src[i] | ((size_t)src[i + 1] << 8);
+                i += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (i + 4 > n) return -1;
+                off = (size_t)src[i] | ((size_t)src[i + 1] << 8) |
+                      ((size_t)src[i + 2] << 16) |
+                      ((size_t)src[i + 3] << 24);
+                i += 4;
+            }
+            if (off == 0 || off > o || o + len > dst_cap) return -1;
+            /* overlapping copies are the RLE mechanism: byte loop */
+            for (size_t k = 0; k < len; k++) {
+                dst[o] = dst[o - off];
+                o++;
+            }
+        }
+    }
+    return (o == want) ? (int64_t)o : -1;
+}
+
+/* ---------------- CRC32C (Castagnoli) ---------------- */
+
+static uint32_t crc32c_table[256];
+static int crc32c_ready = 0;
+
+static void crc32c_init(void) {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+        crc32c_table[i] = c;
+    }
+    crc32c_ready = 1;
+}
+
+uint32_t mtpu_crc32c(const uint8_t *p, size_t n) {
+    if (!crc32c_ready) crc32c_init();
+    uint32_t c = 0xffffffffu;
+    for (size_t i = 0; i < n; i++)
+        c = crc32c_table[(c ^ p[i]) & 0xff] ^ (c >> 8);
+    return c ^ 0xffffffffu;
+}

@@ -264,7 +264,14 @@ class Server:
             notify=self.notifier, region=region, host=address, port=port,
             metrics=self.metrics, trace=self.trace,
             config_sys=self.config_sys, notification=self.notification,
-            sse_config=SSEConfig(self.root_password),
+            # SSE-KMS default key id follows the kms_kes config subsystem
+            # (ref cmd/crypto/kes.go key_name); the key-name registry
+            # persists in the cluster meta bucket so admin-created keys
+            # survive restarts.
+            sse_config=SSEConfig(
+                self.root_password,
+                kms=self._build_kms(),
+            ),
             # Quota admission reads the scanner's usage accounting, never
             # a live walk on the PUT path (ref BucketQuotaSys 1s-TTL
             # cache over loadDataUsageFromBackend).
@@ -284,7 +291,52 @@ class Server:
             cache=self.cache_layer, iam=self.iam,
             mrf=self.mrf,
         )
+        # Service control: `mc admin service restart|stop` unblocks
+        # wait() with the requested action (ref cmd/service.go).
+        self._service_event = __import__("threading").Event()
+        self.service_action: str | None = None
+
+        def _on_service(action: str):
+            self.service_action = action
+            self._service_event.set()
+
+        self.s3.service_cb = _on_service
         self.started_ns = time.time_ns()
+
+    def _build_kms(self):
+        """LocalKMS whose key registry lives under `.minio.sys` in the
+        object layer (key NAMES only; material derives from the root
+        secret — ref pkg/kms + admin KMS key surface)."""
+        import io as _io
+
+        from .crypto.kms import LocalKMS
+        from .utils.errors import StorageError
+
+        ol = self.object_layer
+
+        class _Persist:
+            PATH = "kms/keys.json"
+
+            def load(self):
+                try:
+                    return ol.get_object_bytes(".minio.sys", self.PATH)
+                except StorageError:
+                    return None
+
+            def save(self, data: bytes):
+                try:
+                    ol.put_object(".minio.sys", self.PATH,
+                                  _io.BytesIO(data), len(data))
+                except StorageError:
+                    ol.make_bucket(".minio.sys")
+                    ol.put_object(".minio.sys", self.PATH,
+                                  _io.BytesIO(data), len(data))
+
+        return LocalKMS(
+            self.root_password,
+            self.config_sys.config.get("kms_kes").get("key_name", ""),
+            persist=_Persist(),
+        )
 
     # --- distributed plumbing ---
 
@@ -454,14 +506,19 @@ class Server:
     def endpoint(self) -> str:
         return self.s3.endpoint
 
-    def wait(self):
+    def wait(self) -> str | None:
+        """Block until SIGTERM/SIGINT or an admin service action.
+        Returns 'restart' / 'stop' for admin-driven shutdowns, None for
+        signals (ref serverMain's signal loop + serviceSignalCh)."""
         import signal
 
-        ev = __import__("threading").Event()
-
         def handler(signum, frame):
-            ev.set()
+            self._service_event.set()
 
-        signal.signal(signal.SIGTERM, handler)
-        signal.signal(signal.SIGINT, handler)
-        ev.wait()
+        try:
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:
+            pass  # not the main thread: admin service actions only
+        self._service_event.wait()
+        return self.service_action

@@ -115,3 +115,86 @@ def test_full_server_fs_mode(tmp_path):
         assert got == b"v"
     finally:
         server.stop()
+
+
+def test_cors_preflight_and_headers(tmp_path):
+    server = Server(
+        [str(tmp_path / "cors{1...4}")], port=0,
+        root_user="corsak", root_password="corssecret",
+        enable_scanner=False,
+    ).start()
+    try:
+        conn = http.client.HTTPConnection(server.endpoint, timeout=10)
+        conn.request("OPTIONS", "/anybucket/anykey",
+                     headers={"Origin": "https://app.example",
+                              "Access-Control-Request-Method": "PUT"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Access-Control-Allow-Origin") == "*"
+        assert "PUT" in r.getheader("Access-Control-Allow-Methods", "")
+        r.read()
+        conn.close()
+        # Normal responses carry the CORS origin header too.
+        st, _ = _req(server.endpoint, "corsak", "corssecret", "PUT", "/corsb")
+        assert st == 200
+    finally:
+        server.stop()
+
+
+def test_admin_service_action_unblocks_wait(tmp_path):
+    import threading
+
+    server = Server(
+        [str(tmp_path / "svc{1...4}")], port=0,
+        root_user="svcak", root_password="svcsecret",
+        enable_scanner=False,
+    ).start()
+    try:
+        results = {}
+
+        def waiter():
+            results["action"] = server.wait()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        st, body = _req(server.endpoint, "svcak", "svcsecret", "POST",
+                        "/minio/admin/v3/service",
+                        query=[("action", "restart")])
+        assert st == 200
+        import json as _json
+
+        assert _json.loads(body)["accepted"] is True
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results["action"] == "restart"
+    finally:
+        server.stop()
+
+
+def test_cors_origin_allowlist(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_API_CORS_ALLOW_ORIGIN",
+                       "https://good.example,https://*.trusted.example")
+    server = Server(
+        [str(tmp_path / "corsl{1...4}")], port=0,
+        root_user="clak", root_password="clsecret",
+        enable_scanner=False,
+    ).start()
+    try:
+        def preflight(origin):
+            conn = http.client.HTTPConnection(server.endpoint, timeout=10)
+            try:
+                conn.request("OPTIONS", "/b/k", headers={"Origin": origin})
+                r = conn.getresponse()
+                r.read()
+                return r.getheader("Access-Control-Allow-Origin")
+            finally:
+                conn.close()
+
+        # exact + wildcard matches echo the SINGLE requesting origin
+        assert preflight("https://good.example") == "https://good.example"
+        assert preflight("https://app.trusted.example") == \
+            "https://app.trusted.example"
+        # non-listed origin gets NO allow header (browser blocks)
+        assert preflight("https://evil.example") is None
+    finally:
+        server.stop()

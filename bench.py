@@ -68,6 +68,15 @@ def _bench_dir() -> str:
     return tempfile.mkdtemp(prefix="mtpu-bench-", dir=base)
 
 
+def _cleanup(path: str):
+    """Drop a finished config's data IMMEDIATELY: the bench root lives
+    in tmpfs, and letting configs accumulate (~0.5 GB by config 5)
+    starves small-RAM hosts into swap, corrupting later numbers."""
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
 class _Null:
     def write(self, b):
         return len(b)
@@ -122,6 +131,13 @@ def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
         for s in sinks:
             s.close()
         best = max(best, len(payload) / dt / 1e9)
+        for i, d in enumerate(disks):
+            try:
+                d.delete("bench", f"shard-{rep}-{i}")
+            except Exception:  # noqa: BLE001
+                pass
+    for i in range(16):
+        _cleanup(os.path.join(root, f"enc{i}"))
     return best
 
 
@@ -341,6 +357,16 @@ def main() -> None:
 
     headline = bench_headline_encode(root)
     encode_only = bench_encode_only()
+    configs = {}
+    for key, fn, sub in (
+        ("c1_put_2p2_1mib_p50_ms", bench_config1_put_p50, "c1"),
+        ("c2_roundtrip_12p4_10mib_gbps", bench_config2_roundtrip, "c2"),
+        ("c3_heal_12p4_2down_gbps", bench_config3_heal, "c3"),
+        ("c4_bitrot_get_8p4_gbps", bench_config4_bitrot_get, "c4"),
+        ("c5_pool_batched_put_gbps", bench_config5_pool_put, "c5"),
+    ):
+        configs[key] = round(fn(root), 3)
+        _cleanup(os.path.join(root, sub))
     result = {
         "metric": ("PutObject erasure-encode 12+4 @1MiB, host-fed into "
                    "streaming bitrot writers (the reference's "
@@ -356,18 +382,7 @@ def main() -> None:
         "encode_only_gbps": round(encode_only, 3),
         "host_memcpy_gbps": round(memcpy_gbps, 2),
         "cpu_count": os.cpu_count(),
-        "configs": {
-            "c1_put_2p2_1mib_p50_ms": round(
-                bench_config1_put_p50(root), 3),
-            "c2_roundtrip_12p4_10mib_gbps": round(
-                bench_config2_roundtrip(root), 3),
-            "c3_heal_12p4_2down_gbps": round(
-                bench_config3_heal(root), 3),
-            "c4_bitrot_get_8p4_gbps": round(
-                bench_config4_bitrot_get(root), 3),
-            "c5_pool_batched_put_gbps": round(
-                bench_config5_pool_put(root), 3),
-        },
+        "configs": configs,
         "baseline_estimated": True,
     }
     try:

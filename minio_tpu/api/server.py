@@ -296,7 +296,7 @@ class S3Server:
         from .web import WebHandlers
 
         self.web = WebHandlers(object_layer, iam, bucket_meta,
-                               region=region)
+                               region=region, s3_handlers=self.handlers)
         from ..observability.audit import AuditLogger
 
         self.audit = AuditLogger.from_config(

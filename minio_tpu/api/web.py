@@ -65,13 +65,22 @@ def _verify_token(token: str, iam) -> str:
 
 
 class WebHandlers:
-    """JSON-RPC dispatcher + the token-authed byte paths."""
+    """JSON-RPC dispatcher + the token-authed byte paths.
 
-    def __init__(self, object_layer, iam, bucket_meta, region="us-east-1"):
+    The byte paths DELEGATE to the S3 data-plane handlers (`s3_handlers`)
+    rather than touching the object layer directly, so uploads and
+    downloads get the identical pipeline — quota admission, retention
+    defaults, compression/SSE transforms, events, replication — as a
+    SigV4 request (the reference's web handlers call the same
+    objectAPI+filter path, cmd/web-handlers.go Upload/Download)."""
+
+    def __init__(self, object_layer, iam, bucket_meta, region="us-east-1",
+                 s3_handlers=None):
         self.ol = object_layer
         self.iam = iam
         self.bm = bucket_meta
         self.region = region
+        self.h = s3_handlers
 
     # --- entry points (wired from the S3 server dispatch) ---
 
@@ -145,7 +154,8 @@ class WebHandlers:
         user = params.get("username", "")
         password = params.get("password", "")
         creds = self.iam.get_credentials(user)
-        if creds is None or creds.secret_key != password:
+        if creds is None or not hmac.compare_digest(
+                creds.secret_key.encode(), password.encode()):
             raise S3Error("AccessDenied", "invalid login")
         return {"token": _sign_token(user, password),
                 "uiVersion": "mtpu-web-1"}
@@ -191,9 +201,16 @@ class WebHandlers:
         self._authorize(access_key, "s3:ListBucket", bucket)
         res = self.ol.list_objects(bucket, prefix=prefix, delimiter="/",
                                    marker=params.get("marker", ""))
+        from . import transforms
+
         return {
             "objects": [
-                {"name": o.name, "size": o.size, "etag": o.etag,
+                # Logical (client-visible) size, like the S3 listing —
+                # never the stored compressed/ciphertext size.
+                {"name": o.name,
+                 "size": transforms.actual_object_size(
+                     o.user_defined, o.size),
+                 "etag": o.etag,
                  "lastModified": o.mod_time_ns}
                 for o in res.objects
             ],
@@ -203,12 +220,19 @@ class WebHandlers:
         }
 
     def _m_remove_object(self, params, access_key):
+        """Deletes go through the S3 DeleteObject handler so per-object
+        policy, versioning delete markers, retention/legal-hold checks,
+        events, and delete replication all apply — the console is not a
+        side door around WORM."""
         bucket = params.get("bucketName", "")
         objects = params.get("objects", [])
-        self._authorize(access_key, "s3:DeleteObject", bucket)
         for obj in objects:
-            self._guard_names(bucket, obj)
-            self.ol.delete_object(bucket, obj)
+            # Per-OBJECT authorization: prefix-scoped Deny/Allow must
+            # behave exactly as on the S3 plane.
+            self._authorize(access_key, "s3:DeleteObject", bucket, obj)
+            sub = self._sub_ctx("DELETE", bucket, obj,
+                                access_key=access_key)
+            self.h.delete_object(sub)
         return {}
 
     def _m_presigned_get(self, params, access_key):
@@ -227,7 +251,22 @@ class WebHandlers:
         )
         return {"url": f"http://{host}/{bucket}/{object_}?{qs}"}
 
-    # --- byte paths ---
+    # --- byte paths (delegate to the S3 data-plane handlers) ---
+
+    def _sub_ctx(self, method: str, bucket: str, object_: str,
+                 headers: dict | None = None, body_reader=None,
+                 content_length=None, access_key: str = ""):
+        """Synthetic RequestContext addressing /bucket/object so the S3
+        handlers run their normal pipeline after web-token auth."""
+        from .server import RequestContext
+
+        sub = RequestContext(
+            method, f"/{bucket}/{object_}", [], dict(headers or {}),
+            body_reader if body_reader is not None else io.BytesIO(b""),
+            content_length,
+        )
+        sub.access_key = access_key
+        return sub
 
     def _upload(self, ctx) -> Response:
         access_key = _verify_token(
@@ -238,25 +277,34 @@ class WebHandlers:
         if not bucket or not object_:
             raise S3Error("InvalidArgument", "upload path")
         self._authorize(access_key, "s3:PutObject", bucket, object_)
-        size = ctx.content_length or 0
-        data = ctx.body_reader.read(size)
-        oi = self.ol.put_object(bucket, object_, io.BytesIO(data), size)
-        return Response(200, {"ETag": f'"{oi.etag}"'})
+        # STREAM the body through the full S3 PUT pipeline (quota,
+        # retention defaults, compression/SSE transforms, events,
+        # replication) — never buffered here. Auth headers are stripped
+        # so only content/metadata headers flow through.
+        headers = {
+            k: v for k, v in ctx.raw_headers.items()
+            if k.lower() != "authorization"
+        }
+        sub = self._sub_ctx("PUT", bucket, object_, headers=headers,
+                            body_reader=ctx.body_reader,
+                            content_length=ctx.content_length,
+                            access_key=access_key)
+        return self.h.put_object(sub)
 
     def _download(self, ctx) -> Response:
         token = dict(ctx.query).get("token", "")
         access_key = _verify_token(token, self.iam)
         bucket, _, object_ = ctx.path[len(DOWNLOAD_PREFIX):].partition("/")
         self._authorize(access_key, "s3:GetObject", bucket, object_)
-        buf = io.BytesIO()
-        self.ol.get_object(bucket, object_, buf)
-        data = buf.getvalue()
-        return Response(200, {
-            "Content-Type": "application/octet-stream",
-            "Content-Disposition":
-                f'attachment; filename="{object_.rsplit("/", 1)[-1]}"',
-            "Content-Length": str(len(data)),
-        }, data)
+        # The S3 GET handler streams and runs the decrypt/decompress
+        # chain — the browser must receive object CONTENT, never stored
+        # ciphertext/compressed frames.
+        sub = self._sub_ctx("GET", bucket, object_, access_key=access_key)
+        resp = self.h.get_object(sub)
+        resp.headers["Content-Disposition"] = (
+            f'attachment; filename="{object_.rsplit("/", 1)[-1]}"'
+        )
+        return resp
 
     # --- authz ---
 

@@ -17,6 +17,7 @@ from enum import Enum
 
 import numpy as np
 
+from .. import native
 from ..ops import highwayhash
 from ..utils import ceil_frac
 from ..utils.errors import ErrFileCorrupt, ErrLessData
@@ -47,9 +48,10 @@ class BitrotAlgorithm(Enum):
         if self is BitrotAlgorithm.BLAKE2B512:
             return hashlib.blake2b(digest_size=64)
         # HighwayHash: native C engine when available (the reference uses
-        # Go assembly here), numpy engine as fallback.
-        from .. import native
-
+        # Go assembly here), numpy engine as fallback. The native import
+        # lives at module scope: an in-function import here serializes
+        # EVERY hasher creation on the interpreter's import lock (16
+        # hashers per PUT — visible in profiles under contention).
         h = native.new_highwayhash256(highwayhash.MAGIC_KEY)
         if h is not None:
             return h

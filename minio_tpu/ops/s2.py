@@ -277,8 +277,10 @@ class FrameDecoder:
                 if _masked_crc(raw) != want_crc:
                     raise ValueError("snappy frame CRC mismatch")
                 self._out += raw
-            elif 0x80 <= ctype <= 0xFD:
-                continue  # skippable chunk
+            elif 0x80 <= ctype <= 0xFE:
+                # skippable chunks INCLUDING 0xFE padding (the framing
+                # spec requires decoders to skip padding, not reject it)
+                continue
             else:
                 raise ValueError(f"unknown snappy frame type {ctype:#x}")
 

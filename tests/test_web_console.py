@@ -179,3 +179,51 @@ def test_web_plane_cannot_touch_internal_buckets(server, token):
         r.read()
     finally:
         conn.close()
+
+
+def test_web_download_decodes_transformed_objects(server, token):
+    """An SSE-encrypted object fetched via /minio/download returns the
+    PLAINTEXT content — the web byte path runs the same GET chain as
+    S3, never raw stored ciphertext."""
+    from minio_tpu.api.sign import sign_v4_request
+
+    rpc(server, "web.MakeBucket", {"bucketName": "webenc"}, token)
+    body = b"secret web payload " * 300
+    path = "/webenc/enc.bin"
+    h = sign_v4_request(SK, AK, "PUT", server.endpoint, path, [],
+                        {"x-amz-server-side-encryption": "AES256"}, body)
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("PUT", path, body=body, headers=h)
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+
+    q = urllib.parse.urlencode({"token": token})
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("GET", f"/minio/download/webenc/enc.bin?{q}")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.read() == body  # decrypted, not ciphertext
+    finally:
+        conn.close()
+
+    # and web-uploaded bytes read back identically over signed S3 GET
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("PUT", "/minio/upload/webenc/up.bin", body=body,
+                     headers={"Authorization": f"Bearer {token}",
+                              "Content-Length": str(len(body))})
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+    h = sign_v4_request(SK, AK, "GET", server.endpoint,
+                        "/webenc/up.bin", [], {}, b"")
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("GET", "/webenc/up.bin", headers=h)
+        r = conn.getresponse()
+        assert r.status == 200 and r.read() == body
+    finally:
+        conn.close()

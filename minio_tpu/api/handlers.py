@@ -876,6 +876,28 @@ class S3ApiHandlers:
         incoming_replica = (
             opts.user_defined.get("x-amz-meta-mtpu-replication") == "replica"
         )
+        if incoming_replica:
+            # The replica marker suppresses re-replication, so it is
+            # privileged: s3:ReplicateObject required. Enforced HERE so
+            # every ingress path (SigV4, web console, POST policy)
+            # passes through one guard (ref ReplicateObjectAction check,
+            # cmd/auth-handler.go).
+            from ..iam.policy import Args as _Args
+
+            account = getattr(ctx, "access_key", "") or ""
+            _args = _Args(account=account, action="s3:ReplicateObject",
+                          bucket=ctx.bucket, object=ctx.object)
+            bucket_policy = self.bm.get(ctx.bucket).policy()
+            allowed = (
+                (bool(account) and self.iam.is_allowed(_args))
+                or (bucket_policy is not None
+                    and bucket_policy.is_allowed(_args))
+            )
+            if not allowed:
+                raise S3Error(
+                    "AccessDenied",
+                    "replica marker requires s3:ReplicateObject",
+                )
         if repl_rule is not None:
             from ..replication.pool import PENDING, REPL_STATUS_KEY, REPLICA
 
@@ -1482,6 +1504,106 @@ class S3ApiHandlers:
         ET.SubElement(root, "UploadId").text = upload_id
         return Response.xml(root)
 
+    # Browser form uploads are fully buffered (the multipart/form-data
+    # body must be parsed before the file part is known); bound the
+    # body so a form holder can't OOM the server — larger objects
+    # belong on the streaming PUT/multipart APIs.
+    MAX_POST_POLICY_BODY = 64 << 20
+
+    def post_policy_object(self, ctx) -> Response:
+        """Browser form upload: POST multipart/form-data to the bucket
+        with a signed policy document (ref PostPolicyBucketHandler,
+        cmd/bucket-handlers.go + cmd/postpolicyform.go). Authentication
+        is the policy signature itself, not SigV4 headers — the form's
+        x-amz-credential/x-amz-signature pair is verified against the
+        IAM secret and the policy conditions against the form fields,
+        then the bytes flow through the normal PUT pipeline."""
+        from . import sign as signmod
+
+        self._check_bucket(ctx.bucket)
+        if (ctx.content_length or 0) > self.MAX_POST_POLICY_BODY:
+            raise S3Error(
+                "EntityTooLarge",
+                f"POST form bodies are capped at "
+                f"{self.MAX_POST_POLICY_BODY} bytes",
+            )
+        ctype = ctx.headers.get("content-type", "")
+        fields, file_data, filename = _parse_multipart_form(
+            ctype, ctx.body
+        )
+        policy_b64 = fields.get("policy", "")
+        if not policy_b64:
+            raise S3Error("MalformedPOSTRequest", "missing policy")
+        # --- signature (V4 policy signing: StringToSign IS the policy)
+        cred_str = fields.get("x-amz-credential", "")
+        sig = fields.get("x-amz-signature", "")
+        if not cred_str or not sig:
+            raise S3Error("AccessDenied", "missing POST signature fields")
+        try:
+            cred = signmod.V4Credential(cred_str)
+        except signmod.SignError as exc:
+            raise S3Error("InvalidArgument",
+                          f"bad x-amz-credential: {exc}") from exc
+        creds = self.iam.get_credentials(cred.access_key)
+        if creds is None:
+            raise S3Error("InvalidAccessKeyId", cred.access_key)
+        import hashlib as _hl
+        import hmac as _hmac
+
+        key = signmod.signing_key(
+            creds.secret_key, cred.date, cred.region, cred.service
+        )
+        want = _hmac.new(key, policy_b64.encode(), _hl.sha256).hexdigest()
+        if not _hmac.compare_digest(want, sig):
+            raise S3Error("SignatureDoesNotMatch", "POST policy")
+        # --- policy conditions
+        _check_post_policy(policy_b64, fields, len(file_data), ctx.bucket)
+        key_tmpl = fields.get("key", "")
+        if not key_tmpl:
+            raise S3Error("InvalidArgument", "missing key field")
+        object_ = key_tmpl.replace("${filename}", filename)
+        if not valid_object_name(object_):
+            raise S3Error("InvalidArgument", f"bad key {object_!r}")
+        # --- authorization for the signing identity: SAME rule as the
+        # SigV4 plane (IAM allow OR bucket-policy allow).
+        from ..iam.policy import Args
+
+        args = Args(
+            account=cred.access_key, action="s3:PutObject",
+            bucket=ctx.bucket, object=object_,
+        )
+        bucket_policy = self.bm.get(ctx.bucket).policy()
+        if not (self.iam.is_allowed(args)
+                or (bucket_policy is not None
+                    and bucket_policy.is_allowed(args))):
+            raise S3Error("AccessDenied", "PutObject")
+        # --- run the normal PUT pipeline over the file bytes
+        from .server import RequestContext
+
+        headers = {
+            k: v for k, v in fields.items()
+            if k.startswith("x-amz-meta-") or k == "content-type"
+        }
+        sub = RequestContext(
+            "PUT", f"/{ctx.bucket}/{object_}", [], headers,
+            io.BytesIO(file_data), len(file_data),
+        )
+        sub.access_key = cred.access_key
+        resp = self.put_object(sub)
+        status = fields.get("success_action_status", "204")
+        if status == "201":
+            root = ET.Element("PostResponse")
+            ET.SubElement(root, "Bucket").text = ctx.bucket
+            ET.SubElement(root, "Key").text = object_
+            ET.SubElement(root, "ETag").text = resp.headers.get("ETag", "")
+            out = Response.xml(root)
+            out.status = 201
+            out.headers.update(
+                {k: v for k, v in resp.headers.items() if k != "ETag"}
+            )
+            return out
+        return Response(204, dict(resp.headers))
+
     def put_object_part(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
         q = ctx.qdict
@@ -1667,3 +1789,118 @@ class S3ApiHandlers:
             ET.SubElement(u, "Key").text = mp.object
             ET.SubElement(u, "UploadId").text = mp.upload_id
         return Response.xml(root)
+
+
+class PostPolicyError(S3Error):
+    pass
+
+
+def _parse_multipart_form(content_type: str, body: bytes):
+    """multipart/form-data -> (fields dict, file bytes, filename)."""
+    from email import message_from_bytes
+    from email.policy import HTTP
+
+    raw = (f"Content-Type: {content_type}\r\nMIME-Version: 1.0\r\n\r\n"
+           .encode() + body)
+    msg = message_from_bytes(raw, policy=HTTP)
+    if not msg.is_multipart():
+        raise S3Error("MalformedPOSTRequest", "not multipart/form-data")
+    fields: dict[str, str] = {}
+    file_data: bytes | None = None
+    filename = ""
+    for part in msg.iter_parts():
+        name = part.get_param("name", header="content-disposition")
+        if not name:
+            continue
+        payload = part.get_payload(decode=True) or b""
+        if name == "file":
+            file_data = payload
+            filename = part.get_filename() or ""
+        else:
+            fields[name.lower()] = payload.decode("utf-8", "replace").strip()
+    if file_data is None:
+        raise S3Error("MalformedPOSTRequest", "missing file field")
+    return fields, file_data, filename
+
+
+def _check_post_policy(policy_b64: str, fields: dict, size: int,
+                       bucket: str = ""):
+    """Validate the browser POST policy document's expiration and
+    conditions against the submitted form fields (ref
+    cmd/postpolicyform.go checkPostPolicy)."""
+    import base64 as _b64
+    import datetime as _dt
+    import json as _json
+
+    try:
+        doc = _json.loads(_b64.b64decode(policy_b64))
+    except Exception as exc:
+        raise S3Error("MalformedPOSTRequest", "bad policy") from exc
+    exp = doc.get("expiration", "")
+    try:
+        when = _dt.datetime.fromisoformat(str(exp).replace("Z", "+00:00"))
+    except (ValueError, TypeError) as exc:
+        raise S3Error("MalformedPOSTRequest", "bad expiration") from exc
+    if when.tzinfo is None:
+        when = when.replace(tzinfo=_dt.timezone.utc)
+    if when < _dt.datetime.now(_dt.timezone.utc):
+        raise S3Error("AccessDenied", "policy expired")
+    # The bucket is addressed by the URL, not a form field (AWS POST
+    # policy semantics): surface it to the condition matcher.
+    fields = dict(fields)
+    fields.setdefault("bucket", bucket)
+    covered: set[str] = set()
+    try:
+        for cond in doc.get("conditions", []):
+            if isinstance(cond, dict):
+                for k, v in cond.items():
+                    k = str(k).lower().lstrip("$")
+                    covered.add(k)
+                    if k in ("policy", "x-amz-signature", "file"):
+                        continue
+                    if fields.get(k, "") != str(v):
+                        raise S3Error(
+                            "AccessDenied",
+                            f"policy condition failed: {k}",
+                        )
+            elif isinstance(cond, list) and len(cond) == 3:
+                op, key, val = str(cond[0]).lower(), str(cond[1]), cond[2]
+                if op == "content-length-range":
+                    lo, hi = int(cond[1]), int(cond[2])
+                    if not lo <= size <= hi:
+                        raise S3Error(
+                            "EntityTooLarge" if size > hi
+                            else "EntityTooSmall",
+                            f"{size} outside [{lo},{hi}]",
+                        )
+                    continue
+                k = key.lower().lstrip("$")
+                covered.add(k)
+                have = fields.get(k, "")
+                if op == "eq" and have != str(val):
+                    raise S3Error("AccessDenied",
+                                  f"policy eq condition failed: {k}")
+                if op == "starts-with" and not have.startswith(str(val)):
+                    raise S3Error("AccessDenied",
+                                  f"policy starts-with failed: {k}")
+            else:
+                raise S3Error("MalformedPOSTRequest",
+                              f"unsupported condition shape")
+    except S3Error:
+        raise
+    except Exception as exc:  # noqa: BLE001 - malformed document shapes
+        raise S3Error("MalformedPOSTRequest",
+                      f"bad policy conditions: {exc}") from exc
+    # EVERY non-plumbing form field must be covered by a condition
+    # (AWS POST policy rule) — blocks smuggling metadata, including
+    # the privileged replica marker, past whoever signed the form.
+    exempt = {
+        "policy", "x-amz-signature", "x-amz-algorithm",
+        "x-amz-credential", "x-amz-date", "x-amz-security-token",
+        "bucket", "success_action_status", "success_action_redirect",
+    }
+    for k in fields:
+        if k not in exempt and k not in covered:
+            raise S3Error(
+                "AccessDenied", f"form field {k!r} not covered by policy"
+            )

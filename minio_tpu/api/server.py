@@ -221,6 +221,10 @@ def route(ctx: RequestContext) -> str:
         if m == "POST":
             if "delete" in q:
                 return "delete_multiple_objects"
+            if ctx.headers.get("content-type", "").startswith(
+                    "multipart/form-data"):
+                # Browser form upload (ref PostPolicyBucketHandler).
+                return "post_policy_object"
         raise S3Error("MethodNotAllowed", f"{m} bucket")
     # object routes
     if m == "GET":
@@ -506,7 +510,8 @@ class S3Server:
                 ctx.body.decode(errors="replace")
             ))
             if form.get("Action") in ("AssumeRoleWithWebIdentity",
-                                      "AssumeRoleWithClientGrants"):
+                                      "AssumeRoleWithClientGrants",
+                                      "AssumeRoleWithLDAPIdentity"):
                 return handle_sts(ctx, self.iam, "",
                                   config=self.handlers.config)
             auth_result = authenticate(
@@ -553,6 +558,12 @@ class S3Server:
         ctx.api_name = name
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
+        if name == "post_policy_object":
+            # POST policy uploads authenticate via the SIGNED POLICY in
+            # the form body, not SigV4 headers — the handler verifies
+            # the signature + conditions itself (ref auth-handler.go
+            # authTypePostPolicy branch).
+            return self.handlers.post_policy_object(ctx)
         auth_result = authenticate(
             self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
         )
@@ -566,15 +577,9 @@ class S3Server:
             self.iam, bucket_policy, auth_result, action,
             ctx.bucket, ctx.object,
         )
-        # Replica-marked writes suppress re-replication, so the marker is
-        # privileged: only principals with s3:ReplicateObject may set it
-        # (ref auth-handler.go ReplicateObjectAction check).
-        if (name == "put_object"
-                and ctx.headers.get("x-amz-meta-mtpu-replication")):
-            authorize(
-                self.iam, bucket_policy, auth_result, "s3:ReplicateObject",
-                ctx.bucket, ctx.object,
-            )
+        # (The replica-marker s3:ReplicateObject guard lives inside the
+        # put_object HANDLER so every ingress path — SigV4, web console,
+        # POST policy — passes through it.)
         # Copy requests read from a second location: authorize
         # s3:GetObject on the parsed source too (ref CopyObjectHandler,
         # cmd/object-handlers.go — the source has its own auth check).

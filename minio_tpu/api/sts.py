@@ -122,6 +122,8 @@ def handle_sts(ctx, iam: IAMSys, access_key: str,
     if action in ("AssumeRoleWithWebIdentity",
                   "AssumeRoleWithClientGrants"):
         return _handle_federated(ctx, iam, form, action, config)
+    if action == "AssumeRoleWithLDAPIdentity":
+        return _handle_ldap(ctx, iam, form, config)
     if action != "AssumeRole":
         raise S3Error("NotImplemented", f"STS action {action!r}")
     if form.get("Version") != STS_VERSION:
@@ -189,6 +191,48 @@ def _handle_federated(ctx, iam: IAMSys, form: dict, action: str,
         policy_names=policy_names,
     )
     return _creds_response(ctx, cred, action=action)
+
+
+def _handle_ldap(ctx, iam: IAMSys, form: dict, config) -> Response:
+    """AssumeRoleWithLDAPIdentity (ref cmd/sts-handlers.go:534): an
+    UNSIGNED request carrying LDAPUsername/LDAPPassword; the server
+    binds the derived user DN against the configured directory and
+    mints temp credentials carrying the policies an admin mapped to
+    `ldap:<username>` (set-user-or-group-policy)."""
+    if form.get("Version") != STS_VERSION:
+        raise S3Error("InvalidArgument", "missing STS Version")
+    ldap_cfg = config.get("identity_ldap") if config is not None else None
+    if ldap_cfg is None or not ldap_cfg.get("server_addr"):
+        raise S3Error("NotImplemented", "identity_ldap is not configured")
+    username = form.get("LDAPUsername", "")
+    password = form.get("LDAPPassword", "")
+    if not username or not password:
+        raise S3Error("InvalidArgument", "missing LDAP credentials")
+    # DN template: uid=<user>,<base_dn> (the reference's userDN format
+    # string; commas/escapes in usernames are rejected outright).
+    if any(c in username for c in ",=+<>#;\\\"\0"):
+        raise S3Error("InvalidArgument", "invalid LDAP username")
+    base_dn = ldap_cfg.get("user_dn_search_base_dn", "")
+    dn = f"uid={username},{base_dn}" if base_dn else f"uid={username}"
+    from ..utils.ldap import LDAPError, simple_bind
+
+    try:
+        ok = simple_bind(ldap_cfg["server_addr"], dn, password)
+    except LDAPError as exc:
+        raise S3Error("InternalError", f"ldap: {exc}") from exc
+    if not ok:
+        raise S3Error("AccessDenied", "LDAP bind failed")
+    subject = f"ldap:{username}"
+    policy_names = list(iam.user_policy.get(subject, []))
+    if not policy_names:
+        raise S3Error(
+            "AccessDenied", f"no policies mapped for {subject}"
+        )
+    duration = _parse_duration(form)
+    cred = iam.new_federated_credentials(
+        subject=subject, duration_s=duration, policy_names=policy_names,
+    )
+    return _creds_response(ctx, cred, action="AssumeRoleWithLDAPIdentity")
 
 
 def _creds_response(ctx, cred, action: str = "AssumeRole") -> Response:

@@ -101,10 +101,21 @@ class ObjectStoreBackend(IAMStore):
     def save(self, path: str, data: bytes):
         import io
 
-        self._ol.put_object(
-            self.META_BUCKET, f"{IAM_PREFIX}/{path}", io.BytesIO(data),
-            len(data),
-        )
+        from ..utils.errors import ErrBucketNotFound
+
+        try:
+            self._ol.put_object(
+                self.META_BUCKET, f"{IAM_PREFIX}/{path}",
+                io.BytesIO(data), len(data),
+            )
+        except ErrBucketNotFound:
+            # First IAM write on a fresh deployment creates the cluster
+            # meta bucket (ref .minio.sys bootstrap).
+            self._ol.make_bucket(self.META_BUCKET)
+            self._ol.put_object(
+                self.META_BUCKET, f"{IAM_PREFIX}/{path}",
+                io.BytesIO(data), len(data),
+            )
 
     def load(self, path: str) -> bytes | None:
         from ..utils.errors import StorageError

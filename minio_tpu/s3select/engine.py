@@ -29,7 +29,7 @@ class SelectRequest:
     """Parsed SelectObjectContentRequest."""
 
     expression: str
-    input_format: str = "csv"          # csv | json
+    input_format: str = "csv"          # csv | json | parquet
     file_header_info: str = "NONE"     # USE | IGNORE | NONE
     field_delimiter: str = ","
     record_delimiter: str = "\n"
@@ -67,6 +67,8 @@ class SelectRequest:
                     for sub in el:
                         if sub.tag.endswith("Type"):
                             req.json_type = (sub.text or "LINES").upper()
+                elif tag == "Parquet":
+                    req.input_format = "parquet"
                 elif tag == "FileHeaderInfo":
                     req.file_header_info = (el.text or "NONE").upper()
                 elif tag == "FieldDelimiter":
@@ -171,6 +173,42 @@ def _dicts_to_batches(records: list[dict]):
             [_jsonval(r.get(k)) for r in lowered], dtype=object
         )
     yield _Batch(columns=cols, n=len(records))
+
+
+def _parquet_batches(stream, req: SelectRequest):
+    """Columnar Parquet input (ref pkg/s3select/parquet + the vendored
+    internal/parquet-go reader). Arrow does the decode; values are
+    stringified into the same object-array batches the CSV/JSON readers
+    produce, so the whole SQL engine is format-agnostic. Requires a
+    SEEKABLE stream (the handler spools the logical object)."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as exc:  # pragma: no cover - pyarrow is baked in
+        raise SQLError("Parquet input requires pyarrow") from exc
+
+    try:
+        pf = pq.ParquetFile(stream)
+    except Exception as exc:  # noqa: BLE001 - corrupt/not-parquet
+        raise SQLError(f"malformed Parquet input: {exc}") from exc
+    for rb in pf.iter_batches(batch_size=BATCH_ROWS):
+        cols = {}
+        for name, col in zip(rb.schema.names, rb.columns):
+            cols[name.lower()] = np.array(
+                [_parquetval(v) for v in col.to_pylist()], dtype=object
+            )
+        yield _Batch(columns=cols, n=rb.num_rows)
+
+
+def _parquetval(v):
+    if v is None or isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
 
 
 def _jsonval(v):
@@ -378,8 +416,14 @@ def run_select(req: SelectRequest, stream, emit) -> dict:
     chunk. Returns {"processed": n_bytes, "returned": n_bytes}."""
     query = parse(req.expression)
     counting = _CountingReader(stream)
-    batches = (_csv_batches(counting, req) if req.input_format == "csv"
-               else _json_batches(counting, req))
+    if req.input_format == "parquet":
+        # Parquet needs random access (footer metadata + column chunks):
+        # read the underlying spool directly, not the counting wrapper.
+        batches = _parquet_batches(stream, req)
+    elif req.input_format == "csv":
+        batches = _csv_batches(counting, req)
+    else:
+        batches = _json_batches(counting, req)
 
     returned = 0
     emitted_rows = 0
@@ -442,6 +486,14 @@ def run_select(req: SelectRequest, stream, emit) -> dict:
         chunk = _agg_output(req, query, agg_states)
         returned += len(chunk)
         emit(chunk)
+    if req.input_format == "parquet":
+        # Random-access input: processed = full spool size, not the
+        # counting wrapper (which parquet bypasses).
+        pos = stream.tell()
+        stream.seek(0, io.SEEK_END)
+        processed = stream.tell()
+        stream.seek(pos)
+        return {"returned": returned, "processed": processed}
     return {"returned": returned, "processed": counting.count}
 
 

@@ -1,0 +1,276 @@
+"""Browser POST policy form uploads (ref PostPolicyBucketHandler,
+cmd/bucket-handlers.go + cmd/postpolicyform.go) and S3 Select over
+Parquet input (ref pkg/s3select/parquet)."""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import http.client
+import io
+import json
+import urllib.parse
+import uuid
+
+import pytest
+
+from minio_tpu.api.sign import sign_v4_request, signing_key
+
+AK, SK = "postak", "post-secret-key"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from minio_tpu.server import Server
+
+    root = tmp_path_factory.mktemp("post")
+    srv = Server(
+        [str(root / "disk{1...4}")], port=0,
+        root_user=AK, root_password=SK, enable_scanner=False,
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def _signed_req(srv, method, path, query=None, body=b"", headers=None):
+    query = query or []
+    qs = urllib.parse.urlencode(query)
+    url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+    h = sign_v4_request(SK, AK, method, srv.endpoint, path, query,
+                        dict(headers or {}), body)
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+    try:
+        conn.request(method, url, body=body, headers=h)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _post_form(srv, bucket, fields: dict, file_data: bytes,
+               filename="upload.bin"):
+    boundary = f"----boundary{uuid.uuid4().hex}"
+    parts = []
+    for k, v in fields.items():
+        parts.append(
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="{k}"\r\n\r\n{v}\r\n'.encode()
+        )
+    parts.append(
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"file\"; "
+        f'filename="{filename}"\r\nContent-Type: '
+        f"application/octet-stream\r\n\r\n".encode()
+        + file_data + b"\r\n"
+    )
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+    try:
+        conn.request("POST", f"/{bucket}", body=body, headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}",
+            "Content-Length": str(len(body)),
+        })
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _policy_fields(key_cond, bucket, extra_conds=None, expire_s=600,
+                   secret=SK, access=AK):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    date = now.strftime("%Y%m%d")
+    cred = f"{access}/{date}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": (
+            now + datetime.timedelta(seconds=expire_s)
+        ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "conditions": [
+            {"bucket": bucket},
+            key_cond,
+            {"x-amz-credential": cred},
+        ] + (extra_conds or []),
+    }
+    policy_b64 = base64.b64encode(
+        json.dumps(policy).encode()
+    ).decode()
+    sig = hmac.new(
+        signing_key(secret, date, "us-east-1"),
+        policy_b64.encode(), hashlib.sha256,
+    ).hexdigest()
+    return {
+        "policy": policy_b64,
+        "x-amz-credential": cred,
+        "x-amz-signature": sig,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+    }
+
+
+def test_post_policy_upload(server):
+    assert _signed_req(server, "PUT", "/postbkt")[0] == 200
+    fields = _policy_fields(["starts-with", "$key", "uploads/"], "postbkt")
+    fields["key"] = "uploads/${filename}"
+    body = b"browser form bytes" * 50
+    st, h, raw = _post_form(server, "postbkt", fields, body,
+                            filename="photo.jpg")
+    assert st == 204, raw
+    st, _, got = _signed_req(server, "GET", "/postbkt/uploads/photo.jpg")
+    assert st == 200 and got == body
+
+
+def test_post_policy_201_response(server):
+    fields = _policy_fields({"key": "exact.bin"}, "postbkt")
+    fields["key"] = "exact.bin"
+    fields["success_action_status"] = "201"
+    st, _, raw = _post_form(server, "postbkt", fields, b"x" * 100)
+    assert st == 201
+    assert b"<Key>exact.bin</Key>" in raw
+
+
+def test_post_policy_rejects_bad_signature(server):
+    fields = _policy_fields({"key": "evil.bin"}, "postbkt",
+                            secret="wrong-secret")
+    fields["key"] = "evil.bin"
+    st, _, raw = _post_form(server, "postbkt", fields, b"x")
+    assert st == 403, raw
+
+
+def test_post_policy_enforces_conditions(server):
+    # key outside the starts-with prefix
+    fields = _policy_fields(["starts-with", "$key", "only/"], "postbkt")
+    fields["key"] = "elsewhere/f.bin"
+    st, _, _ = _post_form(server, "postbkt", fields, b"x")
+    assert st == 403
+    # content-length-range violated
+    fields = _policy_fields(
+        {"key": "small.bin"}, "postbkt",
+        extra_conds=[["content-length-range", 1, 10]],
+    )
+    fields["key"] = "small.bin"
+    st, _, raw = _post_form(server, "postbkt", fields, b"y" * 100)
+    assert st == 400, raw
+    # expired policy
+    fields = _policy_fields({"key": "late.bin"}, "postbkt", expire_s=-5)
+    fields["key"] = "late.bin"
+    st, _, _ = _post_form(server, "postbkt", fields, b"x")
+    assert st == 403
+
+
+def test_select_parquet(server):
+    """SQL over a Parquet object with projection, WHERE, aggregates."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({
+        "city": ["oslo", "lima", "pune", "oslo", "lima"],
+        "temp": [3, 19, 31, 5, 21],
+        "humid": [0.8, 0.6, 0.3, 0.7, 0.5],
+    })
+    sink = io.BytesIO()
+    pq.write_table(table, sink)
+    data = sink.getvalue()
+    assert _signed_req(server, "PUT", "/pqbkt")[0] == 200
+    st, _, _ = _signed_req(server, "PUT", "/pqbkt/w.parquet", body=data)
+    assert st == 200
+
+    def select(sql):
+        req_xml = f"""<?xml version="1.0" encoding="UTF-8"?>
+<SelectObjectContentRequest>
+  <Expression>{sql}</Expression>
+  <ExpressionType>SQL</ExpressionType>
+  <InputSerialization><Parquet/></InputSerialization>
+  <OutputSerialization><CSV/></OutputSerialization>
+</SelectObjectContentRequest>"""
+        st, _, raw = _signed_req(
+            server, "POST", "/pqbkt/w.parquet",
+            query=[("select", ""), ("select-type", "2")],
+            body=req_xml.encode(),
+        )
+        assert st == 200, raw
+        # extract Records payloads from the event stream
+        out = b""
+        i = 0
+        while i + 12 <= len(raw):
+            total = int.from_bytes(raw[i:i + 4], "big")
+            hlen = int.from_bytes(raw[i + 4:i + 8], "big")
+            headers = raw[i + 12:i + 12 + hlen]
+            payload = raw[i + 12 + hlen: i + total - 4]
+            if b"Records" in headers:
+                out += payload
+            i += total
+        return out.decode()
+
+    got = select("SELECT city, temp FROM s3object WHERE temp &gt; 10")
+    rows = [r for r in got.strip().split("\n") if r]
+    assert rows == ["lima,19", "pune,31", "lima,21"]
+
+    got = select("SELECT COUNT(*) FROM s3object")
+    assert got.strip() == "5"
+
+    got = select("SELECT AVG(temp) FROM s3object WHERE city = 'oslo'")
+    assert float(got.strip()) == 4.0
+
+
+def test_post_policy_rejects_uncovered_fields(server):
+    """Form fields not covered by a policy condition are refused — the
+    replica-marker smuggle in particular."""
+    fields = _policy_fields({"key": "covered.bin"}, "postbkt")
+    fields["key"] = "covered.bin"
+    fields["x-amz-meta-mtpu-replication"] = "replica"
+    st, _, raw = _post_form(server, "postbkt", fields, b"x")
+    assert st == 403, raw
+    assert b"not covered" in raw or b"ReplicateObject" in raw
+
+
+def test_post_policy_malformed_inputs_are_4xx(server):
+    """Garbage credential scopes / naive expirations / junk condition
+    shapes must come back 4xx, never 500."""
+    # bad credential scope
+    fields = _policy_fields({"key": "a.bin"}, "postbkt")
+    fields["key"] = "a.bin"
+    fields["x-amz-credential"] = "garbage"
+    st, _, raw = _post_form(server, "postbkt", fields, b"x")
+    assert 400 <= st < 500, (st, raw)
+    # timezone-naive expiration
+    import json as _json
+
+    policy = {"expiration": "2030-01-01T00:00:00",
+              "conditions": [{"key": "a.bin"}]}
+    p64 = base64.b64encode(_json.dumps(policy).encode()).decode()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    date = now.strftime("%Y%m%d")
+    cred = f"{AK}/{date}/us-east-1/s3/aws4_request"
+    sig = hmac.new(signing_key(SK, date, "us-east-1"),
+                   p64.encode(), hashlib.sha256).hexdigest()
+    st, _, raw = _post_form(server, "postbkt", {
+        "policy": p64, "x-amz-credential": cred, "x-amz-signature": sig,
+        "key": "a.bin",
+    }, b"x")
+    assert 200 <= st < 500, (st, raw)  # naive exp treated as UTC, not 500
+    # junk condition shape
+    policy = {"expiration": "2030-01-01T00:00:00Z", "conditions": [[1, 2, 3]]}
+    p64 = base64.b64encode(_json.dumps(policy).encode()).decode()
+    sig = hmac.new(signing_key(SK, date, "us-east-1"),
+                   p64.encode(), hashlib.sha256).hexdigest()
+    st, _, raw = _post_form(server, "postbkt", {
+        "policy": p64, "x-amz-credential": cred, "x-amz-signature": sig,
+        "key": "a.bin",
+    }, b"x")
+    assert 400 <= st < 500, (st, raw)
+
+
+def test_post_policy_body_cap(server):
+    """Declared bodies over the cap are refused before parsing."""
+    boundary = "----capboundary"
+    conn = http.client.HTTPConnection(server.endpoint, timeout=10)
+    try:
+        conn.request("POST", "/postbkt", body=b"", headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}",
+            "Content-Length": str(100 << 20),
+        })
+        # server rejects on the declared length without reading 100 MiB
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+    finally:
+        conn.close()

@@ -32,6 +32,10 @@ API_ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("InternalError", "We encountered an internal error, please try again.", HTTPStatus.INTERNAL_SERVER_ERROR),
     _E("InvalidAccessKeyId", "The Access Key Id you provided does not exist in our records.", HTTPStatus.FORBIDDEN),
     _E("InvalidArgument", "Invalid Argument.", HTTPStatus.BAD_REQUEST),
+    _E("InvalidStorageClass", "The storage class you specified is not "
+       "valid.", HTTPStatus.BAD_REQUEST),
+    _E("InvalidTag", "The tag provided was not a valid tag.",
+       HTTPStatus.BAD_REQUEST),
     _E("InvalidBucketName", "The specified bucket is not valid.", HTTPStatus.BAD_REQUEST),
     _E("InvalidDigest", "The Content-Md5 you specified is not valid.", HTTPStatus.BAD_REQUEST),
     _E("InvalidPart", "One or more of the specified parts could not be found.", HTTPStatus.BAD_REQUEST),

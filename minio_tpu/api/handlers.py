@@ -208,8 +208,8 @@ def extract_user_metadata(headers: dict) -> dict:
             meta[lk] = v
         elif lk in _REMEMBERED_HEADERS:
             meta[lk] = v
-        elif lk.startswith("x-amz-storage-class"):
-            meta["x-amz-storage-class"] = v
+        elif lk == "x-amz-storage-class":
+            meta["x-amz-storage-class"] = v.upper()
     return meta
 
 
@@ -737,6 +737,157 @@ class S3ApiHandlers:
         except StorageError as exc:
             raise from_object_error(exc) from exc
 
+    # ---------- object tagging (ref cmd/object-handlers.go
+    # PutObjectTaggingHandler/GetObjectTaggingHandler; tags live in the
+    # version's metadata like the reference's UserTags) ----------
+
+    TAGS_META_KEY = "x-mtpu-internal-tags"
+    MAX_TAGS = 10
+
+    def _validate_tags(self, tags: list[tuple[str, str]]):
+        """One rule set for BOTH tag write paths (subresource XML and
+        the x-amz-tagging header)."""
+        if len(tags) > self.MAX_TAGS:
+            raise S3Error("InvalidTag", f"more than {self.MAX_TAGS} tags")
+        if len({k for k, _ in tags}) != len(tags):
+            raise S3Error("InvalidTag", "duplicate tag keys")
+        for k, v in tags:
+            if not k or len(k) > 128 or len(v) > 256:
+                raise S3Error("InvalidTag", f"bad tag {k!r}")
+
+    def get_object_tagging(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            oi = self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        tags = urllib.parse.parse_qsl(
+            oi.user_defined.get(self.TAGS_META_KEY, ""),
+            keep_blank_values=True,
+        )
+        root = ET.Element("Tagging")
+        ts = ET.SubElement(root, "TagSet")
+        for k, v in tags:
+            tag = ET.SubElement(ts, "Tag")
+            ET.SubElement(tag, "Key").text = k
+            ET.SubElement(tag, "Value").text = v
+        headers = {}
+        if oi.version_id and oi.version_id != "null":
+            headers["x-amz-version-id"] = oi.version_id
+        resp = Response.xml(root)
+        resp.headers.update(headers)
+        return resp
+
+    def put_object_tagging(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            root = ET.fromstring(ctx.body)
+        except ET.ParseError as exc:
+            raise S3Error("MalformedXML", str(exc)) from exc
+        tags: list[tuple[str, str]] = []
+        for tag in root.iter():
+            if not tag.tag.endswith("Tag"):
+                continue
+            k = v = None
+            for sub in tag:
+                if sub.tag.endswith("Key"):
+                    k = (sub.text or "").strip()
+                elif sub.tag.endswith("Value"):
+                    v = sub.text or ""
+            if k is None or v is None:
+                raise S3Error("InvalidTag", "tag missing Key or Value")
+            tags.append((k, v))
+        self._validate_tags(tags)
+        try:
+            self.ol.update_object_metadata(
+                ctx.bucket, ctx.object, opts.version_id,
+                {self.TAGS_META_KEY: urllib.parse.urlencode(tags)},
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(200)
+
+    def delete_object_tagging(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        try:
+            self.ol.update_object_metadata(
+                ctx.bucket, ctx.object, opts.version_id,
+                {self.TAGS_META_KEY: ""},
+            )
+        except StorageError as exc:
+            raise from_object_error(exc) from exc
+        return Response(204)
+
+    # ---------- canned ACLs (ref cmd/acl-handlers.go: S3 ACLs are
+    # hardwired to the private/FULL_CONTROL owner model; IAM/bucket
+    # policy is the real authorization surface) ----------
+
+    def get_acl(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        if ctx.object:
+            opts = self._opts_for(ctx.bucket, ctx.qdict)
+            try:
+                self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+        root = ET.Element("AccessControlPolicy")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "minio-tpu"
+        ET.SubElement(owner, "DisplayName").text = "minio-tpu"
+        acl = ET.SubElement(root, "AccessControlList")
+        grant = ET.SubElement(acl, "Grant")
+        grantee = ET.SubElement(grant, "Grantee")
+        grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+        grantee.set("xsi:type", "CanonicalUser")
+        ET.SubElement(grantee, "ID").text = "minio-tpu"
+        ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
+        return Response.xml(root)
+
+    def put_acl(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        if ctx.object:
+            # ACL verbs must agree about existence: PUT on a missing
+            # key is NoSuchKey, like GET (and AWS).
+            opts = self._opts_for(ctx.bucket, ctx.qdict)
+            try:
+                self.ol.get_object_info(ctx.bucket, ctx.object, opts)
+            except StorageError as exc:
+                raise from_object_error(exc) from exc
+        canned = ctx.headers.get("x-amz-acl", "private")
+        if canned != "private":
+            raise S3Error("NotImplemented",
+                          "only the private canned ACL is supported")
+        if ctx.body:
+            # Parse the document: ONLY the owner FULL_CONTROL grant is
+            # representable; any additional/other grant must be refused
+            # loudly, never silently dropped (ref acl-handlers.go
+            # rejecting non-private policies).
+            try:
+                root = ET.fromstring(ctx.body)
+            except ET.ParseError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            perms = [
+                (el.text or "").strip()
+                for el in root.iter() if el.tag.endswith("Permission")
+            ]
+            if not perms or any(p != "FULL_CONTROL" for p in perms) \
+                    or len(perms) > 1:
+                raise S3Error("NotImplemented",
+                              "custom grants are not supported")
+        return Response(200)
+
+    # Object-level ACL verbs: same canned semantics, distinct handler
+    # names so IAM authorizes s3:GetObjectAcl / s3:PutObjectAcl rather
+    # than the bucket actions.
+    def get_object_acl(self, ctx) -> Response:
+        return self.get_acl(ctx)
+
+    def put_object_acl(self, ctx) -> Response:
+        return self.put_acl(ctx)
+
     def object_retention(self, ctx) -> Response:
         from ..bucket import objectlock as ol_mod
 
@@ -853,6 +1004,28 @@ class S3ApiHandlers:
 
     # ---------- object ----------
 
+    def _apply_storage_class(self, ctx, opts):
+        """x-amz-storage-class → erasure parity via the storage_class
+        config subsystem (ref cmd/config/storageclass applied at
+        cmd/erasure-object.go:611-618)."""
+        sc = ctx.headers.get("x-amz-storage-class", "").upper()
+        if not sc:
+            return
+        if sc not in ("STANDARD", "REDUCED_REDUNDANCY"):
+            raise S3Error("InvalidStorageClass", sc)
+        if self.config is None:
+            return
+        kvs = self.config.get("storage_class")
+        spec = kvs.get("rrs" if sc == "REDUCED_REDUNDANCY"
+                       else "standard", "") or ""
+        if spec.upper().startswith("EC:"):
+            try:
+                opts.parity = int(spec[3:])
+            except ValueError as exc:
+                raise S3Error(
+                    "InvalidArgument", f"bad storage class spec {spec!r}"
+                ) from exc
+
     def put_object(self, ctx) -> Response:
         if not valid_object_name(ctx.object):
             raise S3Error("InvalidArgument", f"bad object name {ctx.object!r}")
@@ -867,6 +1040,16 @@ class S3ApiHandlers:
             raise S3Error("EntityTooLarge")
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        # x-amz-tagging: urlencoded tags supplied at write time (ref
+        # xhttp.AmzObjectTagging handling in PutObjectHandler) — same
+        # validation as the ?tagging subresource, stored normalized.
+        tag_hdr = ctx.headers.get("x-amz-tagging", "")
+        if tag_hdr:
+            tags = urllib.parse.parse_qsl(tag_hdr, keep_blank_values=True)
+            self._validate_tags(tags)
+            opts.user_defined[self.TAGS_META_KEY] = \
+                urllib.parse.urlencode(tags)
+        self._apply_storage_class(ctx, opts)
         self._apply_object_lock(ctx, opts)
         try:
             self.quota.check(ctx.bucket, size)
@@ -1170,6 +1353,18 @@ class S3ApiHandlers:
                 headers[k.title()] = v
         if tiermod.is_transitioned(oi.user_defined):
             headers["x-amz-storage-class"] = oi.user_defined[tiermod.META_TIER]
+        elif oi.user_defined.get("x-amz-storage-class",
+                                 "STANDARD") != "STANDARD":
+            # RRS parity objects advertise their class (AWS echoes only
+            # non-STANDARD classes).
+            headers["x-amz-storage-class"] = \
+                oi.user_defined["x-amz-storage-class"]
+        ntags = len(urllib.parse.parse_qsl(
+            oi.user_defined.get(self.TAGS_META_KEY, ""),
+            keep_blank_values=True,
+        ))
+        if ntags:
+            headers["x-amz-tagging-count"] = str(ntags)
         for qk, hk in _RESPONSE_OVERRIDES.items():
             if qk in ctx.qdict:
                 headers[hk] = ctx.qdict[qk]
@@ -1489,6 +1684,16 @@ class S3ApiHandlers:
             raise S3Error("InvalidArgument", ctx.object)
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         opts.user_defined = extract_user_metadata(ctx.headers)
+        # Same storage-class validation/parity + tag handling as single
+        # PUTs (a REDUCED_REDUNDANCY multipart object must actually GET
+        # the reduced parity it advertises).
+        tag_hdr = ctx.headers.get("x-amz-tagging", "")
+        if tag_hdr:
+            tags = urllib.parse.parse_qsl(tag_hdr, keep_blank_values=True)
+            self._validate_tags(tags)
+            opts.user_defined[self.TAGS_META_KEY] = \
+                urllib.parse.urlencode(tags)
+        self._apply_storage_class(ctx, opts)
         # Multipart objects get the same lock treatment as single PUTs
         # (ref NewMultipartUploadHandler lock-header wiring).
         self._apply_object_lock(ctx, opts)

@@ -47,6 +47,13 @@ def _check_reserved_bucket(bucket: str):
 
 # S3 action names per route (subset of pkg/iam/policy/action.go).
 _ACTIONS = {
+    "get_object_tagging": "s3:GetObjectTagging",
+    "put_object_tagging": "s3:PutObjectTagging",
+    "delete_object_tagging": "s3:DeleteObjectTagging",
+    "get_acl": "s3:GetBucketAcl",
+    "put_acl": "s3:PutBucketAcl",
+    "get_object_acl": "s3:GetObjectAcl",
+    "put_object_acl": "s3:PutObjectAcl",
     "list_buckets": "s3:ListAllMyBuckets",
     "make_bucket": "s3:CreateBucket",
     "head_bucket": "s3:ListBucket",
@@ -178,6 +185,8 @@ def route(ctx: RequestContext) -> str:
         if m == "GET":
             if "location" in q:
                 return "get_bucket_location"
+            if "acl" in q:
+                return "get_acl"
             if "policy" in q:
                 return "get_bucket_policy"
             if "versioning" in q:
@@ -202,6 +211,8 @@ def route(ctx: RequestContext) -> str:
                 return "list_objects_v2"
             return "list_objects_v1"
         if m == "PUT":
+            if "acl" in q:
+                return "put_acl"
             if "policy" in q:
                 return "put_bucket_policy"
             for sub in ("versioning", "tagging", "lifecycle", "encryption",
@@ -234,6 +245,10 @@ def route(ctx: RequestContext) -> str:
             return "object_retention"
         if "legal-hold" in q:
             return "object_legal_hold"
+        if "tagging" in q:
+            return "get_object_tagging"
+        if "acl" in q:
+            return "get_object_acl"
         return "get_object"
     if m == "HEAD":
         return "head_object"
@@ -244,6 +259,10 @@ def route(ctx: RequestContext) -> str:
             return "object_retention"
         if "legal-hold" in q:
             return "object_legal_hold"
+        if "tagging" in q:
+            return "put_object_tagging"
+        if "acl" in q:
+            return "put_object_acl"
         return "put_object"
     if m == "POST":
         if "uploads" in q:
@@ -258,6 +277,8 @@ def route(ctx: RequestContext) -> str:
     if m == "DELETE":
         if "uploadId" in q:
             return "abort_multipart_upload"
+        if "tagging" in q:
+            return "delete_object_tagging"
         return "delete_object"
     raise S3Error("MethodNotAllowed", m)
 

@@ -205,6 +205,15 @@ class ErasureObjects(MultipartMixin):
                     opts: ObjectOptions) -> ObjectInfo:
         n = self.set_drive_count
         parity = self.default_parity
+        if opts.parity is not None:
+            # Storage-class override (ref GetParityForSC applied at
+            # cmd/erasure-object.go:611-618); data must never be
+            # outnumbered by parity.
+            if not 0 < opts.parity <= n // 2:
+                raise ErrInvalidArgument(
+                    f"parity {opts.parity} invalid for {n} drives"
+                )
+            parity = opts.parity
         data_blocks = n - parity
         write_quorum = data_blocks + (1 if data_blocks == parity else 0)
 

@@ -63,6 +63,16 @@ class MultipartMixin:
         opts = opts or ObjectOptions()
         n = self.set_drive_count
         parity = self.default_parity
+        if opts.parity is not None:
+            # Storage-class override: the geometry stored with the
+            # upload drives every subsequent part write + complete.
+            if not 0 < opts.parity <= n // 2:
+                from ..utils.errors import ErrInvalidArgument
+
+                raise ErrInvalidArgument(
+                    f"parity {opts.parity} invalid for {n} drives"
+                )
+            parity = opts.parity
         data_blocks = n - parity
         write_quorum = data_blocks + (1 if data_blocks == parity else 0)
         upload_id = new_uuid()

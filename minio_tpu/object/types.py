@@ -30,6 +30,10 @@ class ObjectOptions:
     # no object left behind (ref pkg/hash/reader.go wired at
     # cmd/object-handlers.go:1555-1570).
     want_md5_hex: str = ""
+    # Parity override from the storage class (x-amz-storage-class →
+    # storage_class config EC:n; ref cmd/erasure-object.go:611-626
+    # globalStorageClass.GetParityForSC). None = set default.
+    parity: int | None = None
 
 
 @dataclass

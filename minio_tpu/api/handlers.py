@@ -755,13 +755,21 @@ class S3ApiHandlers:
             if not k or len(k) > 128 or len(v) > 256:
                 raise S3Error("InvalidTag", f"bad tag {k!r}")
 
-    def get_object_tagging(self, ctx) -> Response:
-        self._check_bucket(ctx.bucket)
+    def _tag_target_info(self, ctx):
+        """Resolve the tagging/ACL target; a delete-markered latest is
+        NoSuchKey like GET/HEAD (AWS: these verbs 404 on deleted keys)."""
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         try:
             oi = self.ol.get_object_info(ctx.bucket, ctx.object, opts)
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        if oi.delete_marker:
+            raise S3Error("NoSuchKey", ctx.object)
+        return oi, opts
+
+    def get_object_tagging(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        oi, opts = self._tag_target_info(ctx)
         tags = urllib.parse.parse_qsl(
             oi.user_defined.get(self.TAGS_META_KEY, ""),
             keep_blank_values=True,
@@ -781,7 +789,7 @@ class S3ApiHandlers:
 
     def put_object_tagging(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
-        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        _, opts = self._tag_target_info(ctx)
         try:
             root = ET.fromstring(ctx.body)
         except ET.ParseError as exc:
@@ -811,7 +819,7 @@ class S3ApiHandlers:
 
     def delete_object_tagging(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
-        opts = self._opts_for(ctx.bucket, ctx.qdict)
+        _, opts = self._tag_target_info(ctx)
         try:
             self.ol.update_object_metadata(
                 ctx.bucket, ctx.object, opts.version_id,
@@ -828,11 +836,7 @@ class S3ApiHandlers:
     def get_acl(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
         if ctx.object:
-            opts = self._opts_for(ctx.bucket, ctx.qdict)
-            try:
-                self.ol.get_object_info(ctx.bucket, ctx.object, opts)
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
+            self._tag_target_info(ctx)
         root = ET.Element("AccessControlPolicy")
         owner = ET.SubElement(root, "Owner")
         ET.SubElement(owner, "ID").text = "minio-tpu"
@@ -849,13 +853,9 @@ class S3ApiHandlers:
     def put_acl(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)
         if ctx.object:
-            # ACL verbs must agree about existence: PUT on a missing
-            # key is NoSuchKey, like GET (and AWS).
-            opts = self._opts_for(ctx.bucket, ctx.qdict)
-            try:
-                self.ol.get_object_info(ctx.bucket, ctx.object, opts)
-            except StorageError as exc:
-                raise from_object_error(exc) from exc
+            # ACL verbs must agree about existence: PUT on a missing or
+            # delete-markered key is NoSuchKey, like GET (and AWS).
+            self._tag_target_info(ctx)
         canned = ctx.headers.get("x-amz-acl", "private")
         if canned != "private":
             raise S3Error("NotImplemented",

@@ -652,7 +652,8 @@ class ErasureObjects(MultipartMixin):
             # Versioned delete without a version: write a delete marker.
             marker = FileInfo(
                 volume=bucket, name=object_, version_id=new_uuid(),
-                deleted=True, mod_time_ns=time.time_ns(),
+                deleted=True,
+                mod_time_ns=opts.mod_time_ns or time.time_ns(),
             )
             errs: list = [None] * n
 

@@ -199,7 +199,10 @@ def test_parse_lifecycle_and_expiry(tmp_path):
     </LifecycleConfiguration>"""
     rules = parse_lifecycle(xml_text)
     assert rules == [{"prefix": "tmp/", "expire_days": 1,
-                      "transition_days": None, "transition_tier": ""}]
+                      "transition_days": None, "transition_tier": "",
+                      "noncurrent_days": None,
+                      "expired_delete_marker": False,
+                      "abort_mpu_days": None}]
 
     ol, _ = make_layer(tmp_path)
     ol.make_bucket("ilmbkt")

@@ -1,0 +1,137 @@
+"""Version-level lifecycle: NoncurrentVersionExpiration, orphan
+delete-marker cleanup, and AbortIncompleteMultipartUpload
+(ref pkg/bucket/lifecycle + cmd/data-scanner.go applyVersionActions)."""
+
+import io
+import time
+
+import pytest
+
+from minio_tpu.background.scanner import DataScanner, parse_lifecycle
+from minio_tpu.bucket import BucketMetadataSys
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.types import ObjectOptions
+from minio_tpu.storage.local import LocalStorage
+
+DEP = "abcdabcd-1111-2222-3333-abcdabcdabcd"
+DAY_NS = 86400 * 10 ** 9
+
+LC_XML = """<LifecycleConfiguration>
+  <Rule><ID>nc</ID><Status>Enabled</Status>
+    <Filter><Prefix></Prefix></Filter>
+    <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+    </NoncurrentVersionExpiration>
+    <Expiration><ExpiredObjectDeleteMarker>true</ExpiredObjectDeleteMarker>
+    </Expiration>
+    <AbortIncompleteMultipartUpload><DaysAfterInitiation>3
+    </DaysAfterInitiation></AbortIncompleteMultipartUpload>
+  </Rule>
+</LifecycleConfiguration>"""
+
+
+def test_parse_extended_rules():
+    rules = parse_lifecycle(LC_XML)
+    assert rules == [{
+        "prefix": "", "expire_days": None, "transition_days": None,
+        "transition_tier": "", "noncurrent_days": 7,
+        "expired_delete_marker": True, "abort_mpu_days": 3,
+    }]
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    sets = ErasureSets(disks, 4, deployment_id=DEP, pool_index=0)
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    bm = BucketMetadataSys(ol)
+    ol.make_bucket("lcb")
+    meta = bm.get("lcb")
+    meta.versioning_xml = (
+        '<VersioningConfiguration><Status>Enabled</Status>'
+        "</VersioningConfiguration>"
+    )
+    meta.lifecycle_xml = LC_XML
+    bm.save(meta)
+    scanner = DataScanner(ol, bm)
+    return ol, bm, scanner
+
+
+def _put(ol, key, body=b"v", age_days=0):
+    opts = ObjectOptions(versioned=True)
+    if age_days:
+        opts.mod_time_ns = time.time_ns() - age_days * DAY_NS
+    return ol.put_object("lcb", key, io.BytesIO(body), len(body), opts)
+
+
+def test_noncurrent_age_counts_from_successor(stack):
+    """NoncurrentDays measures time since the version BECAME noncurrent
+    (its successor's write), never its own age — a 30-day-old version
+    overwritten 10 days ago has been noncurrent 10 days; one overwritten
+    today has been noncurrent 0 days and MUST survive (AWS semantics)."""
+    ol, _, scanner = stack
+    _put(ol, "doc", b"old1", age_days=30)   # superseded 10d ago -> expires
+    _put(ol, "doc", b"old2", age_days=10)   # superseded TODAY -> survives
+    _put(ol, "doc", b"current")
+    scanner.scan_cycle()
+    res = ol.list_object_versions("lcb", prefix="doc")
+    vers = [v for v in res.versions if v.name == "doc"]
+    assert len(vers) == 2
+    assert vers[0].is_latest
+    sink = io.BytesIO()
+    ol.get_object("lcb", "doc", sink)
+    assert sink.getvalue() == b"current"
+
+
+def test_fresh_noncurrent_versions_survive(stack):
+    ol, _, scanner = stack
+    _put(ol, "fresh", b"old", age_days=2)   # noncurrent only 2d
+    _put(ol, "fresh", b"new", age_days=2)
+    scanner.scan_cycle()
+    res = ol.list_object_versions("lcb", prefix="fresh")
+    assert len([v for v in res.versions if v.name == "fresh"]) == 2
+
+
+def test_orphan_delete_marker_removed(stack):
+    ol, _, scanner = stack
+    _put(ol, "ghost", b"x", age_days=30)
+    # an AGED delete marker (20d): the version has been noncurrent 20d
+    # -> expires cycle 1; the marker is then orphaned -> removed cycle 2
+    ol.delete_object(
+        "lcb", "ghost",
+        ObjectOptions(versioned=True,
+                      mod_time_ns=time.time_ns() - 20 * DAY_NS),
+    )
+    scanner.scan_cycle()
+    scanner.scan_cycle()
+    res = ol.list_object_versions("lcb", prefix="ghost")
+    assert [v for v in res.versions if v.name == "ghost"] == []
+
+
+def test_stale_multipart_aborted(stack):
+    ol, _, scanner = stack
+    es = ol.pools[0].sets[0]
+    upload_id = es.new_multipart_upload("lcb", "big.bin")
+    # Backdate the upload metadata so it reads as 5 days old.
+    uploads = es.list_multipart_uploads_all()
+    assert uploads
+    # rewrite mod time via a fresh upload record is complex; instead
+    # monkeypatch the listing age by waiting on the rule threshold:
+    # directly verify the sweep logic with a synthetic old timestamp.
+    scanner._cycle_uploads = None  # fresh walk (normally per-cycle)
+    scanner._abort_stale_uploads(
+        "lcb", parse_lifecycle(LC_XML),
+        time.time_ns() + 4 * DAY_NS,   # "now" is 4 days later
+    )
+    assert es.list_multipart_uploads_all() == []
+    # a FRESH upload survives the sweep
+    es.new_multipart_upload("lcb", "fresh.bin")
+    scanner._cycle_uploads = None
+    scanner._abort_stale_uploads(
+        "lcb", parse_lifecycle(LC_XML), time.time_ns()
+    )
+    assert len(es.list_multipart_uploads_all()) == 1

@@ -114,6 +114,9 @@ def test_canned_acls(server):
 def test_storage_class_parity(server):
     """REDUCED_REDUNDANCY maps to the configured EC:n parity; the class
     is echoed on HEAD and invalid classes are rejected."""
+    # EC:1 so RRS parity (1) observably differs from the 4-disk
+    # default (2).
+    server.config_sys.config.set_kv("storage_class", rrs="EC:1")
     body = b"rrs data" * 100
     st, _, _ = req(server, "PUT", "/tagbkt/rrs.bin", body=body,
                    headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"})
@@ -122,14 +125,17 @@ def test_storage_class_parity(server):
     assert h.get("x-amz-storage-class") == "REDUCED_REDUNDANCY"
     st, _, got = req(server, "GET", "/tagbkt/rrs.bin")
     assert got == body
-    # parity actually differs: EC:2 default rrs on a 4-disk set ->
-    # data=2, parity=2; verify via the stored file info
-    oi = server.object_layer.get_object_info("tagbkt", "rrs.bin")
     # STANDARD (no header) objects keep the default parity
     st, _, _ = req(server, "PUT", "/tagbkt/std.bin", body=body)
     assert st == 200
     st, h, _ = req(server, "HEAD", "/tagbkt/std.bin")
     assert "x-amz-storage-class" not in {k.lower() for k in h}
+    # The parity REALLY differs in the stored erasure geometry.
+    disk = server.object_layer.pools[0].sets[0].disks[0]
+    fi_rrs = disk.read_version("tagbkt", "rrs.bin")
+    fi_std = disk.read_version("tagbkt", "std.bin")
+    assert fi_rrs.erasure.parity_blocks == 1
+    assert fi_std.erasure.parity_blocks == 2
     # invalid class
     st, _, raw = req(server, "PUT", "/tagbkt/bad.bin", body=b"x",
                      headers={"x-amz-storage-class": "GLACIER"})
@@ -213,3 +219,24 @@ def test_multipart_storage_class(server):
     assert got == part
     st, h, _ = req(server, "HEAD", "/tagbkt/mp-rrs")
     assert h.get("x-amz-storage-class") == "REDUCED_REDUNDANCY"
+
+
+def test_tagging_acl_404_on_delete_marker(server):
+    """Tagging/ACL verbs agree with GET/HEAD: a delete-markered key is
+    NoSuchKey."""
+    ver_xml = ('<VersioningConfiguration><Status>Enabled</Status>'
+               "</VersioningConfiguration>")
+    assert req(server, "PUT", "/verbkt")[0] == 200
+    assert req(server, "PUT", "/verbkt", query=[("versioning", "")],
+               body=ver_xml.encode())[0] == 200
+    assert req(server, "PUT", "/verbkt/gone", body=b"x")[0] == 200
+    assert req(server, "DELETE", "/verbkt/gone")[0] == 204
+    for method, query in (("GET", [("tagging", "")]),
+                          ("PUT", [("tagging", "")]),
+                          ("GET", [("acl", "")]),
+                          ("PUT", [("acl", "")])):
+        body = TAGGING_XML.encode() if query[0][0] == "tagging" \
+            and method == "PUT" else b""
+        st, _, raw = req(server, method, "/verbkt/gone", query=query,
+                         body=body)
+        assert st == 404, (method, query, raw)

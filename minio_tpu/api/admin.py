@@ -439,7 +439,9 @@ class AdminHandlers:
             pool = ThreadPoolExecutor(max_workers=1)
             peer_future = pool.submit(self.notification.trace_poll, wait_s)
             pool.shutdown(wait=False)
-        q = self.trace.subscribe()
+        q = self.trace.subscribe(
+            verbose=ctx.qdict.get("verbose") == "true"
+        )
         out = []
         deadline = time.time() + wait_s
         try:

@@ -449,6 +449,38 @@ class S3Server:
                 if err_code in ("AccessDenied", "SignatureDoesNotMatch",
                                 "InvalidAccessKeyId"):
                     self.metrics.inc("s3_auth_failures_total", code=err_code)
+        if self.trace is not None and not ctx.path.startswith(
+                "/minio/health/"):
+            # Full call record AFTER the response exists (ref
+            # httpTracer recording status + latency; the reference
+            # captures bodies only for `mc admin trace -v` consumers).
+            entry = {
+                "api": getattr(ctx, "api_name", "")
+                or f"{ctx.method} {ctx.path}",
+                "method": ctx.method, "path": ctx.path,
+                "request_id": ctx.request_id,
+                "status": resp.status,
+                "duration_ns": _time.monotonic_ns() - t0,
+            }
+            if err_code:
+                entry["error"] = err_code
+            verbose_extra = None
+            if self.trace.any_verbose:
+                verbose_extra = {"headers": {
+                    k: v for k, v in ctx.headers.items()
+                    if not k.startswith("authorization")
+                }}
+                # Only bodies ALREADY materialized (never force-read a
+                # streaming body for tracing), truncated for the bus.
+                if ctx._body is not None:
+                    verbose_extra["request_body"] = ctx._body[:2048].decode(
+                        "utf-8", errors="replace"
+                    )
+                if resp.body:
+                    verbose_extra["response_body"] = resp.body[:2048].decode(
+                        "utf-8", errors="replace"
+                    )
+            self.trace.publish(entry, verbose_extra)
         if self.audit is not None and not ctx.path.startswith(
                 "/minio/health/"):
             # Single audit choke point: every response — including auth
@@ -629,11 +661,6 @@ class S3Server:
                 raise S3Error(
                     "XAmzContentSHA256Mismatch", "empty body, non-empty hash"
                 )
-        if self.trace is not None:
-            self.trace.publish({
-                "api": name, "method": ctx.method, "path": ctx.path,
-                "request_id": ctx.request_id,
-            })
         handler = getattr(self.handlers, name)
         resp = handler(ctx)
         if self.metrics is not None:

@@ -5,6 +5,7 @@ cmd/global-heal.go, cmd/admin-heal-ops.go)."""
 
 from .heal import HealSequence, HealState, MRFHealer, heal_erasure_set
 from .monitor import DiskMonitor
+from .newdisk import FreshDiskHealer, HealingTracker
 from .tracker import DataUpdateTracker
 from .scanner import (
     DataScanner,
@@ -16,5 +17,6 @@ from .scanner import (
 __all__ = [
     "DataScanner", "DataUsageInfo", "DynamicSleeper", "parse_lifecycle",
     "DataUpdateTracker", "DiskMonitor",
+    "FreshDiskHealer", "HealingTracker",
     "HealSequence", "HealState", "MRFHealer", "heal_erasure_set",
 ]

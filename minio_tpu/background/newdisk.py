@@ -1,0 +1,256 @@
+"""Fresh-disk detection + resumable set-wide heal — the equivalent of
+the reference's initAutoHeal / healingTracker machinery
+(/root/reference/cmd/background-newdisks-heal-ops.go: a replaced drive
+is detected by its missing format.json, re-formatted into the set's
+layout, marked with a healing tracker blob persisted ON the healing
+disk, and back-filled by a full erasure-set sweep whose progress
+survives restarts; cmd/global-heal.go:154 healErasureSet).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..object.sets import read_format, write_format
+from ..storage.local import SYSTEM_META_BUCKET
+from ..utils.errors import ErrCorruptedFormat, ErrUnformattedDisk, StorageError
+
+TRACKER_PATH = "healing.json"
+
+
+class HealingTracker:
+    """Progress blob stored on the disk BEING healed (ref healingTracker
+    msgp blob at .minio.sys/healing.bin)."""
+
+    def __init__(self, disk_id: str = "", endpoint: str = "",
+                 started_ns: int = 0, last_bucket: str = "",
+                 last_object: str = "", objects_healed: int = 0,
+                 objects_failed: int = 0, finished: bool = False):
+        self.disk_id = disk_id
+        self.endpoint = endpoint
+        self.started_ns = started_ns or time.time_ns()
+        self.last_bucket = last_bucket
+        self.last_object = last_object
+        self.objects_healed = objects_healed
+        self.objects_failed = objects_failed
+        self.finished = finished
+
+    def to_dict(self) -> dict:
+        return dict(vars(self))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HealingTracker":
+        return cls(**{k: d.get(k) for k in (
+            "disk_id", "endpoint", "started_ns", "last_bucket",
+            "last_object", "objects_healed", "objects_failed", "finished",
+        )})
+
+    def save(self, disk):
+        disk.write_all(SYSTEM_META_BUCKET, TRACKER_PATH,
+                       json.dumps(self.to_dict()).encode())
+
+    @classmethod
+    def load(cls, disk) -> "HealingTracker | None":
+        try:
+            return cls.from_dict(
+                json.loads(disk.read_all(SYSTEM_META_BUCKET, TRACKER_PATH))
+            )
+        except (StorageError, ValueError):
+            return None
+
+    @staticmethod
+    def delete(disk):
+        try:
+            disk.delete(SYSTEM_META_BUCKET, TRACKER_PATH)
+        except StorageError:
+            pass
+
+
+class FreshDiskHealer:
+    """Detect replaced/empty drives and back-fill them.
+
+    Detection: a disk slot whose probe succeeds but whose format.json is
+    missing is a FRESH drive (the liveness monitor handles dead drives;
+    this handles replaced ones). It is re-formatted with the identity the
+    set layout assigns to its slot, a HealingTracker is written to it,
+    and a resumable sweep heals every object back onto it."""
+
+    def __init__(self, object_layer, interval_s: float = 10.0,
+                 metrics=None, logger=None, checkpoint_every: int = 100):
+        self.ol = object_layer
+        self.interval_s = interval_s
+        self.metrics = metrics
+        self.logger = logger
+        self.checkpoint_every = max(1, checkpoint_every)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.healed_disks: list[str] = []
+
+    # -- detection + format heal (ref HealFormat / formatErasureV3) --
+
+    def _heal_format(self, es, slot: int, disk) -> bool:
+        """Write the slot's format identity onto a fresh disk. Layout
+        comes from any formatted peer in the set."""
+        peer_doc = None
+        for other in es.disks:
+            if other is None or other is disk:
+                continue
+            try:
+                peer_doc = read_format(other)
+                break
+            except (ErrUnformattedDisk, ErrCorruptedFormat, StorageError):
+                continue
+        if peer_doc is None:
+            return False  # no reference format: cannot admit the disk
+        layout = peer_doc["xl"]["sets"]
+        set_idx = getattr(es, "set_index", 0)
+        disk_id = layout[set_idx][slot]
+        write_format(
+            disk, peer_doc["id"], disk_id, set_idx, slot, layout,
+            peer_doc["xl"].get("distributionAlgo", "SIPMOD+PARITY"),
+        )
+        disk.set_disk_id(disk_id)
+        return True
+
+    def check_once(self) -> list[str]:
+        """One detection pass; returns endpoints that were healed."""
+        healed = []
+        for pool in getattr(self.ol, "pools", []):
+            for es in pool.sets:
+                for slot, disk in enumerate(es.disks):
+                    if disk is None:
+                        continue
+                    tracker = None
+                    try:
+                        read_format(disk)
+                        # Formatted: resume only if a heal was cut short.
+                        tracker = HealingTracker.load(disk)
+                        if tracker is None or tracker.finished:
+                            continue
+                    except (ErrUnformattedDisk, ErrCorruptedFormat):
+                        if not self._heal_format(es, slot, disk):
+                            continue
+                    except StorageError:
+                        continue  # unreachable: the monitor's problem
+                    if tracker is None:
+                        tracker = HealingTracker(
+                            disk_id=disk.get_disk_id(),
+                            endpoint=disk.endpoint(),
+                        )
+                        tracker.save(disk)
+                    if self._sweep(es, disk, tracker):
+                        healed.append(disk.endpoint())
+        return healed
+
+    # -- resumable sweep (ref healErasureSet + tracker checkpoints) --
+
+    def _sweep(self, es, disk, tracker: HealingTracker) -> bool:
+        """Back-fill EVERY VERSION (incl. delete markers) of every key
+        the fresh disk's SET owns — list_objects would miss noncurrent
+        versions and delete-markered keys, leaving them at reduced
+        redundancy while claiming success; and healing keys owned by
+        OTHER sets would multiply the IO by the set count (ref
+        healErasureSet scoping). Returns True when the sweep completed."""
+        sets = self._owning_sets(es)
+        names = sorted(
+            b.name for b in self.ol.list_buckets()
+            if not b.name.startswith(".")
+        )
+        for bucket in names:
+            if tracker.last_bucket and bucket < tracker.last_bucket:
+                continue
+            marker = (
+                tracker.last_object
+                if bucket == tracker.last_bucket else ""
+            )
+            since_ckpt = 0
+            while True:
+                res = self.ol.list_object_versions(
+                    bucket, key_marker=marker, max_keys=1000,
+                )
+                last_key = ""
+                for v in res.versions:
+                    if v.name == last_key:
+                        continue  # versions healed per KEY below
+                    last_key = v.name
+                    if (sets is not None
+                            and sets.get_hashed_set_index(v.name)
+                            != es.set_index):
+                        continue  # another set owns this key
+                    for vv in (x for x in res.versions
+                               if x.name == v.name):
+                        try:
+                            self.ol.heal_object(
+                                bucket, v.name,
+                                version_id=vv.version_id,
+                            )
+                            tracker.objects_healed += 1
+                        except Exception:  # noqa: BLE001 - counted
+                            tracker.objects_failed += 1
+                    marker = v.name
+                    since_ckpt += 1
+                    if since_ckpt >= self.checkpoint_every:
+                        # Periodic checkpoint so a crash resumes near
+                        # here, not from zero (ref tracker
+                        # bucketDone/objectDone persistence).
+                        since_ckpt = 0
+                        tracker.last_bucket = bucket
+                        tracker.last_object = marker
+                        try:
+                            tracker.save(disk)
+                        except StorageError:
+                            return False  # disk died; retried next pass
+                tracker.last_bucket = bucket
+                tracker.last_object = marker or tracker.last_object
+                try:
+                    tracker.save(disk)
+                except StorageError:
+                    return False  # disk died mid-heal; retried next pass
+                if not res.is_truncated:
+                    break
+                marker = res.next_key_marker
+        tracker.finished = True
+        try:
+            tracker.save(disk)
+            HealingTracker.delete(disk)
+        except StorageError:
+            return False
+        self.healed_disks.append(tracker.endpoint)
+        if self.metrics is not None:
+            self.metrics.inc("disk_fresh_healed_total")
+        if self.logger is not None:
+            self.logger.info(
+                "fresh disk healed", endpoint=tracker.endpoint,
+                objects=tracker.objects_healed,
+            )
+        return True
+
+    def _owning_sets(self, es):
+        """The ErasureSets container holding `es` (for placement
+        filtering); None when the topology has a single set."""
+        for pool in getattr(self.ol, "pools", []):
+            if es in getattr(pool, "sets", []):
+                return pool if pool.set_count > 1 else None
+        return None
+
+    # -- loop --
+
+    def start(self) -> "FreshDiskHealer":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.check_once()
+                except Exception as exc:  # noqa: BLE001 - keep watching
+                    if self.logger is not None:
+                        self.logger.log_once_if(exc, "fresh-disk")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="mtpu-fresh-disk"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

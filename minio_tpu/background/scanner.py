@@ -337,6 +337,11 @@ class DataScanner:
             by_key: dict[str, list] = {}
             for v in res.versions:
                 by_key.setdefault(v.name, []).append(v)
+            # Resume markers must reference a SURVIVING version: a
+            # deleted version id no longer resolves in the next page's
+            # listing, which would skip the rest of its key this cycle.
+            survivor_key, survivor_vid = key_marker, vid_marker
+            deleted_last = False
             for key, versions in by_key.items():
                 matched = [
                     r for r in rules
@@ -349,13 +354,21 @@ class DataScanner:
                 # previous page when the key was split).
                 prev_mtime = carry_mtime if key == carry_key else None
                 for v in versions:
+                    expired = False
                     if not v.is_latest and prev_mtime is not None:
                         noncur_days = (now_ns - prev_mtime) / 1e9 / 86400
                         if any(r["noncurrent_days"] is not None
                                and noncur_days >= r["noncurrent_days"]
                                for r in matched):
                             self._delete_version(bucket, key, v.version_id)
+                            expired = True
                     prev_mtime = v.mod_time_ns
+                    if expired:
+                        deleted_last = (v is res.versions[-1])
+                    else:
+                        survivor_key, survivor_vid = key, v.version_id
+                        if v is res.versions[-1]:
+                            deleted_last = False
                 if (len(versions) == 1 and versions[0].is_latest
                         and versions[0].delete_marker
                         and any(r["expired_delete_marker"]
@@ -378,8 +391,14 @@ class DataScanner:
                 carry_key, carry_mtime = last.name, last.mod_time_ns
             if not res.is_truncated:
                 return
-            key_marker = res.next_key_marker
-            vid_marker = res.next_version_id_marker
+            if deleted_last:
+                # Page ended on a version we just deleted: resume from
+                # the last surviving version instead (idempotent work
+                # may repeat; nothing is skipped).
+                key_marker, vid_marker = survivor_key, survivor_vid
+            else:
+                key_marker = res.next_key_marker
+                vid_marker = res.next_version_id_marker
 
     def _delete_version(self, bucket: str, key: str, version_id: str):
         from ..object.types import ObjectOptions

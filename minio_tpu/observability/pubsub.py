@@ -35,6 +35,17 @@ class PubSub:
             except queue.Full:
                 pass  # drop for slow subscribers (ref pubsub.go Publish)
 
+    def publish_each(self, make_item):
+        """Per-subscriber payloads: make_item(q) -> the item for that
+        queue (verbose traces go only to queues that asked)."""
+        with self._mu:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(make_item(q))
+            except queue.Full:
+                pass
+
     @property
     def num_subscribers(self) -> int:
         with self._mu:

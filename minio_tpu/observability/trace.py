@@ -14,23 +14,53 @@ from .pubsub import PubSub
 
 
 class TraceHub:
-    """Trace bus. publish() takes a dict with at least api/method/path."""
+    """Trace bus. publish() takes a dict with at least api/method/path.
+    Subscribers may request VERBOSE traces (body snippets included, ref
+    `mc admin trace -v` / traceOpts body capture); producers consult
+    `any_verbose` so body copies cost nothing when nobody asked."""
 
     def __init__(self):
         self.bus = PubSub()
+        self._verbose = 0
+        self._vlock = threading.Lock()
+        self._verbose_qs: set[int] = set()
 
-    def publish(self, info: dict):
+    def publish(self, info: dict, verbose_extra: dict | None = None):
+        """Publish one call record. `verbose_extra` (headers/body
+        snippets) reaches ONLY subscribers that asked for verbose —
+        non-verbose consumers must never receive body payloads."""
         if self.bus.num_subscribers == 0:
             return  # tracing is free when nobody listens (ref Trace())
         info = dict(info)
         info.setdefault("time_ns", time.time_ns())
-        self.bus.publish(info)
+        if not verbose_extra:
+            self.bus.publish(info)
+            return
+        merged = {**info, **verbose_extra}
+        with self._vlock:
+            verbose_ids = set(self._verbose_qs)
+        self.bus.publish_each(
+            lambda q: merged if id(q) in verbose_ids else info
+        )
 
-    def subscribe(self):
-        return self.bus.subscribe()
+    def subscribe(self, verbose: bool = False):
+        q = self.bus.subscribe()
+        if verbose:
+            with self._vlock:
+                self._verbose += 1
+                self._verbose_qs.add(id(q))
+        return q
 
     def unsubscribe(self, q):
+        with self._vlock:
+            if id(q) in self._verbose_qs:
+                self._verbose_qs.discard(id(q))
+                self._verbose -= 1
         self.bus.unsubscribe(q)
+
+    @property
+    def any_verbose(self) -> bool:
+        return self._verbose > 0
 
 
 class Logger:

@@ -234,6 +234,13 @@ class Server:
             self.object_layer, mrf_healer=self.mrf,
             metrics=self.metrics, logger=self.logger,
         )
+        # Replaced-drive detection + resumable back-fill heal (ref
+        # initAutoHeal / healingTracker).
+        from .background import FreshDiskHealer
+
+        self.fresh_disk_healer = FreshDiskHealer(
+            self.object_layer, metrics=self.metrics, logger=self.logger,
+        ) if self.mode != "fs" else None
         self._enable_scanner = enable_scanner
 
         # --- HTTP front-end ---
@@ -479,6 +486,8 @@ class Server:
             # scanner load — they run regardless of enable_scanner.
             self.mrf.start()
             self.disk_monitor.start()
+            if self.fresh_disk_healer is not None:
+                self.fresh_disk_healer.start()
             # Tier configs gate READS of transitioned objects — load
             # them regardless of whether the scanner runs.
             self.tiers.load()
@@ -494,6 +503,8 @@ class Server:
         self.scanner.stop()
         self.mrf.stop()
         self.disk_monitor.stop()
+        if self.fresh_disk_healer is not None:
+            self.fresh_disk_healer.stop()
         self.notifier.close()
         if self._listing_coordinator is not None:
             self._listing_coordinator.close()

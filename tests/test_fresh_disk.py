@@ -1,0 +1,111 @@
+"""Fresh-disk detection + resumable back-fill heal (ref
+cmd/background-newdisks-heal-ops.go healingTracker + initAutoHeal,
+cmd/global-heal.go healErasureSet)."""
+
+import io
+import shutil
+
+import pytest
+
+from minio_tpu.background.newdisk import FreshDiskHealer, HealingTracker
+from minio_tpu.object.pools import ErasureServerPools
+from minio_tpu.object.sets import ErasureSets, read_format
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.utils.errors import ErrUnformattedDisk
+
+DEP = "fdfdfdfd-1111-2222-3333-fdfdfdfdfdfd"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    sets = ErasureSets(disks, 4, deployment_id=DEP, pool_index=0)
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    ol.make_bucket("fresh")
+    return tmp_path, disks, sets, ol
+
+
+def _put_many(ol, n=12, size=64 * 1024):
+    for i in range(n):
+        body = bytes([i % 251]) * size
+        ol.put_object("fresh", f"obj/{i:03d}", io.BytesIO(body), size)
+
+
+def _wipe(tmp_path, disks, idx):
+    """Simulate a replaced drive: empty directory, same mount point."""
+    shutil.rmtree(str(tmp_path / f"d{idx}"))
+    disks[idx].__init__(str(tmp_path / f"d{idx}"), endpoint=f"d{idx}")
+
+
+def test_fresh_disk_detected_formatted_and_healed(stack):
+    tmp_path, disks, sets, ol = stack
+    _put_many(ol)
+    _wipe(tmp_path, disks, 2)
+    with pytest.raises(ErrUnformattedDisk):
+        read_format(disks[2])
+
+    healer = FreshDiskHealer(ol)
+    healed = healer.check_once()
+    assert healed == ["d2"]
+    # the disk got its ORIGINAL identity back
+    doc = read_format(disks[2])
+    assert doc["id"] == DEP
+    assert doc["xl"]["this"] == "disk-0-2"
+    # every object is readable even with the OTHER disks' copy of one
+    # shard gone (i.e. the healed disk really carries data again)
+    disks[0].set_online(False) if hasattr(disks[0], "set_online") else None
+    for i in range(12):
+        sink = io.BytesIO()
+        ol.get_object("fresh", f"obj/{i:03d}", sink)
+        assert sink.getvalue() == bytes([i % 251]) * 64 * 1024
+    # the tracker blob is gone after a completed heal
+    assert HealingTracker.load(disks[2]) is None
+
+
+def test_interrupted_heal_resumes(stack):
+    tmp_path, disks, sets, ol = stack
+    _put_many(ol, n=8)
+    _wipe(tmp_path, disks, 1)
+    # Checkpoint every 2 objects so a crash leaves visible progress.
+    healer = FreshDiskHealer(ol, checkpoint_every=2)
+
+    # First pass CRASHES midway (process-death simulation).
+    calls = {"n": 0}
+    real_heal = ol.heal_object
+
+    def crashing(bucket, obj, **kw):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise KeyboardInterrupt  # not swallowed by the sweep
+        return real_heal(bucket, obj, **kw)
+
+    ol.heal_object = crashing
+    with pytest.raises(KeyboardInterrupt):
+        healer.check_once()
+    ol.heal_object = real_heal
+    # Tracker persisted on the healing disk with checkpointed progress.
+    t = HealingTracker.load(disks[1])
+    assert t is not None and not t.finished
+    assert t.objects_healed >= 2
+    assert t.last_object  # resume point recorded
+
+    # Second pass resumes (sees the unfinished tracker on a FORMATTED
+    # disk) and completes.
+    healed = FreshDiskHealer(ol).check_once()
+    assert healed == ["d1"]
+    assert HealingTracker.load(disks[1]) is None
+    for i in range(8):
+        sink = io.BytesIO()
+        ol.get_object("fresh", f"obj/{i:03d}", sink)
+        assert len(sink.getvalue()) == 64 * 1024
+
+
+def test_no_false_positives(stack):
+    _, disks, sets, ol = stack
+    _put_many(ol, n=3)
+    healer = FreshDiskHealer(ol)
+    assert healer.check_once() == []  # healthy set: nothing to do

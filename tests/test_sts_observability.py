@@ -178,3 +178,59 @@ def test_profiling_and_healthinfo_and_audit(srv):
     assert info["host"]["cpus"] >= 1
     assert len(info["disks"]) == 4
     assert all(d["state"] == "ok" for d in info["disks"])
+
+
+def test_trace_full_call_records_and_verbose_bodies(tmp_path):
+    """Traces carry status + latency for every call; verbose subscribers
+    additionally get header/body snippets (ref mc admin trace -v)."""
+    import threading
+
+    from minio_tpu.server import Server
+
+    srv = Server(
+        [str(tmp_path / "trc{1...4}")], port=0,
+        root_user="trak", root_password="trsecret",
+        enable_scanner=False,
+    ).start()
+    try:
+        q = srv.trace.subscribe(verbose=True)
+        try:
+            import http.client as _http
+
+            from minio_tpu.api.sign import sign_v4_request
+
+            def do(method, path, body=b""):
+                h = sign_v4_request("trsecret", "trak", method,
+                                    srv.endpoint, path, [], {}, body)
+                c = _http.HTTPConnection(srv.endpoint, timeout=30)
+                try:
+                    c.request(method, path, body=body, headers=h)
+                    r = c.getresponse()
+                    r.read()
+                    return r.status
+                finally:
+                    c.close()
+
+            assert do("PUT", "/trcbkt") == 200
+            assert do("PUT", "/trcbkt/o", b"traced-body") == 200
+            assert do("GET", "/trcbkt/missing") == 404
+            entries = []
+            import queue as _queue
+
+            while True:
+                try:
+                    entries.append(q.get(timeout=0.5))
+                except _queue.Empty:
+                    break
+        finally:
+            srv.trace.unsubscribe(q)
+        by_api = {e["api"]: e for e in entries}
+        assert by_api["make_bucket"]["status"] == 200
+        assert by_api["make_bucket"]["duration_ns"] > 0
+        assert by_api["get_object"]["status"] == 404
+        assert by_api["get_object"]["error"] == "NoSuchKey"
+        # verbose: response body captured for the error XML
+        assert "NoSuchKey" in by_api["get_object"]["response_body"]
+        assert not srv.trace.any_verbose  # unsubscribe cleared it
+    finally:
+        srv.stop()

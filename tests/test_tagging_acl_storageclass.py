@@ -115,7 +115,7 @@ def test_storage_class_parity(server):
     """REDUCED_REDUNDANCY maps to the configured EC:n parity; the class
     is echoed on HEAD and invalid classes are rejected."""
     # EC:1 so RRS parity (1) observably differs from the 4-disk
-    # default (2).
+    # default (2). Restored at the end — the fixture is module-scoped.
     server.config_sys.config.set_kv("storage_class", rrs="EC:1")
     body = b"rrs data" * 100
     st, _, _ = req(server, "PUT", "/tagbkt/rrs.bin", body=body,
@@ -140,6 +140,8 @@ def test_storage_class_parity(server):
     st, _, raw = req(server, "PUT", "/tagbkt/bad.bin", body=b"x",
                      headers={"x-amz-storage-class": "GLACIER"})
     assert st == 400 and b"InvalidStorageClass" in raw
+    # restore the default so later tests see stock RRS parity
+    server.config_sys.config.set_kv("storage_class", rrs="EC:2")
 
 
 def test_blank_tag_values_roundtrip(server):

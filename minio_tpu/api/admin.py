@@ -73,6 +73,8 @@ class AdminHandlers:
             ("GET", "list-remote-targets"): "list_remote_targets",
             ("DELETE", "remove-remote-target"): "remove_remote_target",
             ("GET", "replication-stats"): "replication_stats",
+            ("POST", "replication-resync"): "replication_resync",
+            ("GET", "replication-resync"): "replication_resync_status",
             ("GET", "bandwidth"): "bandwidth_report",
             ("PUT", "set-bucket-quota"): "set_bucket_quota",
             ("GET", "get-bucket-quota"): "get_bucket_quota",
@@ -133,6 +135,8 @@ class AdminHandlers:
         "list_tiers": "admin:ListTier",
         "remove_tier": "admin:SetTier",
         "replication_stats": "admin:ReplicationDiff",
+        "replication_resync": "admin:ReplicationDiff",
+        "replication_resync_status": "admin:ReplicationDiff",
         "bandwidth_report": "admin:BandwidthMonitor",
     }
 
@@ -782,6 +786,26 @@ class AdminHandlers:
         except KMSError as exc:
             raise S3Error("InvalidArgument", str(exc)) from exc
         return self._json({"created": key_id})
+
+    def replication_resync(self, ctx) -> Response:
+        """Back-fill a bucket's objects to its replication targets (ref
+        `mc admin replicate resync start`)."""
+        if self.repl is None:
+            raise S3Error("NotImplemented", "replication not wired")
+        bucket = ctx.qdict.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket required")
+        if self.bm is None or not self.bm.get(bucket).replication_xml:
+            raise S3Error("InvalidArgument",
+                          f"no replication config on {bucket}")
+        return self._json(self.repl.start_resync(bucket))
+
+    def replication_resync_status(self, ctx) -> Response:
+        if self.repl is None:
+            raise S3Error("NotImplemented", "replication not wired")
+        return self._json(
+            self.repl.resync_status(ctx.qdict.get("bucket", ""))
+        )
 
     def bandwidth_report(self, ctx) -> Response:
         """Per-bucket/target outbound bandwidth (ref madmin

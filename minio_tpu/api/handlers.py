@@ -398,19 +398,26 @@ class S3ApiHandlers:
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        encode = self._listing_encoder(ctx)
+        enc = encode or (lambda s: s)
         root = _xml_root("ListBucketResult")
         ET.SubElement(root, "Name").text = ctx.bucket
-        ET.SubElement(root, "Prefix").text = prefix
-        ET.SubElement(root, "Marker").text = marker
+        # Under encoding-type=url EVERY key-derived element is encoded
+        # (Prefix/Marker/NextMarker/Delimiter) — NextMarker is the one
+        # clients must echo back, and raw bytes there defeat the point.
+        ET.SubElement(root, "Prefix").text = enc(prefix)
+        ET.SubElement(root, "Marker").text = enc(marker)
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         if delimiter:
-            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "Delimiter").text = enc(delimiter)
         ET.SubElement(root, "IsTruncated").text = (
             "true" if res.is_truncated else "false"
         )
         if res.is_truncated and res.next_marker:
-            ET.SubElement(root, "NextMarker").text = res.next_marker
-        self._fill_entries(root, res)
+            ET.SubElement(root, "NextMarker").text = enc(res.next_marker)
+        if encode is not None:
+            ET.SubElement(root, "EncodingType").text = "url"
+        self._fill_entries(root, res, encode=encode)
         return Response.xml(root)
 
     def list_objects_v2(self, ctx) -> Response:
@@ -439,12 +446,16 @@ class S3ApiHandlers:
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        encode = self._listing_encoder(ctx)
+        enc = encode or (lambda s: s)
         root = _xml_root("ListBucketResult")
         ET.SubElement(root, "Name").text = ctx.bucket
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = enc(prefix)
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         if delimiter:
-            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "Delimiter").text = enc(delimiter)
+        if start_after:
+            ET.SubElement(root, "StartAfter").text = enc(start_after)
         ET.SubElement(root, "KeyCount").text = str(
             len(res.objects) + len(res.prefixes)
         )
@@ -456,10 +467,13 @@ class S3ApiHandlers:
         if res.is_truncated and res.next_marker:
             import base64
 
+            # Continuation tokens are opaque b64 — already XML-safe.
             ET.SubElement(root, "NextContinuationToken").text = (
                 base64.b64encode(res.next_marker.encode()).decode()
             )
-        self._fill_entries(root, res, owner=fetch_owner)
+        if encode is not None:
+            ET.SubElement(root, "EncodingType").text = "url"
+        self._fill_entries(root, res, owner=fetch_owner, encode=encode)
         return Response.xml(root)
 
     def list_object_versions(self, ctx) -> Response:
@@ -524,10 +538,23 @@ class S3ApiHandlers:
             ET.SubElement(cp, "Prefix").text = p
         return Response.xml(root)
 
-    def _fill_entries(self, root, res, owner: bool = True):
+    @staticmethod
+    def _listing_encoder(ctx):
+        """encoding-type=url (ref ListObjects EncodingType): keys with
+        characters XML 1.0 can't carry are URL-encoded on request."""
+        enc = ctx.qdict.get("encoding-type", "")
+        if not enc:
+            return None
+        if enc != "url":
+            raise S3Error("InvalidArgument",
+                          f"encoding-type {enc!r} (only 'url')")
+        return lambda s: urllib.parse.quote(s, safe="/")
+
+    def _fill_entries(self, root, res, owner: bool = True, encode=None):
+        enc = encode or (lambda s: s)
         for oi in res.objects:
             c = ET.SubElement(root, "Contents")
-            ET.SubElement(c, "Key").text = oi.name
+            ET.SubElement(c, "Key").text = enc(oi.name)
             ET.SubElement(c, "LastModified").text = iso8601(oi.mod_time_ns)
             ET.SubElement(c, "ETag").text = f'"{oi.etag}"'
             ET.SubElement(c, "Size").text = str(oi.size)
@@ -538,7 +565,7 @@ class S3ApiHandlers:
                 ET.SubElement(o, "DisplayName").text = "minio-tpu"
         for p in res.prefixes:
             cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
+            ET.SubElement(cp, "Prefix").text = enc(p)
 
     def delete_multiple_objects(self, ctx) -> Response:
         self._check_bucket(ctx.bucket)

@@ -84,6 +84,7 @@ class FreshDiskHealer:
         self.metrics = metrics
         self.logger = logger
         self.checkpoint_every = max(1, checkpoint_every)
+        self.page_size = 1000  # listing page (tests shrink to force splits)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.healed_disks: list[str] = []
@@ -154,63 +155,78 @@ class FreshDiskHealer:
         OTHER sets would multiply the IO by the set count (ref
         healErasureSet scoping). Returns True when the sweep completed."""
         sets = self._owning_sets(es)
-        names = sorted(
-            b.name for b in self.ol.list_buckets()
-            if not b.name.startswith(".")
-        )
+        # SYSTEM buckets heal too (bucket configs / IAM blobs are
+        # erasure-coded through the same layer; leaving them one shard
+        # short would put cluster metadata below quorum at the next
+        # failure — ref healErasureSet healing minioMetaBucket first).
+        # '.'-prefixed names sort first, so meta heals before user data.
+        names = sorted(b.name for b in self.ol.list_buckets())
         for bucket in names:
             if tracker.last_bucket and bucket < tracker.last_bucket:
                 continue
-            marker = (
+            # tracker.last_object records the last FULLY-healed key:
+            # resuming with key_marker=<that key> (no version marker)
+            # skips it and continues at the next key.
+            page_key = (
                 tracker.last_object
                 if bucket == tracker.last_bucket else ""
             )
+            page_vid = ""
             since_ckpt = 0
             while True:
                 res = self.ol.list_object_versions(
-                    bucket, key_marker=marker, max_keys=1000,
+                    bucket, key_marker=page_key,
+                    version_id_marker=page_vid, max_keys=self.page_size,
                 )
-                last_key = ""
+                keys_in_page: list[str] = []
                 for v in res.versions:
-                    if v.name == last_key:
-                        continue  # versions healed per KEY below
-                    last_key = v.name
+                    if not keys_in_page or keys_in_page[-1] != v.name:
+                        keys_in_page.append(v.name)
+                # A truncated page may end MID-key: that key's remaining
+                # versions arrive next page (vid-marker continuation),
+                # so it must not be checkpointed as completed yet.
+                split_key = (
+                    keys_in_page[-1]
+                    if res.is_truncated and keys_in_page else None
+                )
+                for key in keys_in_page:
                     if (sets is not None
-                            and sets.get_hashed_set_index(v.name)
+                            and sets.get_hashed_set_index(key)
                             != es.set_index):
                         continue  # another set owns this key
                     for vv in (x for x in res.versions
-                               if x.name == v.name):
+                               if x.name == key):
                         try:
                             self.ol.heal_object(
-                                bucket, v.name,
-                                version_id=vv.version_id,
+                                bucket, key, version_id=vv.version_id,
                             )
                             tracker.objects_healed += 1
                         except Exception:  # noqa: BLE001 - counted
                             tracker.objects_failed += 1
-                    marker = v.name
+                    if key == split_key:
+                        continue  # not complete until the next page
+                    tracker.last_bucket = bucket
+                    tracker.last_object = key
                     since_ckpt += 1
                     if since_ckpt >= self.checkpoint_every:
                         # Periodic checkpoint so a crash resumes near
                         # here, not from zero (ref tracker
                         # bucketDone/objectDone persistence).
                         since_ckpt = 0
-                        tracker.last_bucket = bucket
-                        tracker.last_object = marker
                         try:
                             tracker.save(disk)
                         except StorageError:
                             return False  # disk died; retried next pass
-                tracker.last_bucket = bucket
-                tracker.last_object = marker or tracker.last_object
                 try:
                     tracker.save(disk)
                 except StorageError:
                     return False  # disk died mid-heal; retried next pass
                 if not res.is_truncated:
                     break
-                marker = res.next_key_marker
+                # Mid-key page advance uses BOTH markers so the split
+                # key's remaining versions are listed, not skipped.
+                page_key = res.next_key_marker
+                page_vid = res.next_version_id_marker
         tracker.finished = True
         try:
             tracker.save(disk)

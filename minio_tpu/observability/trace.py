@@ -21,7 +21,6 @@ class TraceHub:
 
     def __init__(self):
         self.bus = PubSub()
-        self._verbose = 0
         self._vlock = threading.Lock()
         self._verbose_qs: set[int] = set()
 
@@ -47,20 +46,17 @@ class TraceHub:
         q = self.bus.subscribe()
         if verbose:
             with self._vlock:
-                self._verbose += 1
                 self._verbose_qs.add(id(q))
         return q
 
     def unsubscribe(self, q):
         with self._vlock:
-            if id(q) in self._verbose_qs:
-                self._verbose_qs.discard(id(q))
-                self._verbose -= 1
+            self._verbose_qs.discard(id(q))
         self.bus.unsubscribe(q)
 
     @property
     def any_verbose(self) -> bool:
-        return self._verbose > 0
+        return bool(self._verbose_qs)
 
 
 class Logger:

@@ -71,6 +71,8 @@ class ReplicationPool:
         from ..observability.bandwidth import BandwidthMonitor
 
         self.bandwidth = BandwidthMonitor()
+        # bucket -> resync walk status (ref resyncReplication state)
+        self.resync_state: dict[str, dict] = {}
 
     def start(self) -> "ReplicationPool":
         for t in self._threads:
@@ -258,6 +260,62 @@ class ReplicationPool:
                         continue  # already gone on the target
                     raise
             self.stats["completed"] += 1
+
+    # --- resync (ref cmd/bucket-replication.go resyncReplication /
+    # --- `mc admin replicate resync`): back-fill objects written BEFORE
+    # --- replication was configured (or after a target wipe) ---
+
+    def start_resync(self, bucket: str) -> dict:
+        """Kick a background walk scheduling every latest live version
+        for replication. Returns the initial status snapshot."""
+        # check-and-set under the pool lock: a client retry racing the
+        # first request must not launch a duplicate walker.
+        with self._cv:
+            state = self.resync_state.get(bucket)
+            if state is not None and state.get("status") == "running":
+                return dict(state)
+            state = {
+                "bucket": bucket, "status": "running",
+                "queued": 0, "started_ns": time.time_ns(),
+            }
+            self.resync_state[bucket] = state
+
+        def walk():
+            try:
+                marker = ""
+                while True:
+                    res = self.ol.list_objects(
+                        bucket, marker=marker, max_keys=1000
+                    )
+                    for oi in res.objects:
+                        # Re-stamp PENDING so status reporting reflects
+                        # the resync (ref resync setting ResetID).
+                        try:
+                            self.ol.update_object_metadata(
+                                bucket, oi.name, "",
+                                {REPL_STATUS_KEY: PENDING},
+                            )
+                        except Exception:  # noqa: BLE001 - advisory
+                            pass
+                        self.schedule(ReplicationTask(bucket, oi.name))
+                        state["queued"] += 1
+                        marker = oi.name
+                    if not res.is_truncated:
+                        break
+                    marker = res.next_marker
+                state["status"] = "completed"
+            except Exception as exc:  # noqa: BLE001 - surfaced in status
+                state["status"] = "failed"
+                state["error"] = str(exc)
+
+        threading.Thread(target=walk, daemon=True,
+                         name="mtpu-resync").start()
+        return dict(state)
+
+    def resync_status(self, bucket: str = "") -> dict:
+        if bucket:
+            return dict(self.resync_state.get(bucket, {"status": "none"}))
+        return {b: dict(s) for b, s in self.resync_state.items()}
 
     def _mark(self, task: ReplicationTask, status: str):
         if task.op != "put":

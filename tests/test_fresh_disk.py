@@ -109,3 +109,58 @@ def test_no_false_positives(stack):
     _put_many(ol, n=3)
     healer = FreshDiskHealer(ol)
     assert healer.check_once() == []  # healthy set: nothing to do
+
+
+def test_page_split_key_versions_all_healed(stack):
+    """A key whose versions straddle listing pages is healed COMPLETELY
+    (regression: key_marker-only resume skipped the split key's tail)."""
+    tmp_path, disks, sets, ol = stack
+    from minio_tpu.object.types import ObjectOptions
+
+    # one key with 5 versions + neighbors, swept with a 2-entry page
+    for i in range(5):
+        body = bytes([i]) * 4096
+        ol.put_object("fresh", "multi", io.BytesIO(body), len(body),
+                      ObjectOptions(versioned=True))
+    for k in ("aaa", "zzz"):
+        ol.put_object("fresh", k, io.BytesIO(b"n"), 1,
+                      ObjectOptions(versioned=True))
+    _wipe(tmp_path, disks, 0)
+    healer = FreshDiskHealer(ol)
+    healer.page_size = 2
+    assert healer.check_once() == ["d0"]
+    # knock a DIFFERENT disk offline: every version must still read,
+    # which requires the healed d0 to carry real shards for ALL of them
+    disks[3]._online = False
+    try:
+        vers = [v for v in
+                ol.list_object_versions("fresh", prefix="multi").versions
+                if v.name == "multi"]
+        assert len(vers) == 5
+        for v in vers:
+            sink = io.BytesIO()
+            ol.get_object("fresh", "multi", sink,
+                          opts=ObjectOptions(version_id=v.version_id))
+            assert len(sink.getvalue()) == 4096
+    finally:
+        disks[3]._online = True
+
+
+def test_system_meta_bucket_healed(stack):
+    """Cluster metadata under the system bucket is back-filled too —
+    a heal that skips it leaves configs below quorum at the next
+    failure."""
+    tmp_path, disks, sets, ol = stack
+    ol.make_bucket(".minio.sys")
+    body = b'{"config": "precious"}'
+    ol.put_object(".minio.sys", "config/blob.json", io.BytesIO(body),
+                  len(body))
+    _wipe(tmp_path, disks, 2)
+    assert FreshDiskHealer(ol).check_once() == ["d2"]
+    disks[0]._online = False
+    try:
+        sink = io.BytesIO()
+        ol.get_object(".minio.sys", "config/blob.json", sink)
+        assert sink.getvalue() == body
+    finally:
+        disks[0]._online = True

@@ -41,13 +41,8 @@ def req(srv, method, path, query=None, body=b"", headers=None):
         conn.close()
 
 
-@pytest.fixture(scope="module")
-def cluster(tmp_path_factory):
-    """Two nodes, one 4-drive erasure set: drives 1-2 on node A,
-    3-4 on node B. Endpoint list is IDENTICAL on both nodes."""
-    tmp = tmp_path_factory.mktemp("multinode")
-    # Two free ports for the storage planes (peer planes bind port+1,
-    # so leave gaps).
+def _boot_cluster(tmp):
+    """One boot attempt; returns (servers, errors)."""
     pa, pb = _free_port(), _free_port()
     while abs(pa - pb) < 2 or pb == pa + 1 or pa == pb + 1:
         pb = _free_port()
@@ -78,6 +73,24 @@ def cluster(tmp_path_factory):
     tb.start()
     ta.join(60)
     tb.join(60)
+    return servers, errors
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """Two nodes, one 4-drive erasure set: drives 1-2 on node A,
+    3-4 on node B. Endpoint list is IDENTICAL on both nodes. The
+    reserved-port trick can race other tests' ephemeral binds under a
+    loaded full-suite run, so boot retries on fresh ports/dirs."""
+    servers = {}
+    errors = {}
+    for attempt in range(3):
+        tmp = tmp_path_factory.mktemp(f"multinode{attempt}")
+        servers, errors = _boot_cluster(tmp)
+        if not errors and len(servers) == 2:
+            break
+        for s in servers.values():
+            s.stop()
     assert not errors, errors
     yield servers["a"], servers["b"]
     servers["a"].stop()

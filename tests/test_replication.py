@@ -220,3 +220,56 @@ def test_replica_writes_not_re_replicated(clusters):
     assert req(dst, "GET", f"/{dst_bucket}/ping")[0] == 404
     st, h, _ = req(src, "HEAD", f"/{bucket}/ping")
     assert h.get("X-Amz-Replication-Status") == "REPLICA"
+
+
+def test_resync_backfills_preexisting_objects(clusters):
+    """Objects written BEFORE replication was configured reach the
+    target after `replicate resync` (ref resyncReplication)."""
+    src, dst = clusters
+    # objects exist first, replication configured after
+    assert req(src, "PUT", "/presync")[0] == 200
+    for srv, b in ((src, "presync"), (dst, "presync-copy")):
+        if b == "presync-copy":
+            assert req(dst, "PUT", f"/{b}")[0] == 200
+        st, _, _ = req(srv, "PUT", f"/{b}", query=[("versioning", "")],
+                       body=VERSIONING_XML.encode())
+        assert st == 200
+    bodies = {f"pre/{i}": f"old-{i}".encode() * 50 for i in range(5)}
+    for k, v in bodies.items():
+        assert req(src, "PUT", f"/presync/{k}", body=v)[0] == 200
+    # now wire replication
+    target = {"endpoint": dst.endpoint, "access_key": AK, "secret_key": SK,
+              "target_bucket": "presync-copy"}
+    st, _, body = req(src, "PUT", "/minio/admin/v3/set-remote-target",
+                      query=[("bucket", "presync")],
+                      body=json.dumps(target).encode())
+    assert st == 200, body
+    arn = json.loads(body)["arn"]
+    st, _, body = req(src, "PUT", "/presync", query=[("replication", "")],
+                      body=REPL_XML.format(arn=arn).encode())
+    assert st == 200, body
+    # nothing replicated yet
+    assert req(dst, "GET", "/presync-copy/pre/0")[0] == 404
+    # resync
+    st, _, body = req(src, "POST", "/minio/admin/v3/replication-resync",
+                      query=[("bucket", "presync")])
+    assert st == 200, body
+    # wait for the background walk to finish SCHEDULING before draining
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if src.repl_pool.resync_status("presync").get("status") \
+                == "completed":
+            break
+        time.sleep(0.05)
+    assert src.repl_pool.drain(20)
+    for k, v in bodies.items():
+        st, _, got = req(dst, "GET", f"/presync-copy/{k}")
+        assert st == 200 and got == v, k
+    # status reports completion + queue depth
+    st, _, body = req(src, "GET", "/minio/admin/v3/replication-resync",
+                      query=[("bucket", "presync")])
+    status = json.loads(body)
+    assert status["status"] == "completed" and status["queued"] == 5
+    # source objects flipped to COMPLETED
+    st, h, _ = req(src, "HEAD", "/presync/pre/0")
+    assert h.get("X-Amz-Replication-Status") == "COMPLETED"

@@ -762,3 +762,28 @@ def test_upload_part_copy(client, bucket):
     assert st == 200, body
     st, _, got = client.request("GET", f"/{bucket}/mpcopy")
     assert st == 200 and got == src + src[:5000]
+
+
+def test_listing_encoding_type_url(client, bucket):
+    # key with characters that need URL encoding in listings
+    key = "enc dir/a+b&c.txt"
+    st, _, _ = client.request("PUT", f"/{bucket}/{key}", body=b"x")
+    assert st == 200
+    st, _, raw = client.request(
+        "GET", f"/{bucket}", query=[("list-type", "2"),
+                                    ("prefix", "enc dir/"),
+                                    ("encoding-type", "url")],
+    )
+    assert st == 200
+    assert b"<EncodingType>url</EncodingType>" in raw
+    assert b"enc%20dir/a%2Bb%26c.txt" in raw
+    # plain listing returns the raw key
+    st, _, raw = client.request(
+        "GET", f"/{bucket}", query=[("prefix", "enc dir/")],
+    )
+    assert b"<EncodingType>" not in raw
+    # bogus encoding type rejected
+    st, _, _ = client.request(
+        "GET", f"/{bucket}", query=[("encoding-type", "base64")],
+    )
+    assert st == 400

@@ -500,27 +500,36 @@ class S3ApiHandlers:
             )
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        # encoding-type=url applies to this listing too (boto3 sends it
+        # by default and url-decodes the response — ignoring it would
+        # hand clients decoded keys that 404 on the next request).
+        encode = self._listing_encoder(ctx)
+        enc = encode or (lambda s: s)
         root = _xml_root("ListVersionsResult")
         ET.SubElement(root, "Name").text = ctx.bucket
-        ET.SubElement(root, "Prefix").text = prefix
-        ET.SubElement(root, "KeyMarker").text = key_marker
+        ET.SubElement(root, "Prefix").text = enc(prefix)
+        ET.SubElement(root, "KeyMarker").text = enc(key_marker)
         if vid_marker:
             ET.SubElement(root, "VersionIdMarker").text = vid_marker
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         if delimiter:
-            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "Delimiter").text = enc(delimiter)
+        if encode is not None:
+            ET.SubElement(root, "EncodingType").text = "url"
         ET.SubElement(root, "IsTruncated").text = (
             "true" if res.is_truncated else "false"
         )
         if res.is_truncated:
-            ET.SubElement(root, "NextKeyMarker").text = res.next_key_marker
+            ET.SubElement(root, "NextKeyMarker").text = enc(
+                res.next_key_marker
+            )
             ET.SubElement(root, "NextVersionIdMarker").text = (
                 res.next_version_id_marker
             )
         for oi in res.versions:
             tag = "DeleteMarker" if oi.delete_marker else "Version"
             v = ET.SubElement(root, tag)
-            ET.SubElement(v, "Key").text = oi.name
+            ET.SubElement(v, "Key").text = enc(oi.name)
             ET.SubElement(v, "VersionId").text = oi.version_id or "null"
             ET.SubElement(v, "IsLatest").text = (
                 "true" if oi.is_latest else "false"
@@ -535,7 +544,7 @@ class S3ApiHandlers:
             ET.SubElement(o, "DisplayName").text = "minio-tpu"
         for p in res.prefixes:
             cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
+            ET.SubElement(cp, "Prefix").text = enc(p)
         return Response.xml(root)
 
     @staticmethod

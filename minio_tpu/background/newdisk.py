@@ -190,21 +190,25 @@ class FreshDiskHealer:
                     if res.is_truncated and keys_in_page else None
                 )
                 for key in keys_in_page:
-                    if (sets is not None
-                            and sets.get_hashed_set_index(key)
-                            != es.set_index):
-                        continue  # another set owns this key
-                    for vv in (x for x in res.versions
-                               if x.name == key):
-                        try:
-                            self.ol.heal_object(
-                                bucket, key, version_id=vv.version_id,
-                            )
-                            tracker.objects_healed += 1
-                        except Exception:  # noqa: BLE001 - counted
-                            tracker.objects_failed += 1
+                    owned = (sets is None
+                             or sets.get_hashed_set_index(key)
+                             == es.set_index)
+                    if owned:
+                        for vv in (x for x in res.versions
+                                   if x.name == key):
+                            try:
+                                self.ol.heal_object(
+                                    bucket, key,
+                                    version_id=vv.version_id,
+                                )
+                                tracker.objects_healed += 1
+                            except Exception:  # noqa: BLE001 - counted
+                                tracker.objects_failed += 1
                     if key == split_key:
                         continue  # not complete until the next page
+                    # Checkpoint advances over OTHER sets' keys too —
+                    # pinning it to owned keys would make a late crash
+                    # resume from near the bucket start.
                     tracker.last_bucket = bucket
                     tracker.last_object = key
                     since_ckpt += 1

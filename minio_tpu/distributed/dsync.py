@@ -24,6 +24,13 @@ LOCK_PREFIX = "/mtpu/lock/v1"
 DEFAULT_EXPIRY_S = 30.0
 REFRESH_INTERVAL_S = 10.0
 
+# Shared pool for per-locker RPC fan-out (lock/unlock/refresh). Tasks
+# never submit nested tasks, so a bounded pool cannot deadlock; under
+# saturation acquisitions queue rather than stall behind a dead peer.
+from concurrent.futures import ThreadPoolExecutor as _TPE  # noqa: E402
+
+_lock_pool = _TPE(max_workers=32, thread_name_prefix="mtpu-dsync")
+
 
 class LocalLocker:
     """In-process lock table for one node (ref cmd/local-locker.go).
@@ -200,16 +207,26 @@ class DRWMutex:
             quorum += 1  # ref drwmutex.go:130-138
         return quorum
 
+    def _call_all(self, method: str, uid: str) -> list[bool]:
+        """One RPC per locker, CONCURRENTLY — a dead/partitioned peer
+        must cost one RTT/timeout total, never a serial sum that stalls
+        every acquisition behind it (the reference issues locker calls
+        on goroutines)."""
+        if len(self.lockers) == 1:
+            return [self.lockers[0].call(
+                method, self.resource, uid, self.owner)]
+        return list(_lock_pool.map(
+            lambda loc: loc.call(method, self.resource, uid, self.owner),
+            self.lockers,
+        ))
+
     def _acquire(self, writer: bool, timeout: float) -> bool:
         method = "lock" if writer else "rlock"
         quorum = self._quorum(writer)
         deadline = time.time() + timeout
         while True:
             uid = str(uuid.uuid4())
-            granted = [
-                loc.call(method, self.resource, uid, self.owner)
-                for loc in self.lockers
-            ]
+            granted = self._call_all(method, uid)
             if sum(granted) >= quorum:
                 self.uid = uid
                 self._writer = writer
@@ -233,8 +250,7 @@ class DRWMutex:
 
     def unlock(self):
         self._stop_refresh_loop()
-        for loc in self.lockers:
-            loc.call("unlock", self.resource, self.uid, self.owner)
+        self._call_all("unlock", self.uid)
         self.uid = ""
 
     def force_unlock(self):
@@ -252,10 +268,7 @@ class DRWMutex:
 
         def loop():
             while not stop.wait(self._refresh_interval):
-                ok = sum(
-                    loc.call("refresh", self.resource, uid, self.owner)
-                    for loc in self.lockers
-                )
+                ok = sum(self._call_all("refresh", uid))
                 if ok < self._quorum(self._writer):
                     # Lost the lock (e.g. lockers restarted / expired):
                     # signal the owner to cancel its operation.

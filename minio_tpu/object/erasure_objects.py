@@ -85,6 +85,13 @@ class ErasureObjects(MultipartMixin):
         from ..utils.nslock import NamespaceLock
 
         self._ns_lock = NamespaceLock()
+        # Cluster-wide lockers (dsync plane): when the server joins a
+        # multi-node deployment it installs the cluster's locker set
+        # here, and namespace locks become quorum DRWMutexes — a write
+        # on node A and node B of one object serialize cluster-wide
+        # (ref nsLockMap with distributed dsync, cmd/namespace-lock.go).
+        self.dist_lockers = None
+        self.dist_owner = ""
 
     # ------------------------------------------------------------------
     # helpers
@@ -97,9 +104,39 @@ class ErasureObjects(MultipartMixin):
     from contextlib import contextmanager as _ctxmgr
 
     @_ctxmgr
+    def _dist_lock(self, bucket: str, object_: str, writer: bool):
+        """Cluster-wide quorum lock when dsync lockers are installed."""
+        from ..distributed.dsync import DRWMutex
+        from ..utils.errors import ErrOperationTimedOut
+
+        mu = DRWMutex(self.dist_lockers, f"{bucket}/{object_}",
+                      owner=self.dist_owner)
+        ok = (mu.lock(timeout=self.NS_LOCK_TIMEOUT_S) if writer
+              else mu.rlock(timeout=self.NS_LOCK_TIMEOUT_S))
+        if not ok:
+            raise ErrOperationTimedOut(f"dsync {bucket}/{object_}")
+        try:
+            yield
+            if mu.lost.is_set():
+                # Refresh quorum vanished mid-operation (locker restart
+                # or expiry): another writer may have been admitted, so
+                # the operation must FAIL rather than report success on
+                # possibly-interleaved state (ref dsync canceling the
+                # op context on lost refresh quorum).
+                raise ErrOperationTimedOut(
+                    f"dsync lock lost during {bucket}/{object_}"
+                )
+        finally:
+            mu.unlock()
+
+    @_ctxmgr
     def _locked_write(self, bucket: str, object_: str):
         from ..utils.errors import ErrOperationTimedOut
 
+        if self.dist_lockers:
+            with self._dist_lock(bucket, object_, writer=True):
+                yield
+            return
         try:
             with self._ns_lock.write(f"{bucket}/{object_}",
                                      timeout=self.NS_LOCK_TIMEOUT_S):
@@ -111,6 +148,10 @@ class ErasureObjects(MultipartMixin):
     def _locked_read(self, bucket: str, object_: str):
         from ..utils.errors import ErrOperationTimedOut
 
+        if self.dist_lockers:
+            with self._dist_lock(bucket, object_, writer=False):
+                yield
+            return
         try:
             with self._ns_lock.read(f"{bucket}/{object_}",
                                     timeout=self.NS_LOCK_TIMEOUT_S):

@@ -84,7 +84,10 @@ class Logger:
         entry.update(fields)
         with self._mu:
             self._ring.append(entry)
-            self._stream.write(json.dumps(entry) + "\n")
+            try:
+                self._stream.write(json.dumps(entry) + "\n")
+            except ValueError:
+                pass  # stream closed (teardown): ring still records
 
     def recent(self, n: int = 100) -> list[dict]:
         with self._mu:

@@ -288,6 +288,14 @@ class ReplicationPool:
                         bucket, marker=marker, max_keys=1000
                     )
                     for oi in res.objects:
+                        marker = oi.name
+                        # REPLICA objects are received copies: resync
+                        # must never push them back (active-active
+                        # loop; ref resyncReplication skipping
+                        # status=Replica).
+                        if oi.user_defined.get(
+                                REPL_STATUS_KEY) == REPLICA:
+                            continue
                         # Re-stamp PENDING so status reporting reflects
                         # the resync (ref resync setting ResetID).
                         try:
@@ -299,7 +307,6 @@ class ReplicationPool:
                             pass
                         self.schedule(ReplicationTask(bucket, oi.name))
                         state["queued"] += 1
-                        marker = oi.name
                     if not res.is_truncated:
                         break
                     marker = res.next_marker

@@ -109,6 +109,7 @@ class Server:
         self.metrics = Metrics()
         self.storage_server = None
         self.peer_server = None
+        self.lock_server = None
         self.notification = None
         self._listing_coordinator = None
 
@@ -418,8 +419,10 @@ class Server:
         )
 
     def _start_peer_mesh(self):
-        """Peer control plane + cross-node listing coordination
-        (ref peer-rest-server + metacache-server-pool)."""
+        """Peer control plane + cross-node listing coordination + the
+        dsync lock plane (ref peer-rest-server, metacache-server-pool,
+        lock-rest-server). Lock plane binds at storage port + 2."""
+        from .distributed.dsync import LockRESTServer, _LockerClient
         from .distributed.listing import ListingCoordinator
         from .distributed.peer import (
             NotificationSys,
@@ -429,6 +432,29 @@ class Server:
 
         secret = self.root_password
         shost, sport = self._storage_address.rsplit(":", 1)
+        # --- lock plane: quorum DRWMutex over every node's locker so
+        # namespace locks hold CLUSTER-wide (ref cmd/namespace-lock.go
+        # distributed branch).
+        self.lock_server = LockRESTServer(
+            secret, shost, int(sport) + 2
+        ).start()
+
+        def lock_addr(node: str) -> str:
+            h, p = node.rsplit(":", 1)
+            return f"{h}:{int(p) + 2}"
+
+        lockers = []
+        for n in self._cluster_nodes:
+            if n == self._storage_address:
+                lockers.append(_LockerClient(local=self.lock_server.locker))
+            else:
+                lockers.append(_LockerClient(
+                    endpoint=lock_addr(n), secret=secret
+                ))
+        for pool in self.object_layer.pools:
+            for es in pool.sets:
+                es.dist_lockers = lockers
+                es.dist_owner = self._storage_address
         self.peer_server = PeerRESTServer(
             secret, shost, int(sport) + 1,
             bucket_meta=self.bucket_meta, iam=self.iam,
@@ -510,6 +536,8 @@ class Server:
             self._listing_coordinator.close()
         if self.peer_server is not None:
             self.peer_server.stop()
+        if getattr(self, "lock_server", None) is not None:
+            self.lock_server.stop()
         if self.storage_server is not None:
             self.storage_server.stop()
 

@@ -1,0 +1,124 @@
+"""Embedded single-page console UI served at /minio/console/ — the
+role of the reference's React browser (cmd/web-router.go serving the
+embedded `browser/` bundle), sized to this runtime: one dependency-free
+HTML page speaking the same `web.*` JSON-RPC + upload/download byte
+paths as minio's UI does."""
+
+CONSOLE_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>minio-tpu console</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.2rem; }
+ input, button { font-size: 1rem; padding: .35rem .6rem; margin: .15rem; }
+ table { border-collapse: collapse; margin-top: 1rem; }
+ td, th { border: 1px solid #ccc; padding: .3rem .7rem; text-align: left; }
+ #err { color: #b00; min-height: 1.2em; }
+ .crumb { cursor: pointer; color: #06c; }
+ section { margin-top: 1rem; }
+</style>
+</head>
+<body>
+<h1>minio-tpu console</h1>
+<div id="err"></div>
+<section id="login">
+ <input id="user" placeholder="access key">
+ <input id="pass" type="password" placeholder="secret key">
+ <button onclick="login()">Sign in</button>
+</section>
+<section id="main" style="display:none">
+ <div>
+  <span class="crumb" onclick="listBuckets()">buckets</span>
+  <span id="where"></span>
+  <input id="newbucket" placeholder="new bucket">
+  <button onclick="makeBucket()">Create</button>
+  <input id="file" type="file">
+  <button onclick="upload()">Upload</button>
+ </div>
+ <table id="tbl"><thead><tr id="hdr"></tr></thead><tbody id="rows">
+ </tbody></table>
+</section>
+<script>
+let token = null, bucket = null;
+const err = m => document.getElementById('err').textContent = m || '';
+async function rpc(method, params) {
+  const r = await fetch('/minio/webrpc', {
+    method: 'POST',
+    headers: token ? {Authorization: 'Bearer ' + token} : {},
+    body: JSON.stringify({jsonrpc: '2.0', id: 1, method, params}),
+  });
+  if (!r.ok) throw new Error(method + ': HTTP ' + r.status);
+  const d = await r.json();
+  if (d.error) throw new Error(d.error.message);
+  return d.result;
+}
+async function login() {
+  err('');
+  try {
+    const res = await rpc('web.Login', {
+      username: document.getElementById('user').value,
+      password: document.getElementById('pass').value});
+    token = res.token;
+    document.getElementById('login').style.display = 'none';
+    document.getElementById('main').style.display = '';
+    listBuckets();
+  } catch (e) { err(e.message); }
+}
+async function listBuckets() {
+  err(''); bucket = null;
+  document.getElementById('where').textContent = '';
+  try {
+    const res = await rpc('web.ListBuckets', {});
+    document.getElementById('hdr').innerHTML = '<th>bucket</th><th></th>';
+    document.getElementById('rows').innerHTML = res.buckets.map(b =>
+      `<tr><td class="crumb" onclick="listObjects('${b.name}')">` +
+      `${b.name}</td>` +
+      `<td><button onclick="rmBucket('${b.name}')">delete</button></td>` +
+      '</tr>').join('');
+  } catch (e) { err(e.message); }
+}
+async function listObjects(b) {
+  err(''); bucket = b;
+  document.getElementById('where').textContent = ' / ' + b;
+  try {
+    const res = await rpc('web.ListObjects', {bucketName: b});
+    document.getElementById('hdr').innerHTML =
+      '<th>key</th><th>size</th><th></th>';
+    document.getElementById('rows').innerHTML = res.objects.map(o =>
+      `<tr><td><a href="/minio/download/${b}/${o.name}?token=${token}">` +
+      `${o.name}</a></td><td>${o.size}</td>` +
+      `<td><button onclick="rmObject('${o.name}')">delete</button></td>` +
+      '</tr>').join('');
+  } catch (e) { err(e.message); }
+}
+async function makeBucket() {
+  try {
+    await rpc('web.MakeBucket',
+              {bucketName: document.getElementById('newbucket').value});
+    listBuckets();
+  } catch (e) { err(e.message); }
+}
+async function rmBucket(b) {
+  try { await rpc('web.DeleteBucket', {bucketName: b}); listBuckets(); }
+  catch (e) { err(e.message); }
+}
+async function rmObject(o) {
+  try {
+    await rpc('web.RemoveObject', {bucketName: bucket, objects: [o]});
+    listObjects(bucket);
+  } catch (e) { err(e.message); }
+}
+async function upload() {
+  const f = document.getElementById('file').files[0];
+  if (!f || !bucket) { err('pick a bucket and a file'); return; }
+  const r = await fetch(`/minio/upload/${bucket}/${f.name}`, {
+    method: 'PUT', headers: {Authorization: 'Bearer ' + token}, body: f});
+  if (!r.ok) { err('upload failed: ' + r.status); return; }
+  listObjects(bucket);
+}
+</script>
+</body>
+</html>
+"""

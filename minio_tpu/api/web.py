@@ -26,6 +26,7 @@ from .handlers import Response
 WEBRPC_PATH = "/minio/webrpc"
 UPLOAD_PREFIX = "/minio/upload/"
 DOWNLOAD_PREFIX = "/minio/download/"
+CONSOLE_PATHS = ("/minio/console", "/minio/console/")
 
 TOKEN_TTL_S = 24 * 3600
 
@@ -86,10 +87,21 @@ class WebHandlers:
 
     def handles(self, path: str) -> bool:
         return (path == WEBRPC_PATH
+                or path in CONSOLE_PATHS
                 or path.startswith(UPLOAD_PREFIX)
                 or path.startswith(DOWNLOAD_PREFIX))
 
     def dispatch(self, ctx) -> Response:
+        if ctx.path in CONSOLE_PATHS:
+            # The embedded single-page UI (ref the reference serving its
+            # React bundle from cmd/web-router.go). Unauthenticated:
+            # the page itself only works after web.Login.
+            from .console_html import CONSOLE_HTML
+
+            return Response(
+                200, {"Content-Type": "text/html; charset=utf-8"},
+                CONSOLE_HTML.encode(),
+            )
         if ctx.path == WEBRPC_PATH:
             return self._rpc(ctx)
         if ctx.path.startswith(UPLOAD_PREFIX):

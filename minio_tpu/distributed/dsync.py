@@ -24,12 +24,49 @@ LOCK_PREFIX = "/mtpu/lock/v1"
 DEFAULT_EXPIRY_S = 30.0
 REFRESH_INTERVAL_S = 10.0
 
-# Shared pool for per-locker RPC fan-out (lock/unlock/refresh). Tasks
-# never submit nested tasks, so a bounded pool cannot deadlock; under
-# saturation acquisitions queue rather than stall behind a dead peer.
+# Acquisition fan-out pool (lock/rlock). LIVENESS traffic — refresh and
+# unlock — deliberately does NOT share it: under an acquisition storm
+# against a dead peer (5s timeouts saturating these workers) a queued
+# refresh could miss the server-side expiry and silently lose a held
+# write lock. Tasks never submit nested tasks, so bounded pools cannot
+# deadlock.
 from concurrent.futures import ThreadPoolExecutor as _TPE  # noqa: E402
 
 _lock_pool = _TPE(max_workers=32, thread_name_prefix="mtpu-dsync")
+_live_pool = _TPE(max_workers=8, thread_name_prefix="mtpu-dsync-live")
+
+# One shared refresher thread ticks every REFRESH_INTERVAL_S over ALL
+# held mutexes (the reference runs one goroutine per held lock; a
+# registry + single ticker gives the same semantics without a thread
+# spawn on every millisecond-long object op).
+_held_mu = threading.Lock()
+_held: dict[int, "DRWMutex"] = {}
+_refresher_on = False
+
+
+def _register_held(mu: "DRWMutex"):
+    global _refresher_on
+    with _held_mu:
+        _held[id(mu)] = mu
+        if _refresher_on:
+            return
+        _refresher_on = True
+
+    def tick():
+        while True:
+            time.sleep(REFRESH_INTERVAL_S)
+            with _held_mu:
+                mus = list(_held.values())
+            for m in mus:
+                _live_pool.submit(m._do_refresh)
+
+    threading.Thread(target=tick, daemon=True,
+                     name="mtpu-dsync-refresh").start()
+
+
+def _deregister_held(mu: "DRWMutex"):
+    with _held_mu:
+        _held.pop(id(mu), None)
 
 
 class LocalLocker:
@@ -195,8 +232,10 @@ class DRWMutex:
         self.owner = owner or str(uuid.uuid4())
         self.uid = ""
         self._writer = False
+        # Kept for API compatibility; the SHARED ticker refreshes every
+        # held mutex at REFRESH_INTERVAL_S (well inside the 30s expiry),
+        # so per-mutex cadence no longer applies.
         self._refresh_interval = refresh_interval
-        self._stop_refresh: threading.Event | None = None
         self.lost = threading.Event()  # set when refresh quorum is lost
 
     def _quorum(self, writer: bool) -> int:
@@ -207,15 +246,15 @@ class DRWMutex:
             quorum += 1  # ref drwmutex.go:130-138
         return quorum
 
-    def _call_all(self, method: str, uid: str) -> list[bool]:
+    def _call_all(self, method: str, uid: str, pool=None) -> list[bool]:
         """One RPC per locker, CONCURRENTLY — a dead/partitioned peer
         must cost one RTT/timeout total, never a serial sum that stalls
         every acquisition behind it (the reference issues locker calls
-        on goroutines)."""
+        on goroutines). `pool` picks acquisition vs liveness workers."""
         if len(self.lockers) == 1:
             return [self.lockers[0].call(
                 method, self.resource, uid, self.owner)]
-        return list(_lock_pool.map(
+        return list((pool or _lock_pool).map(
             lambda loc: loc.call(method, self.resource, uid, self.owner),
             self.lockers,
         ))
@@ -250,7 +289,9 @@ class DRWMutex:
 
     def unlock(self):
         self._stop_refresh_loop()
-        self._call_all("unlock", self.uid)
+        # Release rides the LIVENESS pool: delayed unlocks under an
+        # acquisition storm would extend hold times and feed the storm.
+        self._call_all("unlock", self.uid, pool=_live_pool)
         self.uid = ""
 
     def force_unlock(self):
@@ -258,30 +299,32 @@ class DRWMutex:
         for loc in self.lockers:
             loc.call("force_unlock", self.resource, "", self.owner)
 
-    # --- refresh loop (ref drwmutex.go:214-345) ---
+    # --- refresh (ref drwmutex.go:214-345; executed by the shared
+    # --- module ticker, never a per-acquisition thread) ---
 
     def _start_refresh(self):
         self.lost.clear()
-        stop = threading.Event()
-        self._stop_refresh = stop
+        _register_held(self)
+
+    def _do_refresh(self):
         uid = self.uid
-
-        def loop():
-            while not stop.wait(self._refresh_interval):
-                ok = sum(self._call_all("refresh", uid))
-                if ok < self._quorum(self._writer):
-                    # Lost the lock (e.g. lockers restarted / expired):
-                    # signal the owner to cancel its operation.
-                    self.lost.set()
-                    return
-
-        t = threading.Thread(target=loop, daemon=True)
-        t.start()
+        if not uid:
+            return  # released between tick and execution
+        # Serial per-locker calls: this runs ON the liveness pool, and
+        # nested fan-out into the same pool could starve under many
+        # held locks; a dead peer costs this mutex 5s, nobody else.
+        ok = sum(
+            loc.call("refresh", self.resource, uid, self.owner)
+            for loc in self.lockers
+        )
+        if self.uid == uid and ok < self._quorum(self._writer):
+            # Lost the lock (e.g. lockers restarted / expired): signal
+            # the owner to cancel its operation.
+            self.lost.set()
+            _deregister_held(self)
 
     def _stop_refresh_loop(self):
-        if self._stop_refresh is not None:
-            self._stop_refresh.set()
-            self._stop_refresh = None
+        _deregister_held(self)
 
 
 class Dsync:

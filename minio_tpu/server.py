@@ -384,6 +384,22 @@ class Server:
         self._cluster_nodes = sorted(
             {_split_url(ep)[0] for ep in all_eps}
         )
+        # The peer plane binds port+1 and the lock plane port+2: nodes
+        # sharing a host need port spacing >= 3 or the planes collide.
+        # Fail LOUDLY at boot, not with a cryptic EADDRINUSE later.
+        by_host: dict[str, list[int]] = {}
+        for n in self._cluster_nodes:
+            h, p = n.rsplit(":", 1)
+            by_host.setdefault(h, []).append(int(p))
+        for h, ports in by_host.items():
+            ports.sort()
+            for a, b in zip(ports, ports[1:]):
+                if b - a < 3:
+                    raise ValueError(
+                        f"storage ports {a} and {b} on {h} are closer "
+                        "than 3 apart; the peer (+1) and lock (+2) "
+                        "planes would collide"
+                    )
         local_by_ep = {d.endpoint(): d for d in local_disks}
 
         def mk_disk(ep):
@@ -422,7 +438,7 @@ class Server:
         """Peer control plane + cross-node listing coordination + the
         dsync lock plane (ref peer-rest-server, metacache-server-pool,
         lock-rest-server). Lock plane binds at storage port + 2."""
-        from .distributed.dsync import LockRESTServer, _LockerClient
+        from .distributed.dsync import Dsync, LockRESTServer
         from .distributed.listing import ListingCoordinator
         from .distributed.peer import (
             NotificationSys,
@@ -443,17 +459,17 @@ class Server:
             h, p = node.rsplit(":", 1)
             return f"{h}:{int(p) + 2}"
 
-        lockers = []
-        for n in self._cluster_nodes:
-            if n == self._storage_address:
-                lockers.append(_LockerClient(local=self.lock_server.locker))
-            else:
-                lockers.append(_LockerClient(
-                    endpoint=lock_addr(n), secret=secret
-                ))
+        dsync = Dsync(
+            local=self.lock_server.locker,
+            remote_endpoints=[
+                lock_addr(n) for n in self._cluster_nodes
+                if n != self._storage_address
+            ],
+            secret=secret,
+        )
         for pool in self.object_layer.pools:
             for es in pool.sets:
-                es.dist_lockers = lockers
+                es.dist_lockers = dsync.lockers
                 es.dist_owner = self._storage_address
         self.peer_server = PeerRESTServer(
             secret, shost, int(sport) + 1,

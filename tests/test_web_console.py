@@ -227,3 +227,18 @@ def test_web_download_decodes_transformed_objects(server, token):
         assert r.status == 200 and r.read() == body
     finally:
         conn.close()
+
+
+def test_console_page_served(server):
+    """The embedded UI page is served unauthenticated at
+    /minio/console/ and speaks the webrpc endpoints."""
+    conn = http.client.HTTPConnection(server.endpoint, timeout=10)
+    try:
+        conn.request("GET", "/minio/console/")
+        r = conn.getresponse()
+        body = r.read()
+        assert r.status == 200
+        assert "text/html" in r.getheader("Content-Type", "")
+        assert b"web.Login" in body and b"/minio/webrpc" in body
+    finally:
+        conn.close()

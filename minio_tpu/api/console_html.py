@@ -26,16 +26,16 @@ CONSOLE_HTML = """<!DOCTYPE html>
 <section id="login">
  <input id="user" placeholder="access key">
  <input id="pass" type="password" placeholder="secret key">
- <button onclick="login()">Sign in</button>
+ <button id="loginbtn">Sign in</button>
 </section>
 <section id="main" style="display:none">
  <div>
-  <span class="crumb" onclick="listBuckets()">buckets</span>
+  <span class="crumb" id="crumb-buckets">buckets</span>
   <span id="where"></span>
   <input id="newbucket" placeholder="new bucket">
-  <button onclick="makeBucket()">Create</button>
+  <button id="mkbtn">Create</button>
   <input id="file" type="file">
-  <button onclick="upload()">Upload</button>
+  <button id="upbtn">Upload</button>
  </div>
  <table id="tbl"><thead><tr id="hdr"></tr></thead><tbody id="rows">
  </tbody></table>
@@ -43,6 +43,7 @@ CONSOLE_HTML = """<!DOCTYPE html>
 <script>
 let token = null, bucket = null;
 const err = m => document.getElementById('err').textContent = m || '';
+const el = id => document.getElementById(id);
 async function rpc(method, params) {
   const r = await fetch('/minio/webrpc', {
     method: 'POST',
@@ -54,49 +55,92 @@ async function rpc(method, params) {
   if (d.error) throw new Error(d.error.message);
   return d.result;
 }
+// DOM-only rendering: names NEVER flow through innerHTML or inline
+// handlers (object keys may contain quotes/angle brackets — markup
+// injection here would run attacker JS with the session token).
+function row(cells) {
+  const tr = document.createElement('tr');
+  for (const c of cells) {
+    const td = document.createElement('td');
+    if (c instanceof Node) td.appendChild(c); else td.textContent = c;
+    tr.appendChild(td);
+  }
+  el('rows').appendChild(tr);
+}
+function link(text, fn) {
+  const a = document.createElement('span');
+  a.className = 'crumb';
+  a.textContent = text;
+  a.addEventListener('click', fn);
+  return a;
+}
+function btn(text, fn) {
+  const b = document.createElement('button');
+  b.textContent = text;
+  b.addEventListener('click', fn);
+  return b;
+}
+function setHeader(cols) {
+  el('hdr').replaceChildren(...cols.map(c => {
+    const th = document.createElement('th');
+    th.textContent = c;
+    return th;
+  }));
+  el('rows').replaceChildren();
+}
 async function login() {
   err('');
   try {
     const res = await rpc('web.Login', {
-      username: document.getElementById('user').value,
-      password: document.getElementById('pass').value});
+      username: el('user').value, password: el('pass').value});
     token = res.token;
-    document.getElementById('login').style.display = 'none';
-    document.getElementById('main').style.display = '';
+    el('login').style.display = 'none';
+    el('main').style.display = '';
     listBuckets();
   } catch (e) { err(e.message); }
 }
 async function listBuckets() {
   err(''); bucket = null;
-  document.getElementById('where').textContent = '';
+  el('where').textContent = '';
   try {
     const res = await rpc('web.ListBuckets', {});
-    document.getElementById('hdr').innerHTML = '<th>bucket</th><th></th>';
-    document.getElementById('rows').innerHTML = res.buckets.map(b =>
-      `<tr><td class="crumb" onclick="listObjects('${b.name}')">` +
-      `${b.name}</td>` +
-      `<td><button onclick="rmBucket('${b.name}')">delete</button></td>` +
-      '</tr>').join('');
+    setHeader(['bucket', '']);
+    for (const b of res.buckets)
+      row([link(b.name, () => listObjects(b.name)),
+           btn('delete', () => rmBucket(b.name))]);
   } catch (e) { err(e.message); }
 }
 async function listObjects(b) {
   err(''); bucket = b;
-  document.getElementById('where').textContent = ' / ' + b;
+  el('where').textContent = ' / ' + b;
   try {
     const res = await rpc('web.ListObjects', {bucketName: b});
-    document.getElementById('hdr').innerHTML =
-      '<th>key</th><th>size</th><th></th>';
-    document.getElementById('rows').innerHTML = res.objects.map(o =>
-      `<tr><td><a href="/minio/download/${b}/${o.name}?token=${token}">` +
-      `${o.name}</a></td><td>${o.size}</td>` +
-      `<td><button onclick="rmObject('${o.name}')">delete</button></td>` +
-      '</tr>').join('');
+    setHeader(['key', 'size', '']);
+    for (const o of res.objects)
+      row([link(o.name, () => download(o.name)), String(o.size),
+           btn('delete', () => rmObject(o.name))]);
   } catch (e) { err(e.message); }
+}
+function encPath(key) {
+  // encode each path segment; keep '/' as the separator
+  return key.split('/').map(encodeURIComponent).join('/');
+}
+async function download(key) {
+  // Authorization-header fetch + blob: the bearer token never lands in
+  // URLs, access logs, or browser history.
+  const r = await fetch(
+    '/minio/download/' + encPath(bucket) + '/' + encPath(key),
+    {headers: {Authorization: 'Bearer ' + token}});
+  if (!r.ok) { err('download failed: ' + r.status); return; }
+  const a = document.createElement('a');
+  a.href = URL.createObjectURL(await r.blob());
+  a.download = key.split('/').pop();
+  a.click();
+  URL.revokeObjectURL(a.href);
 }
 async function makeBucket() {
   try {
-    await rpc('web.MakeBucket',
-              {bucketName: document.getElementById('newbucket').value});
+    await rpc('web.MakeBucket', {bucketName: el('newbucket').value});
     listBuckets();
   } catch (e) { err(e.message); }
 }
@@ -111,13 +155,21 @@ async function rmObject(o) {
   } catch (e) { err(e.message); }
 }
 async function upload() {
-  const f = document.getElementById('file').files[0];
+  const f = el('file').files[0];
   if (!f || !bucket) { err('pick a bucket and a file'); return; }
-  const r = await fetch(`/minio/upload/${bucket}/${f.name}`, {
-    method: 'PUT', headers: {Authorization: 'Bearer ' + token}, body: f});
+  const r = await fetch(
+    '/minio/upload/' + encPath(bucket) + '/' + encPath(f.name),
+    {method: 'PUT', headers: {Authorization: 'Bearer ' + token},
+     body: f});
   if (!r.ok) { err('upload failed: ' + r.status); return; }
   listObjects(bucket);
 }
+document.addEventListener('DOMContentLoaded', () => {
+  for (const [id, fn] of [['loginbtn', login], ['mkbtn', makeBucket],
+                          ['upbtn', upload]])
+    el(id).addEventListener('click', fn);
+  el('crumb-buckets').addEventListener('click', listBuckets);
+});
 </script>
 </body>
 </html>

@@ -33,12 +33,18 @@ REFRESH_INTERVAL_S = 10.0
 from concurrent.futures import ThreadPoolExecutor as _TPE  # noqa: E402
 
 _lock_pool = _TPE(max_workers=32, thread_name_prefix="mtpu-dsync")
-_live_pool = _TPE(max_workers=8, thread_name_prefix="mtpu-dsync-live")
+# Refresh is the ONLY traffic on this pool: anything sharing it
+# (acquires, unlocks) could queue 5s-timeout tasks ahead of the
+# refreshes that keep held write locks alive past server-side expiry.
+_refresh_pool = _TPE(max_workers=8, thread_name_prefix="mtpu-dsync-ref")
+_unlock_pool = _TPE(max_workers=16, thread_name_prefix="mtpu-dsync-unl")
 
-# One shared refresher thread ticks every REFRESH_INTERVAL_S over ALL
-# held mutexes (the reference runs one goroutine per held lock; a
-# registry + single ticker gives the same semantics without a thread
-# spawn on every millisecond-long object op).
+# One shared refresher thread ticks every second over ALL held mutexes
+# and refreshes each at ITS OWN cadence (the reference runs one
+# goroutine per held lock; a registry + ticker gives the same
+# semantics without a thread spawn on every millisecond-long op, while
+# sub-10s expiry deployments/tests keep their fast refresh intervals).
+_TICK_S = 1.0
 _held_mu = threading.Lock()
 _held: dict[int, "DRWMutex"] = {}
 _refresher_on = False
@@ -54,11 +60,22 @@ def _register_held(mu: "DRWMutex"):
 
     def tick():
         while True:
-            time.sleep(REFRESH_INTERVAL_S)
+            time.sleep(_TICK_S)
+            now = time.monotonic()
             with _held_mu:
-                mus = list(_held.values())
-            for m in mus:
-                _live_pool.submit(m._do_refresh)
+                due = [
+                    m for m in _held.values()
+                    if (not m._refreshing
+                        and now - m._last_refresh
+                        >= m._refresh_interval)
+                ]
+                for m in due:
+                    # In-flight dedup: a refresh stuck on dead peers
+                    # (5s/peer serial) must not stack duplicates each
+                    # tick and starve OTHER mutexes' refreshes.
+                    m._refreshing = True
+            for m in due:
+                _refresh_pool.submit(m._do_refresh)
 
     threading.Thread(target=tick, daemon=True,
                      name="mtpu-dsync-refresh").start()
@@ -232,10 +249,9 @@ class DRWMutex:
         self.owner = owner or str(uuid.uuid4())
         self.uid = ""
         self._writer = False
-        # Kept for API compatibility; the SHARED ticker refreshes every
-        # held mutex at REFRESH_INTERVAL_S (well inside the 30s expiry),
-        # so per-mutex cadence no longer applies.
         self._refresh_interval = refresh_interval
+        self._last_refresh = 0.0
+        self._refreshing = False
         self.lost = threading.Event()  # set when refresh quorum is lost
 
     def _quorum(self, writer: bool) -> int:
@@ -289,9 +305,11 @@ class DRWMutex:
 
     def unlock(self):
         self._stop_refresh_loop()
-        # Release rides the LIVENESS pool: delayed unlocks under an
-        # acquisition storm would extend hold times and feed the storm.
-        self._call_all("unlock", self.uid, pool=_live_pool)
+        # Dedicated pool: off the acquire pool (delayed unlocks extend
+        # holds and feed acquisition storms) AND off the refresh pool
+        # (an unlock storm against a dead peer must never starve the
+        # refreshes keeping held locks alive).
+        self._call_all("unlock", self.uid, pool=_unlock_pool)
         self.uid = ""
 
     def force_unlock(self):
@@ -304,24 +322,31 @@ class DRWMutex:
 
     def _start_refresh(self):
         self.lost.clear()
+        self._last_refresh = time.monotonic()
+        self._refreshing = False
         _register_held(self)
 
     def _do_refresh(self):
-        uid = self.uid
-        if not uid:
-            return  # released between tick and execution
-        # Serial per-locker calls: this runs ON the liveness pool, and
-        # nested fan-out into the same pool could starve under many
-        # held locks; a dead peer costs this mutex 5s, nobody else.
-        ok = sum(
-            loc.call("refresh", self.resource, uid, self.owner)
-            for loc in self.lockers
-        )
-        if self.uid == uid and ok < self._quorum(self._writer):
-            # Lost the lock (e.g. lockers restarted / expired): signal
-            # the owner to cancel its operation.
-            self.lost.set()
-            _deregister_held(self)
+        try:
+            uid = self.uid
+            if not uid:
+                return  # released between tick and execution
+            # Serial per-locker calls: this runs ON the refresh pool,
+            # and nested fan-out into the same pool could starve under
+            # many held locks; a dead peer costs this mutex 5s, nobody
+            # else's refresh.
+            ok = sum(
+                loc.call("refresh", self.resource, uid, self.owner)
+                for loc in self.lockers
+            )
+            if self.uid == uid and ok < self._quorum(self._writer):
+                # Lost the lock (e.g. lockers restarted / expired):
+                # signal the owner to cancel its operation.
+                self.lost.set()
+                _deregister_held(self)
+        finally:
+            self._last_refresh = time.monotonic()
+            self._refreshing = False
 
     def _stop_refresh_loop(self):
         _deregister_held(self)

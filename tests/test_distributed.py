@@ -259,3 +259,32 @@ def test_inline_object_over_remote_disks(tmp_path, remote_node):
     z.make_bucket("inlinebkt")
     z.put_object("inlinebkt", "tiny.txt", io.BytesIO(b"tiny"), 4)
     assert z.get_object_bytes("inlinebkt", "tiny.txt") == b"tiny"
+
+
+def test_fast_refresh_keeps_short_expiry_lock_alive(tmp_path):
+    """A mutex with a fast refresh interval survives a sub-10s locker
+    expiry window (regression: the shared ticker once ignored per-mutex
+    cadence, silently expiring held locks)."""
+    import time as _time
+
+    from minio_tpu.distributed.dsync import (
+        Dsync,
+        LocalLocker,
+        LockRESTServer,
+    )
+
+    srv = LockRESTServer(SECRET, expiry_s=2.0).start()
+    try:
+        ds = Dsync(local=LocalLocker(expiry_s=2.0),
+                   remote_endpoints=[srv.endpoint], secret=SECRET)
+        m1 = ds.new_mutex("keepalive/res", refresh_interval=0.5)
+        assert m1.lock(timeout=5)
+        _time.sleep(4.0)  # two expiry windows
+        assert not m1.lost.is_set()
+        m2 = ds.new_mutex("keepalive/res", refresh_interval=0.5)
+        assert not m2.lock(timeout=0.5)  # still held
+        m1.unlock()
+        assert m2.lock(timeout=5)
+        m2.unlock()
+    finally:
+        srv.stop()

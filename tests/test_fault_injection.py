@@ -143,3 +143,55 @@ def test_default_error_disk_is_dead_for_everything(tmp_path):
     es.put_object("flt", "obj", io.BytesIO(body), len(body))
     assert _get(es, "flt", "obj") == body
     assert dead.calls > 0  # it was really consulted and really refused
+
+
+def test_fresh_disk_heal_survives_flapping_source(tmp_path):
+    """Back-filling a replaced drive keeps going when one SOURCE disk
+    flaps mid-sweep: failures are counted, the rest of the namespace
+    still heals, and the healed disk serves reads."""
+    import shutil
+
+    from minio_tpu.background.newdisk import FreshDiskHealer
+    from minio_tpu.object.pools import ErasureServerPools
+    from minio_tpu.object.sets import ErasureSets
+
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    sets = ErasureSets(
+        disks, 4,
+        deployment_id="f1aff1af-1111-2222-3333-f1aff1aff1af",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    ol.make_bucket("flap")
+    for i in range(10):
+        body = bytes([i]) * 32768
+        ol.put_object("flap", f"o{i:02d}", io.BytesIO(body), len(body))
+
+    # Replace d3, then make d1 flap: every 5th call errors during the
+    # sweep (reads from it fail intermittently; k=2 still satisfiable
+    # from d0/d2).
+    shutil.rmtree(str(tmp_path / "d3"))
+    disks[3].__init__(str(tmp_path / "d3"), endpoint="d3")
+    es = ol.pools[0].sets[0]
+    flappy = NaughtyDisk(
+        es.disks[1],
+        errors={n: ErrDiskNotFound("flap") for n in range(5, 400, 5)},
+    )
+    es.disks[1] = flappy
+
+    healer = FreshDiskHealer(ol)
+    healed = healer.check_once()
+    assert healed == ["d3"]
+
+    # restore the real d1 and kill d0: reads must come from d2+d3,
+    # proving the healed disk carries usable shards despite the flapping
+    es.disks[1] = flappy._disk
+    es.disks[0] = None
+    for i in range(10):
+        sink = io.BytesIO()
+        ol.get_object("flap", f"o{i:02d}", sink)
+        assert sink.getvalue() == bytes([i]) * 32768, i

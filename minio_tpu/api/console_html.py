@@ -128,15 +128,17 @@ function encPath(key) {
 async function download(key) {
   // Authorization-header fetch + blob: the bearer token never lands in
   // URLs, access logs, or browser history.
-  const r = await fetch(
-    '/minio/download/' + encPath(bucket) + '/' + encPath(key),
-    {headers: {Authorization: 'Bearer ' + token}});
-  if (!r.ok) { err('download failed: ' + r.status); return; }
-  const a = document.createElement('a');
-  a.href = URL.createObjectURL(await r.blob());
-  a.download = key.split('/').pop();
-  a.click();
-  URL.revokeObjectURL(a.href);
+  try {
+    const r = await fetch(
+      '/minio/download/' + encPath(bucket) + '/' + encPath(key),
+      {headers: {Authorization: 'Bearer ' + token}});
+    if (!r.ok) { err('download failed: ' + r.status); return; }
+    const a = document.createElement('a');
+    a.href = URL.createObjectURL(await r.blob());
+    a.download = key.split('/').pop();
+    a.click();
+    URL.revokeObjectURL(a.href);
+  } catch (e) { err(e.message); }
 }
 async function makeBucket() {
   try {

@@ -304,7 +304,11 @@ class WebHandlers:
         return self.h.put_object(sub)
 
     def _download(self, ctx) -> Response:
-        token = dict(ctx.query).get("token", "")
+        # Token accepted from the Authorization header (preferred: never
+        # lands in URLs/logs) or the ?token= query (share-link style).
+        token = ctx.headers.get("authorization", "") \
+            .removeprefix("Bearer ").strip() \
+            or dict(ctx.query).get("token", "")
         access_key = _verify_token(token, self.iam)
         bucket, _, object_ = ctx.path[len(DOWNLOAD_PREFIX):].partition("/")
         self._authorize(access_key, "s3:GetObject", bucket, object_)

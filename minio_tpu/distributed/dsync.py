@@ -327,6 +327,10 @@ class DRWMutex:
         _register_held(self)
 
     def _do_refresh(self):
+        # Stamp at START: period must be start-to-start, or slow-but-
+        # alive peers stretch the effective interval past the expiry
+        # (dedup via _refreshing already prevents stacking).
+        self._last_refresh = time.monotonic()
         try:
             uid = self.uid
             if not uid:
@@ -345,7 +349,6 @@ class DRWMutex:
                 self.lost.set()
                 _deregister_held(self)
         finally:
-            self._last_refresh = time.monotonic()
             self._refreshing = False
 
     def _stop_refresh_loop(self):

@@ -202,6 +202,19 @@ class LocalStorage(StorageAPI):
                 with open(os.path.join(p, XL_META_FILE), "rb") as f:
                     yield rel, f.read()
                 return
+            if "xl.json" in names:
+                # Legacy v1 object: surface it to listings/scanner/heal
+                # as a CONVERTED modern journal so consumers need no
+                # legacy awareness.
+                from .xlmeta_v1 import legacy_to_xlmeta
+
+                try:
+                    with open(os.path.join(p, "xl.json"), "rb") as f:
+                        meta = legacy_to_xlmeta(f.read(), volume, rel)
+                    yield rel, meta.to_bytes()
+                except Exception:  # noqa: BLE001 - unreadable legacy doc
+                    pass
+                return
             for name in names:
                 child = f"{rel}/{name}" if rel else name
                 if os.path.isdir(os.path.join(p, name)):
@@ -224,9 +237,22 @@ class LocalStorage(StorageAPI):
             with open(meta_path, "rb") as f:
                 return XLMeta.from_bytes(f.read())
         except FileNotFoundError:
-            if not os.path.isdir(self._vol_path(volume)):
-                raise ErrVolumeNotFound(volume) from None
-            raise ErrFileNotFound(f"{volume}/{path}") from None
+            # Legacy object (pre-2020 reference deployments migrated in
+            # place): fall back to the v1 xl.json document
+            # (ref cmd/xl-storage-format-v1.go readers).
+            from .xlmeta_v1 import XL_JSON_FILE, legacy_to_xlmeta
+
+            legacy = os.path.join(
+                self._file_path(volume, path), XL_JSON_FILE
+            )
+            try:
+                with open(legacy, "rb") as f:
+                    raw = f.read()
+            except FileNotFoundError:
+                if not os.path.isdir(self._vol_path(volume)):
+                    raise ErrVolumeNotFound(volume) from None
+                raise ErrFileNotFound(f"{volume}/{path}") from None
+            return legacy_to_xlmeta(raw, volume, path)
 
     def _write_meta(self, volume: str, path: str, meta: XLMeta):
         obj_dir = self._file_path(volume, path)
@@ -290,11 +316,13 @@ class LocalStorage(StorageAPI):
             if meta.versions:
                 self._write_meta(volume, path, meta)
             else:
+                # Journal empty: NOTHING under the object dir is valid
+                # anymore — including a legacy xl.json and its bare
+                # part.N files (data_dir="" means no per-version dir to
+                # rmtree above). Removing only xl.meta would resurrect
+                # legacy objects via the fallback reader.
                 obj_dir = self._file_path(volume, path)
-                try:
-                    os.remove(os.path.join(obj_dir, XL_META_FILE))
-                except FileNotFoundError:
-                    pass
+                shutil.rmtree(obj_dir, ignore_errors=True)
                 self._cleanup_empty_dirs(volume, path)
 
     def delete_versions(self, volume: str, versions: list[FileInfo]) -> list:
@@ -313,8 +341,10 @@ class LocalStorage(StorageAPI):
         while cur != vol and cur.startswith(vol):
             try:
                 os.rmdir(cur)
+            except FileNotFoundError:
+                pass  # already removed (e.g. rmtree'd object dir)
             except OSError:
-                break
+                break  # non-empty: stop climbing
             cur = os.path.dirname(cur)
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
@@ -450,8 +480,9 @@ class LocalStorage(StorageAPI):
 
     def check_file(self, volume: str, path: str) -> None:
         self._require_online()
-        meta = os.path.join(self._file_path(volume, path), XL_META_FILE)
-        if not os.path.isfile(meta):
+        obj_dir = self._file_path(volume, path)
+        if not (os.path.isfile(os.path.join(obj_dir, XL_META_FILE))
+                or os.path.isfile(os.path.join(obj_dir, "xl.json"))):
             raise ErrFileNotFound(f"{volume}/{path}")
 
     def delete(self, volume: str, path: str, recursive: bool = False) -> None:

@@ -1,0 +1,107 @@
+"""Legacy xl.json (format v1) reader — pre-2020 objects written by the
+reference's v1 metadata format (/root/reference/cmd/
+xl-storage-format-v1.go: JSON doc with stat/erasure/meta/parts; part
+files live directly under the object dir, no per-version data dir).
+
+Read-only migration support: `legacy_to_xlmeta` converts the JSON doc
+into the modern in-memory journal (one version, empty data_dir — the
+part path `<object>//part.N` collapses to the legacy location under
+POSIX), so every downstream consumer (quorum pick, erasure readers,
+bitrot verify) works unchanged. Streaming bitrot algorithms interleave
+hashes in the part files themselves in v1 exactly as in v2, so data
+reads are identical once the geometry is known.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+
+from ..utils.errors import ErrCorruptedFormat
+from .fileinfo import ChecksumInfo, ErasureInfo, FileInfo, ObjectPartInfo
+
+XL_JSON_FILE = "xl.json"
+
+# v1 checksum algorithm names map 1:1 onto our BitrotAlgorithm values.
+_KNOWN_ALGOS = {"sha256", "blake2b", "highwayhash256", "highwayhash256S"}
+
+
+def _parse_rfc3339_ns(s: str) -> int:
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except ValueError as exc:
+        raise ErrCorruptedFormat(f"xl.json modTime {s!r}") from exc
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1e9)
+
+
+def parse_xl_json(raw: bytes) -> dict:
+    try:
+        doc = json.loads(raw)
+    except ValueError as exc:
+        raise ErrCorruptedFormat("xl.json is not JSON") from exc
+    if doc.get("format") != "xl":
+        raise ErrCorruptedFormat(
+            f"xl.json format {doc.get('format')!r}"
+        )
+    return doc
+
+
+def legacy_to_fileinfo(doc: dict, volume: str, path: str) -> FileInfo:
+    """One v1 document -> a modern FileInfo (data_dir stays empty: the
+    legacy part layout has no per-version directory)."""
+    stat = doc.get("stat", {})
+    er = doc.get("erasure", {})
+    meta = dict(doc.get("meta", {}))
+    checksums = []
+    for c in er.get("checksum", []):
+        algo = c.get("algorithm", "")
+        if algo not in _KNOWN_ALGOS:
+            raise ErrCorruptedFormat(f"xl.json bitrot algo {algo!r}")
+        name = c.get("name", "")
+        try:
+            part_no = int(name.split(".", 1)[1]) if "." in name else 1
+        except ValueError:
+            part_no = 1
+        checksums.append(ChecksumInfo(
+            part_number=part_no, algorithm=algo,
+            hash=bytes.fromhex(c.get("hash", "") or ""),
+        ))
+    parts = [
+        ObjectPartInfo(
+            number=int(p["number"]), size=int(p["size"]),
+            actual_size=int(p.get("actualSize", p["size"])),
+        )
+        for p in doc.get("parts", [])
+    ]
+    etag = meta.pop("etag", "")
+    return FileInfo(
+        volume=volume,
+        name=path,
+        version_id="",          # v1 predates versioning: null version
+        size=int(stat.get("size", 0)),
+        mod_time_ns=_parse_rfc3339_ns(stat.get("modTime", "1970-01-01T00:00:00Z")),
+        metadata={**meta, **({"etag": etag} if etag else {})},
+        erasure=ErasureInfo(
+            data_blocks=int(er.get("data", 0)),
+            parity_blocks=int(er.get("parity", 0)),
+            block_size=int(er.get("blockSize", 0)),
+            index=int(er.get("index", 0)),
+            distribution=[int(x) for x in er.get("distribution", [])],
+            checksums=checksums,
+        ),
+        parts=parts,
+        data_dir="",            # legacy: parts directly under the object
+    )
+
+
+def legacy_to_xlmeta(raw: bytes, volume: str, path: str):
+    """xl.json bytes -> a modern XLMeta journal with the one legacy
+    version, so _read_meta callers need no legacy awareness."""
+    from .xlmeta import XLMeta
+
+    fi = legacy_to_fileinfo(parse_xl_json(raw), volume, path)
+    meta = XLMeta()
+    meta.versions = [fi.to_dict()]
+    return meta

@@ -1,0 +1,177 @@
+"""Legacy xl.json (format v1) read support: an on-disk layout written
+by a pre-2020 reference deployment (xl.json + part files directly under
+the object dir) reads through the modern erasure path unchanged
+(ref cmd/xl-storage-format-v1.go)."""
+
+import datetime
+import io
+import json
+import os
+import shutil
+
+import pytest
+
+from minio_tpu.object.erasure_objects import ErasureObjects
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.xlmeta_v1 import legacy_to_fileinfo, parse_xl_json
+from minio_tpu.utils.errors import ErrCorruptedFormat
+
+
+def _legacy_convert(tmp_path, disks, bucket, obj):
+    """Rewrite a freshly-written v2 object into the v1 on-disk layout:
+    parts move from <obj>/<data_dir>/part.N to <obj>/part.N and xl.meta
+    is replaced by a hand-built xl.json."""
+    for disk in disks:
+        fi = disk.read_version(bucket, obj)
+        obj_dir = os.path.join(disk.root, bucket, obj)
+        # move part files up to the legacy location
+        dd = os.path.join(obj_dir, fi.data_dir)
+        for name in os.listdir(dd):
+            shutil.move(os.path.join(dd, name),
+                        os.path.join(obj_dir, name))
+        os.rmdir(dd)
+        mod = datetime.datetime.fromtimestamp(
+            fi.mod_time_ns / 1e9, tz=datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+        doc = {
+            "version": "1.0.3", "format": "xl",
+            "stat": {"size": fi.size, "modTime": mod},
+            "erasure": {
+                "algorithm": "klauspost/reedsolomon/vandermonde",
+                "data": fi.erasure.data_blocks,
+                "parity": fi.erasure.parity_blocks,
+                "blockSize": fi.erasure.block_size,
+                "index": fi.erasure.index,
+                "distribution": fi.erasure.distribution,
+                "checksum": [
+                    {"name": f"part.{c.part_number}",
+                     "algorithm": c.algorithm,
+                     "hash": c.hash.hex()}
+                    for c in fi.erasure.checksums
+                ],
+            },
+            "minio": {"release": "RELEASE.2019-10-12T01-39-57Z"},
+            "meta": {**fi.metadata, "etag": fi.metadata.get("etag", "")},
+            "parts": [
+                {"number": p.number, "name": f"part.{p.number}",
+                 "size": p.size, "actualSize": p.actual_size}
+                for p in fi.parts
+            ],
+        }
+        os.unlink(os.path.join(obj_dir, "xl.meta"))
+        with open(os.path.join(obj_dir, "xl.json"), "w") as f:
+            json.dump(doc, f)
+
+
+@pytest.fixture()
+def es(tmp_path):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(4)
+    ]
+    es = ErasureObjects(disks, default_parity=2)
+    es.make_bucket("legacy")
+    return es, disks, tmp_path
+
+
+def test_legacy_object_reads_through_modern_path(es):
+    es, disks, tmp_path = es
+    body = b"vintage 2019 object " * 120000  # ~2.4 MB: real part files, not inline
+    es.put_object("legacy", "old/data.bin", io.BytesIO(body), len(body))
+    _legacy_convert(tmp_path, disks, "legacy", "old/data.bin")
+    # no xl.meta remains anywhere
+    for d in disks:
+        assert not os.path.exists(
+            os.path.join(d.root, "legacy", "old/data.bin", "xl.meta")
+        )
+    # full read, ranged read, HEAD-equivalent
+    sink = io.BytesIO()
+    info = es.get_object("legacy", "old/data.bin", sink)
+    assert sink.getvalue() == body
+    assert info.size == len(body)
+    sink = io.BytesIO()
+    es.get_object("legacy", "old/data.bin", sink, offset=100, length=500)
+    assert sink.getvalue() == body[100:600]
+
+
+def test_legacy_object_degraded_read_and_heal(es):
+    es, disks, tmp_path = es
+    body = os.urandom(2 * 1024 * 1024)
+    es.put_object("legacy", "old/heal.bin", io.BytesIO(body), len(body))
+    _legacy_convert(tmp_path, disks, "legacy", "old/heal.bin")
+    # kill one disk's copy entirely: read still works, heal restores it
+    shutil.rmtree(os.path.join(disks[2].root, "legacy", "old/heal.bin"))
+    sink = io.BytesIO()
+    es.get_object("legacy", "old/heal.bin", sink)
+    assert sink.getvalue() == body
+    res = es.heal_object("legacy", "old/heal.bin")
+    assert res["healed"]
+
+
+def test_v1_parser_validation():
+    with pytest.raises(ErrCorruptedFormat):
+        parse_xl_json(b"not json")
+    with pytest.raises(ErrCorruptedFormat):
+        parse_xl_json(json.dumps({"format": "fs"}).encode())
+    doc = {
+        "format": "xl",
+        "stat": {"size": 10, "modTime": "2019-01-02T03:04:05Z"},
+        "erasure": {"data": 2, "parity": 2, "blockSize": 1048576,
+                    "index": 1, "distribution": [1, 2, 3, 4],
+                    "checksum": [{"name": "part.1",
+                                  "algorithm": "highwayhash256S",
+                                  "hash": ""}]},
+        "meta": {"etag": "abc", "x-amz-meta-color": "sepia"},
+        "parts": [{"number": 1, "name": "part.1", "size": 10}],
+    }
+    fi = legacy_to_fileinfo(doc, "b", "o")
+    assert fi.size == 10
+    assert fi.erasure.data_blocks == 2
+    assert fi.data_dir == ""
+    assert fi.metadata["x-amz-meta-color"] == "sepia"
+    assert fi.metadata["etag"] == "abc"
+    assert fi.erasure.get_checksum_info(1).algorithm == "highwayhash256S"
+    # bad algorithm rejected
+    doc["erasure"]["checksum"][0]["algorithm"] = "md5"
+    with pytest.raises(ErrCorruptedFormat):
+        legacy_to_fileinfo(doc, "b", "o")
+
+
+def test_legacy_object_delete_does_not_resurrect(es):
+    """Deleting a legacy object removes xl.json AND its part files —
+    a delete that leaves the legacy doc behind resurrects the object
+    on the next read (regression)."""
+    es, disks, tmp_path = es
+    body = os.urandom(2 * 1024 * 1024)
+    es.put_object("legacy", "old/del.bin", io.BytesIO(body), len(body))
+    _legacy_convert(tmp_path, disks, "legacy", "old/del.bin")
+    es.delete_object("legacy", "old/del.bin")
+    from minio_tpu.utils.errors import StorageError
+
+    with pytest.raises(StorageError):
+        sink = io.BytesIO()
+        es.get_object("legacy", "old/del.bin", sink)
+    for d in disks:
+        assert not os.path.exists(
+            os.path.join(d.root, "legacy", "old/del.bin")
+        )
+
+
+def test_legacy_object_visible_in_listings(es):
+    """walk_dir surfaces legacy objects (converted journals), so
+    listings, the scanner, and heal sweeps all see them."""
+    es, disks, tmp_path = es
+    body = os.urandom(2 * 1024 * 1024)
+    es.put_object("legacy", "old/seen.bin", io.BytesIO(body), len(body))
+    es.put_object("legacy", "modern.bin", io.BytesIO(b"m" * 2048), 2048)
+    _legacy_convert(tmp_path, disks, "legacy", "old/seen.bin")
+    names = [n for n, _ in disks[0].walk_dir("legacy")]
+    assert "old/seen.bin" in names and "modern.bin" in names
+    # the yielded blob parses as a modern journal
+    from minio_tpu.storage.xlmeta import XLMeta
+
+    blob = dict(disks[0].walk_dir("legacy"))["old/seen.bin"]
+    fi = XLMeta.from_bytes(blob).to_file_info("legacy", "old/seen.bin", None)
+    assert fi.size == len(body)
+    # check_file agrees
+    disks[0].check_file("legacy", "old/seen.bin")

@@ -242,3 +242,26 @@ def test_console_page_served(server):
         assert b"web.Login" in body and b"/minio/webrpc" in body
     finally:
         conn.close()
+
+
+def test_download_accepts_authorization_header(server, token):
+    """The console fetches downloads with a Bearer header (keeps the
+    token out of URLs); the server must accept it (regression: only
+    ?token= worked)."""
+    rpc(server, "web.MakeBucket", {"bucketName": "hdrload"}, token)
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("PUT", "/minio/upload/hdrload/f.bin", body=b"hdr!",
+                     headers={"Authorization": f"Bearer {token}",
+                              "Content-Length": "4"})
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    try:
+        conn.request("GET", "/minio/download/hdrload/f.bin",
+                     headers={"Authorization": f"Bearer {token}"})
+        r = conn.getresponse()
+        assert r.status == 200 and r.read() == b"hdr!"
+    finally:
+        conn.close()

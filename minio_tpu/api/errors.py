@@ -128,6 +128,7 @@ def from_object_error(exc: Exception) -> "S3Error":
         (oe.ErrInvalidPart, "InvalidPart"),
         (oe.ErrInvalidArgument, "InvalidArgument"),
         (oe.ErrMethodNotAllowed, "MethodNotAllowed"),
+        (oe.ErrPreconditionFailed, "PreconditionFailed"),
         (oe.ErrErasureReadQuorum, "SlowDown"),
         (oe.ErrErasureWriteQuorum, "SlowDown"),
         (oe.ErrLessData, "IncompleteBody"),

@@ -1482,6 +1482,12 @@ class S3ApiHandlers:
                 )
                 return Response(206, headers, body_stream=stream)
             return Response(200, headers, body_stream=stream)
+        # Pin the stream to the ADVERTISED version: headers are on the
+        # wire before the body, and a concurrent overwrite between the
+        # info fetch and the locked data read must abort with ZERO bytes
+        # (severed connection) rather than serve different bytes under
+        # the old ETag. Applies to every local-read branch below.
+        opts.expected_etag = oi.etag
         if transformed:
             # Streaming decrypt/decompress writer chain onto the socket
             # (ref NewGetObjectReader, cmd/object-api-utils.go:595): the

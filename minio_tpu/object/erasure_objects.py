@@ -38,6 +38,7 @@ from ..utils.errors import (
     ErrLessData,
     ErrMethodNotAllowed,
     ErrObjectNotFound,
+    ErrPreconditionFailed,
     ErrVersionNotFound,
     ErrVolumeNotFound,
     ErrBucketNotFound,
@@ -602,6 +603,14 @@ class ErasureObjects(MultipartMixin):
             if not opts.version_id:
                 raise ErrObjectNotFound(f"{bucket}/{object_}")
             raise ErrMethodNotAllowed("delete marker")
+        if (opts.expected_etag
+                and fi.metadata.get("etag", "") != opts.expected_etag):
+            # The object changed between the caller's header fetch and
+            # this locked read: abort with ZERO bytes written rather
+            # than stream a different object under the advertised ETag.
+            raise ErrPreconditionFailed(
+                f"{bucket}/{object_}: etag changed"
+            )
 
         total = fi.size
         if length == -1:

@@ -263,6 +263,18 @@ class FSObjects:
 
     def get_object(self, bucket, object_, writer, offset=0, length=-1,
                    opts=None):
+        if opts is not None and getattr(opts, "expected_etag", ""):
+            # Same coherence pin as the erasure layer: the caller
+            # advertised an ETag before the body streams; an overwrite
+            # since then must abort with zero bytes, never serve
+            # different content under the old headers.
+            from ..utils.errors import ErrPreconditionFailed
+
+            cur = self.get_object_info(bucket, object_, opts)
+            if cur.etag != opts.expected_etag:
+                raise ErrPreconditionFailed(
+                    f"{bucket}/{object_}: etag changed"
+                )
         data = self.get_object_bytes(bucket, object_, offset, length, opts)
         writer.write(data)
         return self.get_object_info(bucket, object_, opts)

@@ -34,6 +34,12 @@ class ObjectOptions:
     # storage_class config EC:n; ref cmd/erasure-object.go:611-626
     # globalStorageClass.GetParityForSC). None = set default.
     parity: int | None = None
+    # ETag the caller already ADVERTISED (headers sent before the body
+    # streams): if the version resolved under the read lock differs, the
+    # read aborts BEFORE byte 0 so a concurrent overwrite can never put
+    # new bytes under an old ETag (the reference instead holds the lock
+    # from GetObjectNInfo through the reader's lifetime).
+    expected_etag: str = ""
 
 
 @dataclass

@@ -128,6 +128,11 @@ class ErrRemoteTier(StorageError):
     error paths in cmd/bucket-lifecycle.go) — retriable 503."""
 
 
+class ErrPreconditionFailed(StorageError):
+    """The object changed between the caller's metadata fetch and the
+    locked data read (expected_etag mismatch): retriable race loss."""
+
+
 class ErrOperationTimedOut(StorageError):
     """Namespace-lock acquisition timed out (ref: OperationTimedOut,
     cmd/typed-errors.go) — surfaces as a retriable 503 instead of a

@@ -235,3 +235,30 @@ def test_put_delete_race(ol):
         assert hashlib.md5(sink.getvalue()).hexdigest() == info.etag
     except (ErrObjectNotFound, StorageError):
         pass  # cleanly absent is a legal outcome
+
+
+def test_streamed_get_never_serves_wrong_etag(ol):
+    """A GET whose object is overwritten between the header fetch and
+    the locked data read must ABORT, never stream new bytes under the
+    old advertised ETag (expected_etag pinning)."""
+    from minio_tpu.utils.errors import ErrPreconditionFailed
+
+    body1 = b"\x01" * 100_000
+    ol.put_object("race", "pin", io.BytesIO(body1), len(body1),
+                  ObjectOptions())
+    info1 = ol.get_object_info("race", "pin")
+    # overwrite AFTER the info fetch (simulating the handler's window)
+    body2 = b"\x02" * 100_000
+    ol.put_object("race", "pin", io.BytesIO(body2), len(body2),
+                  ObjectOptions())
+    sink = io.BytesIO()
+    with pytest.raises(ErrPreconditionFailed):
+        ol.get_object("race", "pin", sink,
+                      opts=ObjectOptions(expected_etag=info1.etag))
+    assert sink.getvalue() == b""  # ZERO bytes escaped
+    # matching etag streams normally
+    info2 = ol.get_object_info("race", "pin")
+    sink = io.BytesIO()
+    ol.get_object("race", "pin", sink,
+                  opts=ObjectOptions(expected_etag=info2.etag))
+    assert sink.getvalue() == body2

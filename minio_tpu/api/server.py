@@ -358,7 +358,19 @@ class S3Server:
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
             do_OPTIONS = _dispatch
 
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                import sys as _sys
+
+                # Aborted client connections (downloads cancelled, race
+                # severs) are routine — no stderr tracebacks for them.
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionResetError,
+                                    BrokenPipeError, TimeoutError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self.httpd = _Server((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: threading.Thread | None = None

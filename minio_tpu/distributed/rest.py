@@ -98,7 +98,19 @@ class RPCServer:
             def do_POST(self):
                 outer._handle(self)
 
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                import sys as _sys
+
+                # Client resets/disconnects during node outages are
+                # routine — never spray tracebacks to stderr for them.
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionResetError,
+                                    BrokenPipeError, TimeoutError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self.httpd = _Server((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: threading.Thread | None = None

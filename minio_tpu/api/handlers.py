@@ -2027,13 +2027,18 @@ class S3ApiHandlers:
             uploads = self.ol.list_multipart_uploads(ctx.bucket, prefix)
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        # Same encoding-type=url contract as the object listings.
+        encode = self._listing_encoder(ctx)
+        enc = encode or (lambda s: s)
         root = _xml_root("ListMultipartUploadsResult")
         ET.SubElement(root, "Bucket").text = ctx.bucket
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = enc(prefix)
+        if encode is not None:
+            ET.SubElement(root, "EncodingType").text = "url"
         ET.SubElement(root, "IsTruncated").text = "false"
         for mp in uploads:
             u = ET.SubElement(root, "Upload")
-            ET.SubElement(u, "Key").text = mp.object
+            ET.SubElement(u, "Key").text = enc(mp.object)
             ET.SubElement(u, "UploadId").text = mp.upload_id
         return Response.xml(root)
 

@@ -787,3 +787,17 @@ def test_listing_encoding_type_url(client, bucket):
         "GET", f"/{bucket}", query=[("encoding-type", "base64")],
     )
     assert st == 400
+
+
+def test_list_multipart_uploads_encoding_type(client, bucket):
+    st, _, raw = client.request(
+        "POST", f"/{bucket}/mp enc+key", query=[("uploads", "")],
+    )
+    assert st == 200
+    st, _, raw = client.request(
+        "GET", f"/{bucket}", query=[("uploads", ""),
+                                    ("encoding-type", "url")],
+    )
+    assert st == 200
+    assert b"<EncodingType>url</EncodingType>" in raw
+    assert b"mp%20enc%2Bkey" in raw

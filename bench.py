@@ -224,6 +224,31 @@ def bench_config4_bitrot_get(root: str, reps: int = 5):
     return reps * size / (time.perf_counter() - t0) / 1e9
 
 
+class _ZeroCopyReader:
+    """Stream over a shared payload without the per-PUT BytesIO copy —
+    the 4 MiB memcpy per put stole the GIL from the admitted encoder and
+    polluted the aggregate number with harness cost."""
+
+    def __init__(self, payload: bytes):
+        self._mv = memoryview(payload)
+        self._pos = 0
+
+    def read(self, n: int = -1) -> bytes:
+        left = len(self._mv) - self._pos
+        if n is None or n < 0 or n > left:
+            n = left
+        out = bytes(self._mv[self._pos: self._pos + n])
+        self._pos += n
+        return out
+
+    def readinto(self, b) -> int:
+        view = memoryview(b)
+        n = min(len(view), len(self._mv) - self._pos)
+        view[:n] = self._mv[self._pos: self._pos + n]
+        self._pos += n
+        return n
+
+
 def bench_config5_pool_put(root: str, n_objects: int = 24):
     """Config 5: multi-set pool, batched multi-object PUT aggregate GB/s."""
     from concurrent.futures import ThreadPoolExecutor
@@ -248,13 +273,137 @@ def bench_config5_pool_put(root: str, n_objects: int = 24):
     payload = os.urandom(size)
 
     def put(i):
-        ol.put_object("bench", f"batch/o{i}", io.BytesIO(payload), size)
+        ol.put_object("bench", f"batch/o{i}", _ZeroCopyReader(payload), size)
 
     with ThreadPoolExecutor(max_workers=8) as pool:
         t0 = time.perf_counter()
         list(pool.map(put, range(n_objects)))
         dt = time.perf_counter() - t0
     return n_objects * size / dt / 1e9
+
+
+def bench_put_stages(root: str, total_mib: int = 32) -> dict:
+    """Per-stage breakdown of ONE PutObject stream (12+4 @ 1 MiB blocks)
+    on this host, in GB/s of INPUT bytes — the decomposition that locates
+    where e2e throughput goes. Stages mirror the PUT pipeline order:
+    source read -> md5 (ETag) -> GF encode -> bitrot frame -> shard write
+    -> xl.meta commit. Single-threaded, like one admitted PUT stream."""
+    import ctypes
+    import hashlib
+
+    from minio_tpu import native
+    from minio_tpu.erasure.codec import Erasure
+    from minio_tpu.ops import gf_native
+    from minio_tpu.ops import highwayhash as hhmod
+    from minio_tpu.storage.fileinfo import (
+        ChecksumInfo, ErasureInfo, FileInfo, new_uuid,
+    )
+    from minio_tpu.storage.xlmeta import XLMeta
+
+    out: dict = {}
+    er = Erasure(12, 4, MIB)
+    S = er.shard_size()
+    payload = np.random.default_rng(3).integers(
+        0, 256, total_mib * MIB, np.uint8
+    ).tobytes()
+    nbytes = len(payload)
+
+    def rate(fn, reps=3, scale=1.0):
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            best = max(best, nbytes * scale / dt / 1e9)
+        return round(best, 3)
+
+    # 1: stream read into the [k, B*S] strip buffer (readinto scatter).
+    buf = np.empty((12, 8 * S), dtype=np.uint8)
+
+    def fill():
+        src = io.BytesIO(payload)
+        for blk in range(total_mib):
+            col = (blk % 8) * S
+            for j in range(12):
+                src.readinto(memoryview(buf[j, col: col + S])[: MIB - j * S if j == 11 else S])
+
+    out["source_read_gbps"] = rate(fill)
+    # 2: content md5 (the S3 ETag contract; serial by construction).
+    out["md5_gbps"] = rate(lambda: hashlib.md5(payload))
+    # 3: GF(2^8) parity encode (native engine on [k, B*S] strips).
+    out["encode_gbps"] = rate(
+        lambda: [gf_native.apply_matrix(er._parity_mat, buf)
+                 for _ in range(total_mib // 8)]
+    )
+    # 4: bitrot framing ([H||chunk]*, hash + copy, native).
+    lib = native.load()
+    if lib is not None:
+        row = np.ascontiguousarray(buf[0])
+        n = row.size
+        nch = (n + S - 1) // S
+        fout = np.empty(n + 32 * nch, dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+
+        def frame():
+            for _ in range(nbytes // n):
+                lib.hh256_frame(hhmod.MAGIC_KEY, row.ctypes.data_as(u8p),
+                                n, S, fout.ctypes.data_as(u8p))
+
+        out["bitrot_frame_gbps"] = rate(frame)
+        # 5: framed shard write, raw fd (the write path after the
+        # buffered-IO fix).
+        wdir = os.path.join(root, "stages")
+        os.makedirs(wdir, exist_ok=True)
+
+        def shard_write():
+            fd = os.open(os.path.join(wdir, "w"),
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
+            for _ in range(nbytes // n):
+                os.write(fd, memoryview(fout))
+            os.close(fd)
+
+        out["shard_write_gbps"] = rate(shard_write)
+        _cleanup(wdir)
+    # 6: metadata commit (16 disks' xl.meta serialize+write+rename), in
+    # microseconds per PUT rather than GB/s — it is size-independent.
+    mdir = os.path.join(root, "stages-meta")
+    os.makedirs(mdir, exist_ok=True)
+    fi = FileInfo(
+        volume="b", name="o", version_id="", data_dir=new_uuid(),
+        mod_time_ns=time.time_ns(), size=10 * MIB,
+        metadata={"etag": "0" * 32},
+        erasure=ErasureInfo(
+            data_blocks=12, parity_blocks=4, block_size=MIB, index=1,
+            distribution=list(range(1, 17)),
+            checksums=[ChecksumInfo(1, "highwayhash256S")],
+        ),
+    )
+    fi.add_part(1, 10 * MIB, 10 * MIB)
+    t0 = time.perf_counter()
+    reps = 50
+    for r in range(reps):
+        for d in range(16):
+            m = XLMeta()
+            m.add_version(fi)
+            p = os.path.join(mdir, f"d{d}.xl.meta")
+            with open(p + ".tmp", "wb") as f:
+                f.write(m.to_bytes())
+            os.replace(p + ".tmp", p)
+    out["meta_commit_us_per_put"] = round(
+        (time.perf_counter() - t0) / reps * 1e6
+    )
+    _cleanup(mdir)
+    # The serial PUT model: input passes once through each byte-rate
+    # stage (frame+write carry the 1.33x shard expansion).
+    inv = 0.0
+    for key, exp in (("source_read_gbps", 1.0), ("md5_gbps", 1.0),
+                     ("encode_gbps", 1.0), ("bitrot_frame_gbps", 4 / 3),
+                     ("shard_write_gbps", 4 / 3)):
+        if key in out and out[key] > 0:
+            inv += exp / out[key]
+    if inv > 0:
+        out["model_put_gbps"] = round(1.0 / inv, 3)
+    return out
 
 
 def bench_device(tpu_ok: bool) -> dict:
@@ -296,6 +445,17 @@ def bench_device(tpu_ok: bool) -> dict:
             measure(lambda b, x: rs_pallas.apply_gf_matrix_pallas(b, x),
                     (bitmat, blocks)), 3,
         )
+    # H2D bandwidth: the quantity that decides the host-vs-device engine
+    # policy. The device pipeline is feed-bound, so it beats the native
+    # host engine exactly when H2D GB/s exceeds the native host-fed rate
+    # (the crossover recorded in the main result).
+    h2d_src = np.random.default_rng(7).integers(
+        0, 256, 64 * MIB, np.uint8
+    )
+    jax.device_put(h2d_src[: MIB]).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    jax.device_put(h2d_src).block_until_ready()
+    out["h2d_gbps"] = round(h2d_src.nbytes / (time.perf_counter() - t0) / 1e9, 3)
     if tpu_ok:
         # Host-fed device-engine stream: the full async overlap pipeline.
         from minio_tpu.erasure.bitrot import (
@@ -367,22 +527,41 @@ def main() -> None:
     ):
         configs[key] = round(fn(root), 3)
         _cleanup(os.path.join(root, sub))
+    try:
+        stages = bench_put_stages(root)
+    except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort
+        stages = {"error": f"{type(exc).__name__}: {exc}"}
     result = {
         "metric": ("PutObject erasure-encode 12+4 @1MiB, host-fed into "
                    "streaming bitrot writers (the reference's "
                    "BenchmarkErasureEncode conditions)"),
         "value": round(headline, 3),
         "unit": "GB/s",
-        # The 6 GB/s AVX2 denominator is a PURE-encode estimate
-        # (klauspost README-class), so the like-for-like ratio uses the
-        # pure-encode measurement; the harness e2e number above is
-        # memcpy-ceiling-bound (see memcpy_gbps) on small hosts.
-        "vs_baseline": round(encode_only / AVX2_BASELINE_GBPS, 3),
+        # vs_baseline describes `value` against the same quantity's AVX2
+        # estimate. There is no published reference e2e number, so the
+        # conservative proxy is the 6 GB/s PURE-encode estimate — the
+        # reference harness would also lose its IO/hash passes on this
+        # host, making this ratio a LOWER bound on parity. The
+        # like-for-like pure-encode ratio is reported separately.
+        "vs_baseline": round(headline / AVX2_BASELINE_GBPS, 3),
+        "vs_baseline_encode_only": round(encode_only / AVX2_BASELINE_GBPS, 3),
+        # Normalization for cross-round comparability: e2e numbers are
+        # memory-bandwidth-bound, and the bench hosts' memcpy varies
+        # >2x day to day; value/memcpy cancels the host weather.
+        "value_per_memcpy": round(headline / memcpy_gbps, 3),
         "engine": engine,
         "encode_only_gbps": round(encode_only, 3),
         "host_memcpy_gbps": round(memcpy_gbps, 2),
         "cpu_count": os.cpu_count(),
         "configs": configs,
+        # Per-stage serial decomposition of PUT: the e2e number is the
+        # harmonic composition of these (model_put_gbps); md5 (the S3
+        # ETag contract) is the dominant serial stage on 1-core hosts.
+        "put_stages": stages,
+        # The device engine beats the native host engine when the
+        # attachment's H2D bandwidth exceeds the native host-fed rate;
+        # see device.h2d_gbps for what this attachment provides.
+        "device_crossover_h2d_gbps": round(headline, 3),
         "baseline_estimated": True,
     }
     try:

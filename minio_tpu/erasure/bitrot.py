@@ -203,6 +203,11 @@ class StreamingBitrotReader:
     xl.meta buffer, or a remote storage stream plugs in.
     """
 
+    # Set by the caller when the underlying stream is a local file /
+    # in-memory buffer: the ParallelReader runs local reads inline on
+    # single-core hosts instead of paying pool-dispatch overhead.
+    local = False
+
     def __init__(self, open_stream, till_offset: int, shard_size: int,
                  algo: BitrotAlgorithm = BitrotAlgorithm.HIGHWAYHASH256S):
         self._open = open_stream
@@ -236,6 +241,68 @@ class StreamingBitrotReader:
             )
         self._curr += length
         return buf
+
+    def read_chunks(self, offset: int, lengths: list[int]) -> list:
+        """Read + verify several consecutive chunks in ONE underlying read
+        and (when native) ONE verify call — the batched read path that
+        amortizes the per-chunk Python/syscall cost of read_at across a
+        whole batch of blocks. Returns a list of memoryviews, one per
+        requested chunk length."""
+        if not lengths:
+            return []
+        if offset % self._shard_size != 0:
+            raise ValueError("offset must be shard-aligned")
+        if self._rc is None:
+            self._curr = offset
+            stream_off = bitrot_stream_offset(offset, self._shard_size, self._algo)
+            self._rc = self._open(stream_off, self._till - stream_off)
+        if offset != self._curr:
+            raise ValueError("non-sequential bitrot read")
+        ds = self._algo.digest_size
+        phys = sum(lengths) + ds * len(lengths)
+        raw = self._rc.read(phys)
+        if len(raw) != phys:
+            raise ErrFileCorrupt("short framed read")
+        from .. import native as _native
+
+        lib = _native.load()
+        mv = memoryview(raw)
+        out = []
+        if (lib is not None
+                and self._algo is BitrotAlgorithm.HIGHWAYHASH256S
+                and all(ln == self._shard_size for ln in lengths[:-1])):
+            # One native pass verifies every frame (chunk lengths in the
+            # physical layout are shard_size except a trailing short one —
+            # exactly hh256_verify_frames' framing contract).
+            import ctypes
+
+            import numpy as np
+
+            arr = np.frombuffer(raw, dtype=np.uint8)
+            bad = lib.hh256_verify_frames(
+                highwayhash.MAGIC_KEY,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                phys, self._shard_size,
+            )
+            if bad >= 0:
+                raise ErrFileCorrupt(f"streaming bitrot mismatch chunk {bad}")
+            off = 0
+            for ln in lengths:
+                out.append(mv[off + ds: off + ds + ln])
+                off += ds + ln
+        else:
+            off = 0
+            for ln in lengths:
+                hash_want = bytes(mv[off: off + ds])
+                chunk = mv[off + ds: off + ds + ln]
+                h = self._algo.new()
+                h.update(chunk)
+                if h.digest() != hash_want:
+                    raise ErrFileCorrupt("streaming bitrot mismatch")
+                out.append(chunk)
+                off += ds + ln
+        self._curr += sum(lengths)
+        return out
 
     def close(self):
         if self._rc is not None and hasattr(self._rc, "close"):

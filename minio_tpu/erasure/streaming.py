@@ -37,6 +37,9 @@ from .codec import Erasure
 # a pool keeps Python thread churn bounded).
 _io_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-io")
 
+from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
+from ..utils.fanout import is_local_sink as _is_local_sink
+
 
 class ParallelWriter:
     """Write shard blocks to k+m writers in parallel, tolerating failures
@@ -64,12 +67,25 @@ class ParallelWriter:
                 self.errs[i] = exc
                 self.writers[i] = None
 
+        self._fanout(do)
+
+    def _fanout(self, do):
+        """Dispatch do(i) across writers: remote sinks through the pool,
+        local sinks inline on single-core hosts (fanout cost > overlap
+        gain there)."""
         futures = []
+        inline = []
         for i in range(len(self.writers)):
-            if self.writers[i] is None:
+            w = self.writers[i]
+            if w is None:
                 self.errs[i] = ErrDiskNotFound(f"writer {i}")
                 continue
-            futures.append(_io_pool.submit(do, i))
+            if _SINGLE_CORE and _is_local_sink(getattr(w, "_sink", w)):
+                inline.append(i)
+            else:
+                futures.append(_io_pool.submit(do, i))
+        for i in inline:
+            do(i)
         for f in futures:
             f.result()
 
@@ -102,23 +118,7 @@ class ParallelWriter:
                 self.errs[i] = exc
                 self.writers[i] = None
 
-        futures = []
-        for i in range(len(self.writers)):
-            if self.writers[i] is None:
-                self.errs[i] = ErrDiskNotFound(f"writer {i}")
-                continue
-            futures.append(_io_pool.submit(do, i))
-        for f in futures:
-            f.result()
-
-        nil_count = sum(1 for e in self.errs if e is None)
-        if nil_count >= self.write_quorum:
-            return
-        err = reduce_write_quorum_errs(
-            self.errs, OBJECT_OP_IGNORED_ERRS, self.write_quorum
-        )
-        if err is not None:
-            raise err
+        self._fanout(do)
 
 
 def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
@@ -331,6 +331,12 @@ class ParallelReader:
     can kick off heal, exactly like the reference's bitrotHeal /
     missingPartsHeal flags."""
 
+    # Blocks fetched per fan-out: one read_chunks + one verify call per
+    # reader covers BATCH_BLOCKS blocks, amortizing the per-block task
+    # dispatch and file-read cost (the reference amortizes differently —
+    # goroutines are ~free; Python's are not).
+    BATCH_BLOCKS = 8
+
     def __init__(self, readers: list, erasure: Erasure, offset: int, total_length: int):
         self.readers = list(readers)
         self.org_readers = readers
@@ -342,6 +348,8 @@ class ParallelReader:
         self.reader_to_buf = list(range(len(readers)))
         self.saw_missing = False
         self.saw_corrupt = False
+        self._queue: list = []  # prefetched per-block buf lists
+        self._blocks_wanted = None  # caller hint: don't prefetch past it
 
     def prefer_readers(self, prefer: list[bool]):
         """Move preferred (typically local) readers to the front
@@ -363,20 +371,41 @@ class ParallelReader:
         self.readers = readers
         self.reader_to_buf = r2b
 
+    def set_blocks_wanted(self, n: int):
+        """Bound prefetching to the caller's remaining block count so a
+        small range-GET never reads batch-extra chunks."""
+        self._blocks_wanted = n
+
     def read(self) -> list:
         """One block's worth: returns newBuf list (len n) with >= dataBlocks
-        filled entries, or raises quorum error."""
-        shard = self.shard_size
-        if self.offset + shard > self.shard_file_size:
-            shard = self.shard_file_size - self.offset
-        new_buf: list = [None] * len(self.readers)
-        if shard == 0:
-            return new_buf
+        filled entries, or raises quorum error. Internally fetches
+        BATCH_BLOCKS blocks per reader fan-out."""
+        if not self._queue:
+            self._fetch_batch()
+        return self._queue.pop(0)
+
+    def _fetch_batch(self):
+        # Per-block chunk lengths for this batch (tail chunk is short).
+        n_max = self.BATCH_BLOCKS
+        if self._blocks_wanted is not None:
+            n_max = max(1, min(n_max, self._blocks_wanted))
+        lengths: list[int] = []
+        off = self.offset
+        for _ in range(n_max):
+            shard = min(self.shard_size, self.shard_file_size - off)
+            if shard <= 0:
+                break
+            lengths.append(shard)
+            off += shard
+        if not lengths:
+            self._queue.append([None] * len(self.readers))
+            return
 
         import threading
 
         lock = threading.Lock()
-        state = {"next": 0, "filled": 0}
+        results: dict[int, list] = {}  # buf_idx -> per-block chunks
+        state = {"next": 0}
 
         def try_next() -> int | None:
             with lock:
@@ -394,7 +423,7 @@ class ParallelReader:
                     continue
                 buf_idx = self.reader_to_buf[i]
                 try:
-                    buf = rr.read_at(self.offset, shard)
+                    chunks = rr.read_chunks(self.offset, lengths)
                 except Exception as exc:  # noqa: BLE001 - classified below
                     if isinstance(exc, ErrFileNotFound):
                         self.saw_missing = True
@@ -406,35 +435,45 @@ class ParallelReader:
                     i = try_next()
                     continue
                 with lock:
-                    new_buf[buf_idx] = buf
-                    state["filled"] += 1
+                    results[buf_idx] = chunks
                 return
 
-        futures = []
+        first = []
         for _ in range(self.data_blocks):
             i = try_next()
             if i is not None:
-                futures.append(_io_pool.submit(run, i))
-        for f in futures:
-            f.result()
+                first.append(i)
+        if _SINGLE_CORE and all(
+            getattr(self.readers[i], "local", False) for i in first
+        ):
+            for i in first:
+                run(i)
+        else:
+            futures = [_io_pool.submit(run, i) for i in first]
+            for f in futures:
+                f.result()
 
         # Late escalation: if concurrent failures left us short but readers
         # remain untried, keep going serially.
-        while (
-            sum(1 for b in new_buf if b is not None) < self.data_blocks
-            and state["next"] < len(self.readers)
-        ):
+        while len(results) < self.data_blocks and state["next"] < len(self.readers):
             i = try_next()
             if i is not None:
                 run(i)
 
-        if sum(1 for b in new_buf if b is not None) >= self.data_blocks:
-            self.offset += shard
-            return new_buf
-        err = reduce_read_quorum_errs(
-            self.errs, OBJECT_OP_IGNORED_ERRS, self.data_blocks
-        )
-        raise err if err else ErrErasureReadQuorum()
+        if len(results) < self.data_blocks:
+            err = reduce_read_quorum_errs(
+                self.errs, OBJECT_OP_IGNORED_ERRS, self.data_blocks
+            )
+            raise err if err else ErrErasureReadQuorum()
+
+        for t in range(len(lengths)):
+            new_buf: list = [None] * len(self.org_readers)
+            for buf_idx, chunks in results.items():
+                new_buf[buf_idx] = chunks[t]
+            self._queue.append(new_buf)
+        self.offset += sum(lengths)
+        if self._blocks_wanted is not None:
+            self._blocks_wanted -= len(lengths)
 
 
 def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
@@ -460,6 +499,13 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     block_size = erasure.block_size
     start_block = offset // block_size
     end_block = (offset + length) // block_size
+    # Exact number of blocks the loop below will consume (the end block
+    # contributes none when the range ends on a block boundary) — bounds
+    # the reader's prefetch so a small range-GET reads no extra chunks.
+    n_reads = end_block - start_block + 1
+    if end_block > start_block and (offset + length) % block_size == 0:
+        n_reads -= 1
+    reader.set_blocks_wanted(n_reads)
 
     bytes_written = 0
     heal_hint: Exception | None = None
@@ -518,7 +564,10 @@ def _write_data_blocks(dst, blocks: list, data_blocks: int,
         offset = 0
         if write < len(chunk):
             chunk = chunk[:write]
-        dst.write(bytes(chunk))
+        # memoryview straight through — a bytes() copy here is a full
+        # extra pass over every GET byte; all sinks (sockets, files,
+        # transform writers) accept the buffer protocol.
+        dst.write(chunk)
         written += len(chunk)
         write -= len(chunk)
         if write <= 0:

@@ -227,3 +227,26 @@ void hh256_frame(const uint8_t *key32, const uint8_t *data, size_t len,
         off += c;
     }
 }
+
+/* Verify a physical [H(chunk)||chunk]* region in one call — the read-side
+ * twin of hh256_frame (cmd/bitrot-streaming.go:152-168 verifies chunk by
+ * chunk; doing all chunks per file read removes the per-chunk Python
+ * round-trip from GET/heal). `len` is the PHYSICAL length (frames
+ * included); every chunk is `chunk` bytes except a final short one.
+ * Returns -1 when every chunk verifies, else the index of the first bad
+ * or truncated chunk. */
+int64_t hh256_verify_frames(const uint8_t *key32, const uint8_t *framed,
+                            size_t len, size_t chunk) {
+    uint8_t got[32];
+    size_t off = 0;
+    int64_t idx = 0;
+    while (off < len) {
+        if (len - off <= 32) return idx; /* truncated frame */
+        size_t c = len - off - 32 < chunk ? len - off - 32 : chunk;
+        hh256_hash(key32, framed + off + 32, c, got);
+        if (memcmp(got, framed + off, 32) != 0) return idx;
+        off += 32 + c;
+        idx++;
+    }
+    return -1;
+}

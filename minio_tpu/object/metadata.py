@@ -50,7 +50,9 @@ def read_all_file_info(disks: list, bucket: str, object_: str,
         except Exception as exc:  # noqa: BLE001 - collected for quorum
             errs[i] = exc
 
-    list(_meta_pool.map(do, range(len(disks))))
+    from .erasure_objects import _fanout
+
+    _fanout(do, len(disks), disks)
     return fis, errs
 
 

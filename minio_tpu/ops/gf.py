@@ -165,6 +165,24 @@ def reconstruct_matrix(
     present: list[int],
     targets: list[int],
 ) -> np.ndarray:
+    """Cached front-end: a heal/degraded-read of an N-block part asks for
+    the SAME (present, targets) matrix N times; the inversion costs
+    ~0.6 ms a call, which dominated heal throughput before caching."""
+    return _reconstruct_matrix_cached(
+        data_shards, parity_shards, tuple(present), tuple(targets)
+    )
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=256)
+def _reconstruct_matrix_cached(
+    data_shards: int,
+    parity_shards: int,
+    present: tuple,
+    targets: tuple,
+) -> np.ndarray:
     """Byte matrix mapping k chosen present shards to the target shards.
 
     `present` must list >= k available shard indices (data first is not
@@ -178,7 +196,7 @@ def reconstruct_matrix(
     k = data_shards
     if len(present) < k:
         raise ValueError("need at least dataShards present shards")
-    rows = present[:k]
+    rows = list(present[:k])
     full = rs_matrix(data_shards, parity_shards)
     sub = full[rows]  # [k, k]
     inv = gf_mat_inv(sub)  # present -> original data

@@ -52,11 +52,9 @@ def _nibble_tables(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
     """tables[r][k][2][16]: T_lo[n]=c*n, T_hi[n]=c*(n<<4) per coefficient."""
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
     tables = np.empty((r, k, 2, 16), dtype=np.uint8)
-    for rr in range(r):
-        for j in range(k):
-            c = int(mat[rr, j])
-            tables[rr, j, 0] = [gf.gf_mul(c, x) for x in range(16)]
-            tables[rr, j, 1] = [gf.gf_mul(c, x << 4) for x in range(16)]
+    nib = np.arange(16, dtype=np.uint8)
+    tables[:, :, 0, :] = gf.gf_mul(mat[:, :, None], nib[None, None, :])
+    tables[:, :, 1, :] = gf.gf_mul(mat[:, :, None], (nib << 4)[None, None, :])
     return np.ascontiguousarray(tables)
 
 
@@ -71,19 +69,18 @@ def _affine_qwords(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
     (out_bit p = XOR_q B[p][q]*in_bit[q]) packed LSB-first.
     """
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
-    out = np.empty((r, k), dtype=np.uint64)
-    for rr in range(r):
-        for j in range(k):
-            c = int(mat[rr, j])
-            q = 0
-            for p in range(8):
-                # Row p: bit q set iff bit p of c*(1<<q) is set.
-                row = 0
-                for b in range(8):
-                    if (gf.gf_mul(c, 1 << b) >> p) & 1:
-                        row |= 1 << b
-                q |= row << (8 * (7 - p))
-            out[rr, j] = np.uint64(q)
+    # prods[q] = c * (1 << q): row p of the bit matrix has bit q set iff
+    # bit p of prods[q] is set. Vectorized over every coefficient at once
+    # (the scalar triple loop cost ~12 ms per new matrix — paid on every
+    # first heal/degraded-read with a fresh survivor pattern).
+    shifts = (np.uint8(1) << np.arange(8, dtype=np.uint8))
+    prods = gf.gf_mul(mat[None, :, :], shifts[:, None, None]).astype(np.uint64)
+    out = np.zeros((r, k), dtype=np.uint64)
+    for p in range(8):
+        row = np.zeros((r, k), dtype=np.uint64)
+        for q in range(8):
+            row |= ((prods[q] >> np.uint64(p)) & np.uint64(1)) << np.uint64(q)
+        out |= row << np.uint64(8 * (7 - p))
     return np.ascontiguousarray(out)
 
 

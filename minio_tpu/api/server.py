@@ -299,7 +299,7 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
                  sse_config=None, quota=None, tier_engine=None,
-                 tiers=None, logger=None):
+                 tiers=None, logger=None, tls=None):
         from ..replication import ReplicationPool
 
         self.repl_pool = ReplicationPool(
@@ -358,15 +358,32 @@ class S3Server:
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
             do_OPTIONS = _dispatch
 
+        from ..utils import certs as _certs
+
+        self.tls = tls if tls is not None else _certs.global_tls()
+
         class _Server(ThreadingHTTPServer):
+            def finish_request(self, request, client_address):
+                # TLS handshake in the handler thread, never the accept
+                # loop (one slow/hostile client must not stall the S3
+                # plane; ref cmd/http/server.go per-conn tls.Server).
+                if outer.tls is not None:
+                    request = outer.tls.server_context.wrap_socket(
+                        request, server_side=True
+                    )
+                super().finish_request(request, client_address)
+
             def handle_error(self, request, client_address):
+                import ssl as _ssl
                 import sys as _sys
 
                 # Aborted client connections (downloads cancelled, race
-                # severs) are routine — no stderr tracebacks for them.
+                # severs) are routine — no stderr tracebacks for them;
+                # ditto TLS handshake failures from plaintext probes.
                 exc = _sys.exc_info()[1]
                 if isinstance(exc, (ConnectionResetError,
-                                    BrokenPipeError, TimeoutError)):
+                                    BrokenPipeError, TimeoutError,
+                                    _ssl.SSLError)):
                     return
                 super().handle_error(request, client_address)
 

@@ -34,6 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
              "topologies with http:// endpoints (peer plane binds "
              "PORT+1)",
     )
+    srv.add_argument(
+        "--certs-dir", default=None, metavar="DIR",
+        help="directory holding public.crt + private.key; serves every "
+             "plane (S3 + storage/lock/peer RPC) over TLS with hot cert "
+             "reload (also via MTPU_CERTS_DIR)",
+    )
     srv.add_argument("--quiet", action="store_true")
     return p
 
@@ -47,10 +53,12 @@ def main(argv: list[str] | None = None) -> int:
             args.endpoints, address=args.address, port=args.port,
             fs_mode=args.fs, set_drive_count=args.set_drive_count,
             storage_address=args.storage_address,
+            certs_dir=args.certs_dir,
         ).start()
         if not args.quiet:
+            scheme = "https" if server.cert_manager is not None else "http"
             print(f"minio-tpu {server.mode} mode")
-            print(f"S3 endpoint: http://{server.endpoint}")
+            print(f"S3 endpoint: {scheme}://{server.endpoint}")
             print(f"RootUser: {server.root_user}")
         try:
             action = server.wait()

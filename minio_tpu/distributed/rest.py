@@ -68,9 +68,16 @@ class RPCServer:
     """
 
     def __init__(self, prefix: str, secret: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, tls=None):
+        from ..utils import certs as _certs
+
         self.prefix = prefix.rstrip("/")
         self.secret = secret
+        # TLS: explicit manager, else the process-global one (set at
+        # server boot) so every RPC plane upgrades together — bearer
+        # secrets must never cross the wire in the clear when the
+        # deployment has certs (ref cmd/server-main.go:431-433).
+        self.tls = tls if tls is not None else _certs.global_tls()
         self._methods: dict = {}
         # Live connection sockets, so stop() can sever keep-alive peers —
         # shutdown() alone leaves pooled client connections being served
@@ -99,14 +106,28 @@ class RPCServer:
                 outer._handle(self)
 
         class _Server(ThreadingHTTPServer):
+            def finish_request(self, request, client_address):
+                # Per-connection TLS wrap in the HANDLER thread: wrapping
+                # the listening socket would run handshakes in the accept
+                # loop, letting one slow client stall every plane peer.
+                if outer.tls is not None:
+                    request = outer.tls.server_context.wrap_socket(
+                        request, server_side=True
+                    )
+                super().finish_request(request, client_address)
+
             def handle_error(self, request, client_address):
+                import ssl as _ssl
                 import sys as _sys
 
                 # Client resets/disconnects during node outages are
-                # routine — never spray tracebacks to stderr for them.
+                # routine — never spray tracebacks to stderr for them;
+                # ditto handshake failures from port scanners /
+                # plaintext probes of a TLS plane.
                 exc = _sys.exc_info()[1]
                 if isinstance(exc, (ConnectionResetError,
-                                    BrokenPipeError, TimeoutError)):
+                                    BrokenPipeError, TimeoutError,
+                                    _ssl.SSLError)):
                     return
                 super().handle_error(request, client_address)
 
@@ -224,6 +245,13 @@ class RPCClient:
         with self._lock:
             if self._pool:
                 return self._pool.pop()
+        from ..utils import certs as _certs
+
+        ctx = _certs.client_ssl_context()
+        if ctx is not None:
+            return http.client.HTTPSConnection(
+                self.endpoint_str, timeout=self.timeout, context=ctx
+            )
         return http.client.HTTPConnection(
             self.endpoint_str, timeout=self.timeout
         )

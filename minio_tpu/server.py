@@ -94,9 +94,30 @@ class Server:
                  root_password: str | None = None, fs_mode: bool = False,
                  set_drive_count: int | None = None,
                  enable_scanner: bool = True,
-                 storage_address: str | None = None):
+                 storage_address: str | None = None,
+                 certs_dir: str | None = None):
         erasure_self_test()
         bitrot_self_test()
+        # --- TLS first: every plane (S3, storage, lock, peer) binds
+        # after this, and the RPC clients consult the global manager, so
+        # certs must be live before any listener or dial exists
+        # (ref cmd/server-main.go:431-433 getTLSConfig before newAllSubsystems).
+        from .utils import certs as certs_mod
+
+        self.cert_manager = None
+        certs_dir = certs_dir or os.environ.get("MTPU_CERTS_DIR")
+        if certs_dir:
+            pair = certs_mod.find_certs(certs_dir)
+            if pair is None:
+                # An explicitly requested TLS dir with no usable pair
+                # must fail loudly — silently serving the RPC planes'
+                # bearer secrets in plaintext is the worst outcome.
+                raise ValueError(
+                    f"--certs-dir {certs_dir!r}: public.crt/private.key "
+                    "not found"
+                )
+            self.cert_manager = certs_mod.CertManager(*pair).start_watcher()
+            certs_mod.set_global_tls(self.cert_manager)
         self.root_user = root_user or os.environ.get(
             "MTPU_ROOT_USER", "minioadmin"
         )
@@ -556,6 +577,12 @@ class Server:
             self.lock_server.stop()
         if self.storage_server is not None:
             self.storage_server.stop()
+        if self.cert_manager is not None:
+            from .utils import certs as certs_mod
+
+            self.cert_manager.stop()
+            if certs_mod.global_tls() is self.cert_manager:
+                certs_mod.set_global_tls(None)
 
     @property
     def endpoint(self) -> str:

@@ -1,0 +1,205 @@
+"""TLS certificate management: hot-reloading server/client SSL contexts
+for all four wire planes (S3 + storage/lock/peer RPC) — the equivalent of
+the reference's pkg/certs (/root/reference/pkg/certs/certs.go:1), which
+watches cert files and serves the fresh chain to new handshakes via
+GetCertificate, wired at cmd/server-main.go:431-433.
+
+Python shape: ONE long-lived ssl.SSLContext per direction; a poll thread
+re-runs load_cert_chain on the live context when the files change, so
+new handshakes pick up rotated certs without rebinding any listener
+(OpenSSL applies a context's cert chain at handshake time). The
+reference uses fsnotify; a 1 s mtime poll is equivalent for rotation
+frequencies that matter (certbot renews daily at most).
+
+A process-wide singleton mirrors the reference's globalIsTLS: the RPC
+clients (distributed/rest.py) consult it so every intra-cluster dial
+upgrades to HTTPS the moment the server boots with certs.
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+import threading
+
+
+class CertManager:
+    """Load + hot-reload one cert/key pair; hand out live contexts."""
+
+    def __init__(self, cert_file: str, key_file: str,
+                 ca_file: str | None = None, poll_interval: float = 1.0):
+        self.cert_file = cert_file
+        self.key_file = key_file
+        # Trust roots for *client-side* verification of peers. A
+        # self-signed deployment points this at the cert itself
+        # (the reference trusts ~/.minio/certs/CAs the same way).
+        self.ca_file = ca_file or cert_file
+        self.poll_interval = poll_interval
+        self._server_ctx = self._build_server_ctx()
+        self._client_ctx = self._build_client_ctx()
+        self._mtimes = self._stat()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.reloads = 0
+
+    def _build_server_ctx(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        return ctx
+
+    def _build_client_ctx(self) -> ssl.SSLContext:
+        ctx = ssl.create_default_context(cafile=self.ca_file)
+        # When ca_file defaults to the server's own cert, a CA-issued
+        # deployment trusts a LEAF, not a root — allow partial-chain
+        # verification so that works on 3.12 (3.13 defaults it on).
+        # Cluster planes dial nodes by IP/host from the endpoint list;
+        # the certs carry those names as SANs, so hostname verification
+        # stays ON.
+        ctx.verify_flags |= ssl.VERIFY_X509_PARTIAL_CHAIN
+        return ctx
+
+    def _stat(self):
+        out = []
+        for p in (self.cert_file, self.key_file):
+            try:
+                out.append(os.stat(p).st_mtime_ns)
+            except OSError:
+                out.append(0)
+        return out
+
+    @property
+    def server_context(self) -> ssl.SSLContext:
+        return self._server_ctx
+
+    @property
+    def client_context(self) -> ssl.SSLContext:
+        return self._client_ctx
+
+    def maybe_reload(self) -> bool:
+        """Swap in FRESH contexts if the files changed. New handshakes
+        (which read self._server_ctx per connection) pick up the new
+        chain; in-flight handshakes keep their old context object —
+        mutating a live SSL_CTX under concurrent handshakes is an
+        OpenSSL data race. Load failures (mid-rotation partial writes)
+        keep the previous contexts serving."""
+        cur = self._stat()
+        if cur == self._mtimes:
+            return False
+        try:
+            server_ctx = self._build_server_ctx()
+            client_ctx = self._build_client_ctx()
+        except (OSError, ssl.SSLError):
+            return False
+        self._server_ctx = server_ctx
+        self._client_ctx = client_ctx
+        self._mtimes = cur
+        self.reloads += 1
+        return True
+
+    def start_watcher(self) -> "CertManager":
+        if self._thread is not None:
+            return self
+
+        def watch():
+            while not self._stop.wait(self.poll_interval):
+                self.maybe_reload()
+
+        self._thread = threading.Thread(
+            target=watch, daemon=True, name="mtpu-cert-watch"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+# --- process-wide TLS state (the reference's globalIsTLS) ---
+
+_global: CertManager | None = None
+
+
+def set_global_tls(mgr: CertManager | None):
+    global _global
+    _global = mgr
+
+
+def global_tls() -> CertManager | None:
+    return _global
+
+
+def client_ssl_context() -> ssl.SSLContext | None:
+    """What intra-cluster RPC clients pass to HTTPSConnection; None in a
+    plaintext deployment."""
+    return _global.client_context if _global is not None else None
+
+
+def find_certs(certs_dir: str) -> tuple[str, str] | None:
+    """MinIO's layout: <certs_dir>/public.crt + private.key
+    (ref cmd/common-main.go getTLSConfig)."""
+    cert = os.path.join(certs_dir, "public.crt")
+    key = os.path.join(certs_dir, "private.key")
+    if os.path.isfile(cert) and os.path.isfile(key):
+        return cert, key
+    return None
+
+
+def generate_self_signed(certs_dir: str, hosts: list[str] | None = None,
+                         valid_days: int = 365) -> tuple[str, str]:
+    """Write a self-signed public.crt/private.key covering `hosts`
+    (DNS or IP SANs) — the dev/test bootstrap path (the reference ships
+    docs/tls/kubernetes generators; operators bring real certs)."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    hosts = hosts or ["127.0.0.1", "localhost"]
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "minio-tpu")]
+    )
+    sans = []
+    for h in hosts:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=valid_days))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None), critical=True
+        )
+        .sign(key, hashes.SHA256())
+    )
+    os.makedirs(certs_dir, exist_ok=True)
+    cert_file = os.path.join(certs_dir, "public.crt")
+    key_file = os.path.join(certs_dir, "private.key")
+    # Write-then-rename so a watcher never loads a half-written pair.
+    for path, data in (
+        (cert_file, cert.public_bytes(serialization.Encoding.PEM)),
+        (key_file, key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )),
+    ):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    return cert_file, key_file

@@ -35,6 +35,7 @@ class SelectRequest:
     record_delimiter: str = "\n"
     quote_character: str = '"'
     json_type: str = "LINES"           # LINES | DOCUMENT
+    compression_type: str = "NONE"     # NONE | GZIP | BZIP2
     output_format: str = "csv"
     output_field_delimiter: str = ","
     output_record_delimiter: str = "\n"
@@ -77,6 +78,15 @@ class SelectRequest:
                     req.record_delimiter = el.text or "\n"
                 elif tag == "QuoteCharacter":
                     req.quote_character = el.text or '"'
+                elif tag == "CompressionType":
+                    req.compression_type = (el.text or "NONE").upper()
+        if req.compression_type not in ("NONE", "GZIP", "BZIP2"):
+            # ref pkg/s3select/select.go:54-60 (gzip/bzip2 only)
+            raise SQLError(
+                f"unsupported CompressionType {req.compression_type!r}"
+            )
+        if req.compression_type != "NONE" and req.input_format == "parquet":
+            raise SQLError("Parquet input cannot be compressed")
         outser = find("OutputSerialization")
         if outser is not None:
             for el in outser.iter():
@@ -93,10 +103,18 @@ class SelectRequest:
 @dataclass
 class _Batch:
     """One decoded batch: column name -> object ndarray of strings
-    (None = missing/null). Positional _N names always present for CSV."""
+    (None = missing/null). Positional _N names always present for CSV.
+    `records` (JSON/Parquet, only when the query references nested
+    paths) keeps the RAW decoded rows so a.b[0].c paths resolve against
+    real structure instead of flattened strings."""
 
     columns: dict
     n: int
+    records: list | None = None
+    # Resolved-path arrays cache here, NOT in columns: SELECT * derives
+    # its output from columns, and a WHERE-resolved path must not
+    # surface as a synthetic extra output column.
+    path_cache: dict = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -138,13 +156,13 @@ def _rows_to_batch(rows: list[list[str]], header: list[str] | None) -> _Batch:
     return _Batch(columns=cols, n=len(rows))
 
 
-def _json_batches(stream, req: SelectRequest):
+def _json_batches(stream, req: SelectRequest, keep_records: bool = False):
     text = io.TextIOWrapper(stream, encoding="utf-8")
     records: list[dict] = []
     if req.json_type == "DOCUMENT":
         doc = json.load(text)
         records = doc if isinstance(doc, list) else [doc]
-        yield from _dicts_to_batches(records)
+        yield from _dicts_to_batches(records, keep_records)
         return
     batch: list[dict] = []
     for line in text:
@@ -154,13 +172,13 @@ def _json_batches(stream, req: SelectRequest):
         obj = json.loads(line)
         batch.append(obj if isinstance(obj, dict) else {"_1": obj})
         if len(batch) >= BATCH_ROWS:
-            yield from _dicts_to_batches(batch)
+            yield from _dicts_to_batches(batch, keep_records)
             batch = []
     if batch:
-        yield from _dicts_to_batches(batch)
+        yield from _dicts_to_batches(batch, keep_records)
 
 
-def _dicts_to_batches(records: list[dict]):
+def _dicts_to_batches(records: list[dict], keep_records: bool = False):
     keys: list[str] = []
     for r in records:
         for k in r:
@@ -172,10 +190,11 @@ def _dicts_to_batches(records: list[dict]):
         cols[k] = np.array(
             [_jsonval(r.get(k)) for r in lowered], dtype=object
         )
-    yield _Batch(columns=cols, n=len(records))
+    yield _Batch(columns=cols, n=len(records),
+                 records=lowered if keep_records else None)
 
 
-def _parquet_batches(stream, req: SelectRequest):
+def _parquet_batches(stream, req: SelectRequest, keep_records: bool = False):
     """Columnar Parquet input (ref pkg/s3select/parquet + the vendored
     internal/parquet-go reader). Arrow does the decode; values are
     stringified into the same object-array batches the CSV/JSON readers
@@ -192,11 +211,16 @@ def _parquet_batches(stream, req: SelectRequest):
         raise SQLError(f"malformed Parquet input: {exc}") from exc
     for rb in pf.iter_batches(batch_size=BATCH_ROWS):
         cols = {}
-        for name, col in zip(rb.schema.names, rb.columns):
-            cols[name.lower()] = np.array(
-                [_parquetval(v) for v in col.to_pylist()], dtype=object
+        names_l = [n.lower() for n in rb.schema.names]
+        pylists = [col.to_pylist() for col in rb.columns]
+        for name, vals in zip(names_l, pylists):
+            cols[name] = np.array(
+                [_parquetval(v) for v in vals], dtype=object
             )
-        yield _Batch(columns=cols, n=rb.num_rows)
+        recs = None
+        if keep_records and pylists:
+            recs = [dict(zip(names_l, row)) for row in zip(*pylists)]
+        yield _Batch(columns=cols, n=rb.num_rows, records=recs)
 
 
 def _parquetval(v):
@@ -225,11 +249,71 @@ def _jsonval(v):
 # vectorized evaluation
 # ---------------------------------------------------------------------------
 
+_PATH_PART_RE = re.compile(r"^([^\[\]]+)((?:\[\d+\])*)$")
+_PATH_IDX_RE = re.compile(r"\[(\d+)\]")
+
+
+def _path_tokens(name: str) -> list | None:
+    """'a.b[0].c' -> [('k','a'),('k','b'),('i',0),('k','c')]; None when
+    the name is not a path (plain column)."""
+    if "." not in name and "[" not in name:
+        return None
+    toks: list = []
+    for part in name.split("."):
+        m = _PATH_PART_RE.match(part)
+        if m is None:
+            return None
+        toks.append(("k", m.group(1)))
+        for idx in _PATH_IDX_RE.findall(m.group(2)):
+            toks.append(("i", int(idx)))
+    return toks
+
+
+_MISSING = object()
+
+
+def _resolve_path(rec, toks):
+    cur = rec
+    for kind, v in toks:
+        if kind == "k":
+            if not isinstance(cur, dict):
+                return None
+            nxt = cur.get(v, _MISSING)
+            if nxt is _MISSING:
+                # Nested keys keep their original case; match
+                # case-insensitively like the top-level columns.
+                for k2, val in cur.items():
+                    if isinstance(k2, str) and k2.lower() == v:
+                        nxt = val
+                        break
+                else:
+                    return None
+            cur = nxt
+        else:
+            if not isinstance(cur, list) or v >= len(cur):
+                return None
+            cur = cur[v]
+    return cur
+
+
 def _col(batch: _Batch, name: str) -> np.ndarray:
     arr = batch.columns.get(name)
-    if arr is None:
-        return np.full(batch.n, None, dtype=object)
-    return arr
+    if arr is not None:
+        return arr
+    arr = batch.path_cache.get(name)
+    if arr is not None:
+        return arr
+    toks = _path_tokens(name)
+    if toks is not None and batch.records is not None:
+        # Nested JSON path (ref pkg/s3select/sql/jsonpath.go:34):
+        # resolve against the raw rows once per batch.
+        arr = np.array(
+            [_jsonval(_resolve_path(r, toks)) for r in batch.records],
+            dtype=object,
+        )
+        batch.path_cache[name] = arr
+        return arr
+    return np.full(batch.n, None, dtype=object)
 
 
 def _as_float(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -253,20 +337,200 @@ _CMP_NUM = {
 }
 
 
+# ---- scalar functions (ref pkg/s3select/sql/funceval.go:37-69,
+# stringfuncs.go, timestampfuncs.go) ----
+
+_TS_FORMATS = (
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y-%m-%dT%H:%MZ", "%Y-%m-%dT%H:%M",
+)
+
+
+def _parse_ts(v: str):
+    import datetime as _dt
+
+    s = v.strip()
+    for fmt in _TS_FORMATS:
+        try:
+            t = _dt.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=_dt.timezone.utc)
+        return t
+    raise SQLError(f"TO_TIMESTAMP: unparseable {v!r}")
+
+
+def _fmt_ts(t) -> str:
+    s = t.isoformat()
+    return s.replace("+00:00", "Z")
+
+
+def _scalar_fn_values(term, batch: _Batch) -> tuple[np.ndarray, str]:
+    """Evaluate ("fn", name, args) over a batch; returns (object array,
+    type hint 'num'|'str'|'any')."""
+    _, name, args = term
+
+    def vals(a):
+        return _eval_values(a, batch)[0]
+
+    n = batch.n
+    if name == "utcnow":
+        import datetime as _dt
+
+        now = _fmt_ts(
+            _dt.datetime.now(_dt.timezone.utc).replace(microsecond=0)
+        )
+        return np.full(n, now, dtype=object), "str"
+    if name == "cast":
+        src = vals(args[0])
+        typ = args[1][1]
+        out = np.empty(n, dtype=object)
+        for i, v in enumerate(src):
+            if v is None:
+                out[i] = None
+                continue
+            try:
+                if typ == "int":
+                    out[i] = int(float(v))
+                elif typ == "float":
+                    out[i] = float(v)
+                elif typ == "string":
+                    out[i] = str(v)
+                elif typ == "bool":
+                    s = str(v).strip().lower()
+                    if s in ("true", "1"):
+                        out[i] = "true"
+                    elif s in ("false", "0"):
+                        out[i] = "false"
+                    else:
+                        raise ValueError(s)
+                else:  # timestamp
+                    out[i] = _fmt_ts(_parse_ts(str(v)))
+            except (TypeError, ValueError) as exc:
+                # The reference fails the query on an uncastable value
+                # (sql/funceval.go intCast errors), not silently NULLs.
+                raise SQLError(f"CAST: cannot cast {v!r} to {typ}") from exc
+        return out, ("num" if typ in ("int", "float") else "str")
+    if name == "substring":
+        src = vals(args[0])
+        start = _eval_scalar_int(args[1], batch)
+        length = _eval_scalar_int(args[2], batch) if len(args) > 2 else None
+        out = np.empty(n, dtype=object)
+        for i, v in enumerate(src):
+            if v is None:
+                out[i] = None
+                continue
+            s = str(v)
+            st = start[i]
+            ln = None if length is None else length[i]
+            if st is None or (length is not None and ln is None):
+                out[i] = None
+                continue
+            # SQL semantics: 1-based; start < 1 eats into the length.
+            if ln is None:
+                out[i] = s[max(st - 1, 0):]
+            else:
+                end = st - 1 + ln
+                out[i] = s[max(st - 1, 0): max(end, 0)]
+        return out, "str"
+    if name in ("lower", "upper"):
+        src = vals(args[0])
+        f = str.lower if name == "lower" else str.upper
+        return np.array(
+            [None if v is None else f(str(v)) for v in src], dtype=object
+        ), "str"
+    if name == "char_length":
+        src = vals(args[0])
+        return np.array(
+            [None if v is None else len(str(v)) for v in src], dtype=object
+        ), "num"
+    if name == "trim":
+        src = vals(args[0])
+        mode = args[1][1]
+        chars_arr = vals(args[2]) if args[2][1] is not None else None
+        out = np.empty(n, dtype=object)
+        for i, v in enumerate(src):
+            if v is None:
+                out[i] = None
+                continue
+            s = str(v)
+            ch = None if chars_arr is None else chars_arr[i]
+            if mode == "leading":
+                out[i] = s.lstrip(ch)
+            elif mode == "trailing":
+                out[i] = s.rstrip(ch)
+            else:
+                out[i] = s.strip(ch)
+        return out, "str"
+    if name == "to_timestamp":
+        src = vals(args[0])
+        return np.array(
+            [None if v is None else _fmt_ts(_parse_ts(str(v)))
+             for v in src], dtype=object,
+        ), "str"
+    if name == "coalesce":
+        cols = [vals(a) for a in args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = next(
+                (c[i] for c in cols if c[i] is not None), None
+            )
+        return out, "any"
+    if name == "nullif":
+        a = vals(args[0])
+        b = vals(args[1])
+        return np.array(
+            [None if (a[i] is not None and b[i] is not None
+                      and str(a[i]) == str(b[i])) else a[i]
+             for i in range(n)], dtype=object,
+        ), "any"
+    raise SQLError(f"unsupported function {name!r}")
+
+
+def _eval_scalar_int(term, batch: _Batch) -> list:
+    arr, _ = _eval_values(term, batch)
+    out = []
+    for v in arr:
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            out.append(int(float(v)))
+        except (TypeError, ValueError):
+            raise SQLError(f"expected integer, got {v!r}") from None
+    return out
+
+
+def _eval_values(term, batch: _Batch) -> tuple[np.ndarray, str]:
+    """Any value-producing AST node -> (object array, type hint)."""
+    kind = term[0]
+    if kind == "col":
+        return _col(batch, term[1]), "any"
+    if kind == "lit":
+        v = term[1]
+        hint = ("num" if isinstance(v, (int, float))
+                and not isinstance(v, bool) else "any")
+        return np.full(batch.n, v, dtype=object), hint
+    if kind == "fn":
+        return _scalar_fn_values(term, batch)
+    raise SQLError(f"unsupported operand {kind!r}")
+
+
 def _cmp(op: str, left, right, batch: _Batch) -> np.ndarray:
-    lv = _operand_values(left, batch)
-    rv = _operand_values(right, batch)
-    numeric = (
-        _is_numeric_literal(left) or _is_numeric_literal(right)
-    )
-    if numeric:
-        lf, lok = _to_float(lv, batch.n)
-        rf, rok = _to_float(rv, batch.n)
+    larr, lh = _eval_values(left, batch)
+    rarr, rh = _eval_values(right, batch)
+    # Numeric compare when either side is statically numeric (numeric
+    # literal, CAST-to-number, CHAR_LENGTH); otherwise string compare.
+    if "num" in (lh, rh):
+        lf, lok = _to_float(("arr", larr), batch.n)
+        rf, rok = _to_float(("arr", rarr), batch.n)
         with np.errstate(invalid="ignore"):
             m = _CMP_NUM[op](lf, rf)
         return m & lok & rok
-    ls = _to_str(lv, batch.n)
-    rs = _to_str(rv, batch.n)
+    ls = _to_str(("arr", larr), batch.n)
+    rs = _to_str(("arr", rarr), batch.n)
     valid = np.array([a is not None for a in ls], dtype=bool) & \
         np.array([b is not None for b in rs], dtype=bool)
     if op in ("=", "!="):
@@ -287,12 +551,9 @@ def _operand_values(term, batch: _Batch):
     kind = term[0]
     if kind == "col":
         return ("arr", _col(batch, term[1]))
+    if kind == "fn":
+        return ("arr", _eval_values(term, batch)[0])
     return ("lit", term[1])
-
-
-def _is_numeric_literal(term) -> bool:
-    return term[0] == "lit" and isinstance(term[1], (int, float)) \
-        and not isinstance(term[1], bool)
 
 
 def _to_float(val, n: int) -> tuple[np.ndarray, np.ndarray]:
@@ -310,7 +571,8 @@ def _to_str(val, n: int) -> list:
     kind, v = val
     if kind == "lit":
         return [None if v is None else str(v)] * n
-    return list(v)
+    return [None if x is None else (x if isinstance(x, str) else str(x))
+            for x in v]
 
 
 def _like_regex(pattern: str) -> re.Pattern:
@@ -391,6 +653,31 @@ class _AggState:
     seen: int = 0
 
 
+class _DecompressErrors(io.RawIOBase):
+    """Translate decompressor failures (corrupt/truncated input raises
+    BadGzipFile/OSError/EOFError) into SQLError so the handler returns a
+    client error, not a 500 (ref pkg/s3select/select.go input errors)."""
+
+    def __init__(self, src, kind: str):
+        super().__init__()
+        self._src = src
+        self._kind = kind
+
+    def readinto(self, b) -> int:
+        try:
+            data = self._src.read(len(b))
+        except (OSError, EOFError) as exc:
+            raise SQLError(
+                f"malformed {self._kind} input: {exc}"
+            ) from exc
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def readable(self) -> bool:
+        return True
+
+
 class _CountingReader(io.RawIOBase):
     """Byte-counting raw reader (TextIOWrapper-compatible) feeding the
     BytesProcessed stat."""
@@ -416,14 +703,28 @@ def run_select(req: SelectRequest, stream, emit) -> dict:
     chunk. Returns {"processed": n_bytes, "returned": n_bytes}."""
     query = parse(req.expression)
     counting = _CountingReader(stream)
+    # Nested paths need the raw decoded rows kept per batch.
+    need_paths = any("." in c or "[" in c for c in query.columns)
+    # Compressed input: BytesProcessed counts COMPRESSED bytes scanned
+    # (the counting wrapper sits under the decompressor), matching the
+    # reference's progress semantics (pkg/s3select/progress.go).
+    data_src = io.BufferedReader(counting)
+    if req.compression_type == "GZIP":
+        import gzip
+
+        data_src = _DecompressErrors(gzip.GzipFile(fileobj=data_src), "GZIP")
+    elif req.compression_type == "BZIP2":
+        import bz2
+
+        data_src = _DecompressErrors(bz2.BZ2File(data_src), "BZIP2")
     if req.input_format == "parquet":
         # Parquet needs random access (footer metadata + column chunks):
         # read the underlying spool directly, not the counting wrapper.
-        batches = _parquet_batches(stream, req)
+        batches = _parquet_batches(stream, req, keep_records=need_paths)
     elif req.input_format == "csv":
-        batches = _csv_batches(counting, req)
+        batches = _csv_batches(data_src, req)
     else:
-        batches = _json_batches(counting, req)
+        batches = _json_batches(data_src, req, keep_records=need_paths)
 
     returned = 0
     emitted_rows = 0
@@ -447,9 +748,14 @@ def run_select(req: SelectRequest, stream, emit) -> dict:
                 width += 1
             names = [f"_{j + 1}" for j in range(width)] or \
                 list(batch.columns)
+            cols = [_col(batch, nm) for nm in names]
         else:
-            names = [p[1] for p in query.projections]
-        cols = [_col(batch, nm) for nm in names]
+            names = [p[1] if p[0] == "col" else "" for p in query.projections]
+            cols = [
+                _col(batch, p[1]) if p[0] == "col"
+                else _eval_values(p[1], batch)[0]
+                for p in query.projections
+            ]
         buf = io.StringIO()
         if req.output_format == "json":
             keys = _output_keys(query, names)
@@ -501,9 +807,14 @@ def _output_keys(query: Query, names: list[str]) -> list[str]:
     if query.star:
         return names
     out = []
-    for p in query.projections:
-        alias = p[2] if p[0] == "col" else p[3]
-        out.append(alias or (p[1] if p[0] == "col" else p[1]))
+    for pos, p in enumerate(query.projections):
+        if p[0] == "col":
+            out.append(p[2] or p[1])
+        elif p[0] == "fnp":
+            # Unaliased expressions project as _N, AWS-style.
+            out.append(p[2] or f"_{pos + 1}")
+        else:
+            out.append(p[3] or p[1])
     return out
 
 

@@ -5,18 +5,27 @@ language surface, plain Python).
 Supported:
   SELECT * | proj[, proj...] FROM S3Object[.*] [alias] [WHERE expr]
       [LIMIT n]
-  proj  := column | aggregate [AS alias]
+  proj  := column | aggregate | scalar-fn [AS alias]
   agg   := COUNT(*) | COUNT(col) | SUM(col) | AVG(col) | MIN(col)
            | MAX(col)
-  col   := name | "quoted name" | _N | alias.name
+  col   := name | "quoted name" | _N | alias.name | nested JSON paths
+           a.b.c and a[0].b (ref pkg/s3select/sql/jsonpath.go:34)
+  fn    := CAST(x AS INT|FLOAT|STRING|BOOL|TIMESTAMP) | SUBSTRING(s
+           FROM n [FOR m] | s, n[, m]) | CHAR_LENGTH(s) |
+           CHARACTER_LENGTH(s) | LOWER(s) | UPPER(s) | TRIM([BOTH|
+           LEADING|TRAILING] [chars FROM] s) | UTCNOW() |
+           TO_TIMESTAMP(s) | COALESCE(a, b, ...) | NULLIF(a, b)
+           (ref pkg/s3select/sql/funceval.go:37-69, stringfuncs.go,
+           timestampfuncs.go)
   expr  := comparisons (= != <> < <= > >=), LIKE, IN (...),
            BETWEEN a AND b, IS [NOT] NULL, AND, OR, NOT, parentheses
   lit   := 'string' | number | TRUE | FALSE | NULL
 
 AST is plain tuples (engine.py pattern-matches on the first element):
-  ("col", name) ("lit", value) ("cmp", op, l, r) ("and", a, b)
-  ("or", a, b) ("not", e) ("like", col, pat) ("in", col, [lits])
-  ("between", col, lo, hi) ("isnull", col, negated)
+  ("col", name) ("lit", value) ("fn", name, [args...])
+  ("cmp", op, l, r) ("and", a, b) ("or", a, b) ("not", e)
+  ("like", col, pat) ("in", col, [lits]) ("between", col, lo, hi)
+  ("isnull", col, negated)
 Aggregates: ("agg", fn, col_or_None).
 """
 
@@ -36,7 +45,7 @@ _TOKEN_RE = re.compile(
       | (?P<string>'(?:[^']|'')*')
       | (?P<qident>"(?:[^"]|"")*")
       | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\.|\*)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\.|\*|\[|\])
     )""",
     re.VERBOSE,
 )
@@ -44,10 +53,26 @@ _TOKEN_RE = re.compile(
 _KEYWORDS = {
     "select", "from", "where", "limit", "and", "or", "not", "like", "in",
     "between", "is", "null", "as", "true", "false", "count", "sum", "avg",
-    "min", "max", "escape",
+    "min", "max", "escape", "cast", "substring", "char_length",
+    "character_length", "lower", "upper", "trim", "utcnow",
+    "to_timestamp", "coalesce", "nullif", "for", "both", "leading",
+    "trailing", "int", "integer", "float", "decimal", "numeric", "string",
+    "bool", "boolean", "timestamp",
 }
 
 _AGGS = {"count", "sum", "avg", "min", "max"}
+
+# Scalar functions and their argument arity ranges (checked at parse).
+_SCALAR_FNS = {
+    "cast", "substring", "char_length", "character_length", "lower",
+    "upper", "trim", "utcnow", "to_timestamp", "coalesce", "nullif",
+}
+
+_CAST_TYPES = {
+    "int": "int", "integer": "int", "float": "float", "decimal": "float",
+    "numeric": "float", "string": "string", "bool": "bool",
+    "boolean": "bool", "timestamp": "timestamp",
+}
 
 
 @dataclass
@@ -115,24 +140,40 @@ class _Parser:
 
     # --- terms ---
 
-    def column_name(self, alias: str) -> str:
+    def _path_part(self) -> str:
         k, v = self.next()
         if k == "qident":
-            name = v[1:-1].replace('""', '"')
-        elif k == "ident":
-            name = v
-        elif k == "kw":  # keywords are legal column names in practice
-            name = v
-        else:
-            raise SQLError(f"expected column name, got {v!r}")
-        # alias-qualified: s.col
-        if self.accept_op("."):
-            if name.lower() != (alias or "s3object").lower() and \
-                    name.lower() != "s3object":
-                raise SQLError(f"unknown table alias {name!r}")
-            return self.column_name(alias)
-        self.columns.append(name.lower())
-        return name.lower()
+            return v[1:-1].replace('""', '"')
+        if k in ("ident", "kw"):  # keywords are legal column names
+            return v
+        raise SQLError(f"expected column name, got {v!r}")
+
+    def column_name(self, alias: str) -> str:
+        """Column reference, possibly a nested JSON path: a.b.c, a[0].b
+        (ref pkg/s3select/sql/jsonpath.go:34 — .key and [index] steps;
+        wildcards are not supported). The stored name keeps the path
+        syntax; engine._col resolves it against raw JSON records."""
+        parts = [self._path_part().lower()]
+        while True:
+            if self.accept_op("["):
+                k, v = self.next()
+                if k != "number" or "." in v or int(v) < 0:
+                    raise SQLError("array index must be a non-negative int")
+                if not self.accept_op("]"):
+                    raise SQLError("missing ]")
+                parts[-1] += f"[{int(v)}]"
+            elif self.accept_op("."):
+                parts.append(self._path_part().lower())
+            else:
+                break
+        # Strip a leading table alias (s.col / S3Object.col).
+        if len(parts) > 1 and "[" not in parts[0] and parts[0] in (
+            (alias or "").lower(), "s3object",
+        ):
+            parts = parts[1:]
+        name = ".".join(parts)
+        self.columns.append(name)
+        return name
 
     def literal(self):
         k, v = self.next()
@@ -148,12 +189,89 @@ class _Parser:
             return ("lit", None)
         raise SQLError(f"expected literal, got {v!r}")
 
+    def _at_fn_call(self) -> bool:
+        """Scalar-fn keyword ONLY when followed by '(' — a bare `lower`
+        or `cast` stays usable as a column name (it was before these
+        keywords existed)."""
+        k, v = self.peek()
+        if k != "kw" or v not in _SCALAR_FNS:
+            return False
+        nxt = self.toks[self.i + 1] if self.i + 1 < len(self.toks) else ("eof", "")
+        return nxt == ("op", "(")
+
     def operand(self, alias: str):
+        if self._at_fn_call():
+            return self.scalar_fn(alias)
         k, v = self.peek()
         if k in ("number", "string") or (k == "kw" and v in
                                          ("true", "false", "null")):
             return self.literal()
         return ("col", self.column_name(alias))
+
+    def scalar_fn(self, alias: str):
+        """One scalar function call -> ("fn", name, [arg-nodes])
+        (ref pkg/s3select/sql/funceval.go:37-69)."""
+        _, fn = self.next()
+        if not self.accept_op("("):
+            raise SQLError(f"{fn.upper()} needs (")
+
+        def close():
+            if not self.accept_op(")"):
+                raise SQLError(f"missing ) after {fn.upper()}")
+
+        if fn == "utcnow":
+            close()
+            return ("fn", "utcnow", [])
+        if fn == "cast":
+            arg = self.operand(alias)
+            self.expect_kw("as")
+            k, v = self.next()
+            if k != "kw" or v not in _CAST_TYPES:
+                raise SQLError(f"unsupported CAST type {v!r}")
+            close()
+            return ("fn", "cast", [arg, ("lit", _CAST_TYPES[v])])
+        if fn == "substring":
+            args = [self.operand(alias)]
+            if self.accept_kw("from"):
+                args.append(self.operand(alias))
+                if self.accept_kw("for"):
+                    args.append(self.operand(alias))
+            else:
+                while self.accept_op(","):
+                    args.append(self.operand(alias))
+            if len(args) not in (2, 3):
+                raise SQLError("SUBSTRING needs (s FROM n [FOR m])")
+            close()
+            return ("fn", "substring", args)
+        if fn == "trim":
+            mode = "both"
+            k, v = self.peek()
+            if k == "kw" and v in ("both", "leading", "trailing"):
+                mode = v
+                self.i += 1
+            chars = None
+            if self.accept_kw("from"):
+                arg = self.operand(alias)
+            else:
+                first = self.operand(alias)
+                if self.accept_kw("from"):
+                    chars, arg = first, self.operand(alias)
+                else:
+                    arg = first
+            close()
+            return ("fn", "trim", [arg, ("lit", mode),
+                                   chars if chars else ("lit", None)])
+        args = [self.operand(alias)]
+        while self.accept_op(","):
+            args.append(self.operand(alias))
+        close()
+        name = "char_length" if fn == "character_length" else fn
+        want = {"lower": (1, 1), "upper": (1, 1), "char_length": (1, 1),
+                "to_timestamp": (1, 1), "nullif": (2, 2),
+                "coalesce": (1, 99)}[name]
+        if not want[0] <= len(args) <= want[1]:
+            raise SQLError(f"{fn.upper()}: wrong argument count")
+        return ("fn", name, args)
 
     # --- expressions ---
 
@@ -232,14 +350,19 @@ class _Parser:
             if not self.accept_op(")"):
                 raise SQLError("missing )")
             out = ["agg", fn, col, ""]
+            alias_at = 3
+        elif self._at_fn_call():
+            out = ["fnp", self.scalar_fn(alias), ""]
+            alias_at = 2
         else:
-            out = ["col", self.column_name(alias), "", ""]
+            out = ["col", self.column_name(alias), ""]
+            alias_at = 2
         if self.accept_kw("as"):
             k, v = self.next()
             if k == "qident":
                 v = v[1:-1]
-            out[-1 if out[0] == "agg" else 2] = v
-        return tuple(out[:4] if out[0] == "agg" else out[:3])
+            out[alias_at] = v
+        return tuple(out)
 
     def parse(self) -> Query:
         self.expect_kw("select")

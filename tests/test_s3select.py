@@ -299,3 +299,210 @@ def test_select_oracle_fuzz():
         if want_count:
             assert float(sum_s) == float(want_sum), (trial, sql, got)
         assert stats["processed"] == len(csv_text.encode())
+
+
+# ---------- round-4 depth: nested paths, scalar fns, compression ----------
+
+JSONL_NESTED = (
+    '{"name": "alice", "addr": {"city": "oslo", "zip": "0150"}, '
+    '"tags": ["a", "b"], "scores": [{"v": 9}, {"v": 4}]}\n'
+    '{"name": "bob", "addr": {"city": "lima"}, "tags": ["c"], '
+    '"scores": [{"v": 7}]}\n'
+    '{"name": "carol"}\n'
+)
+
+
+def test_json_nested_paths():
+    out, _ = _run("SELECT s.addr.city FROM S3Object s", JSONL_NESTED,
+                  in_fmt="json", out="json")
+    rows = [json.loads(x) for x in out.strip().split("\n")]
+    assert [r["addr.city"] for r in rows] == ["oslo", "lima", None]
+
+
+def test_json_array_index_path():
+    out, _ = _run("SELECT s.tags[0], s.scores[0].v FROM S3Object s",
+                  JSONL_NESTED, in_fmt="json", out="csv")
+    assert out.strip().split("\n") == ["a,9", "c,7", ","]
+
+
+def test_json_nested_path_in_where():
+    out, _ = _run("SELECT s.name FROM S3Object s "
+                  "WHERE s.addr.city = 'oslo'",
+                  JSONL_NESTED, in_fmt="json", out="csv")
+    assert out.strip() == "alice"
+    out, _ = _run("SELECT s.name FROM S3Object s WHERE s.scores[0].v > 5",
+                  JSONL_NESTED, in_fmt="json", out="csv")
+    assert out.strip().split("\n") == ["alice", "bob"]
+
+
+def test_cast_int_float_where():
+    out, _ = _run("SELECT name FROM S3Object "
+                  "WHERE CAST(salary AS INT) >= 110")
+    assert out.strip().split("\n") == ["alice", "carol", "erin"]
+    out, _ = _run("SELECT CAST(salary AS FLOAT) FROM S3Object LIMIT 1")
+    assert out.strip() == "120.0"
+
+
+def test_cast_failure_is_query_error():
+    with pytest.raises(SQLError):
+        _run("SELECT CAST(name AS INT) FROM S3Object")
+
+
+def test_substring_forms():
+    out, _ = _run("SELECT SUBSTRING(name FROM 2 FOR 3) FROM S3Object "
+                  "LIMIT 2")
+    assert out.strip().split("\n") == ["lic", "ob"]
+    out, _ = _run("SELECT SUBSTRING(name, 1, 2) FROM S3Object LIMIT 1")
+    assert out.strip() == "al"
+
+
+def test_string_functions():
+    out, _ = _run("SELECT UPPER(name), CHAR_LENGTH(dept) FROM S3Object "
+                  "LIMIT 2")
+    assert out.strip().split("\n") == ["ALICE,3", "BOB,5"]
+    out, _ = _run("SELECT name FROM S3Object WHERE LOWER(dept) = 'eng' "
+                  "AND CHAR_LENGTH(name) > 4")
+    assert out.strip().split("\n") == ["alice", "carol"]
+    out, _ = _run("SELECT TRIM('  pad  ') FROM S3Object LIMIT 1")
+    assert out.strip() == "pad"
+    out, _ = _run("SELECT TRIM(LEADING 'x' FROM 'xxabcx') FROM S3Object "
+                  "LIMIT 1")
+    assert out.strip() == "abcx"
+
+
+def test_utcnow_and_to_timestamp():
+    out, _ = _run("SELECT UTCNOW() FROM S3Object LIMIT 1")
+    assert out.strip().endswith("Z") and "T" in out
+    out, _ = _run("SELECT TO_TIMESTAMP('2026-07-30') FROM S3Object "
+                  "LIMIT 1")
+    assert out.strip() == "2026-07-30T00:00:00Z"
+    out, _ = _run("SELECT name FROM S3Object "
+                  "WHERE TO_TIMESTAMP('2026-01-02') > "
+                  "TO_TIMESTAMP('2026-01-01') LIMIT 1")
+    assert out.strip() == "alice"
+
+
+def test_coalesce_nullif():
+    out, _ = _run("SELECT COALESCE(missing_col, name) FROM S3Object "
+                  "LIMIT 1")
+    assert out.strip() == "alice"
+    out, _ = _run("SELECT NULLIF(dept, 'eng') FROM S3Object LIMIT 2")
+    # A lone NULL field serializes as "" (csv disambiguates empty row).
+    assert out.strip().split("\n") == ['""', "sales"]
+
+
+def _run_compressed(sql, data: bytes, compression: str):
+    import io
+
+    req = SelectRequest(expression=sql, file_header_info="USE",
+                        compression_type=compression)
+    chunks = []
+    stats = run_select(req, io.BytesIO(data), chunks.append)
+    return b"".join(chunks).decode(), stats
+
+
+def test_gzip_input():
+    import gzip
+
+    data = gzip.compress(CSV.encode())
+    out, stats = _run_compressed(
+        "SELECT name FROM S3Object WHERE dept = 'eng'", data, "GZIP"
+    )
+    assert out.strip().split("\n") == ["alice", "carol", "erin"]
+    # BytesProcessed counts COMPRESSED bytes scanned.
+    assert stats["processed"] == len(data)
+
+
+def test_bzip2_input():
+    import bz2
+
+    data = bz2.compress(CSV.encode())
+    out, _ = _run_compressed(
+        "SELECT COUNT(*) FROM S3Object", data, "BZIP2"
+    )
+    assert out.strip() == "5"
+
+
+def test_compression_xml_parse_and_reject():
+    xml = b"""<?xml version="1.0"?><SelectObjectContentRequest>
+      <Expression>SELECT * FROM S3Object</Expression>
+      <ExpressionType>SQL</ExpressionType>
+      <InputSerialization><CompressionType>GZIP</CompressionType>
+        <CSV/></InputSerialization>
+      <OutputSerialization><CSV/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    req = SelectRequest.from_xml(xml)
+    assert req.compression_type == "GZIP"
+    with pytest.raises(SQLError):
+        SelectRequest.from_xml(xml.replace(b"GZIP", b"SNAPPY"))
+
+
+def test_fn_projection_output_keys_json():
+    out, _ = _run("SELECT UPPER(name) AS nm, CHAR_LENGTH(name) "
+                  "FROM S3Object LIMIT 1", out="json")
+    rec = json.loads(out.strip())
+    assert rec == {"nm": "ALICE", "_2": 5}
+
+
+def test_select_oracle_fuzz_scalar_fns():
+    """Property test over the round-4 surface: scalar functions +
+    nested-JSON paths + gzip, vs a plain Python oracle."""
+    import gzip
+    import io
+    import random
+
+    rng = random.Random(7)
+    words = ["alpha", "beta", "Gamma", "delta9", "x", "Y z", "omega"]
+    for trial in range(15):
+        nrows = rng.randrange(1, 120)
+        rows = [
+            {"w": rng.choice(words), "n": rng.randrange(-30, 30),
+             "d": {"k": rng.randrange(0, 10)}}
+            for _ in range(nrows)
+        ]
+        jsonl = "".join(json.dumps(r) + "\n" for r in rows)
+        start = rng.randrange(1, 4)
+        ln = rng.randrange(1, 4)
+        thresh = rng.randrange(0, 10)
+        sql = (
+            f"SELECT UPPER(s.w), SUBSTRING(s.w FROM {start} FOR {ln}), "
+            f"CHAR_LENGTH(s.w), CAST(s.n AS INT) FROM S3Object s "
+            f"WHERE s.d.k >= {thresh}"
+        )
+        want = [
+            [r["w"].upper(), r["w"][start - 1:start - 1 + ln],
+             len(r["w"]), r["n"]]
+            for r in rows if r["d"]["k"] >= thresh
+        ]
+        data = gzip.compress(jsonl.encode())
+        req = SelectRequest(expression=sql, input_format="json",
+                            compression_type="GZIP", output_format="json")
+        out = []
+        stats = run_select(req, io.BytesIO(data), out.append)
+        got = [json.loads(x) for x in
+               b"".join(out).decode().strip().split("\n")] \
+            if out and b"".join(out).strip() else []
+        assert len(got) == len(want), (trial, sql)
+        for g, w in zip(got, want):
+            assert list(g.values()) == w, (trial, sql, g, w)
+        assert stats["processed"] == len(data)
+
+
+def test_fn_keyword_columns_still_selectable():
+    out, _ = _run("SELECT lower, cast FROM S3Object WHERE trim = 'x'",
+                  data="lower,cast,trim\nA,B,x\nC,D,y\n", header="USE")
+    assert out.strip() == "A,B"
+
+
+def test_star_not_polluted_by_where_path():
+    out, _ = _run('SELECT * FROM S3Object s WHERE s.addr.city = \'oslo\'',
+                  '{"name": "alice", "addr": {"city": "oslo"}}\n',
+                  in_fmt="json", out="json")
+    rec = json.loads(out.strip())
+    assert set(rec) == {"name", "addr"}, rec
+
+
+def test_corrupt_gzip_is_client_error():
+    with pytest.raises(SQLError):
+        _run_compressed("SELECT * FROM S3Object", b"not gzip at all",
+                        "GZIP")

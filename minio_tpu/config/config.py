@@ -51,7 +51,7 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "", "queue_dir": "", "queue_limit": "0"},
     "notify_mysql": {"enable": "off", "dsn_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
     "notify_postgres": {"enable": "off", "connection_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
-    "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace", "queue_dir": "", "queue_limit": "0"},
+    "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace", "password": "", "queue_dir": "", "queue_limit": "0"},
 }
 
 HELP: dict[str, str] = {
@@ -71,9 +71,9 @@ HELP: dict[str, str] = {
     "heal": "manage object healing frequency and bitrot verification",
     "scanner": "manage namespace scanning for usage calculation, lifecycle, healing",
     "notify_webhook": "publish bucket notifications to webhook endpoints",
-    "notify_mysql": "publish bucket notifications to MySQL databases",
-    "notify_postgres": "publish bucket notifications to Postgres databases",
-    "notify_redis": "publish bucket notifications to Redis datastores",
+    "notify_mysql": "publish bucket notifications to MySQL databases (QUEUE-ONLY in this runtime: no mysql driver ships, events persist in queue_dir until an external drainer delivers them)",
+    "notify_postgres": "publish bucket notifications to Postgres databases (QUEUE-ONLY in this runtime: no postgres driver ships, events persist in queue_dir until an external drainer delivers them)",
+    "notify_redis": "publish bucket notifications to Redis datastores (live delivery over a built-in RESP client)",
 }
 
 DEFAULT_TARGET = "_"

@@ -1,0 +1,145 @@
+"""Minimal RESP (REdis Serialization Protocol) client over a raw socket
+— the wire layer for RedisTarget (ref pkg/event/target/redis.go, which
+links gomodule/redigo; the protocol itself is a few dozen lines, so no
+driver is needed).
+
+RESP2 only: commands encode as arrays of bulk strings; replies parse
+simple strings (+), errors (-), integers (:), bulk strings ($), arrays
+(*). Covers PING/AUTH/SELECT/HSET/HDEL/RPUSH/EXPIRE — everything the
+notification target speaks.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class RespError(RuntimeError):
+    """Server-side -ERR reply."""
+
+
+class RespClient:
+    """One pooled connection to a Redis server; thread-safe (a lock
+    serializes command/reply round trips, like redigo's conn)."""
+
+    def __init__(self, address: str, password: str = "", db: int = 0,
+                 timeout: float = 5.0):
+        host, sep, port = address.rpartition(":")
+        if sep and port.isdigit() and (":" not in host or
+                                       host.startswith("[")):
+            # host:port, incl. bracketed IPv6 ([::1]:6379).
+            self.host, self.port = host.strip("[]") or "127.0.0.1", int(port)
+        else:
+            # Port-less (myredis) or bare IPv6 (::1) address: the whole
+            # string is the host, default Redis port.
+            self.host, self.port = address.strip("[]") or "127.0.0.1", 6379
+        self.password = password
+        self.db = db
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._mu = threading.Lock()
+
+    # --- connection ---
+
+    def _connect(self):
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+        try:
+            if self.password:
+                self._roundtrip("AUTH", self.password)
+            if self.db:
+                self._roundtrip("SELECT", str(self.db))
+        except Exception:
+            # A half-initialized connection (failed AUTH/SELECT, e.g.
+            # -LOADING during restart) must not be pooled: it would
+            # answer every later command with -NOAUTH forever.
+            self._teardown()
+            raise
+
+    def close(self):
+        with self._mu:
+            self._teardown()
+
+    def _teardown(self):
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._rfile = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # --- protocol ---
+
+    @staticmethod
+    def _encode(args) -> bytes:
+        out = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out.append(f"${len(b)}\r\n".encode())
+            out.append(b)
+            out.append(b"\r\n")
+        return b"".join(out)
+
+    def _read_reply(self):
+        line = self._rfile.readline()
+        if not line.endswith(b"\r\n"):
+            raise ConnectionError("short RESP reply")
+        kind, rest = line[:1], line[1:-2]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            buf = self._rfile.read(n + 2)
+            if len(buf) != n + 2:
+                raise ConnectionError("short bulk read")
+            return buf[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise ConnectionError(f"bad RESP type byte {kind!r}")
+
+    def _roundtrip(self, *args):
+        self._sock.sendall(self._encode(args))
+        return self._read_reply()
+
+    def command(self, *args):
+        """Send one command; reconnect once on a dead pooled socket.
+        RespError (server rejected the command) does NOT tear down the
+        connection; socket errors do."""
+        with self._mu:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._connect()
+                try:
+                    return self._roundtrip(*args)
+                except RespError:
+                    raise
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def ping(self) -> bool:
+        try:
+            return self.command("PING") == "PONG"
+        except (OSError, ConnectionError, RespError):
+            return False

@@ -65,8 +65,15 @@ class EventNotifier:
         self._mu = threading.Lock()
         self._q: queue.Queue = queue.Queue(10000)
         self._stop = threading.Event()
+        self._kick = threading.Event()
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
+        # Single wire-delivery thread for store-backed targets: drains
+        # backlogs immediately on a kick from the worker, and every
+        # RETRY_INTERVAL_S while a backlog remains (the reference's
+        # per-target retry ticker in sendFromStore).
+        self._retry = threading.Thread(target=self._retry_loop, daemon=True)
+        self._retry.start()
 
     # --- rules ---
 
@@ -123,7 +130,15 @@ class EventNotifier:
                     continue
                 try:
                     target.save(payload)
-                    if self.metrics is not None:
+                    if target.store is not None:
+                        # Persisted; the wire push happens in the retry
+                        # thread (kicked below) so a down target's
+                        # connect timeouts never stall THIS worker and
+                        # starve healthy targets — the reference's
+                        # store.Put + sendFromStore wakeup split.
+                        self._kick.set()
+                    elif self.metrics is not None:
+                        # Storeless save() IS the wire send.
                         self.metrics.inc("events_sent_total", arn=arn)
                 except Exception as exc:  # noqa: BLE001 - per-target
                     if self.metrics is not None:
@@ -146,6 +161,35 @@ class EventNotifier:
             total += t.drain()
         return total
 
+    RETRY_INTERVAL_S = 3.0
+
+    def _retry_loop(self):
+        while not self._stop.is_set():
+            self._kick.wait(self.RETRY_INTERVAL_S)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
+            for arn, t in list(self.targets.items()):
+                if t.store is None or len(t.store) == 0:
+                    continue
+                try:
+                    sent = t.drain()
+                except Exception:  # noqa: BLE001 - next tick retries
+                    continue
+                if sent and self.metrics is not None:
+                    # Counted at the WIRE, not at queue time — the
+                    # counter must not report delivery during an outage.
+                    self.metrics.inc("events_sent_total", sent, arn=arn)
+
     def close(self):
         self._stop.set()
+        self._kick.set()
         self._worker.join(timeout=2)
+        self._retry.join(timeout=2)
+        for t in self.targets.values():
+            closer = getattr(t, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 - best-effort shutdown
+                    pass

@@ -68,6 +68,7 @@ class Target:
     def __init__(self, arn: str, store: QueueStore | None = None):
         self.arn = arn
         self.store = store
+        self._drain_mu = threading.Lock()
 
     def is_active(self) -> bool:
         return True
@@ -84,18 +85,21 @@ class Target:
             self.send_now(event)
 
     def drain(self) -> int:
-        """Send queued events in order; stop at first failure."""
+        """Send queued events in order; stop at first failure. Locked:
+        two concurrent drains of one target would each read the same
+        head-of-queue file and deliver it twice."""
         if self.store is None:
             return 0
-        sent = 0
-        for key in self.store.list():
-            try:
-                self.send_now(self.store.get(key))
-            except Exception:  # noqa: BLE001 - stays queued
-                break
-            self.store.delete(key)
-            sent += 1
-        return sent
+        with self._drain_mu:
+            sent = 0
+            for key in self.store.list():
+                try:
+                    self.send_now(self.store.get(key))
+                except Exception:  # noqa: BLE001 - stays queued
+                    break
+                self.store.delete(key)
+                sent += 1
+            return sent
 
 
 class WebhookTarget(Target):
@@ -131,9 +135,13 @@ class WebhookTarget(Target):
 
 
 class _DBTargetBase(Target):
-    """Config-compatible database/redis targets. The reference links
-    native client drivers; this image has none, so events queue durably
-    until a driver-equipped process drains them."""
+    """Config-compatible SQL database targets. The reference links
+    native mysql/postgres drivers; this image has none, so for these
+    two, events queue durably until a driver-equipped process drains
+    them — an operator configuring notify_mysql / notify_postgres gets
+    a growing queue_dir and NO live delivery (documented in
+    config/config.py kvs help). Redis is NOT in this class: its wire
+    protocol needs no driver, so RedisTarget delivers live."""
 
     driver = "unavailable"
 
@@ -166,15 +174,51 @@ class PostgresTarget(_DBTargetBase):
         self.table = table
 
 
-class RedisTarget(_DBTargetBase):
+class RedisTarget(Target):
+    """Live Redis delivery over a raw-socket RESP client
+    (ref pkg/event/target/redis.go:203 Send):
+
+    - format=namespace: the hash `key` mirrors the namespace — HSET
+      <key> <bucket/object> <record-json> on create, HDEL on remove.
+    - format=access: RPUSH <key> <{"Event": records, "EventTime": t}>,
+      an append-only access log.
+    """
+
     driver = "redis"
 
     def __init__(self, arn: str, address: str, key: str,
-                 fmt: str = "namespace", store: QueueStore | None = None):
+                 fmt: str = "namespace", store: QueueStore | None = None,
+                 password: str = ""):
         super().__init__(arn, store)
         self.address = address
         self.key = key
         self.format = fmt
+        from .resp import RespClient
+
+        self._client = RespClient(address, password=password)
+
+    def is_active(self) -> bool:
+        return self._client.ping()
+
+    def send_now(self, event: dict) -> None:
+        records = event.get("Records", [])
+        name = event.get("EventName", "")
+        obj_key = event.get("Key", "")
+        if self.format == "access":
+            ts = records[0].get("eventTime", "") if records else ""
+            self._client.command(
+                "RPUSH", self.key,
+                json.dumps({"Event": records, "EventTime": ts}),
+            )
+            return
+        if "ObjectRemoved" in name:
+            self._client.command("HDEL", self.key, obj_key)
+        else:
+            data = json.dumps(records[0] if records else event)
+            self._client.command("HSET", self.key, obj_key, data)
+
+    def close(self):
+        self._client.close()
 
 
 def targets_from_config(config, region: str = "us-east-1",
@@ -224,5 +268,6 @@ def targets_from_config(config, region: str = "us-east-1",
             else:
                 out[arn] = cls(arn, kvs.get("address", ""),
                                kvs.get("key", ""),
-                               kvs.get("format", "namespace"), store)
+                               kvs.get("format", "namespace"), store,
+                               password=kvs.get("password", ""))
     return out

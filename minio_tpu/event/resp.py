@@ -121,21 +121,32 @@ class RespClient:
         return self._read_reply()
 
     def command(self, *args):
-        """Send one command; reconnect once on a dead pooled socket.
+        """Send one command. A dead POOLED socket detected at send time
+        retries once on a fresh connection; a failure while READING the
+        reply never retries — the server may have executed the command,
+        and re-sending would duplicate non-idempotent ops like RPUSH
+        (redigo, the reference's client, does not auto-retry either).
         RespError (server rejected the command) does NOT tear down the
         connection; socket errors do."""
         with self._mu:
             for attempt in (0, 1):
-                if self._sock is None:
+                fresh = self._sock is None
+                if fresh:
                     self._connect()
                 try:
-                    return self._roundtrip(*args)
+                    self._sock.sendall(self._encode(args))
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    if fresh or attempt:
+                        raise
+                    continue  # stale pooled socket: one fresh retry
+                try:
+                    return self._read_reply()
                 except RespError:
                     raise
                 except (OSError, ConnectionError):
                     self._teardown()
-                    if attempt:
-                        raise
+                    raise
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def ping(self) -> bool:

@@ -65,15 +65,24 @@ class EventNotifier:
         self._mu = threading.Lock()
         self._q: queue.Queue = queue.Queue(10000)
         self._stop = threading.Event()
-        self._kick = threading.Event()
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
-        # Single wire-delivery thread for store-backed targets: drains
-        # backlogs immediately on a kick from the worker, and every
-        # RETRY_INTERVAL_S while a backlog remains (the reference's
-        # per-target retry ticker in sendFromStore).
-        self._retry = threading.Thread(target=self._retry_loop, daemon=True)
-        self._retry.start()
+        # One wire-delivery thread PER store-backed target (the
+        # reference's per-target sendFromStore goroutine): a down
+        # target's connect timeouts only stall its own backlog, never
+        # another target's.
+        self._kicks: dict[str, threading.Event] = {}
+        self._retry_threads: list[threading.Thread] = []
+        for arn, t in self.targets.items():
+            if t.store is None:
+                continue
+            ev = threading.Event()
+            self._kicks[arn] = ev
+            th = threading.Thread(
+                target=self._retry_loop, args=(arn, t, ev), daemon=True
+            )
+            th.start()
+            self._retry_threads.append(th)
 
     # --- rules ---
 
@@ -131,12 +140,14 @@ class EventNotifier:
                 try:
                     target.save(payload)
                     if target.store is not None:
-                        # Persisted; the wire push happens in the retry
-                        # thread (kicked below) so a down target's
-                        # connect timeouts never stall THIS worker and
-                        # starve healthy targets — the reference's
-                        # store.Put + sendFromStore wakeup split.
-                        self._kick.set()
+                        # Persisted; the wire push happens in the
+                        # target's own retry thread (kicked below) so a
+                        # down target's connect timeouts never stall
+                        # THIS worker — the reference's store.Put +
+                        # sendFromStore wakeup split.
+                        kick = self._kicks.get(arn)
+                        if kick is not None:
+                            kick.set()
                     elif self.metrics is not None:
                         # Storeless save() IS the wire send.
                         self.metrics.inc("events_sent_total", arn=arn)
@@ -163,29 +174,38 @@ class EventNotifier:
 
     RETRY_INTERVAL_S = 3.0
 
-    def _retry_loop(self):
+    def _retry_loop(self, arn: str, t, kick: threading.Event):
         while not self._stop.is_set():
-            self._kick.wait(self.RETRY_INTERVAL_S)
-            self._kick.clear()
+            kick.wait(self.RETRY_INTERVAL_S)
+            kick.clear()
             if self._stop.is_set():
                 return
-            for arn, t in list(self.targets.items()):
-                if t.store is None or len(t.store) == 0:
-                    continue
-                try:
-                    sent = t.drain()
-                except Exception:  # noqa: BLE001 - next tick retries
-                    continue
-                if sent and self.metrics is not None:
-                    # Counted at the WIRE, not at queue time — the
-                    # counter must not report delivery during an outage.
-                    self.metrics.inc("events_sent_total", sent, arn=arn)
+            if len(t.store) == 0:
+                continue
+            try:
+                sent = t.drain()
+            except Exception:  # noqa: BLE001 - next tick retries
+                continue
+            if sent and self.metrics is not None:
+                # Counted at the WIRE, not at queue time — the counter
+                # must not report delivery during an outage.
+                self.metrics.inc("events_sent_total", sent, arn=arn)
+            if len(t.store) > 0 and t.last_error is not None:
+                # Backlog remains after a drain attempt: the outage
+                # must be VISIBLE (errors counter + one log line), not
+                # just a silently growing queue_dir.
+                if self.metrics is not None:
+                    self.metrics.inc("events_errors_total", arn=arn)
+                if self.logger is not None:
+                    self.logger.log_once_if(t.last_error, f"notify:{arn}")
 
     def close(self):
         self._stop.set()
-        self._kick.set()
+        for ev in self._kicks.values():
+            ev.set()
         self._worker.join(timeout=2)
-        self._retry.join(timeout=2)
+        for th in self._retry_threads:
+            th.join(timeout=2)
         for t in self.targets.values():
             closer = getattr(t, "close", None)
             if closer is not None:

@@ -69,6 +69,10 @@ class Target:
         self.arn = arn
         self.store = store
         self._drain_mu = threading.Lock()
+        # Last wire failure (drain swallows it to keep events queued);
+        # the notifier's retry loop surfaces it to metrics/logs so an
+        # outage with a growing backlog is never invisible.
+        self.last_error: Exception | None = None
 
     def is_active(self) -> bool:
         return True
@@ -95,10 +99,13 @@ class Target:
             for key in self.store.list():
                 try:
                     self.send_now(self.store.get(key))
-                except Exception:  # noqa: BLE001 - stays queued
+                except Exception as exc:  # noqa: BLE001 - stays queued
+                    self.last_error = exc
                     break
                 self.store.delete(key)
                 sent += 1
+            else:
+                self.last_error = None
             return sent
 
 
@@ -190,6 +197,12 @@ class RedisTarget(Target):
                  fmt: str = "namespace", store: QueueStore | None = None,
                  password: str = ""):
         super().__init__(arn, store)
+        if not address.strip():
+            # An enabled target with no address must fail construction
+            # loudly — the client's localhost default would otherwise
+            # quietly write events into whatever Redis is on loopback
+            # (ref RedisArgs.Validate rejects empty addr).
+            raise ValueError(f"{arn}: notify_redis address is required")
         self.address = address
         self.key = key
         self.format = fmt

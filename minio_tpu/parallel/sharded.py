@@ -136,25 +136,35 @@ class ShardedErasure:
 
     # --- degraded read / heal (get path) ---
 
+    def _recon_consts(self, survivors: tuple, targets: tuple):
+        """(recon bit-matrix, survivor index vector) — the static
+        operands shared by the degraded-read and heal programs."""
+        recon_np = gf.bit_matrix(
+            gf.reconstruct_matrix(self.k, self.m, list(survivors), list(targets))
+        )
+        return (
+            jnp.asarray(recon_np, dtype=jnp.int8),
+            jnp.asarray(survivors[: self.k], dtype=jnp.int32),
+        )
+
+    def _gather_and_rebuild(self, stripe, recon, surv_idx):
+        """Gather k survivor lanes (the all-gather over ICI — the
+        parallelReader analog, reference cmd/erasure-decode.go:133-188
+        without the dynamic escalation) and matmul-reconstruct."""
+        surv = jnp.take(stripe, surv_idx, axis=1)
+        surv = jax.lax.with_sharding_constraint(
+            surv, NamedSharding(self.mesh, P("dp", None, None))
+        )
+        return apply_gf_matrix(recon, surv)
+
     def _decode_fn(self, survivors: tuple, targets: tuple):
         cached = self._decode_cache.get((survivors, targets))
         if cached is not None:
             return cached
-        recon_np = gf.bit_matrix(
-            gf.reconstruct_matrix(self.k, self.m, list(survivors), list(targets))
-        )
-        recon = jnp.asarray(recon_np, dtype=jnp.int8)
-        surv_idx = jnp.asarray(survivors[: self.k], dtype=jnp.int32)
+        recon, surv_idx = self._recon_consts(survivors, targets)
 
         def decode(stripe):
-            # Gathering k survivor lanes from a lane-sharded stripe is the
-            # all-gather over ICI (parallelReader analog, reference
-            # cmd/erasure-decode.go:133-188 without the dynamic escalation).
-            surv = jnp.take(stripe, surv_idx, axis=1)
-            surv = jax.lax.with_sharding_constraint(
-                surv, NamedSharding(self.mesh, P("dp", None, None))
-            )
-            return apply_gf_matrix(recon, surv)
+            return self._gather_and_rebuild(stripe, recon, surv_idx)
 
         fn = jax.jit(
             decode,
@@ -206,6 +216,68 @@ class ShardedErasure:
             else:
                 parts.append(stripe[:, i : i + 1, :])
         return jnp.concatenate(parts, axis=1)
+
+
+    # --- heal (reconstruct-to-stale-lane) ---
+
+    def heal(self, stripe: jax.Array, dead: tuple[int, ...]) -> jax.Array:
+        """Rebuild the `dead` lanes from survivors and write them back
+        into the lane-sharded stripe — the device analog of the
+        reference's low-level heal, which regenerates ONLY the stale
+        disks' shards with quorum-1 writers
+        (cmd/erasure-lowlevel-heal.go:28-48). Returns the healed stripe,
+        still lane-sharded; the failure pattern is static per compile,
+        exactly like reconstruct()."""
+        targets = tuple(sorted(set(dead)))
+        survivors = self._survivors(set(dead))
+        key = ("heal", survivors, targets)
+        fn = self._decode_cache.get(key)
+        if fn is None:
+            recon, surv_idx = self._recon_consts(survivors, targets)
+            tgt_idx = jnp.asarray(targets, dtype=jnp.int32)
+
+            def heal_fn(stripe):
+                rebuilt = self._gather_and_rebuild(stripe, recon, surv_idx)
+                healed = stripe.at[:, tgt_idx, :].set(
+                    rebuilt.astype(stripe.dtype)
+                )
+                return jax.lax.with_sharding_constraint(
+                    healed, self.stripe_spec
+                )
+
+            fn = jax.jit(
+                heal_fn,
+                in_shardings=(self.stripe_spec,),
+                out_shardings=self.stripe_spec,
+            )
+            self._decode_cache[key] = fn
+        return fn(stripe)
+
+    # --- device-side bitrot digests ---
+
+    @functools.cached_property
+    def _digest_fn(self):
+        from ..ops.highwayhash_jax import hash256_batch_jax
+
+        def digest(stripe):
+            # Per-lane-local hashing: every device digests its own
+            # shards, no cross-lane traffic (the fused verify of
+            # erasure/bitrot.hash_shard_chunks, on the mesh).
+            out = hash256_batch_jax(stripe)
+            return jax.lax.with_sharding_constraint(
+                out, NamedSharding(self.mesh, P("dp", "lane", None))
+            )
+
+        return jax.jit(
+            digest,
+            in_shardings=(self.stripe_spec,),
+            out_shardings=NamedSharding(self.mesh, P("dp", "lane", None)),
+        )
+
+    def bitrot_digests(self, stripe: jax.Array) -> jax.Array:
+        """HighwayHash-256 of every shard, computed lane-local on the
+        mesh: [B, k+m, 32]."""
+        return self._digest_fn(stripe)
 
 
 def full_put_get_step(se: ShardedErasure, blocks: np.ndarray,

@@ -36,6 +36,18 @@ CONSOLE_HTML = """<!DOCTYPE html>
   <button id="mkbtn">Create</button>
   <input id="file" type="file">
   <button id="upbtn">Upload</button>
+  <button id="delselbtn">Delete selected</button>
+ </div>
+ <div id="share" style="display:none">
+  <b>share link</b>
+  expiry (seconds): <input id="shareexp" value="604800" size="8">
+  <button id="sharebtn">Generate</button>
+  <input id="shareurl" size="80" readonly>
+ </div>
+ <div id="policy" style="display:none">
+  <b>bucket policy</b> (empty = remove)<br>
+  <textarea id="policytext" rows="8" cols="80"></textarea><br>
+  <button id="policysave">Save policy</button>
  </div>
  <table id="tbl"><thead><tr id="hdr"></tr></thead><tbody id="rows">
  </tbody></table>
@@ -99,26 +111,129 @@ async function login() {
     listBuckets();
   } catch (e) { err(e.message); }
 }
+function hidePanels() {
+  el('share').style.display = 'none';
+  el('policy').style.display = 'none';
+}
 async function listBuckets() {
-  err(''); bucket = null;
+  err(''); bucket = null; shareKey = null;
   el('where').textContent = '';
+  hidePanels();
   try {
     const res = await rpc('web.ListBuckets', {});
-    setHeader(['bucket', '']);
+    setHeader(['bucket', '', '']);
     for (const b of res.buckets)
       row([link(b.name, () => listObjects(b.name)),
+           btn('policy', () => editPolicy(b.name)),
            btn('delete', () => rmBucket(b.name))]);
   } catch (e) { err(e.message); }
+}
+function checkbox(key) {
+  const c = document.createElement('input');
+  c.type = 'checkbox';
+  c.dataset.key = key;
+  c.className = 'selbox';
+  return c;
 }
 async function listObjects(b) {
   err(''); bucket = b;
   el('where').textContent = ' / ' + b;
+  hidePanels();
   try {
     const res = await rpc('web.ListObjects', {bucketName: b});
-    setHeader(['key', 'size', '']);
+    setHeader(['', 'key', 'size', '', '', '']);
     for (const o of res.objects)
-      row([link(o.name, () => download(o.name)), String(o.size),
+      row([checkbox(o.name),
+           link(o.name, () => download(o.name)), String(o.size),
+           btn('versions', () => listVersions(o.name)),
+           btn('share', () => openShare(o.name)),
            btn('delete', () => rmObject(o.name))]);
+  } catch (e) { err(e.message); }
+}
+async function listVersions(key) {
+  err('');
+  hidePanels();
+  try {
+    // Follow the pagination markers to the end (bounded): a truncated
+    // first page must never masquerade as the full version history.
+    let versions = [], keyMarker = '', vidMarker = '';
+    for (let page = 0; page < 50; page++) {
+      const res = await rpc('web.ListObjectVersions',
+                            {bucketName: bucket, prefix: key,
+                             keyMarker, versionIdMarker: vidMarker});
+      versions.push(...res.versions);
+      if (!res.isTruncated) break;
+      keyMarker = res.nextKeyMarker;
+      vidMarker = res.nextVersionIdMarker;
+      if (page === 49) err('version list truncated at 50 pages');
+    }
+    el('where').textContent = ' / ' + bucket + ' / ' + key + ' (versions)';
+    setHeader(['versionId', 'latest', 'type', 'size', '', '']);
+    for (const v of versions) {
+      if (v.name !== key) continue;
+      row([v.versionId, v.isLatest ? 'yes' : '',
+           v.deleteMarker ? 'delete marker' : 'object', String(v.size),
+           v.deleteMarker || v.isLatest ? '' :
+             btn('restore', () => restoreVersion(key, v.versionId)),
+           btn('delete version', () => delVersion(key, v.versionId))]);
+    }
+    row([link('\\u2190 back to ' + bucket, () => listObjects(bucket)),
+         '', '', '', '', '']);
+  } catch (e) { err(e.message); }
+}
+async function restoreVersion(key, vid) {
+  try {
+    await rpc('web.RestoreVersion',
+              {bucketName: bucket, objectName: key, versionId: vid});
+    listVersions(key);
+  } catch (e) { err(e.message); }
+}
+async function delVersion(key, vid) {
+  try {
+    await rpc('web.DeleteVersion',
+              {bucketName: bucket, objectName: key, versionId: vid});
+    listVersions(key);
+  } catch (e) { err(e.message); }
+}
+let shareKey = null;
+function openShare(key) {
+  shareKey = key;
+  el('share').style.display = '';
+  el('shareurl').value = '';
+}
+async function genShare() {
+  if (!shareKey) return;
+  try {
+    const res = await rpc('web.PresignedGet', {
+      bucketName: bucket, objectName: shareKey,
+      expiry: parseInt(el('shareexp').value, 10) || 604800,
+      host: location.host});
+    el('shareurl').value = res.url;
+  } catch (e) { err(e.message); }
+}
+let policyBucket = null;
+async function editPolicy(b) {
+  policyBucket = b;
+  try {
+    const res = await rpc('web.GetBucketPolicy', {bucketName: b});
+    el('policytext').value = res.policy;
+    el('policy').style.display = '';
+  } catch (e) { err(e.message); }
+}
+async function savePolicy() {
+  try {
+    await rpc('web.SetBucketPolicy',
+              {bucketName: policyBucket, policy: el('policytext').value});
+    err('policy saved');
+  } catch (e) { err(e.message); }
+}
+async function delSelected() {
+  const keys = [...document.querySelectorAll('.selbox')]
+    .filter(c => c.checked).map(c => c.dataset.key);
+  if (!keys.length) { err('nothing selected'); return; }
+  try {
+    await rpc('web.RemoveObject', {bucketName: bucket, objects: keys});
+    listObjects(bucket);
   } catch (e) { err(e.message); }
 }
 function encPath(key) {
@@ -168,7 +283,9 @@ async function upload() {
 }
 document.addEventListener('DOMContentLoaded', () => {
   for (const [id, fn] of [['loginbtn', login], ['mkbtn', makeBucket],
-                          ['upbtn', upload]])
+                          ['upbtn', upload], ['delselbtn', delSelected],
+                          ['sharebtn', genShare],
+                          ['policysave', savePolicy]])
     el(id).addEventListener('click', fn);
   el('crumb-buckets').addEventListener('click', listBuckets);
 });

@@ -380,6 +380,66 @@ class S3ApiHandlers:
         if not self.ol.bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
 
+    # --- dummy bucket subresources (ref cmd/dummy-handlers.go): canned
+    # S3-shaped answers for SDK feature probes ---
+
+    def get_bucket_cors(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        raise S3Error("NoSuchCORSConfiguration", ctx.bucket)
+
+    def get_bucket_website(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        raise S3Error("NoSuchWebsiteConfiguration", ctx.bucket)
+
+    def delete_bucket_website(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        return Response(200)
+
+    def get_bucket_accelerate(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        return Response.xml(_xml_root("AccelerateConfiguration"))
+
+    def get_bucket_request_payment(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        root = _xml_root("RequestPaymentConfiguration")
+        ET.SubElement(root, "Payer").text = "BucketOwner"
+        return Response.xml(root)
+
+    def get_bucket_logging(self, ctx) -> Response:
+        self._check_bucket(ctx.bucket)
+        return Response.xml(_xml_root("BucketLoggingStatus"))
+
+    def get_bucket_policy_status(self, ctx) -> Response:
+        # ref GetBucketPolicyStatusHandler: IsPublic == the policy has
+        # an Allow statement granting to the wildcard principal. Parsed
+        # structurally: a Deny-all policy or a wildcard Action with a
+        # specific principal must NOT read as public.
+        self._check_bucket(ctx.bucket)
+        public = False
+        try:
+            import json as _json
+
+            meta = self.bm.get(ctx.bucket)
+            doc = _json.loads(meta.policy_json) if meta.policy_json else {}
+            stmts = doc.get("Statement") or []
+            if isinstance(stmts, dict):
+                stmts = [stmts]
+            for s in stmts:
+                if s.get("Effect") != "Allow":
+                    continue
+                pr = s.get("Principal")
+                aws = pr.get("AWS") if isinstance(pr, dict) else pr
+                if isinstance(aws, str):
+                    aws = [aws]
+                if aws and "*" in aws:
+                    public = True
+                    break
+        except Exception:  # noqa: BLE001 - unparseable = not public
+            public = False
+        root = _xml_root("PolicyStatus")
+        ET.SubElement(root, "IsPublic").text = "TRUE" if public else "FALSE"
+        return Response.xml(root)
+
     # --- listing ---
 
     def list_objects_v1(self, ctx) -> Response:

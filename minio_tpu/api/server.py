@@ -181,10 +181,21 @@ def route(ctx: RequestContext) -> str:
         if m == "GET":
             return "list_buckets"
         raise S3Error("MethodNotAllowed", "service endpoint")
+    _check_rejected_apis(m, q, bool(ctx.object))
     if not ctx.object:
         if m == "GET":
             if "location" in q:
                 return "get_bucket_location"
+            # Dummy subresources (ref cmd/dummy-handlers.go): canned
+            # responses so SDK feature probes see S3-shaped answers.
+            for sub, op in (("cors", "get_bucket_cors"),
+                            ("website", "get_bucket_website"),
+                            ("accelerate", "get_bucket_accelerate"),
+                            ("requestPayment", "get_bucket_request_payment"),
+                            ("logging", "get_bucket_logging"),
+                            ("policyStatus", "get_bucket_policy_status")):
+                if sub in q:
+                    return op
             if "acl" in q:
                 return "get_acl"
             if "policy" in q:
@@ -225,6 +236,8 @@ def route(ctx: RequestContext) -> str:
         if m == "DELETE":
             if "policy" in q:
                 return "delete_bucket_policy"
+            if "website" in q:
+                return "delete_bucket_website"
             for sub in ("tagging", "lifecycle", "encryption", "replication"):
                 if sub in q:
                     return f"bucket_{sub.replace('-', '_')}"
@@ -281,6 +294,36 @@ def route(ctx: RequestContext) -> str:
             return "delete_object_tagging"
         return "delete_object"
     raise S3Error("MethodNotAllowed", m)
+
+
+# Unsupported S3 APIs rejected up front with NotImplemented, mirroring
+# the reference's rejectUnsupportedAPIs table (cmd/api-router.go:87-176).
+# Deviation: PUT ?acl stays supported (canned-ACL dummy) — the reference
+# registers both a rejection and a dummy handler for it and the
+# rejection shadows the handler; the dummy is the useful behavior.
+_REJECTED_BUCKET_SUBS = {
+    "GET": ("metrics", "publicAccessBlock", "ownershipControls",
+            "intelligent-tiering", "analytics"),
+    "PUT": ("cors", "metrics", "website", "logging", "accelerate",
+            "requestPayment", "publicAccessBlock", "ownershipControls",
+            "intelligent-tiering", "analytics"),
+    "DELETE": ("cors", "metrics", "logging", "accelerate",
+               "requestPayment", "acl", "publicAccessBlock",
+               "ownershipControls", "intelligent-tiering", "analytics"),
+    "HEAD": ("acl",),
+}
+_REJECTED_OBJECT_SUBS = {
+    "GET": ("torrent",),
+    "PUT": ("torrent",),
+    "DELETE": ("torrent", "acl"),
+}
+
+
+def _check_rejected_apis(method: str, q: dict, is_object: bool):
+    table = _REJECTED_OBJECT_SUBS if is_object else _REJECTED_BUCKET_SUBS
+    for sub in table.get(method, ()):
+        if sub in q:
+            raise S3Error("NotImplemented", f"{method} ?{sub}")
 
 
 def _reserved_metadata_check(ctx: RequestContext):

@@ -119,6 +119,11 @@ class WebHandlers:
         "web.ListObjects": "_m_list_objects",
         "web.RemoveObject": "_m_remove_object",
         "web.PresignedGet": "_m_presigned_get",
+        "web.ListObjectVersions": "_m_list_object_versions",
+        "web.DeleteVersion": "_m_delete_version",
+        "web.RestoreVersion": "_m_restore_version",
+        "web.GetBucketPolicy": "_m_get_bucket_policy",
+        "web.SetBucketPolicy": "_m_set_bucket_policy",
     }
 
     def _rpc(self, ctx) -> Response:
@@ -247,6 +252,105 @@ class WebHandlers:
             self.h.delete_object(sub)
         return {}
 
+    def _m_list_object_versions(self, params, access_key):
+        """All versions (incl. delete markers) under a prefix — the
+        console's versions view (the reference UI reads versions via its
+        SDK; web parity lives here)."""
+        bucket = params.get("bucketName", "")
+        prefix = params.get("prefix", "")
+        self._authorize(access_key, "s3:ListBucketVersions", bucket)
+        res = self.ol.list_object_versions(
+            bucket, prefix=prefix, key_marker=params.get("keyMarker", ""),
+            version_id_marker=params.get("versionIdMarker", ""),
+        )
+        from . import transforms
+
+        versions = []
+        for v in res.versions:
+            versions.append({
+                "name": v.name,
+                "versionId": v.version_id or "null",
+                "isLatest": v.is_latest,
+                "deleteMarker": v.delete_marker,
+                "size": transforms.actual_object_size(
+                    v.user_defined, v.size) if not v.delete_marker else 0,
+                "etag": v.etag,
+                "lastModified": v.mod_time_ns,
+            })
+        return {
+            "versions": versions,
+            "isTruncated": res.is_truncated,
+            "nextKeyMarker": res.next_key_marker,
+            "nextVersionIdMarker": res.next_version_id_marker,
+        }
+
+    def _m_delete_version(self, params, access_key):
+        """Permanently delete ONE version (or remove a delete marker) —
+        through the S3 DeleteObject handler so retention/legal-hold and
+        replication semantics hold."""
+        bucket = params.get("bucketName", "")
+        object_ = params.get("objectName", "")
+        version_id = params.get("versionId", "")
+        if not version_id:
+            raise S3Error("InvalidArgument", "versionId required")
+        self._authorize(access_key, "s3:DeleteObjectVersion", bucket, object_)
+        sub = self._sub_ctx("DELETE", bucket, object_,
+                            access_key=access_key,
+                            query=[("versionId", version_id)])
+        self.h.delete_object(sub)
+        return {}
+
+    def _m_restore_version(self, params, access_key):
+        """Make an old version current again: server-side copy of that
+        version onto the same key (the S3-native restore idiom; goes
+        through the copy handler so events/replication/SSE apply)."""
+        bucket = params.get("bucketName", "")
+        object_ = params.get("objectName", "")
+        version_id = params.get("versionId", "")
+        if not version_id:
+            raise S3Error("InvalidArgument", "versionId required")
+        self._authorize(access_key, "s3:GetObjectVersion", bucket, object_)
+        self._authorize(access_key, "s3:PutObject", bucket, object_)
+        import urllib.parse
+
+        src = (f"/{urllib.parse.quote(bucket)}/"
+               f"{urllib.parse.quote(object_)}?versionId={version_id}")
+        sub = self._sub_ctx("PUT", bucket, object_,
+                            headers={"x-amz-copy-source": src},
+                            access_key=access_key)
+        self.h.put_object(sub)
+        return {}
+
+    def _m_get_bucket_policy(self, params, access_key):
+        bucket = params.get("bucketName", "")
+        self._authorize(access_key, "s3:GetBucketPolicy", bucket)
+        if not self.ol.bucket_exists(bucket):
+            # "no policy set" and "no such bucket" must be
+            # distinguishable, like the S3-plane handler.
+            raise S3Error("NoSuchBucket", bucket)
+        meta = self.bm.get(bucket)
+        return {"policy": meta.policy_json or ""}
+
+    def _m_set_bucket_policy(self, params, access_key):
+        """Set (or clear, with an empty string) the bucket policy JSON —
+        the console's policy editor (ref web.SetBucketPolicy; raw JSON
+        instead of the ref's canned none/readonly/readwrite presets,
+        which the UI provides as templates client-side)."""
+        bucket = params.get("bucketName", "")
+        policy = params.get("policy", "")
+        self._authorize(access_key, "s3:PutBucketPolicy", bucket)
+        if not policy.strip():
+            self.h.delete_bucket_policy(
+                self._sub_ctx("DELETE", bucket, "", access_key=access_key)
+            )
+            return {}
+        self.h.put_bucket_policy(self._sub_ctx(
+            "PUT", bucket, "", access_key=access_key,
+            body_reader=io.BytesIO(policy.encode()),
+            content_length=len(policy.encode()),
+        ))
+        return {}
+
     def _m_presigned_get(self, params, access_key):
         """Shareable presigned GET URL (ref web.PresignedGet)."""
         bucket = params.get("bucketName", "")
@@ -267,13 +371,15 @@ class WebHandlers:
 
     def _sub_ctx(self, method: str, bucket: str, object_: str,
                  headers: dict | None = None, body_reader=None,
-                 content_length=None, access_key: str = ""):
+                 content_length=None, access_key: str = "",
+                 query: list | None = None):
         """Synthetic RequestContext addressing /bucket/object so the S3
         handlers run their normal pipeline after web-token auth."""
         from .server import RequestContext
 
         sub = RequestContext(
-            method, f"/{bucket}/{object_}", [], dict(headers or {}),
+            method, f"/{bucket}/{object_}", list(query or []),
+            dict(headers or {}),
             body_reader if body_reader is not None else io.BytesIO(b""),
             content_length,
         )

@@ -184,7 +184,14 @@ class EventNotifier:
                 continue
             try:
                 sent = t.drain()
-            except Exception:  # noqa: BLE001 - next tick retries
+            except Exception as exc:  # noqa: BLE001 - next tick retries
+                # A store-level failure (unreadable queue_dir) must be as
+                # visible as a wire failure — this is the invisible-
+                # outage class the retry loop exists to surface.
+                if self.metrics is not None:
+                    self.metrics.inc("events_errors_total", arn=arn)
+                if self.logger is not None:
+                    self.logger.log_once_if(exc, f"notify:{arn}")
                 continue
             if sent and self.metrics is not None:
                 # Counted at the WIRE, not at queue time — the counter

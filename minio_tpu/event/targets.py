@@ -279,8 +279,19 @@ def targets_from_config(config, region: str = "us-east-1",
                 out[arn] = cls(arn, kvs.get("connection_string", ""),
                                kvs.get("table", ""), store)
             else:
-                out[arn] = cls(arn, kvs.get("address", ""),
-                               kvs.get("key", ""),
-                               kvs.get("format", "namespace"), store,
-                               password=kvs.get("password", ""))
+                try:
+                    out[arn] = cls(arn, kvs.get("address", ""),
+                                   kvs.get("key", ""),
+                                   kvs.get("format", "namespace"), store,
+                                   password=kvs.get("password", ""))
+                except ValueError as exc:
+                    # A persisted-but-invalid target config (the admin
+                    # API accepted it before validation) must not
+                    # crash-loop the whole server at boot: skip the
+                    # target loudly.
+                    import sys
+
+                    sys.stderr.write(
+                        f"minio-tpu: skipping invalid target {arn}: {exc}\n"
+                    )
     return out

@@ -265,3 +265,101 @@ def test_download_accepts_authorization_header(server, token):
         assert r.status == 200 and r.read() == b"hdr!"
     finally:
         conn.close()
+
+
+def _enable_versioning(server, bucket):
+    import tests.test_s3_api as s3t
+
+    c = s3t.Client(server.s3, access=AK, secret=SK)
+    body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+    st, _, _ = c.request("PUT", f"/{bucket}", query=[("versioning", "")],
+                         body=body)
+    assert st == 200
+
+
+def test_versions_view_restore_and_delete(server, token):
+    assert rpc(server, "web.MakeBucket",
+               {"bucketName": "webver"}, token)[1].get("result") == {}
+    _enable_versioning(server, "webver")
+    for data in (b"v1-bytes", b"v2-bytes"):
+        conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+        conn.request("PUT", "/minio/upload/webver/doc.txt", body=data,
+                     headers={"Authorization": f"Bearer {token}"})
+        assert conn.getresponse().status == 200
+        conn.close()
+    st, resp = rpc(server, "web.ListObjectVersions",
+                   {"bucketName": "webver", "prefix": "doc.txt"}, token)
+    assert st == 200, resp
+    versions = [v for v in resp["result"]["versions"]
+                if v["name"] == "doc.txt"]
+    assert len(versions) == 2
+    assert versions[0]["isLatest"] and not versions[1]["isLatest"]
+    old = versions[1]
+    # Restore the old version: server-side copy -> NEW latest version.
+    st, resp = rpc(server, "web.RestoreVersion",
+                   {"bucketName": "webver", "objectName": "doc.txt",
+                    "versionId": old["versionId"]}, token)
+    assert st == 200 and resp.get("result") == {}, resp
+    # Download now serves v1 content.
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("GET", "/minio/download/webver/doc.txt",
+                 headers={"Authorization": f"Bearer {token}"})
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b"v1-bytes"
+    conn.close()
+    # Delete one specific version permanently.
+    st, resp = rpc(server, "web.ListObjectVersions",
+                   {"bucketName": "webver", "prefix": "doc.txt"}, token)
+    n_before = len(resp["result"]["versions"])
+    victim = resp["result"]["versions"][-1]
+    st, resp = rpc(server, "web.DeleteVersion",
+                   {"bucketName": "webver", "objectName": "doc.txt",
+                    "versionId": victim["versionId"]}, token)
+    assert st == 200 and resp.get("result") == {}, resp
+    st, resp = rpc(server, "web.ListObjectVersions",
+                   {"bucketName": "webver", "prefix": "doc.txt"}, token)
+    assert len(resp["result"]["versions"]) == n_before - 1
+    assert all(v["versionId"] != victim["versionId"]
+               for v in resp["result"]["versions"])
+
+
+def test_policy_editor_roundtrip(server, token):
+    assert rpc(server, "web.MakeBucket",
+               {"bucketName": "webpol"}, token)[1].get("result") == {}
+    st, resp = rpc(server, "web.GetBucketPolicy",
+                   {"bucketName": "webpol"}, token)
+    assert st == 200 and resp["result"]["policy"] == ""
+    policy = json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{
+            "Effect": "Allow", "Principal": {"AWS": ["*"]},
+            "Action": ["s3:GetObject"],
+            "Resource": ["arn:aws:s3:::webpol/*"],
+        }],
+    })
+    st, resp = rpc(server, "web.SetBucketPolicy",
+                   {"bucketName": "webpol", "policy": policy}, token)
+    assert st == 200 and resp.get("result") == {}, resp
+    st, resp = rpc(server, "web.GetBucketPolicy",
+                   {"bucketName": "webpol"}, token)
+    got = json.loads(resp["result"]["policy"])
+    assert got["Statement"][0]["Action"] == ["s3:GetObject"]
+    # Clearing: empty policy string removes it.
+    st, resp = rpc(server, "web.SetBucketPolicy",
+                   {"bucketName": "webpol", "policy": ""}, token)
+    assert st == 200, resp
+    st, resp = rpc(server, "web.GetBucketPolicy",
+                   {"bucketName": "webpol"}, token)
+    assert resp["result"]["policy"] == ""
+
+
+def test_console_page_has_new_controls(server):
+    conn = http.client.HTTPConnection(server.endpoint, timeout=30)
+    conn.request("GET", "/minio/console/")
+    r = conn.getresponse()
+    page = r.read().decode()
+    conn.close()
+    for needle in ("web.ListObjectVersions", "web.RestoreVersion",
+                   "web.SetBucketPolicy", "shareexp", "Delete selected"):
+        assert needle in page, needle

@@ -262,16 +262,39 @@ class AdminHandlers:
             parts = text.split()
             target = parts[0]
             kv = dict(p.split("=", 1) for p in parts[1:])
+            self._validate_target_kv(target, kv)
             self.config_sys.config.set_kv(target, **kv)
         except (ValueError, IndexError) as exc:
             raise S3Error("InvalidArgument", str(exc)) from exc
         self.config_sys.save()
         return self._json({"restart": False})
 
+    def _validate_target_kv(self, target: str, kv: dict):
+        """Reject configs that would brick or silently no-op a subsystem
+        BEFORE persisting — an accepted-then-skipped-at-boot target
+        (targets_from_config's backstop) helps nobody. Mirrors the
+        reference validating target args inside config.LookupConfig."""
+        subsys = target.split(":", 1)[0]
+        if subsys == "notify_redis":
+            merged = dict(self.config_sys.config.get(target))
+            merged.update(kv)
+            if merged.get("enable") == "on" and \
+                    not merged.get("address", "").strip():
+                raise ValueError(
+                    "notify_redis: address is required when enable=on"
+                )
+
     def del_config_kv(self, ctx) -> Response:
         if self.config_sys is None:
             raise S3Error("NotImplemented", "config system not wired")
-        self.config_sys.config.del_target(ctx.body.decode().strip())
+        target = ctx.body.decode().strip()
+        if not target:
+            raise S3Error("InvalidArgument", "config target required")
+        try:
+            self.config_sys.config.del_target(target)
+        except (KeyError, ValueError) as exc:
+            # Unknown subsystem/target is a CLIENT error, not a 500.
+            raise S3Error("InvalidArgument", str(exc)) from exc
         self.config_sys.save()
         return self._json({})
 

@@ -159,7 +159,7 @@ async function listVersions(key) {
     let versions = [], keyMarker = '', vidMarker = '';
     for (let page = 0; page < 50; page++) {
       const res = await rpc('web.ListObjectVersions',
-                            {bucketName: bucket, prefix: key,
+                            {bucketName: bucket, objectName: key,
                              keyMarker, versionIdMarker: vidMarker});
       versions.push(...res.versions);
       if (!res.isTruncated) break;

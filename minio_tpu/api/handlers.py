@@ -415,11 +415,13 @@ class S3ApiHandlers:
         # structurally: a Deny-all policy or a wildcard Action with a
         # specific principal must NOT read as public.
         self._check_bucket(ctx.bucket)
+        # Metadata load OUTSIDE the try: a storage failure must surface
+        # as an error, never masquerade as IsPublic=FALSE.
+        meta = self.bm.get(ctx.bucket)
         public = False
         try:
             import json as _json
 
-            meta = self.bm.get(ctx.bucket)
             doc = _json.loads(meta.policy_json) if meta.policy_json else {}
             stmts = doc.get("Statement") or []
             if isinstance(stmts, dict):

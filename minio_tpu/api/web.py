@@ -257,7 +257,11 @@ class WebHandlers:
         console's versions view (the reference UI reads versions via its
         SDK; web parity lives here)."""
         bucket = params.get("bucketName", "")
-        prefix = params.get("prefix", "")
+        # objectName filters to ONE key server-side (the console's
+        # versions view) so sibling keys sharing the prefix aren't
+        # serialized and shipped just to be dropped client-side.
+        object_name = params.get("objectName", "")
+        prefix = object_name or params.get("prefix", "")
         self._authorize(access_key, "s3:ListBucketVersions", bucket)
         res = self.ol.list_object_versions(
             bucket, prefix=prefix, key_marker=params.get("keyMarker", ""),
@@ -267,6 +271,8 @@ class WebHandlers:
 
         versions = []
         for v in res.versions:
+            if object_name and v.name != object_name:
+                continue
             versions.append({
                 "name": v.name,
                 "versionId": v.version_id or "null",
@@ -344,10 +350,10 @@ class WebHandlers:
                 self._sub_ctx("DELETE", bucket, "", access_key=access_key)
             )
             return {}
+        data = policy.encode()
         self.h.put_bucket_policy(self._sub_ctx(
             "PUT", bucket, "", access_key=access_key,
-            body_reader=io.BytesIO(policy.encode()),
-            content_length=len(policy.encode()),
+            body_reader=io.BytesIO(data), content_length=len(data),
         ))
         return {}
 

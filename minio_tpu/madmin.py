@@ -1,0 +1,284 @@
+"""AdminClient — the operator client library for the admin plane, the
+counterpart of the reference's madmin package
+(/root/reference/pkg/madmin/*.go: api.go NewAdminClient + the typed
+per-route helpers like info-commands.go ServerInfo, config-kv-commands.go
+GetConfigKV, user-commands.go AddUser, heal-commands.go Heal).
+
+Typed wrappers over the `/minio/admin/v3/*` routes (api/admin.py), SigV4
+signed with the operator credential. Every method returns parsed JSON
+(dict/list) or bytes for binary payloads; non-2xx responses raise
+AdminError carrying the S3 error code.
+
+    from minio_tpu.madmin import AdminClient
+    adm = AdminClient("127.0.0.1:9000", "minioadmin", "minioadmin")
+    info = adm.server_info()
+    adm.add_user("alice", "alicesecret123")
+    adm.set_policy("readonly", user="alice")
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import ssl
+import urllib.parse
+
+from .api.sign import sign_v4_request
+
+ADMIN_PREFIX = "/minio/admin/v3"
+
+
+class AdminError(Exception):
+    """Non-2xx admin response."""
+
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"{status} {code}: {message}")
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class AdminClient:
+    """One admin endpoint + operator credential."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 secure: bool = False, ssl_context: ssl.SSLContext | None = None,
+                 timeout: float = 60.0):
+        self.endpoint = endpoint
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.secure = secure or ssl_context is not None
+        self.ssl_context = ssl_context
+        self.timeout = timeout
+
+    # --- transport ---
+
+    def _call(self, method: str, path: str, query: list | None = None,
+              body: bytes = b"", raw: bool = False):
+        query = query or []
+        full = ADMIN_PREFIX + path
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(full) + (f"?{qs}" if qs else "")
+        headers = sign_v4_request(
+            self.secret_key, self.access_key, method, self.endpoint,
+            full, query, {}, body,
+        )
+        if self.secure:
+            ctx = self.ssl_context or ssl.create_default_context()
+            conn = http.client.HTTPSConnection(
+                self.endpoint, timeout=self.timeout, context=ctx
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                self.endpoint, timeout=self.timeout
+            )
+        try:
+            conn.request(method, url, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        finally:
+            conn.close()
+        if resp.status // 100 != 2:
+            code, message = "", ""
+            try:
+                import xml.etree.ElementTree as ET
+
+                root = ET.fromstring(data)
+                code = root.findtext("Code") or ""
+                message = root.findtext("Message") or ""
+            except ET.ParseError:
+                message = data.decode(errors="replace")[:200]
+            raise AdminError(resp.status, code, message)
+        if raw:
+            return data
+        if not data:
+            return {}
+        try:
+            return json.loads(data)
+        except ValueError:
+            return data
+
+    # --- info / usage / metrics (ref madmin/info-commands.go) ---
+
+    def server_info(self) -> dict:
+        return self._call("GET", "/info")
+
+    def storage_info(self) -> dict:
+        return self._call("GET", "/storageinfo")
+
+    def data_usage_info(self) -> dict:
+        return self._call("GET", "/datausage")
+
+    def metrics(self) -> bytes:
+        """Prometheus exposition text."""
+        return self._call("GET", "/metrics", raw=True)
+
+    def health_info(self) -> dict:
+        """OBD / health diagnostics bundle (ref madmin/health.go)."""
+        return self._call("GET", "/healthinfo")
+
+    def account_info(self) -> dict:
+        return self._call("GET", "/accountinfo")
+
+    # --- config KV (ref madmin/config-kv-commands.go) ---
+
+    def get_config_kv(self, key: str) -> dict:
+        return self._call("GET", "/get-config-kv", [("key", key)])
+
+    def set_config_kv(self, kv: str) -> dict:
+        """kv: 'subsys[:target] key=value ...' exactly like `mc admin
+        config set`."""
+        return self._call("PUT", "/set-config-kv", body=kv.encode())
+
+    def del_config_kv(self, target: str) -> dict:
+        # The target travels in the body, like `mc admin config reset`.
+        return self._call("DELETE", "/del-config-kv", body=target.encode())
+
+    def help_config_kv(self) -> dict:
+        return self._call("GET", "/help-config-kv")
+
+    def list_config_history(self, count: int = 10) -> list:
+        return self._call("GET", "/list-config-history-kv",
+                          [("count", str(count))])
+
+    def restore_config_history(self, restore_id: str) -> dict:
+        return self._call("PUT", "/restore-config-history-kv",
+                          [("restoreId", restore_id)])
+
+    # --- users / policies (ref madmin/user-commands.go) ---
+
+    def list_users(self) -> dict:
+        return self._call("GET", "/list-users")
+
+    def add_user(self, access_key: str, secret_key: str) -> dict:
+        return self._call(
+            "PUT", "/add-user", [("accessKey", access_key)],
+            json.dumps({"secretKey": secret_key}).encode(),
+        )
+
+    def remove_user(self, access_key: str) -> dict:
+        return self._call("DELETE", "/remove-user",
+                          [("accessKey", access_key)])
+
+    def set_user_status(self, access_key: str, status: str) -> dict:
+        return self._call("PUT", "/set-user-status",
+                          [("accessKey", access_key), ("status", status)])
+
+    def list_policies(self) -> dict:
+        return self._call("GET", "/list-canned-policies")
+
+    def add_policy(self, name: str, policy: dict | str) -> dict:
+        body = (policy if isinstance(policy, str)
+                else json.dumps(policy)).encode()
+        return self._call("PUT", "/add-canned-policy",
+                          [("name", name)], body)
+
+    def remove_policy(self, name: str) -> dict:
+        return self._call("DELETE", "/remove-canned-policy",
+                          [("name", name)])
+
+    def set_policy(self, policy_name: str, user: str = "",
+                   group: str = "") -> dict:
+        q = [("policyName", policy_name)]
+        if user:
+            q.append(("userOrGroup", user))
+            q.append(("isGroup", "false"))
+        elif group:
+            q.append(("userOrGroup", group))
+            q.append(("isGroup", "true"))
+        return self._call("PUT", "/set-user-or-group-policy", q)
+
+    # --- heal (ref madmin/heal-commands.go) ---
+
+    def heal(self, bucket: str = "", prefix: str = "",
+             recursive: bool = True, dry_run: bool = False) -> dict:
+        path = "/heal"
+        if bucket:
+            path += f"/{bucket}"
+            if prefix:
+                path += f"/{prefix}"
+        q = []
+        if recursive:
+            q.append(("recursive", "true"))
+        if dry_run:
+            q.append(("dryRun", "true"))
+        return self._call("POST", path, q)
+
+    # --- locks / trace / logs (ref madmin/top-commands.go) ---
+
+    def top_locks(self) -> dict:
+        return self._call("GET", "/top")
+
+    def trace(self, wait_s: float = 2.0, verbose: bool = False):
+        q = [("wait", str(wait_s))]
+        if verbose:
+            q.append(("verbose", "true"))
+        return self._call("GET", "/trace", q)
+
+    def audit_log(self, n: int = 100):
+        return self._call("GET", "/audit-log", [("n", str(n))])
+
+    def console_log(self, n: int = 100):
+        return self._call("GET", "/console", [("n", str(n))])
+
+    # --- service control (ref madmin/service-commands.go) ---
+
+    def service_restart(self) -> dict:
+        return self._call("POST", "/service", [("action", "restart")])
+
+    def service_stop(self) -> dict:
+        return self._call("POST", "/service", [("action", "stop")])
+
+    # --- profiling (ref madmin/profiling-commands.go) ---
+
+    def start_profiling(self) -> dict:
+        return self._call("POST", "/start-profiling")
+
+    def download_profiling(self) -> bytes:
+        return self._call("GET", "/download-profiling", raw=True)
+
+    # --- quota / bandwidth / replication (ref madmin/quota-commands.go) ---
+
+    def set_bucket_quota(self, bucket: str, quota_bytes: int,
+                         quota_type: str = "hard") -> dict:
+        return self._call(
+            "PUT", "/set-bucket-quota", [("bucket", bucket)],
+            json.dumps({"quota": quota_bytes, "quotatype": quota_type}
+                       ).encode(),
+        )
+
+    def get_bucket_quota(self, bucket: str) -> dict:
+        return self._call("GET", "/get-bucket-quota", [("bucket", bucket)])
+
+    def bandwidth(self, buckets: list[str] | None = None) -> dict:
+        q = [("buckets", ",".join(buckets))] if buckets else []
+        return self._call("GET", "/bandwidth", q)
+
+    def replication_stats(self, bucket: str) -> dict:
+        return self._call("GET", "/replication-stats", [("bucket", bucket)])
+
+    def replication_resync(self, bucket: str, arn: str = "") -> dict:
+        q = [("bucket", bucket)]
+        if arn:
+            q.append(("arn", arn))
+        return self._call("POST", "/replication-resync", q)
+
+    # --- KMS (ref madmin/kms-commands.go) ---
+
+    def kms_status(self, key_id: str = "") -> dict:
+        return self._call("GET", "/kms",
+                          [("key-id", key_id)] if key_id else [])
+
+    def kms_create_key(self, key_id: str) -> dict:
+        return self._call("POST", "/kms", [("key-id", key_id)])
+
+    # --- tiers (ref madmin/tier.go) ---
+
+    def add_tier(self, config: dict) -> dict:
+        return self._call("PUT", "/add-tier", body=json.dumps(config).encode())
+
+    def list_tiers(self) -> list:
+        return self._call("GET", "/list-tiers")
+
+    def remove_tier(self, name: str) -> dict:
+        return self._call("DELETE", "/remove-tier", [("name", name)])

@@ -14,6 +14,7 @@ import time
 
 from ..iam import Args, Policy
 from ..utils.errors import StorageError
+from ..utils.sysinfo import probe as _sysinfo_probe
 from .errors import S3Error
 from .handlers import Response
 
@@ -262,27 +263,20 @@ class AdminHandlers:
             parts = text.split()
             target = parts[0]
             kv = dict(p.split("=", 1) for p in parts[1:])
-            self._validate_target_kv(target, kv)
+            # Per-subsystem validation happens inside Config.set_kv so
+            # every write path (set, restore) shares one guard.
             self.config_sys.config.set_kv(target, **kv)
         except (ValueError, IndexError) as exc:
             raise S3Error("InvalidArgument", str(exc)) from exc
         self.config_sys.save()
-        return self._json({"restart": False})
-
-    def _validate_target_kv(self, target: str, kv: dict):
-        """Reject configs that would brick or silently no-op a subsystem
-        BEFORE persisting — an accepted-then-skipped-at-boot target
-        (targets_from_config's backstop) helps nobody. Mirrors the
-        reference validating target args inside config.LookupConfig."""
-        subsys = target.split(":", 1)[0]
-        if subsys == "notify_redis":
-            merged = dict(self.config_sys.config.get(target))
-            merged.update(kv)
-            if merged.get("enable") == "on" and \
-                    not merged.get("address", "").strip():
-                raise ValueError(
-                    "notify_redis: address is required when enable=on"
-                )
+        # Keys read once at server construction need a restart to take
+        # effect — say so instead of implying they're live (the
+        # reference's config subsystem reports the same flag).
+        restart_keys = {"requests_max", "requests_deadline"}
+        needs_restart = (
+            target.split(":", 1)[0] == "api" and bool(restart_keys & set(kv))
+        )
+        return self._json({"restart": needs_restart})
 
     def del_config_kv(self, ctx) -> Response:
         if self.config_sys is None:
@@ -630,6 +624,10 @@ class AdminHandlers:
             },
             "versions": versions,
             "disks": disks,
+            # Platform probe: mounts, block-device identity, cpu SIMD
+            # capability, cgroup limits, net links (the pkg/disk +
+            # pkg/smart + gopsutil collectors of cmd/admin-obd.go).
+            "sys": _sysinfo_probe(),
         })
 
     # ---------- remote tiers (ref the madmin tier registry / tier admin

@@ -326,6 +326,21 @@ def _check_rejected_apis(method: str, q: dict, is_object: bool):
             raise S3Error("NotImplemented", f"{method} ?{sub}")
 
 
+def _parse_duration_s(text: str) -> float | None:
+    """'10s' / '1m' / '1h' / bare seconds -> seconds; None if bad."""
+    t = (text or "").strip().lower()
+    mult = 1.0
+    for suffix, m in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if t.endswith(suffix):
+            t = t[: -len(suffix)]
+            mult = m
+            break
+    try:
+        return float(t) * mult
+    except ValueError:
+        return None
+
+
 def _reserved_metadata_check(ctx: RequestContext):
     """Reject client-supplied internal metadata (ref
     cmd/generic-handlers.go ReservedMetadataPrefix filter)."""
@@ -387,6 +402,25 @@ class S3Server:
         kvs = config_sys.config.get("api") if config_sys is not None else {}
         self.cors_origin = (kvs.get("cors_allow_origin", "*") or "*") \
             if hasattr(kvs, "get") else "*"
+        # API request throttle (ref maxClients, cmd/handler-api.go:36-78):
+        # `api requests_max` bounds concurrent S3 data-plane requests per
+        # node; waiters past `api requests_deadline` get 503 SlowDown.
+        # 0 = unlimited (the reference auto-sizes from RAM; explicit
+        # opt-in keeps small-host behavior predictable here).
+        self._requests_sem = None
+        self._requests_deadline_s = 10.0
+        if hasattr(kvs, "get"):
+            # Parsed independently: a bad deadline must never silently
+            # disable the concurrency limit the operator configured.
+            try:
+                req_max = int(kvs.get("requests_max", "0") or "0")
+            except ValueError:
+                req_max = 0
+            if req_max > 0:
+                self._requests_sem = threading.BoundedSemaphore(req_max)
+            dl = _parse_duration_s(kvs.get("requests_deadline", "10s"))
+            if dl is not None:
+                self._requests_deadline_s = dl
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -479,21 +513,35 @@ class S3Server:
             self.metrics.inc_gauge("s3_requests_inflight")
         err_code = ""
         try:
-            resp = self._process(ctx)
-        except S3Error as exc:
-            err_code = exc.api.code
-            resp = Response(
-                exc.api.status,
-                {"Content-Type": "application/xml"},
-                error_xml(exc.api, ctx.path, ctx.request_id, exc.detail),
-            )
-        except Exception as exc:  # noqa: BLE001 — render as InternalError
-            err_code = "InternalError"
-            api = API_ERRORS["InternalError"]
-            resp = Response(
-                api.status, {"Content-Type": "application/xml"},
-                error_xml(api, ctx.path, ctx.request_id, str(exc)),
-            )
+            try:
+                resp = self._process(ctx)
+            except S3Error as exc:
+                err_code = exc.api.code
+                resp = Response(
+                    exc.api.status,
+                    {"Content-Type": "application/xml"},
+                    error_xml(exc.api, ctx.path, ctx.request_id, exc.detail),
+                )
+            except Exception as exc:  # noqa: BLE001 — as InternalError
+                err_code = "InternalError"
+                api = API_ERRORS["InternalError"]
+                resp = Response(
+                    api.status, {"Content-Type": "application/xml"},
+                    error_xml(api, ctx.path, ctx.request_id, str(exc)),
+                )
+            self._finish(h, ctx, resp, t0, err_code)
+        finally:
+            # The throttle slot covers everything from admission through
+            # the written response — released here, NEVER lower down, so
+            # a metrics/trace/audit failure can't leak a permit and
+            # ratchet the server toward permanent 503s.
+            if getattr(ctx, "held_request_slot", False):
+                self._requests_sem.release()
+
+    def _finish(self, h, ctx, resp, t0, err_code):
+        """Post-response accounting (metrics, trace, audit) + the write."""
+        import time as _time
+
         if self.metrics is not None:
             api_name = getattr(ctx, "api_name", "") or "unknown"
             self.metrics.inc_gauge("s3_requests_inflight", -1)
@@ -683,6 +731,16 @@ class S3Server:
         ctx.api_name = name
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
+        if self._requests_sem is not None:
+            # Slot held until the RESPONSE is fully written (released in
+            # _handle's finally), covering streamed GET bodies like the
+            # reference's maxClients wrapping the whole ServeHTTP.
+            if not self._requests_sem.acquire(
+                    timeout=self._requests_deadline_s):
+                if self.metrics is not None:
+                    self.metrics.inc("s3_requests_rejected_total")
+                raise S3Error("SlowDown", "request limit reached")
+            ctx.held_request_slot = True
         if name == "post_policy_object":
             # POST policy uploads authenticate via the SIGNED POLICY in
             # the form body, not SigV4 headers — the handler verifies

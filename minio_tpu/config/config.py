@@ -78,6 +78,25 @@ HELP: dict[str, str] = {
 
 DEFAULT_TARGET = "_"
 
+# Keys that must be non-empty for a subsystem target with enable=on —
+# accepting the config and silently skipping the target at boot helps
+# nobody (ref per-target args.Validate() in pkg/event/target/*.go).
+_REQUIRED_WHEN_ENABLED = {
+    "notify_redis": ("address",),
+    "notify_webhook": ("endpoint",),
+    "notify_mysql": ("dsn_string",),
+    "notify_postgres": ("connection_string",),
+}
+
+
+def validate_subsys(sub: str, kvs) -> None:
+    req = _REQUIRED_WHEN_ENABLED.get(sub)
+    if not req or kvs.get("enable") != "on":
+        return
+    for k in req:
+        if not (kvs.get(k) or "").strip():
+            raise ValueError(f"{sub}: {k} is required when enable=on")
+
 
 class KVS(dict):
     """One target's key-value set."""
@@ -111,7 +130,24 @@ class Config:
         cur = self._data[sub].setdefault(
             target, KVS(SUBSYSTEMS[sub])
         )
+        before = dict(cur)
         cur.update(kv)
+        try:
+            validate_subsys(sub, self.get(subsys_target))
+        except ValueError:
+            # Reject-and-revert: an invalid combination must never be
+            # persisted to be skipped at next boot.
+            cur.clear()
+            cur.update(before)
+            raise
+
+    def validate(self):
+        """Whole-config validation — the guard for bulk write paths
+        (history restore) that bypass set_kv."""
+        for sub in _REQUIRED_WHEN_ENABLED:
+            for target in self.targets(sub):
+                suffix = "" if target == DEFAULT_TARGET else f":{target}"
+                validate_subsys(sub, self.get(f"{sub}{suffix}"))
 
     def del_target(self, subsys_target: str):
         sub, target = self.split_subsys(subsys_target)
@@ -255,5 +291,8 @@ class ConfigSys:
         The pre-restore config is itself kept in history."""
         raw = self.history_get(name)
         cfg = Config.from_json(raw)
+        # Validate BEFORE replacing the live config: a history entry
+        # predating a validation rule must not brick the subsystem.
+        cfg.validate()
         self.config = cfg
         self.save(keep_history=True)

@@ -1,0 +1,149 @@
+"""Platform probing for the OBD health bundle — the /proc and /sys
+readers standing in for the reference's pkg/disk, pkg/smart and
+gopsutil-backed cmd/admin-obd.go collectors. SMART attributes proper
+need raw-device ioctls (root); /sys/block exposes the identity facts
+(model, rotational, scheduler, size) the bundle needs for triage, so we
+read those and say so."""
+
+from __future__ import annotations
+
+import os
+
+
+def mounts() -> list[dict]:
+    """Parsed /proc/mounts (device, mountpoint, fstype, options) —
+    pkg/disk.GetInfo's mount table, minus pseudo filesystems."""
+    skip_fs = {"proc", "sysfs", "devpts", "cgroup", "cgroup2", "securityfs",
+               "debugfs", "tracefs", "pstore", "bpf", "configfs",
+               "fusectl", "mqueue", "hugetlbfs", "binfmt_misc", "autofs"}
+    out = []
+    try:
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 4 or parts[2] in skip_fs:
+                    continue
+                out.append({
+                    "device": parts[0], "mountpoint": parts[1],
+                    "fstype": parts[2], "options": parts[3],
+                })
+    except OSError:
+        pass
+    return out
+
+
+def block_devices() -> list[dict]:
+    """/sys/block identity facts per device (pkg/smart's triage subset:
+    model/rotational/size/scheduler; SMART attributes need root ioctls,
+    noted per device)."""
+    out = []
+    try:
+        names = sorted(os.listdir("/sys/block"))
+    except OSError:
+        return out
+
+    def read(dev, rel):
+        try:
+            with open(f"/sys/block/{dev}/{rel}") as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    for dev in names:
+        if dev.startswith(("loop", "ram", "zram")):
+            continue
+        size_sectors = read(dev, "size")
+        entry = {
+            "name": dev,
+            "model": read(dev, "device/model"),
+            "rotational": read(dev, "queue/rotational") == "1",
+            "scheduler": read(dev, "queue/scheduler"),
+            "size_bytes": int(size_sectors) * 512 if size_sectors.isdigit()
+            else 0,
+            "smart": "unavailable (needs raw-device ioctl)",
+        }
+        out.append(entry)
+    return out
+
+
+def cpu_info() -> dict:
+    """Model + the SIMD capability flags the native engines key off."""
+    model = ""
+    flags: list[str] = []
+    interesting = {"avx2", "avx512f", "gfni", "ssse3", "sha_ni", "aes",
+                   "vpclmulqdq", "avx512vbmi"}
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name") and not model:
+                    model = line.split(":", 1)[1].strip()
+                elif line.startswith("flags") and not flags:
+                    flags = sorted(
+                        set(line.split(":", 1)[1].split()) & interesting
+                    )
+    except OSError:
+        pass
+    la = (0.0, 0.0, 0.0)
+    try:
+        la = os.getloadavg()
+    except OSError:
+        pass
+    return {"model": model, "count": os.cpu_count(), "simd": flags,
+            "loadavg_1m": round(la[0], 2), "loadavg_5m": round(la[1], 2)}
+
+
+def cgroup_limits() -> dict:
+    """Container memory/cpu limits (cgroup v2 with v1 fallback) — the
+    reference reads these to size caches (pkg/sys/stats_linux.go)."""
+    out: dict = {}
+    for path, key in (
+        ("/sys/fs/cgroup/memory.max", "memory_max"),
+        ("/sys/fs/cgroup/memory.current", "memory_current"),
+        ("/sys/fs/cgroup/cpu.max", "cpu_max"),
+        ("/sys/fs/cgroup/memory/memory.limit_in_bytes", "memory_max"),
+    ):
+        if key in out:
+            continue
+        try:
+            with open(path) as f:
+                val = f.read().strip()
+            out[key] = val if not val.isdigit() else int(val)
+        except OSError:
+            continue
+    return out
+
+
+def net_interfaces() -> list[dict]:
+    out = []
+    try:
+        names = sorted(os.listdir("/sys/class/net"))
+    except OSError:
+        return out
+    for dev in names:
+        def read(rel, d=dev):
+            try:
+                with open(f"/sys/class/net/{d}/{rel}") as f:
+                    return f.read().strip()
+            except OSError:
+                return ""
+
+        spd = read("speed")
+        out.append({
+            "name": dev,
+            "mtu": int(read("mtu") or 0),
+            "state": read("operstate"),
+            "speed_mbps": int(spd)
+            if spd.lstrip("-").isdigit() and spd != "-1" else None,
+        })
+    return out
+
+
+def probe() -> dict:
+    """The full platform section of the OBD bundle."""
+    return {
+        "cpu": cpu_info(),
+        "mounts": mounts(),
+        "block_devices": block_devices(),
+        "cgroup": cgroup_limits(),
+        "net": net_interfaces(),
+    }

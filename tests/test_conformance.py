@@ -280,3 +280,36 @@ def test_policy_status_structural(cl):
     st, _, body = cl.request("GET", f"/{BKT}", query=[("policyStatus", "")])
     assert st == 200 and b"<IsPublic>TRUE</IsPublic>" in body, body
     cl.request("DELETE", f"/{BKT}", query=[("policy", "")])
+
+
+def test_requests_max_throttle(tmp_path):
+    """api requests_max bounds concurrent S3 requests; waiters past
+    requests_deadline get 503 SlowDown (ref cmd/handler-api.go
+    maxClients)."""
+    from minio_tpu.api import S3Server
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.config import ConfigSys
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.object.fs import FSObjects
+
+    ol = FSObjects(str(tmp_path / "fs"))
+    cfg = ConfigSys(ol, secret=SECRET)
+    cfg.config.set_kv("api", requests_max="1", requests_deadline="1s")
+    srv = S3Server(ol, IAMSys(ACCESS, SECRET), BucketMetadataSys(ol),
+                   config_sys=cfg).start()
+    try:
+        c = Client(srv)
+        assert c.request("PUT", "/thrbkt")[0] == 200  # throttled + works
+        # Hold the only slot: the next data-plane request must wait out
+        # the deadline and get 503 SlowDown.
+        assert srv._requests_sem.acquire(timeout=5)
+        try:
+            st, _, body = c.request("GET", "/thrbkt")
+            assert st == 503, (st, body[:200])
+            assert _err_code(body) == "SlowDown"
+        finally:
+            srv._requests_sem.release()
+        # Slot free again: requests flow.
+        assert c.request("GET", "/thrbkt")[0] == 200
+    finally:
+        srv.stop()

@@ -115,3 +115,15 @@ def test_invalid_notify_config_rejected_at_set_time(adm):
         adm.set_config_kv("notify_redis enable=on key=events")
     assert ei.value.code == "InvalidArgument"
     assert "address" in ei.value.message
+
+
+def test_health_info_platform_probe(adm):
+    info = adm.health_info()
+    sys_ = info["sys"]
+    assert sys_["cpu"]["count"] >= 1
+    assert isinstance(sys_["mounts"], list)
+    assert isinstance(sys_["block_devices"], list)
+    assert isinstance(sys_["net"], list)
+    # every mount row carries the four identity fields
+    for m in sys_["mounts"][:3]:
+        assert set(m) == {"device", "mountpoint", "fstype", "options"}

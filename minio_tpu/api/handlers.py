@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import datetime
 import hashlib
+import json
 import io
 import re
 import urllib.parse
@@ -379,6 +380,69 @@ class S3ApiHandlers:
     def _check_bucket(self, bucket: str):
         if not self.ol.bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
+
+    def listen_notification(self, ctx) -> Response:
+        """GET /bucket?events=...&prefix=&suffix= — live bucket event
+        feed (ref ListenNotificationHandler, cmd/bucket-notification-
+        handlers.go:160): newline-delimited JSON records streamed as
+        events happen, blank-line keepalives every few seconds, ended by
+        client disconnect. MinIO-extension API used by `mc watch`."""
+        self._check_bucket(ctx.bucket)
+        if self.notify is None:
+            raise S3Error("NotImplemented", "no event notifier")
+        from ..event.rules import TargetRule, expand_name, valid_event_name
+
+        want_events: list[str] = []
+        for k, v in ctx.query:
+            if k == "events" and v:
+                if not valid_event_name(v):
+                    # ref ParseName errors on unknown event names — a
+                    # silent never-matching stream helps nobody.
+                    raise S3Error("InvalidArgument",
+                                  f"unknown event name {v!r}")
+                want_events.extend(expand_name(v))
+        if not want_events:
+            raise S3Error("InvalidArgument", "events parameter required")
+        # One shared matcher with the notification targets — the listen
+        # filter must never diverge from rule-target semantics.
+        rule = TargetRule(
+            arn="", events=want_events,
+            prefix=ctx.qdict.get("prefix", ""),
+            suffix=ctx.qdict.get("suffix", ""),
+        )
+        bucket = ctx.bucket
+        notify = self.notify
+
+        def stream(dst):
+            import queue as _queue
+
+            sub = notify.subscribe()
+            try:
+                while True:
+                    try:
+                        name, b, key, payload = sub.get(timeout=5.0)
+                    except _queue.Empty:
+                        # Keepalive: lets dead clients surface as write
+                        # errors instead of leaking subscriptions.
+                        dst.write(b"\n")
+                        dst.flush()
+                        continue
+                    if b != bucket or not rule.matches(name, key):
+                        continue
+                    dst.write(json.dumps(
+                        {"Records": payload.get("Records", [])}
+                    ).encode() + b"\n")
+                    dst.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return  # client hung up: normal end of a watch
+            finally:
+                notify.unsubscribe(sub)
+
+        resp = Response(
+            200, {"Content-Type": "application/json"}, body_stream=stream
+        )
+        resp.unbounded_stream = True
+        return resp
 
     # --- dummy bucket subresources (ref cmd/dummy-handlers.go): canned
     # S3-shaped answers for SDK feature probes ---

@@ -47,6 +47,7 @@ def _check_reserved_bucket(bucket: str):
 
 # S3 action names per route (subset of pkg/iam/policy/action.go).
 _ACTIONS = {
+    "listen_notification": "s3:ListenBucketNotification",
     "get_object_tagging": "s3:GetObjectTagging",
     "put_object_tagging": "s3:PutObjectTagging",
     "delete_object_tagging": "s3:DeleteObjectTagging",
@@ -218,6 +219,8 @@ def route(ctx: RequestContext) -> str:
                 return "list_multipart_uploads"
             if "versions" in q:
                 return "list_object_versions"
+            if "events" in q:
+                return "listen_notification"
             if q.get("list-type") == "2":
                 return "list_objects_v2"
             return "list_objects_v1"
@@ -731,10 +734,13 @@ class S3Server:
         ctx.api_name = name
         if self.metrics is not None:
             self.metrics.inc("s3_requests_total", api=name)
-        if self._requests_sem is not None:
+        if self._requests_sem is not None and name != "listen_notification":
             # Slot held until the RESPONSE is fully written (released in
             # _handle's finally), covering streamed GET bodies like the
             # reference's maxClients wrapping the whole ServeHTTP.
+            # listen_notification is exempt: a watch stream lives for
+            # hours and would permanently pin a permit (the reference
+            # likewise excludes it from maxClients).
             if not self._requests_sem.acquire(
                     timeout=self._requests_deadline_s):
                 if self.metrics is not None:
@@ -847,9 +853,17 @@ class S3Server:
             headers["x-amz-request-id"] = ctx.request_id
             body = resp.body if ctx.method != "HEAD" else b""
             streaming = resp.body_stream is not None and ctx.method != "HEAD"
-            if streaming and "Content-Length" not in headers:
+            unbounded = streaming and getattr(resp, "unbounded_stream", False)
+            if unbounded:
+                # Close-delimited body (listen-notification style live
+                # feeds have no length); the connection ends the stream.
+                headers.pop("Content-Length", None)
+                headers["Connection"] = "close"
+                h.close_connection = True
+            elif streaming and "Content-Length" not in headers:
                 raise RuntimeError("streaming response needs Content-Length")
-            if "Content-Length" not in headers or ctx.method == "HEAD":
+            if not unbounded and (
+                    "Content-Length" not in headers or ctx.method == "HEAD"):
                 headers["Content-Length"] = headers.get(
                     "Content-Length", str(len(resp.body))
                 )

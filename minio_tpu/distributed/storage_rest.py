@@ -505,7 +505,8 @@ class RemoteStorage(StorageAPI):
         }, want_stream=True)
         return io.BytesIO(data)
 
-    def create_file_writer(self, volume: str, path: str):
+    def create_file_writer(self, volume: str, path: str,
+                           size: int = -1):
         return _RemoteWriter(self, volume, path)
 
     def rename_file(self, src_volume: str, src_path: str,

@@ -35,6 +35,17 @@ _EXPANSIONS = {
 }
 
 
+_VALID_NAMES = set(_EXPANSIONS) | {
+    n for vs in _EXPANSIONS.values() for n in vs
+} | {"s3:ObjectRestore:Post", "s3:ObjectRestore:Completed"}
+
+
+def valid_event_name(name: str) -> bool:
+    """Known event name or wildcard (ref pkg/event/name.go ParseName,
+    which errors on unknown names)."""
+    return name in _VALID_NAMES
+
+
 def expand_name(name: str) -> list[str]:
     return _EXPANSIONS.get(name, [name])
 

@@ -62,6 +62,7 @@ class EventNotifier:
         self.metrics = metrics
         self.logger = logger
         self._rules: dict[str, list[TargetRule]] = {}
+        self._subs: list[queue.Queue] = []
         self._mu = threading.Lock()
         self._q: queue.Queue = queue.Queue(10000)
         self._stop = threading.Event()
@@ -105,12 +106,32 @@ class EventNotifier:
 
     # --- send path ---
 
+    def subscribe(self, maxsize: int = 1000) -> "queue.Queue":
+        """Live event feed for ListenNotification: every event (not just
+        rule-matched ones) is pushed as (event_name, bucket, key,
+        payload); the listener filters. Matches the reference
+        registering an in-memory PeerRESTClient target per listen call
+        (cmd/notification.go AddRemoteTarget for listenNotification)."""
+        q: queue.Queue = queue.Queue(maxsize)
+        with self._mu:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q):
+        with self._mu:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
     def send(self, event_name: str, bucket: str, oi=None, key: str = ""):
         """Non-blocking: match rules, enqueue for the worker."""
         if oi is not None:
             key = oi.name
         arns = match_rules(self.rules_for(bucket), event_name, key)
-        if not arns:
+        with self._mu:
+            subs = list(self._subs)
+        if not arns and not subs:
             return
         record = make_event_record(
             event_name, bucket, key,
@@ -121,6 +142,13 @@ class EventNotifier:
         )
         payload = {"EventName": event_name, "Key": f"{bucket}/{key}",
                    "Records": [record]}
+        for sq in subs:
+            try:
+                sq.put_nowait((event_name, bucket, key, payload))
+            except queue.Full:
+                pass  # slow listener drops; targets are unaffected
+        if not arns:
+            return
         try:
             self._q.put_nowait((arns, payload))
         except queue.Full:

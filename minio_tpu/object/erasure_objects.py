@@ -332,6 +332,16 @@ class ErasureObjects(MultipartMixin):
         data_dir = new_uuid()
         tee = TeeMD5Reader(reader)
 
+        # Physical per-shard file size (erasure shard + bitrot frames):
+        # known up front for sized PUTs, lets O_DIRECT disks fallocate.
+        from ..erasure.bitrot import bitrot_shard_file_size
+
+        phys_shard = (
+            bitrot_shard_file_size(
+                shard_file_size, erasure.shard_size(),
+                BitrotAlgorithm.HIGHWAYHASH256S,
+            ) if shard_file_size >= 0 else -1
+        )
         writers: list = [None] * n
         sinks: list = [None] * n
         for i, disk in enumerate(disks_by_shard):
@@ -342,7 +352,9 @@ class ErasureObjects(MultipartMixin):
                     sinks[i] = io.BytesIO()
                 else:
                     sinks[i] = disk.create_file_writer(
-                        SYSTEM_META_BUCKET, f"{self._tmp_path(tmp_id)}/part.1"
+                        SYSTEM_META_BUCKET,
+                        f"{self._tmp_path(tmp_id)}/part.1",
+                        size=phys_shard,
                     )
                 writers[i] = StreamingBitrotWriter(
                     sinks[i], BitrotAlgorithm.HIGHWAYHASH256S
@@ -357,6 +369,15 @@ class ErasureObjects(MultipartMixin):
                 with _encode_slot():
                     total = encode_stream(erasure, tee, writers, write_quorum)
         except Exception:
+            # Close abandoned sinks BEFORE the tmp cleanup: raw-fd
+            # (O_DIRECT) sinks hold an fd + staging buffer that GC may
+            # not finalize promptly — aborted uploads must not leak them.
+            for s in sinks:
+                if s is not None:
+                    try:
+                        s.close()
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
             self._cleanup_tmp(disks_by_shard, tmp_id)
             raise
         if size >= 0 and total != size:
@@ -953,6 +974,13 @@ class ErasureObjects(MultipartMixin):
                         avail_by_shard[s], metas_by_shard[s], bucket, object_,
                         ref_fi, part.number, till, erasure.shard_size(),
                     )
+                from ..erasure.bitrot import bitrot_shard_file_size
+
+                phys_shard = bitrot_shard_file_size(
+                    erasure.shard_file_size(part.size),
+                    erasure.shard_size(),
+                    BitrotAlgorithm.HIGHWAYHASH256S,
+                )
                 writers: list = [None] * len(disks_by_shard)
                 sinks: dict[int, object] = {}
                 for s in stale_shards:
@@ -962,11 +990,24 @@ class ErasureObjects(MultipartMixin):
                         sinks[s] = disks_by_shard[s].create_file_writer(
                             SYSTEM_META_BUCKET,
                             f"{self._tmp_path(tmp_id)}/part.{part.number}",
+                            size=phys_shard,
                         )
                     writers[s] = StreamingBitrotWriter(
                         sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
                     )
-                heal_stream(erasure, writers, readers, part.size)
+                try:
+                    heal_stream(erasure, writers, readers, part.size)
+                except Exception:
+                    # Close raw-fd sinks before bailing (O_DIRECT fd +
+                    # staging buffer must not wait for GC).
+                    for s in stale_shards:
+                        if not inline:
+                            try:
+                                sinks[s].close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                    self._cleanup_tmp(disks_by_shard, tmp_id)
+                    raise
                 for s in stale_shards:
                     if inline:
                         healed_inline[s][part.number] = sinks[s].getvalue()

@@ -144,12 +144,21 @@ class MultipartMixin:
         tmp_part = f"part.{part_number}.tmp.{new_uuid()}"
         writers: list = [None] * len(disks_by_shard)
         sinks: list = [None] * len(disks_by_shard)
+        from ..erasure.bitrot import bitrot_shard_file_size
+
+        phys_shard = (
+            bitrot_shard_file_size(
+                erasure.shard_file_size(size), erasure.shard_size(),
+                BitrotAlgorithm.HIGHWAYHASH256S,
+            ) if size >= 0 else -1
+        )
         for i, disk in enumerate(disks_by_shard):
             if disk is None:
                 continue
             try:
                 sinks[i] = disk.create_file_writer(
-                    SYSTEM_META_BUCKET, f"{upload_path}/{tmp_part}"
+                    SYSTEM_META_BUCKET, f"{upload_path}/{tmp_part}",
+                    size=phys_shard,
                 )
                 writers[i] = StreamingBitrotWriter(
                     sinks[i], BitrotAlgorithm.HIGHWAYHASH256S
@@ -158,6 +167,14 @@ class MultipartMixin:
                 writers[i] = None
 
         def _drop_tmp():
+            # Close any open sinks FIRST: raw-fd (O_DIRECT) writers hold
+            # an fd + staging buffer that GC may not finalize promptly.
+            for s in sinks:
+                if s is not None:
+                    try:
+                        s.close()
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
             for disk in disks_by_shard:
                 if disk is None:
                     continue

@@ -1,0 +1,237 @@
+"""O_DIRECT + fallocate shard-file IO — the L0 layer of the reference's
+xl-storage (/root/reference/cmd/xl-storage.go:1089 odirectReader and the
+CreateFile path using fallocate + directio writes, pkg/disk/directio_*).
+
+Purpose on real NVMe/SSD deployments: shard streams are written once and
+read rarely (until a GET), so routing them through the page cache evicts
+hot metadata for cold bulk bytes. O_DIRECT bypasses the cache;
+posix_fallocate reserves contiguous extents up front (no ENOSPC at
+commit time, less fragmentation).
+
+Semantics preserved exactly: DirectFileWriter is a drop-in sink for
+StreamingBitrotWriter (write/fileno/flush/close). O_DIRECT demands
+block-aligned buffers, lengths, and offsets, so writes stage through one
+reusable aligned buffer and flush in aligned chunks; the final
+sub-block tail is written after flipping O_DIRECT off (the standard
+last-partial-block technique — the reference pads with zeroes instead
+because its erasure shards are block-multiple; arbitrary sinks here may
+not be).
+
+Opt-in via MTPU_ODIRECT=1 (storage/local.py); tmpfs and filesystems
+without O_DIRECT fall back to the buffered writer transparently — the
+bench host's tmpfs cannot exercise this path, real disks can.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+
+ALIGN = 4096  # covers 512e and 4Kn devices (ref pkg/disk directio block)
+_BUF_SIZE = 1 << 20
+
+
+def supports_odirect(directory: str) -> bool:
+    """Probe whether `directory`'s filesystem accepts O_DIRECT opens."""
+    probe = os.path.join(directory, f".odirect-probe-{os.getpid()}")
+    try:
+        fd = os.open(probe, os.O_WRONLY | os.O_CREAT | os.O_DIRECT, 0o600)
+    except OSError:
+        return False
+    os.close(fd)
+    try:
+        os.unlink(probe)
+    except OSError:
+        pass
+    return True
+
+
+class DirectFileWriter:
+    """Write-once file sink over an O_DIRECT fd with aligned staging."""
+
+    def __init__(self, path: str, expected_size: int = -1,
+                 fsync_on_close: bool = False):
+        # _closed guards __del__ against a partially-built instance
+        # (os.open or mmap failing mid-init must not AttributeError in
+        # the finalizer or leak the fd).
+        self._closed = True
+        self._fd = os.open(
+            path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC | os.O_DIRECT,
+            0o644,
+        )
+        self._path = path
+        # fsync must run AFTER the buffered tail write inside close()
+        # (an outer fsync-then-close wrapper would sync too early), so
+        # the durability point is owned here.
+        self._fsync_on_close = fsync_on_close
+        if expected_size > 0:
+            try:
+                # Extent reservation (ref xl-storage Fallocate before
+                # CreateFile): commit-time ENOSPC becomes open-time.
+                os.posix_fallocate(self._fd, 0, expected_size)
+            except OSError:
+                pass
+        # mmap pages are page-aligned — the portable aligned allocator.
+        try:
+            self._buf = mmap.mmap(-1, _BUF_SIZE)
+        except OSError:
+            os.close(self._fd)
+            raise
+        self._fill = 0
+        self._offset = 0
+        self._closed = False
+
+    def write(self, data) -> int:
+        mv = memoryview(data)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        total = len(mv)
+        pos = 0
+        while pos < total:
+            n = min(total - pos, _BUF_SIZE - self._fill)
+            self._buf[self._fill: self._fill + n] = mv[pos: pos + n]
+            self._fill += n
+            pos += n
+            if self._fill == _BUF_SIZE:
+                self._flush_aligned(_BUF_SIZE)
+        return total
+
+    def _flush_aligned(self, n_aligned: int):
+        """Write n_aligned (multiple of ALIGN) bytes from the buffer via
+        the O_DIRECT fd; keep any remainder staged. The memoryview is
+        released promptly — a live export blocks mmap.close()."""
+        with memoryview(self._buf) as mv:
+            written = 0
+            while written < n_aligned:
+                n = os.write(self._fd, mv[written:n_aligned])
+                written += n
+                if written % ALIGN and written < n_aligned:
+                    # A non-block-multiple short write leaves both the
+                    # buffer address and the file offset unaligned; a
+                    # blind retry would fail with EINVAL and mask the
+                    # real cause (ENOSPC/RLIMIT). Surface it directly.
+                    raise OSError(
+                        f"O_DIRECT short write left unaligned offset "
+                        f"({written}/{n_aligned}) on {self._path}"
+                    )
+        rest = self._fill - n_aligned
+        if rest:
+            self._buf.move(0, n_aligned, rest)
+        self._fill = rest
+        self._offset += n_aligned
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def flush(self):
+        pass  # aligned data is flushed eagerly; the tail goes at close
+
+    def __del__(self):
+        # Failure-path safety net: a PUT that dies mid-stream abandons
+        # its sinks without close(); the buffered path's file objects
+        # are GC-finalized, and this raw fd + 1 MiB mmap must be too —
+        # otherwise every aborted upload leaks until EMFILE.
+        if not self._closed:
+            self._closed = True
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            try:
+                self._buf.close()
+            except (BufferError, ValueError):
+                pass
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            aligned = (self._fill // ALIGN) * ALIGN
+            if aligned:
+                self._flush_aligned(aligned)
+            if self._fill:
+                # Sub-block tail: O_DIRECT cannot write it without
+                # padding the FILE SIZE, so flip to buffered for the
+                # final write (fcntl F_SETFL, the standard close-out).
+                import fcntl
+
+                flags = fcntl.fcntl(self._fd, fcntl.F_GETFL)
+                fcntl.fcntl(self._fd, fcntl.F_SETFL,
+                            flags & ~os.O_DIRECT)
+                with memoryview(self._buf) as mv:
+                    written = 0
+                    while written < self._fill:
+                        written += os.write(self._fd, mv[written:self._fill])
+                self._offset += self._fill
+                self._fill = 0
+            # fallocate may have reserved past the true end.
+            os.ftruncate(self._fd, self._offset)
+            if self._fsync_on_close:
+                os.fsync(self._fd)
+        finally:
+            os.close(self._fd)
+            self._buf.close()
+
+
+class DirectReader:
+    """Streaming O_DIRECT file reader with a FIXED 1 MiB aligned bounce
+    buffer — the odirectReader analog (cmd/xl-storage.go:1089) for
+    verify/heal scans that must neither pollute the page cache nor
+    materialize multi-GiB parts in memory."""
+
+    def __init__(self, path: str):
+        self._closed = True  # guards __del__ on partial init
+        self._fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+        self.size = os.fstat(self._fd).st_size
+        try:
+            self._buf = mmap.mmap(-1, _BUF_SIZE)
+        except OSError:
+            os.close(self._fd)
+            raise
+        self._avail = 0   # valid bytes in buffer
+        self._pos = 0     # consumed bytes in buffer
+        self._read_total = 0
+        self._closed = False
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            out = bytearray()
+            while True:
+                chunk = self.read(_BUF_SIZE)
+                if not chunk:
+                    return bytes(out)
+                out += chunk
+        out = bytearray()
+        while n > 0:
+            if self._pos == self._avail:
+                if self._read_total >= self.size:
+                    break
+                got = os.readv(self._fd, [self._buf])
+                if got <= 0:
+                    break
+                # The final block may read past EOF padding; clamp.
+                got = min(got, self.size - self._read_total)
+                self._read_total += got
+                self._avail, self._pos = got, 0
+                if got == 0:
+                    break
+            take = min(n, self._avail - self._pos)
+            out += self._buf[self._pos: self._pos + take]
+            self._pos += take
+            n -= take
+        return bytes(out)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        os.close(self._fd)
+        try:
+            self._buf.close()
+        except (BufferError, ValueError):
+            pass
+
+    def __del__(self):
+        if not getattr(self, "_closed", True):
+            self.close()

@@ -144,7 +144,8 @@ class StorageAPI(abc.ABC):
     def read_file_stream(self, volume: str, path: str, offset: int, length: int): ...
 
     @abc.abstractmethod
-    def create_file_writer(self, volume: str, path: str):
+    def create_file_writer(self, volume: str, path: str,
+                           size: int = -1):
         """Open a writable sink for streaming shard writes — the Python
         seam for the reference's pipe-into-CreateFile pattern
         (cmd/bitrot-streaming.go:83-99). Caller must close()."""

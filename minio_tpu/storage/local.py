@@ -66,6 +66,14 @@ class LocalStorage(StorageAPI):
         self._lock = threading.RLock()
         self._online = True
         os.makedirs(os.path.join(self.root, *SYSTEM_TMP.split("/")), exist_ok=True)
+        # O_DIRECT shard writes (ref cmd/xl-storage.go:1089 + fallocate):
+        # opt-in (MTPU_ODIRECT=1) and probed per disk root — tmpfs and
+        # other cache-only filesystems fall back to buffered writes.
+        self._odirect = False
+        if os.environ.get("MTPU_ODIRECT") == "1":
+            from .directio import supports_odirect
+
+            self._odirect = supports_odirect(self.root)
 
     # --- helpers ---
 
@@ -420,12 +428,24 @@ class LocalStorage(StorageAPI):
         if size >= 0 and written != size:
             raise ErrLessDataOrMore(written, size)
 
-    def create_file_writer(self, volume: str, path: str):
+    def create_file_writer(self, volume: str, path: str,
+                           size: int = -1):
         self._require_online()
         if not os.path.isdir(self._vol_path(volume)):
             raise ErrVolumeNotFound(volume)
         p = self._file_path(volume, path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
+        if self._odirect:
+            from .directio import DirectFileWriter
+
+            try:
+                # Durability handled inside (fsync after the tail write);
+                # a known size preallocates extents (fallocate) so
+                # commit-time ENOSPC becomes open-time.
+                return DirectFileWriter(p, expected_size=size,
+                                        fsync_on_close=self._fsync)
+            except OSError:
+                pass  # per-file fallback (e.g. fs quirk): buffered path
         # Unbuffered: shard writers emit one large framed write per batch
         # (erasure/streaming.py write_strips), so Python's buffered-IO
         # layer would only add a full extra memcpy per write — measured
@@ -527,12 +547,25 @@ class LocalStorage(StorageAPI):
                     self._file_path(volume, path), fi.data_dir, f"part.{part.number}"
                 )
                 try:
-                    stream = open(p, "rb")
-                    file_size = os.stat(p).st_size
+                    if self._odirect:
+                        # Deep scans read EVERY byte of cold data once —
+                        # exactly what must not evict the page cache
+                        # (ref odirectReader, cmd/xl-storage.go:1089).
+                        # Streaming: constant memory even for GiB parts.
+                        from .directio import DirectReader
+
+                        stream = DirectReader(p)
+                        file_size = stream.size
+                    else:
+                        stream = open(p, "rb")
+                        file_size = os.stat(p).st_size
                 except FileNotFoundError:
                     raise ErrFileNotFound(
                         f"{volume}/{path} part.{part.number}"
                     ) from None
+                except OSError:
+                    stream = open(p, "rb")
+                    file_size = os.stat(p).st_size
             try:
                 ci = fi.erasure.get_checksum_info(part.number)
                 bitrot_verify(

@@ -313,3 +313,66 @@ def test_requests_max_throttle(tmp_path):
         assert c.request("GET", "/thrbkt")[0] == 200
     finally:
         srv.stop()
+
+
+def test_listen_notification_stream(tmp_path):
+    """GET ?events= streams live bucket events as NDJSON (the
+    ListenNotification MinIO-extension API, `mc watch`)."""
+    import http.client
+    import json as _json
+    import threading
+    import time
+    import urllib.parse
+
+    from minio_tpu.api import S3Server
+    from minio_tpu.api.sign import sign_v4_request
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.event.system import EventNotifier
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.object.fs import FSObjects
+
+    ol = FSObjects(str(tmp_path / "fs"))
+    bm = BucketMetadataSys(ol)
+    notify = EventNotifier(bucket_meta=bm, targets={})
+    srv = S3Server(ol, IAMSys(ACCESS, SECRET), bm, notify=notify).start()
+    try:
+        c = Client(srv)
+        assert c.request("PUT", "/watchbkt")[0] == 200
+        got: list[dict] = []
+        ready = threading.Event()
+
+        def watch():
+            query = [("events", "s3:ObjectCreated:*"), ("prefix", "logs/")]
+            qs = urllib.parse.urlencode(query)
+            h = sign_v4_request(SECRET, ACCESS, "GET", srv.endpoint,
+                                "/watchbkt", query, {}, b"")
+            conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+            conn.request("GET", f"/watchbkt?{qs}", headers=h)
+            r = conn.getresponse()
+            assert r.status == 200
+            ready.set()
+            while len(got) < 2:
+                line = r.fp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if line:
+                    got.append(_json.loads(line))
+            conn.close()
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        time.sleep(0.2)  # subscription registered before the PUTs
+        assert c.request("PUT", "/watchbkt/logs/a.txt", body=b"x")[0] == 200
+        assert c.request("PUT", "/watchbkt/other.txt", body=b"y")[0] == 200
+        assert c.request("PUT", "/watchbkt/logs/b.txt", body=b"z")[0] == 200
+        t.join(15)
+        assert len(got) == 2, got
+        keys = [r["Records"][0]["s3"]["object"]["key"] for r in got]
+        assert keys == ["logs/a.txt", "logs/b.txt"]
+        names = {r["Records"][0]["eventName"] for r in got}
+        assert all(n.startswith("ObjectCreated") for n in names)
+    finally:
+        srv.stop()
+        notify.close()

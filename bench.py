@@ -189,27 +189,31 @@ def bench_config2_roundtrip(root: str, reps: int = 5):
     return moved / (time.perf_counter() - t0) / 1e9
 
 
-def bench_config3_heal(root: str):
+def bench_config3_heal(root: str, reps: int = 3):
     """Config 3: 12+4 with 2 drives' shards lost, low-level heal GB/s
-    (bytes of object data repaired per second)."""
+    (bytes of object data repaired per second). Best of `reps`
+    kill+heal cycles — a single-shot heal was the noisiest number in
+    the file (one scheduler hiccup = a 2x swing)."""
     es, disks = _mk_set(os.path.join(root, "c3"), 16, 4)
     size = 10 * MIB
     es.put_object("bench", "heal-me", io.BytesIO(os.urandom(size)), size)
-    # Knock out two shards' files + metadata.
-    killed = 0
-    for d in disks:
-        if killed == 2:
-            break
-        try:
-            d.delete("bench", "heal-me", recursive=True)
-            killed += 1
-        except Exception:  # noqa: BLE001
-            continue
-    t0 = time.perf_counter()
-    res = es.heal_object("bench", "heal-me")
-    dt = time.perf_counter() - t0
-    assert res["healed"], res
-    return size / dt / 1e9
+    best = 0.0
+    for _ in range(reps):
+        killed = 0
+        for d in disks:
+            if killed == 2:
+                break
+            try:
+                d.delete("bench", "heal-me", recursive=True)
+                killed += 1
+            except Exception:  # noqa: BLE001
+                continue
+        t0 = time.perf_counter()
+        res = es.heal_object("bench", "heal-me")
+        dt = time.perf_counter() - t0
+        assert res["healed"], res
+        best = max(best, size / dt / 1e9)
+    return best
 
 
 def bench_config4_bitrot_get(root: str, reps: int = 5):

@@ -507,6 +507,10 @@ class RemoteStorage(StorageAPI):
 
     def create_file_writer(self, volume: str, path: str,
                            size: int = -1):
+        # The size hint is not forwarded here: the buffered writer knows
+        # the EXACT length at close and ships it on the CreateFile RPC,
+        # where the server-side LocalStorage.create_file applies the
+        # O_DIRECT/fallocate treatment.
         return _RemoteWriter(self, volume, path)
 
     def rename_file(self, src_volume: str, src_path: str,

@@ -98,6 +98,17 @@ def _encode_slot():
         _encode_slots.release()
 
 
+def _close_sinks(sinks):
+    """Best-effort close of every open sink — failure paths must never
+    leave raw-fd (O_DIRECT) writers to the GC."""
+    for s in sinks.values() if isinstance(sinks, dict) else sinks:
+        if s is not None:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+
 def _fanout(fn, n: int, disks: list):
     """Run fn(i) for i in range(n): through the pool when any disk is
     remote (network overlap pays regardless of cores) or the host has
@@ -372,15 +383,11 @@ class ErasureObjects(MultipartMixin):
             # Close abandoned sinks BEFORE the tmp cleanup: raw-fd
             # (O_DIRECT) sinks hold an fd + staging buffer that GC may
             # not finalize promptly — aborted uploads must not leak them.
-            for s in sinks:
-                if s is not None:
-                    try:
-                        s.close()
-                    except Exception:  # noqa: BLE001 - best effort
-                        pass
+            _close_sinks(sinks)
             self._cleanup_tmp(disks_by_shard, tmp_id)
             raise
         if size >= 0 and total != size:
+            _close_sinks(sinks)
             self._cleanup_tmp(disks_by_shard, tmp_id)
             raise ErrLessData(f"read {total} bytes, expected {size}")
         size = total
@@ -983,29 +990,26 @@ class ErasureObjects(MultipartMixin):
                 )
                 writers: list = [None] * len(disks_by_shard)
                 sinks: dict[int, object] = {}
-                for s in stale_shards:
-                    if inline:
-                        sinks[s] = io.BytesIO()
-                    else:
-                        sinks[s] = disks_by_shard[s].create_file_writer(
-                            SYSTEM_META_BUCKET,
-                            f"{self._tmp_path(tmp_id)}/part.{part.number}",
-                            size=phys_shard,
-                        )
-                    writers[s] = StreamingBitrotWriter(
-                        sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
-                    )
                 try:
+                    for s in stale_shards:
+                        if inline:
+                            sinks[s] = io.BytesIO()
+                        else:
+                            sinks[s] = disks_by_shard[s].create_file_writer(
+                                SYSTEM_META_BUCKET,
+                                f"{self._tmp_path(tmp_id)}/part.{part.number}",
+                                size=phys_shard,
+                            )
+                        writers[s] = StreamingBitrotWriter(
+                            sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
+                        )
                     heal_stream(erasure, writers, readers, part.size)
                 except Exception:
-                    # Close raw-fd sinks before bailing (O_DIRECT fd +
-                    # staging buffer must not wait for GC).
-                    for s in stale_shards:
-                        if not inline:
-                            try:
-                                sinks[s].close()
-                            except Exception:  # noqa: BLE001
-                                pass
+                    # Writer creation OR the heal itself failed: close
+                    # whatever sinks exist (O_DIRECT fds must not wait
+                    # for GC) and drop the staged tmp shards.
+                    if not inline:
+                        _close_sinks(sinks)
                     self._cleanup_tmp(disks_by_shard, tmp_id)
                     raise
                 for s in stale_shards:

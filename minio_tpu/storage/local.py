@@ -408,23 +408,25 @@ class LocalStorage(StorageAPI):
 
     def create_file(self, volume: str, path: str, size: int, reader) -> None:
         """Stream-write a file of `size` bytes (-1 = unknown), ref
-        cmd/xl-storage.go:1487 CreateFile."""
+        cmd/xl-storage.go:1487 CreateFile. Routes through
+        create_file_writer so the storage-REST plane's writes (this is
+        the server side of remote CreateFile, which always carries the
+        exact length) get the same O_DIRECT + fallocate treatment as
+        local shard writers."""
         self._require_online()
         if not os.path.isdir(self._vol_path(volume)):
             raise ErrVolumeNotFound(volume)
-        p = self._file_path(volume, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        w = self.create_file_writer(volume, path, size=size)
         written = 0
-        with open(p, "wb") as f:
+        try:
             while True:
                 chunk = reader.read(1 << 20)
                 if not chunk:
                     break
-                f.write(chunk)
+                w.write(chunk)
                 written += len(chunk)
-            if self._fsync:
-                f.flush()
-                os.fsync(f.fileno())
+        finally:
+            w.close()
         if size >= 0 and written != size:
             raise ErrLessDataOrMore(written, size)
 

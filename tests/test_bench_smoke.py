@@ -1,0 +1,42 @@
+"""bench.py smoke: the driver runs it once per round on real hardware —
+a syntax error or broken helper there silently zeroes the round's
+benchmark record, so the pieces must stay importable and runnable."""
+
+import io
+
+
+def test_bench_helpers_produce_sane_numbers(tmp_path):
+    import bench
+
+    root = str(tmp_path)
+    v = bench.bench_headline_encode(root, total_mib=8, reps=1)
+    assert v > 0.01
+    assert bench.bench_encode_only(total_mib=8, reps=1) > 0.1
+    p50 = bench.bench_config1_put_p50(root, n=4)
+    assert 0 < p50 < 10_000
+    stages = bench.bench_put_stages(root, total_mib=4)
+    for key in ("source_read_gbps", "md5_gbps", "encode_gbps",
+                "model_put_gbps"):
+        assert stages.get(key, 0) > 0, (key, stages)
+    assert stages["meta_commit_us_per_put"] > 0
+
+
+def test_zero_copy_reader_contract():
+    from bench import _ZeroCopyReader
+
+    payload = bytes(range(256)) * 10
+    r = _ZeroCopyReader(payload)
+    assert r.read(100) == payload[:100]
+    buf = bytearray(50)
+    assert r.readinto(buf) == 50
+    assert bytes(buf) == payload[100:150]
+    rest = r.read()
+    assert rest == payload[150:]
+    assert r.read(10) == b""
+
+
+def test_heal_bench_survives_reps(tmp_path):
+    import bench
+
+    v = bench.bench_config3_heal(str(tmp_path), reps=2)
+    assert v > 0.001

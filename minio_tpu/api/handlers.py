@@ -1703,8 +1703,25 @@ class S3ApiHandlers:
                 in_spool.seek(0, io.SEEK_END)
                 logical = in_spool.tell()
                 in_spool.seek(0)
+                on_batch = None
+                if req.request_progress:
+                    # Progress frames every >=1 MiB of scanned input
+                    # (ref pkg/s3select/progress.go periodic frames).
+                    last = [0]
+
+                    def on_batch(processed, returned):
+                        # BytesScanned/BytesProcessed are RUNNING counts
+                        # (the AWS progress semantic) — one figure here,
+                        # since the engine counts bytes at the source.
+                        if processed - last[0] >= (1 << 20):
+                            last[0] = processed
+                            out_spool.write(eventstream.progress_message(
+                                processed, processed, returned
+                            ))
+
                 try:
-                    stats = run_select(req, in_spool, emit)
+                    stats = run_select(req, in_spool, emit,
+                                       on_batch=on_batch)
                 except SQLError as exc:
                     raise S3Error("InvalidArgument", str(exc)) from exc
                 except (ValueError, UnicodeDecodeError) as exc:

@@ -344,12 +344,28 @@ def _parse_duration_s(text: str) -> float | None:
         return None
 
 
+# S3 header-size contract (ref cmd/generic-handlers.go:55-93
+# setRequestHeaderSizeLimitHandler): headers <= 8 KiB total,
+# user-defined metadata <= 2 KiB.
+_MAX_HEADER_SIZE = 8 * 1024
+_MAX_USER_META_SIZE = 2 * 1024
+_USER_META_PREFIXES = ("x-amz-meta-", "x-minio-meta-", "x-mtpu-meta-")
+
+
 def _reserved_metadata_check(ctx: RequestContext):
-    """Reject client-supplied internal metadata (ref
-    cmd/generic-handlers.go ReservedMetadataPrefix filter)."""
-    for k in ctx.headers:
+    """Reject client-supplied internal metadata + oversized headers (ref
+    cmd/generic-handlers.go ReservedMetadataPrefix filter and the
+    header/user-metadata size limits)."""
+    size = usersize = 0
+    for k, v in ctx.headers.items():
         if k.startswith("x-mtpu-internal-") or k.startswith("x-minio-internal-"):
             raise S3Error("AccessDenied", "reserved metadata prefix")
+        length = len(k) + len(v)
+        size += length
+        if k.startswith(_USER_META_PREFIXES):
+            usersize += length
+        if usersize > _MAX_USER_META_SIZE or size > _MAX_HEADER_SIZE:
+            raise S3Error("MetadataTooLarge", "headers exceed S3 limits")
 
 
 class S3Server:
@@ -657,6 +673,14 @@ class S3Server:
                     headers["Vary"] = "Origin"
             return Response(200, headers)
         _reserved_metadata_check(ctx)
+        # Browser redirect (ref cmd/generic-handlers.go:151
+        # setBrowserRedirectHandler): a human hitting the root with a
+        # browser lands on the console, SDKs keep getting S3 XML.
+        if (ctx.method == "GET"
+                and ctx.path in ("/", "/minio", "/minio/")
+                and "text/html" in ctx.headers.get("accept", "")):
+            return Response(303, {"Location": "/minio/console/",
+                                  "Content-Length": "0"})
         # Health endpoints: unauthenticated, GET/HEAD only
         # (ref cmd/healthcheck-router.go)
         if ctx.path.startswith("/minio/health/"):

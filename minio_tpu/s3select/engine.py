@@ -36,6 +36,7 @@ class SelectRequest:
     quote_character: str = '"'
     json_type: str = "LINES"           # LINES | DOCUMENT
     compression_type: str = "NONE"     # NONE | GZIP | BZIP2
+    request_progress: bool = False     # RequestProgress/Enabled
     output_format: str = "csv"
     output_field_delimiter: str = ","
     output_record_delimiter: str = "\n"
@@ -87,6 +88,13 @@ class SelectRequest:
             )
         if req.compression_type != "NONE" and req.input_format == "parquet":
             raise SQLError("Parquet input cannot be compressed")
+        rp = find("RequestProgress")
+        if rp is not None:
+            for sub in rp.iter():
+                if sub.tag.endswith("Enabled"):
+                    req.request_progress = (
+                        (sub.text or "").strip().lower() == "true"
+                    )
         outser = find("OutputSerialization")
         if outser is not None:
             for el in outser.iter():
@@ -698,9 +706,12 @@ class _CountingReader(io.RawIOBase):
         return True
 
 
-def run_select(req: SelectRequest, stream, emit) -> dict:
+def run_select(req: SelectRequest, stream, emit, on_batch=None) -> dict:
     """Run the query over `stream`, calling emit(chunk_bytes) per output
-    chunk. Returns {"processed": n_bytes, "returned": n_bytes}."""
+    chunk. Returns {"processed": n_bytes, "returned": n_bytes}.
+    `on_batch(processed_bytes, returned_bytes)` fires after each input
+    batch — the hook behind RequestProgress events
+    (ref pkg/s3select/progress.go periodic progress frames)."""
     query = parse(req.expression)
     counting = _CountingReader(stream)
     # Nested paths need the raw decoded rows kept per batch.
@@ -787,6 +798,16 @@ def run_select(req: SelectRequest, stream, emit) -> dict:
         else:
             if not out_rows(batch, mask):
                 break
+        if on_batch is not None:
+            # Parquet bypasses the counting wrapper (random access on
+            # the spool): its progress is the spool position instead.
+            if req.input_format == "parquet":
+                try:
+                    on_batch(stream.tell(), returned)
+                except (OSError, ValueError):
+                    pass
+            else:
+                on_batch(counting.count, returned)
 
     if query.aggregate:
         chunk = _agg_output(req, query, agg_states)

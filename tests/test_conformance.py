@@ -376,3 +376,29 @@ def test_listen_notification_stream(tmp_path):
     finally:
         srv.stop()
         notify.close()
+
+
+def test_metadata_too_large_and_browser_redirect(cl):
+    # 2 KiB user-metadata cap (ref generic-handlers.go:58).
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/meta-heavy",
+        headers={"x-amz-meta-big": "v" * 3000}, body=b"x",
+    )
+    assert st == 400 and _err_code(body) == "MetadataTooLarge"
+    # Under the cap still works.
+    st, _, _ = cl.request("PUT", f"/{BKT}/meta-ok",
+                          headers={"x-amz-meta-small": "v" * 100}, body=b"x")
+    assert st == 200
+    # Browser hitting / gets the console; an SDK (no text/html Accept)
+    # gets the S3 service response.
+    import http.client
+
+    conn = http.client.HTTPConnection(cl.host, timeout=10)
+    conn.request("GET", "/", headers={"Accept": "text/html,*/*"})
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 303
+    assert r.getheader("Location") == "/minio/console/"
+    conn.close()
+    st, _, _ = cl.request("GET", "/")
+    assert st in (200, 403)  # S3 ListBuckets path, not a redirect

@@ -506,3 +506,34 @@ def test_corrupt_gzip_is_client_error():
     with pytest.raises(SQLError):
         _run_compressed("SELECT * FROM S3Object", b"not gzip at all",
                         "GZIP")
+
+
+def test_request_progress_frames(cl):
+    """RequestProgress Enabled=true interleaves Progress events in the
+    stream (ref pkg/s3select/progress.go)."""
+    big_csv = "name,n\n" + "".join(
+        f"row{i},{i}\n" for i in range(300000)
+    )
+    assert cl.request("PUT", "/sel/big.csv",
+                      body=big_csv.encode())[0] == 200
+    body = """<?xml version="1.0" encoding="UTF-8"?>
+<SelectObjectContentRequest>
+  <Expression>SELECT name FROM S3Object WHERE n = 5</Expression>
+  <ExpressionType>SQL</ExpressionType>
+  <RequestProgress><Enabled>true</Enabled></RequestProgress>
+  <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>
+  </InputSerialization>
+  <OutputSerialization><CSV/></OutputSerialization>
+</SelectObjectContentRequest>""".encode()
+    st, _, resp = cl.request(
+        "POST", "/sel/big.csv",
+        query=[("select", ""), ("select-type", "2")], body=body,
+    )
+    assert st == 200
+    decoded = eventstream.decode_messages(resp)
+    types = [m["headers"][":event-type"] for m in decoded]
+    assert "Progress" in types, types
+    assert types[-2:] == ["Stats", "End"]
+    prog = next(m for m in decoded
+                if m["headers"][":event-type"] == "Progress")
+    assert b"<BytesProcessed>" in prog["payload"]

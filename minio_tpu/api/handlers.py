@@ -1292,6 +1292,7 @@ class S3ApiHandlers:
             src_info = self.ol.get_object_info(sbucket, sobject, src_opts)
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        self._copy_source_conditions(ctx, src_info)
         opts = self._opts_for(ctx.bucket, ctx.qdict)
         directive = ctx.headers.get("x-amz-metadata-directive", "COPY")
         from ..bucket import objectlock as ol_mod
@@ -1454,6 +1455,40 @@ class S3ApiHandlers:
         if len(raw) != 16:
             raise S3Error("InvalidDigest")
         return raw.hex()
+
+    @staticmethod
+    def _copy_source_conditions(ctx, src_info):
+        """x-amz-copy-source-if-{match,none-match,modified-since,
+        unmodified-since}: preconditions on the SOURCE of a copy, all
+        failing with 412 (ref checkCopyObjectPreconditions,
+        cmd/object-handlers-common.go — unlike GET conditionals, a
+        failed none-match/modified-since is 412, never 304)."""
+        etag = f'"{src_info.etag}"'
+        im = ctx.headers.get("x-amz-copy-source-if-match", "")
+        if im and im not in (etag, src_info.etag, "*"):
+            raise S3Error("PreconditionFailed", "x-amz-copy-source-if-match")
+        inm = ctx.headers.get("x-amz-copy-source-if-none-match", "")
+        if inm and (inm in (etag, src_info.etag) or inm == "*"):
+            raise S3Error("PreconditionFailed",
+                          "x-amz-copy-source-if-none-match")
+        mod_s = src_info.mod_time_ns // 10 ** 9
+
+        def parse(h):
+            try:
+                return int(datetime.datetime.strptime(
+                    h, "%a, %d %b %Y %H:%M:%S GMT"
+                ).replace(tzinfo=datetime.timezone.utc).timestamp())
+            except ValueError:
+                return None
+
+        ims = ctx.headers.get("x-amz-copy-source-if-modified-since", "")
+        if ims and (t := parse(ims)) is not None and mod_s <= t:
+            raise S3Error("PreconditionFailed",
+                          "x-amz-copy-source-if-modified-since")
+        ius = ctx.headers.get("x-amz-copy-source-if-unmodified-since", "")
+        if ius and (t := parse(ius)) is not None and mod_s > t:
+            raise S3Error("PreconditionFailed",
+                          "x-amz-copy-source-if-unmodified-since")
 
     def _conditional_headers(self, ctx, oi):
         """If-Match / If-None-Match / If-(Un)Modified-Since

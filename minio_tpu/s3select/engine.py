@@ -40,6 +40,7 @@ class SelectRequest:
     output_format: str = "csv"
     output_field_delimiter: str = ","
     output_record_delimiter: str = "\n"
+    output_quote_fields: str = "ASNEEDED"  # ASNEEDED | ALWAYS
 
     @classmethod
     def from_xml(cls, body: bytes) -> "SelectRequest":
@@ -105,6 +106,12 @@ class SelectRequest:
                     req.output_field_delimiter = el.text or ","
                 elif tag == "RecordDelimiter":
                     req.output_record_delimiter = el.text or "\n"
+                elif tag == "QuoteFields":
+                    req.output_quote_fields = (el.text or "ASNEEDED").upper()
+        if req.output_quote_fields not in ("ASNEEDED", "ALWAYS"):
+            raise SQLError(
+                f"invalid QuoteFields {req.output_quote_fields!r}"
+            )
         return req
 
 
@@ -780,6 +787,9 @@ def run_select(req: SelectRequest, stream, emit, on_batch=None) -> dict:
                 buf, delimiter=req.output_field_delimiter,
                 lineterminator=req.output_record_delimiter,
                 quotechar='"',
+                quoting=(_csv.QUOTE_ALL
+                         if req.output_quote_fields == "ALWAYS"
+                         else _csv.QUOTE_MINIMAL),
             )
             for i in idx:
                 w.writerow(["" if cols[j][i] is None else cols[j][i]

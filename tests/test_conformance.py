@@ -402,3 +402,42 @@ def test_metadata_too_large_and_browser_redirect(cl):
     conn.close()
     st, _, _ = cl.request("GET", "/")
     assert st in (200, 403)  # S3 ListBuckets path, not a redirect
+
+
+def test_copy_source_conditionals(cl):
+    """x-amz-copy-source-if-* preconditions fail with 412
+    (ref checkCopyObjectPreconditions)."""
+    st, h, _ = cl.request("HEAD", f"/{BKT}/{OBJ}")
+    etag = h["ETag"]
+    # if-match with the right etag copies; with a wrong etag it 412s.
+    st, _, _ = cl.request(
+        "PUT", f"/{BKT}/cc-dst",
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-match": etag})
+    assert st == 200
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/cc-dst2",
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-match": '"deadbeef"'})
+    assert st == 412 and _err_code(body) == "PreconditionFailed"
+    # none-match that MATCHES -> 412 (never 304 for copies).
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/cc-dst3",
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-none-match": etag})
+    assert st == 412 and _err_code(body) == "PreconditionFailed"
+    # unmodified-since in the past -> 412; in the future -> copies.
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/cc-dst4",
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-unmodified-since":
+                     "Mon, 01 Jan 2001 00:00:00 GMT"})
+    assert st == 412
+    st, _, _ = cl.request(
+        "PUT", f"/{BKT}/cc-dst5",
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-unmodified-since":
+                     "Fri, 01 Jan 2100 00:00:00 GMT"})
+    assert st == 200
+    for k in ("cc-dst", "cc-dst5"):
+        cl.request("DELETE", f"/{BKT}/{k}")

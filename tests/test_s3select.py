@@ -537,3 +537,23 @@ def test_request_progress_frames(cl):
     prog = next(m for m in decoded
                 if m["headers"][":event-type"] == "Progress")
     assert b"<BytesProcessed>" in prog["payload"]
+
+
+def test_quote_fields_always():
+    req = SelectRequest(expression="SELECT name, dept FROM S3Object LIMIT 1",
+                        file_header_info="USE")
+    req.output_quote_fields = "ALWAYS"
+    import io as _io
+
+    chunks = []
+    run_select(req, _io.BytesIO(CSV.encode()), chunks.append)
+    assert b"".join(chunks).decode().strip() == '"alice","eng"'
+    xml = b"""<?xml version="1.0"?><SelectObjectContentRequest>
+      <Expression>SELECT * FROM S3Object</Expression>
+      <ExpressionType>SQL</ExpressionType>
+      <InputSerialization><CSV/></InputSerialization>
+      <OutputSerialization><CSV><QuoteFields>ALWAYS</QuoteFields></CSV>
+      </OutputSerialization></SelectObjectContentRequest>"""
+    assert SelectRequest.from_xml(xml).output_quote_fields == "ALWAYS"
+    with pytest.raises(SQLError):
+        SelectRequest.from_xml(xml.replace(b"ALWAYS", b"SOMETIMES"))

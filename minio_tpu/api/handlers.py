@@ -117,6 +117,23 @@ class _RangeCopyReader:
         return data
 
 
+def _parse_http_date(h: str) -> int | None:
+    """RFC 7231 IMF-fixdate -> epoch seconds; None if unparseable (the
+    one shared parse behind every conditional-header site)."""
+    try:
+        return int(datetime.datetime.strptime(
+            h, "%a, %d %b %Y %H:%M:%S GMT"
+        ).replace(tzinfo=datetime.timezone.utc).timestamp())
+    except ValueError:
+        return None
+
+
+def _etag_matches(header_value: str, etag: str) -> bool:
+    """True when the header's ETag (quoted, bare, or '*') names `etag` —
+    shared by the GET (304) and copy-source (412) precondition checks."""
+    return header_value in (f'"{etag}"', etag, "*")
+
+
 def parse_copy_source(header: str) -> tuple[str, str, str]:
     """Parse x-amz-copy-source into (bucket, object, versionId).
 
@@ -1463,63 +1480,42 @@ class S3ApiHandlers:
         failing with 412 (ref checkCopyObjectPreconditions,
         cmd/object-handlers-common.go — unlike GET conditionals, a
         failed none-match/modified-since is 412, never 304)."""
-        etag = f'"{src_info.etag}"'
+        mod_s = src_info.mod_time_ns // 10 ** 9
         im = ctx.headers.get("x-amz-copy-source-if-match", "")
-        if im and im not in (etag, src_info.etag, "*"):
+        if im and not _etag_matches(im, src_info.etag):
             raise S3Error("PreconditionFailed", "x-amz-copy-source-if-match")
         inm = ctx.headers.get("x-amz-copy-source-if-none-match", "")
-        if inm and (inm in (etag, src_info.etag) or inm == "*"):
+        if inm and _etag_matches(inm, src_info.etag):
             raise S3Error("PreconditionFailed",
                           "x-amz-copy-source-if-none-match")
-        mod_s = src_info.mod_time_ns // 10 ** 9
-
-        def parse(h):
-            try:
-                return int(datetime.datetime.strptime(
-                    h, "%a, %d %b %Y %H:%M:%S GMT"
-                ).replace(tzinfo=datetime.timezone.utc).timestamp())
-            except ValueError:
-                return None
-
         ims = ctx.headers.get("x-amz-copy-source-if-modified-since", "")
-        if ims and (t := parse(ims)) is not None and mod_s <= t:
+        if ims and (t := _parse_http_date(ims)) is not None and mod_s <= t:
             raise S3Error("PreconditionFailed",
                           "x-amz-copy-source-if-modified-since")
         ius = ctx.headers.get("x-amz-copy-source-if-unmodified-since", "")
-        if ius and (t := parse(ius)) is not None and mod_s > t:
+        if ius and (t := _parse_http_date(ius)) is not None and mod_s > t:
             raise S3Error("PreconditionFailed",
                           "x-amz-copy-source-if-unmodified-since")
 
     def _conditional_headers(self, ctx, oi):
         """If-Match / If-None-Match / If-(Un)Modified-Since
-        (ref cmd/object-handlers-common.go checkPreconditions)."""
-        inm = ctx.headers.get("if-none-match", "")
-        im = ctx.headers.get("if-match", "")
+        (ref cmd/object-handlers-common.go checkPreconditions). GET
+        semantics: failed none-match/modified-since is 304; the
+        copy-source variant above turns every failure into 412."""
         etag = f'"{oi.etag}"'
-        if im and im not in (etag, oi.etag, "*"):
+        mod_s = oi.mod_time_ns // 10 ** 9
+        im = ctx.headers.get("if-match", "")
+        if im and not _etag_matches(im, oi.etag):
             raise S3Error("PreconditionFailed", "If-Match")
-        if inm and (inm in (etag, oi.etag) or inm == "*"):
+        inm = ctx.headers.get("if-none-match", "")
+        if inm and _etag_matches(inm, oi.etag):
             return Response(304, {"ETag": etag})
         ims = ctx.headers.get("if-modified-since", "")
-        if ims:
-            try:
-                t = datetime.datetime.strptime(
-                    ims, "%a, %d %b %Y %H:%M:%S GMT"
-                ).replace(tzinfo=datetime.timezone.utc)
-                if oi.mod_time_ns // 10 ** 9 <= int(t.timestamp()):
-                    return Response(304, {"ETag": etag})
-            except ValueError:
-                pass
+        if ims and (t := _parse_http_date(ims)) is not None and mod_s <= t:
+            return Response(304, {"ETag": etag})
         ius = ctx.headers.get("if-unmodified-since", "")
-        if ius:
-            try:
-                t = datetime.datetime.strptime(
-                    ius, "%a, %d %b %Y %H:%M:%S GMT"
-                ).replace(tzinfo=datetime.timezone.utc)
-                if oi.mod_time_ns // 10 ** 9 > int(t.timestamp()):
-                    raise S3Error("PreconditionFailed", "If-Unmodified-Since")
-            except ValueError:
-                pass
+        if ius and (t := _parse_http_date(ius)) is not None and mod_s > t:
+            raise S3Error("PreconditionFailed", "If-Unmodified-Since")
         return None
 
     def _object_headers(self, ctx, oi) -> dict:
@@ -2078,6 +2074,9 @@ class S3ApiHandlers:
             src_info = self.ol.get_object_info(sbucket, sobject, src_opts)
         except StorageError as exc:
             raise from_object_error(exc) from exc
+        # Same source preconditions as whole-object copy (ref
+        # checkCopyObjectPartPreconditions).
+        self._copy_source_conditions(ctx, src_info)
         rng = ctx.headers.get("x-amz-copy-source-range", "")
         offset, length = 0, src_info.size
         if rng:

@@ -107,7 +107,10 @@ class SelectRequest:
                 elif tag == "RecordDelimiter":
                     req.output_record_delimiter = el.text or "\n"
                 elif tag == "QuoteFields":
-                    req.output_quote_fields = (el.text or "ASNEEDED").upper()
+                    req.output_quote_fields = (
+                        (el.text or "ASNEEDED").strip().upper()
+                        or "ASNEEDED"
+                    )
         if req.output_quote_fields not in ("ASNEEDED", "ALWAYS"):
             raise SQLError(
                 f"invalid QuoteFields {req.output_quote_fields!r}"

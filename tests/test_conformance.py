@@ -441,3 +441,18 @@ def test_copy_source_conditionals(cl):
     assert st == 200
     for k in ("cc-dst", "cc-dst5"):
         cl.request("DELETE", f"/{BKT}/{k}")
+
+
+def test_upload_part_copy_conditionals(cl):
+    st, _, body = cl.request("POST", f"/{BKT}/pc-obj",
+                             query=[("uploads", "")])
+    upload_id = ET.fromstring(body).findtext("UploadId") or \
+        ET.fromstring(body).findtext("{*}UploadId")
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/pc-obj",
+        query=[("partNumber", "1"), ("uploadId", upload_id)],
+        headers={"x-amz-copy-source": f"/{BKT}/{OBJ}",
+                 "x-amz-copy-source-if-match": '"not-the-etag"'})
+    assert st == 412 and _err_code(body) == "PreconditionFailed"
+    cl.request("DELETE", f"/{BKT}/pc-obj",
+               query=[("uploadId", upload_id)])

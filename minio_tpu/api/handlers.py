@@ -1731,8 +1731,6 @@ class S3ApiHandlers:
             except StorageError as exc:
                 raise from_object_error(exc) from exc
             with in_spool:
-                in_spool.seek(0, io.SEEK_END)
-                logical = in_spool.tell()
                 in_spool.seek(0)
                 on_batch = None
                 if req.request_progress:
@@ -1740,14 +1738,14 @@ class S3ApiHandlers:
                     # (ref pkg/s3select/progress.go periodic frames).
                     last = [0]
 
-                    def on_batch(processed, returned):
-                        # BytesScanned/BytesProcessed are RUNNING counts
-                        # (the AWS progress semantic) — one figure here,
-                        # since the engine counts bytes at the source.
-                        if processed - last[0] >= (1 << 20):
-                            last[0] = processed
+                    def on_batch(scanned, processed, returned):
+                        # BytesScanned = input bytes read (compressed
+                        # for GZIP/BZIP2); BytesProcessed = decompressed
+                        # bytes — the AWS/reference split.
+                        if scanned - last[0] >= (1 << 20):
+                            last[0] = scanned
                             out_spool.write(eventstream.progress_message(
-                                processed, processed, returned
+                                scanned, processed, returned
                             ))
 
                 try:
@@ -1758,8 +1756,11 @@ class S3ApiHandlers:
                 except (ValueError, UnicodeDecodeError) as exc:
                     raise S3Error("InvalidRequest",
                                   f"malformed input: {exc}") from exc
+            # Stats must agree with the Progress frames: the engine's
+            # own counters, not oi.size — a LIMIT query that early-exits
+            # scans only part of the object.
             out_spool.write(eventstream.stats_message(
-                oi.size, logical, stats["returned"]
+                stats["scanned"], stats["processed"], stats["returned"]
             ))
             out_spool.write(eventstream.end_message())
         except BaseException:

@@ -186,9 +186,11 @@ class RedisTarget(Target):
     (ref pkg/event/target/redis.go:203 Send):
 
     - format=namespace: the hash `key` mirrors the namespace — HSET
-      <key> <bucket/object> <record-json> on create, HDEL on remove.
-    - format=access: RPUSH <key> <{"Event": records, "EventTime": t}>,
-      an append-only access log.
+      <key> <bucket/object> {"Records":[record]} on create/overwrite,
+      HDEL only on the exact s3:ObjectRemoved:Delete (delete markers
+      and other ObjectRemoved:* variants are HSET like the reference).
+    - format=access: RPUSH <key> [{"Event": records, "EventTime": t}]
+      — a ONE-element JSON array, matching redis.go RedisAccessEvent.
     """
 
     driver = "redis"
@@ -221,13 +223,14 @@ class RedisTarget(Target):
             ts = records[0].get("eventTime", "") if records else ""
             self._client.command(
                 "RPUSH", self.key,
-                json.dumps({"Event": records, "EventTime": ts}),
+                json.dumps([{"Event": records, "EventTime": ts}]),
             )
             return
-        if "ObjectRemoved" in name:
+        if name == "s3:ObjectRemoved:Delete":
             self._client.command("HDEL", self.key, obj_key)
         else:
-            data = json.dumps(records[0] if records else event)
+            data = json.dumps({"Records": records} if records
+                              else {"Records": [event]})
             self._client.command("HSET", self.key, obj_key, data)
 
     def close(self):

@@ -131,6 +131,23 @@ class MultipartMixin:
                         opts: ObjectOptions | None = None) -> PartInfo:
         if not 1 <= part_number <= MAX_PART_ID:
             raise ErrInvalidPart(f"part number {part_number}")
+        # Same admission control as _put_object: concurrent part uploads
+        # must not bypass the PUT slots and thrash the single pipeline a
+        # 1-core host can sustain (measured 20% aggregate loss).
+        from .erasure_objects import _SINGLE_CORE, _encode_slot
+
+        if _SINGLE_CORE:
+            with _encode_slot():
+                return self._put_object_part_inner(
+                    bucket, object_, upload_id, part_number, reader, size,
+                    opts)
+        return self._put_object_part_inner(
+            bucket, object_, upload_id, part_number, reader, size, opts)
+
+    def _put_object_part_inner(self, bucket: str, object_: str,
+                               upload_id: str, part_number: int, reader,
+                               size: int,
+                               opts: ObjectOptions | None = None) -> PartInfo:
         fi, fis, upload_path = self._upload_fi(bucket, object_, upload_id)
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         write_quorum = k + (1 if k == m else 0)
@@ -184,8 +201,16 @@ class MultipartMixin:
                 except Exception:  # noqa: BLE001 - best effort
                     pass
 
+        from .erasure_objects import _SINGLE_CORE, _encode_slot
+
         try:
-            total = encode_stream(erasure, tee, writers, write_quorum)
+            if _SINGLE_CORE:
+                # Already inside the whole-part slot from put_object_part.
+                total = encode_stream(erasure, tee, writers, write_quorum)
+            else:
+                with _encode_slot():
+                    total = encode_stream(erasure, tee, writers,
+                                          write_quorum)
         except Exception:
             _drop_tmp()
             raise

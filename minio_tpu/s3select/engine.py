@@ -133,6 +133,10 @@ class _Batch:
     # its output from columns, and a WHERE-resolved path must not
     # surface as a synthetic extra output column.
     path_cache: dict = field(default_factory=dict)
+    # Query-start UTCNOW() value: evaluated once per query (ref
+    # pkg/s3select/sql/timestampfuncs.go per-query context), stamped
+    # onto each batch by run_select so rows across batches agree.
+    utcnow: str | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -385,6 +389,14 @@ def _fmt_ts(t) -> str:
     return s.replace("+00:00", "Z")
 
 
+def _query_utcnow() -> str:
+    import datetime as _dt
+
+    return _fmt_ts(
+        _dt.datetime.now(_dt.timezone.utc).replace(microsecond=0)
+    )
+
+
 def _scalar_fn_values(term, batch: _Batch) -> tuple[np.ndarray, str]:
     """Evaluate ("fn", name, args) over a batch; returns (object array,
     type hint 'num'|'str'|'any')."""
@@ -395,11 +407,7 @@ def _scalar_fn_values(term, batch: _Batch) -> tuple[np.ndarray, str]:
 
     n = batch.n
     if name == "utcnow":
-        import datetime as _dt
-
-        now = _fmt_ts(
-            _dt.datetime.now(_dt.timezone.utc).replace(microsecond=0)
-        )
+        now = batch.utcnow or _query_utcnow()
         return np.full(n, now, dtype=object), "str"
     if name == "cast":
         src = vals(args[0])
@@ -718,26 +726,35 @@ class _CountingReader(io.RawIOBase):
 
 def run_select(req: SelectRequest, stream, emit, on_batch=None) -> dict:
     """Run the query over `stream`, calling emit(chunk_bytes) per output
-    chunk. Returns {"processed": n_bytes, "returned": n_bytes}.
-    `on_batch(processed_bytes, returned_bytes)` fires after each input
-    batch — the hook behind RequestProgress events
+    chunk. Returns {"scanned", "processed", "returned"} byte counts.
+    `on_batch(scanned_bytes, processed_bytes, returned_bytes)` fires
+    after each input batch — the hook behind RequestProgress events
     (ref pkg/s3select/progress.go periodic progress frames)."""
     query = parse(req.expression)
     counting = _CountingReader(stream)
     # Nested paths need the raw decoded rows kept per batch.
     need_paths = any("." in c or "[" in c for c in query.columns)
-    # Compressed input: BytesProcessed counts COMPRESSED bytes scanned
-    # (the counting wrapper sits under the decompressor), matching the
-    # reference's progress semantics (pkg/s3select/progress.go).
+    # Compressed input: BytesScanned counts COMPRESSED bytes (the
+    # counting wrapper under the decompressor) while BytesProcessed
+    # counts DECOMPRESSED bytes (a second wrapper above it) — the
+    # AWS/reference split (pkg/s3select/progress.go progressReader).
+    # Uncompressed input shares one counter for both.
     data_src = io.BufferedReader(counting)
+    processed_counting = counting
     if req.compression_type == "GZIP":
         import gzip
 
-        data_src = _DecompressErrors(gzip.GzipFile(fileobj=data_src), "GZIP")
+        processed_counting = _CountingReader(
+            _DecompressErrors(gzip.GzipFile(fileobj=data_src), "GZIP")
+        )
+        data_src = io.BufferedReader(processed_counting)
     elif req.compression_type == "BZIP2":
         import bz2
 
-        data_src = _DecompressErrors(bz2.BZ2File(data_src), "BZIP2")
+        processed_counting = _CountingReader(
+            _DecompressErrors(bz2.BZ2File(data_src), "BZIP2")
+        )
+        data_src = io.BufferedReader(processed_counting)
     if req.input_format == "parquet":
         # Parquet needs random access (footer metadata + column chunks):
         # read the underlying spool directly, not the counting wrapper.
@@ -803,7 +820,9 @@ def run_select(req: SelectRequest, stream, emit, on_batch=None) -> dict:
         emit(chunk)
         return query.limit is None or emitted_rows < query.limit
 
+    utcnow = _query_utcnow()
     for batch in batches:
+        batch.utcnow = utcnow
         mask = (eval_where(query.where, batch) if query.where is not None
                 else np.ones(batch.n, dtype=bool))
         if query.aggregate:
@@ -816,25 +835,29 @@ def run_select(req: SelectRequest, stream, emit, on_batch=None) -> dict:
             # the spool): its progress is the spool position instead.
             if req.input_format == "parquet":
                 try:
-                    on_batch(stream.tell(), returned)
+                    pos = stream.tell()
+                    on_batch(pos, pos, returned)
                 except (OSError, ValueError):
                     pass
             else:
-                on_batch(counting.count, returned)
+                on_batch(counting.count, processed_counting.count,
+                         returned)
 
     if query.aggregate:
         chunk = _agg_output(req, query, agg_states)
         returned += len(chunk)
         emit(chunk)
     if req.input_format == "parquet":
-        # Random-access input: processed = full spool size, not the
-        # counting wrapper (which parquet bypasses).
+        # Random-access input: scanned/processed = full spool size, not
+        # the counting wrapper (which parquet bypasses).
         pos = stream.tell()
         stream.seek(0, io.SEEK_END)
         processed = stream.tell()
         stream.seek(pos)
-        return {"returned": returned, "processed": processed}
-    return {"returned": returned, "processed": counting.count}
+        return {"returned": returned, "scanned": processed,
+                "processed": processed}
+    return {"returned": returned, "scanned": counting.count,
+            "processed": processed_counting.count}
 
 
 def _output_keys(query: Query, names: list[str]) -> list[str]:

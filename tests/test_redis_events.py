@@ -144,7 +144,15 @@ def test_namespace_format_hset_hdel(fake):
     t.send_now(_event("s3:ObjectCreated:Put", "photos", "cat.png"))
     assert fake.hashes["bucketevents"].keys() == {"photos/cat.png"}
     rec = json.loads(fake.hashes["bucketevents"]["photos/cat.png"])
-    assert rec["eventName"] == "ObjectCreated:Put"
+    # Wire format parity (ref redis.go:178): {"Records": [event]}
+    assert rec["Records"][0]["eventName"] == "ObjectCreated:Put"
+    # DeleteMarkerCreated is NOT the exact ObjectRemoved:Delete event:
+    # the reference HSETs it like any other record (only :Delete HDELs).
+    t.send_now(_event("s3:ObjectRemoved:DeleteMarkerCreated",
+                      "photos", "cat.png"))
+    marker = json.loads(fake.hashes["bucketevents"]["photos/cat.png"])
+    assert marker["Records"][0]["eventName"] == (
+        "ObjectRemoved:DeleteMarkerCreated")
     t.send_now(_event("s3:ObjectRemoved:Delete", "photos", "cat.png"))
     assert fake.hashes["bucketevents"] == {}
     t.close()
@@ -157,8 +165,10 @@ def test_access_format_rpush(fake):
     t.send_now(_event("s3:ObjectCreated:Put", "b", "o2"))
     entries = [json.loads(v) for v in fake.lists["accesslog"]]
     assert len(entries) == 2
-    assert entries[0]["Event"][0]["s3"]["bucket"]["name"] == "b"
-    assert entries[0]["EventTime"]
+    # Each RPUSH value is a ONE-element array (ref RedisAccessEvent).
+    assert isinstance(entries[0], list) and len(entries[0]) == 1
+    assert entries[0][0]["Event"][0]["s3"]["bucket"]["name"] == "b"
+    assert entries[0][0]["EventTime"]
     t.close()
 
 

@@ -382,6 +382,29 @@ def test_utcnow_and_to_timestamp():
     assert out.strip() == "alice"
 
 
+def test_utcnow_stable_across_batches():
+    """UTCNOW() is evaluated once per query, not per input batch
+    (ref pkg/s3select/sql/timestampfuncs.go per-query context)."""
+    import io as _io
+
+    from minio_tpu.s3select import engine as _eng
+
+    rows = "\n".join(f"r{i},1" for i in range(_eng.BATCH_ROWS + 10))
+    req = SelectRequest(expression="SELECT UTCNOW() FROM S3Object")
+    chunks = []
+    # Deterministic: a ticking clock would hand each batch a different
+    # value if UTCNOW were (incorrectly) re-evaluated per batch.
+    tick = iter(range(10**6))
+    orig = _eng._query_utcnow
+    _eng._query_utcnow = lambda: f"tick-{next(tick)}"
+    try:
+        run_select(req, _io.BytesIO(rows.encode()), chunks.append)
+    finally:
+        _eng._query_utcnow = orig
+    vals = set(b"".join(chunks).decode().strip().split("\n"))
+    assert vals == {"tick-0"}  # spans >=2 batches, one timestamp
+
+
 def test_coalesce_nullif():
     out, _ = _run("SELECT COALESCE(missing_col, name) FROM S3Object "
                   "LIMIT 1")
@@ -409,8 +432,9 @@ def test_gzip_input():
         "SELECT name FROM S3Object WHERE dept = 'eng'", data, "GZIP"
     )
     assert out.strip().split("\n") == ["alice", "carol", "erin"]
-    # BytesProcessed counts COMPRESSED bytes scanned.
-    assert stats["processed"] == len(data)
+    # BytesScanned counts COMPRESSED bytes; BytesProcessed decompressed.
+    assert stats["scanned"] == len(data)
+    assert stats["processed"] == len(CSV.encode())
 
 
 def test_bzip2_input():
@@ -485,7 +509,8 @@ def test_select_oracle_fuzz_scalar_fns():
         assert len(got) == len(want), (trial, sql)
         for g, w in zip(got, want):
             assert list(g.values()) == w, (trial, sql, g, w)
-        assert stats["processed"] == len(data)
+        assert stats["scanned"] == len(data)
+        assert stats["processed"] == len(jsonl.encode())
 
 
 def test_fn_keyword_columns_still_selectable():

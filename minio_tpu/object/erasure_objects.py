@@ -62,40 +62,7 @@ BLOCK_SIZE_V2 = 1 << 20  # erasure block size, ref cmd/object-api-common.go:39
 _obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
 
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
-
-# Admission control for the CPU-bound encode+hash+write section of PUT:
-# at most cpu_count streams run it concurrently; excess PUTs queue, and a
-# queue wait past the deadline returns 503 like the reference's
-# maxClients throttle (cmd/handler-api.go:36-78) — on a small host, N
-# concurrent encode pipelines thrash caches and aggregate BELOW one
-# serial stream (measured: 8-way 0.229 GB/s vs serial 0.283 on 1 core).
-_encode_slots = threading.BoundedSemaphore(
-    int(os.environ.get("MTPU_MAX_CONCURRENT_ENCODES", "0"))
-    or max(1, os.cpu_count() or 1)
-)
-_ENCODE_SLOT_DEADLINE_S = float(
-    os.environ.get("MTPU_ENCODE_SLOT_DEADLINE_S", "30")
-)
-
-from contextlib import contextmanager as _slot_ctxmgr
-
-
-@_slot_ctxmgr
-def _encode_slot():
-    """Bounded admission: a slow uploader holding a slot must not wedge
-    every other PUT forever — waiters time out to a retriable 503
-    (ErrOperationTimedOut), matching the reference's deadline'd
-    maxClients queue."""
-    from ..utils.errors import ErrOperationTimedOut
-
-    if not _encode_slots.acquire(timeout=_ENCODE_SLOT_DEADLINE_S):
-        raise ErrOperationTimedOut(
-            "server busy: PUT admission queue deadline exceeded"
-        )
-    try:
-        yield
-    finally:
-        _encode_slots.release()
+from ..utils.fanout import encode_slot as _encode_slot
 
 
 def _close_sinks(sinks):

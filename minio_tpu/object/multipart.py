@@ -16,6 +16,8 @@ from ..erasure.bitrot import BitrotAlgorithm, StreamingBitrotWriter
 from ..erasure.codec import Erasure
 from ..erasure.streaming import encode_stream
 from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
+from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
+from ..utils.fanout import encode_slot as _encode_slot
 from ..storage.local import SYSTEM_META_BUCKET
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
@@ -134,8 +136,6 @@ class MultipartMixin:
         # Same admission control as _put_object: concurrent part uploads
         # must not bypass the PUT slots and thrash the single pipeline a
         # 1-core host can sustain (measured 20% aggregate loss).
-        from .erasure_objects import _SINGLE_CORE, _encode_slot
-
         if _SINGLE_CORE:
             with _encode_slot():
                 return self._put_object_part_inner(
@@ -200,8 +200,6 @@ class MultipartMixin:
                                 f"{upload_path}/{tmp_part}")
                 except Exception:  # noqa: BLE001 - best effort
                     pass
-
-        from .erasure_objects import _SINGLE_CORE, _encode_slot
 
         try:
             if _SINGLE_CORE:

@@ -26,6 +26,47 @@ def test_mesh_shape(mesh):
     assert mesh.shape["lane"] in (2, 4, 8)
 
 
+def test_make_mesh_shape_selection():
+    """Pin the shape policy: default maximizes lanes (<=8, power of
+    two); explicit `lanes` honors dp>1 splits; non-dividing lanes
+    rejected."""
+    m = make_mesh(8)
+    assert (m.shape["dp"], m.shape["lane"]) == (1, 8)
+    m = make_mesh(8, lanes=4)
+    assert (m.shape["dp"], m.shape["lane"]) == (2, 4)
+    m = make_mesh(8, lanes=2)
+    assert (m.shape["dp"], m.shape["lane"]) == (4, 2)
+    m = make_mesh(4)
+    assert (m.shape["dp"], m.shape["lane"]) == (1, 4)
+    m = make_mesh(6)  # non-power-of-two: largest 2^i lane group dividing 6
+    assert (m.shape["dp"], m.shape["lane"]) == (3, 2)
+    with pytest.raises(ValueError):
+        make_mesh(8, lanes=3)
+    with pytest.raises(ValueError):
+        make_mesh(1 << 10)  # more devices than exist
+
+
+@pytest.mark.parametrize("lanes", [4, 2])
+def test_dp_parallel_roundtrip(lanes):
+    """dp>1 meshes carry the batch axis over multiple devices: encode +
+    degraded read + heal on (dp=8//lanes, lane=lanes) with the 12+4
+    north-star geometry (16 % lanes == 0 -> multiple shards per lane)."""
+    mesh = make_mesh(8, lanes=lanes)
+    dp = mesh.shape["dp"]
+    assert dp > 1
+    k, m, shard = 12, 4, 256
+    blocks = _random_blocks(dp * 2, k, shard, seed=11)
+    se = ShardedErasure(mesh, k, m, block_size=k * shard)
+    dead = (0, 5, 13, 15)
+    stripe, recovered = full_put_get_step(se, blocks, dead)
+    assert np.array_equal(np.asarray(recovered), blocks)
+    import jax.numpy as jnp
+
+    wounded = stripe.at[:, jnp.asarray(dead), :].set(0)
+    healed = np.asarray(se.heal(wounded, dead))
+    assert np.array_equal(healed, np.asarray(stripe))
+
+
 def test_sharded_encode_matches_host_codec(mesh):
     k, m, shard = 4, 4, 512
     se = ShardedErasure(mesh, k, m, block_size=k * shard)

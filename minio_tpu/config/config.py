@@ -49,8 +49,8 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
     "scanner": {"delay": "10", "max_wait": "15s", "cycle": "1m"},
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "", "queue_dir": "", "queue_limit": "0"},
-    "notify_mysql": {"enable": "off", "dsn_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
-    "notify_postgres": {"enable": "off", "connection_string": "", "table": "", "queue_dir": "", "queue_limit": "0"},
+    "notify_mysql": {"enable": "off", "dsn_string": "", "table": "", "format": "namespace", "queue_dir": "", "queue_limit": "0"},
+    "notify_postgres": {"enable": "off", "connection_string": "", "table": "", "format": "namespace", "queue_dir": "", "queue_limit": "0"},
     "notify_redis": {"enable": "off", "address": "", "key": "", "format": "namespace", "password": "", "queue_dir": "", "queue_limit": "0"},
 }
 
@@ -71,8 +71,8 @@ HELP: dict[str, str] = {
     "heal": "manage object healing frequency and bitrot verification",
     "scanner": "manage namespace scanning for usage calculation, lifecycle, healing",
     "notify_webhook": "publish bucket notifications to webhook endpoints",
-    "notify_mysql": "publish bucket notifications to MySQL databases (QUEUE-ONLY in this runtime: no mysql driver ships, events persist in queue_dir until an external drainer delivers them)",
-    "notify_postgres": "publish bucket notifications to Postgres databases (QUEUE-ONLY in this runtime: no postgres driver ships, events persist in queue_dir until an external drainer delivers them)",
+    "notify_mysql": "publish bucket notifications to MySQL databases (live delivery over the MySQL wire protocol; events queue in queue_dir while the server is down)",
+    "notify_postgres": "publish bucket notifications to Postgres databases (live delivery over the Postgres wire protocol; events queue in queue_dir while the server is down)",
     "notify_redis": "publish bucket notifications to Redis datastores (live delivery over a built-in RESP client)",
 }
 
@@ -84,8 +84,8 @@ DEFAULT_TARGET = "_"
 _REQUIRED_WHEN_ENABLED = {
     "notify_redis": ("address",),
     "notify_webhook": ("endpoint",),
-    "notify_mysql": ("dsn_string",),
-    "notify_postgres": ("connection_string",),
+    "notify_mysql": ("dsn_string", "table"),
+    "notify_postgres": ("connection_string", "table"),
 }
 
 

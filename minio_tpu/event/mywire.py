@@ -1,0 +1,333 @@
+"""Minimal MySQL client over a raw socket — the wire layer for
+MySQLTarget (ref pkg/event/target/mysql.go, which links
+go-sql-driver/mysql; the notification target needs only handshake +
+COM_QUERY/COM_PING, so no driver is required — same approach as
+resp.py / pgwire.py).
+
+Implements the v10 handshake with mysql_native_password (including the
+auth-switch path servers send when the account uses it non-default) and
+the text protocol for statements that return OK packets. Literals are
+inlined with backslash-aware escaping (MySQL's default sql_mode keeps
+backslash escapes on, unlike Postgres)."""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+# Server status flag: sql_mode includes NO_BACKSLASH_ESCAPES — escaping
+# must switch to quote-doubling only (go-sql-driver tracks the same
+# flag for interpolateParams).
+SERVER_STATUS_NO_BACKSLASH_ESCAPES = 0x200
+
+
+class MyError(RuntimeError):
+    """Server ERR packet."""
+
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(f"mysql error {code}: {message}")
+
+
+def escape_literal(s: str, no_backslash_escapes: bool = False) -> str:
+    """Quote a string literal for the session's active escaping mode.
+    Doubling ' is valid in BOTH modes; backslash sequences are only
+    escapes when NO_BACKSLASH_ESCAPES is off — doubling backslashes
+    there (or failing to, in default mode) is an injection vector for
+    attacker-controlled object keys, so the caller must pass the mode
+    the server reported in its status flags."""
+    if no_backslash_escapes:
+        return "'" + s.replace("'", "''") + "'"
+    out = []
+    for ch in s:
+        if ch == "\x00":
+            out.append("\\0")
+        elif ch == "'":
+            out.append("''")
+        elif ch == "\\":
+            out.append("\\\\")
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ch == "\x1a":
+            out.append("\\Z")
+        else:
+            out.append(ch)
+    return "'" + "".join(out) + "'"
+
+
+def escape_ident(s: str) -> str:
+    return "`" + s.replace("`", "``") + "`"
+
+
+def _native_password_token(password: str, scramble: bytes) -> bytes:
+    """SHA1(password) XOR SHA1(scramble + SHA1(SHA1(password)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(scramble + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+class MyClient:
+    """One pooled connection; a lock serializes command round trips."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, timeout: float = 5.0):
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._seq = 0
+        self.status = 0  # server status flags (handshake + each OK)
+        self._mu = threading.Lock()
+
+    @property
+    def no_backslash_escapes(self) -> bool:
+        return bool(self.status & SERVER_STATUS_NO_BACKSLASH_ESCAPES)
+
+    # --- packet framing (3-byte LE length + 1-byte sequence id) ---
+
+    def _read_packet(self) -> bytes:
+        head = self._rfile.read(4)
+        if len(head) != 4:
+            raise ConnectionError("short mysql packet header")
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        self._seq = head[3] + 1
+        payload = self._rfile.read(ln)
+        if len(payload) != ln:
+            raise ConnectionError("short mysql packet body")
+        return payload
+
+    def _send_packet(self, payload: bytes):
+        ln = len(payload)
+        self._sock.sendall(
+            bytes((ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
+                   self._seq & 0xFF)) + payload
+        )
+        self._seq += 1
+
+    # --- handshake ---
+
+    @staticmethod
+    def _parse_handshake(pkt: bytes) -> tuple[bytes, str, int]:
+        """Return (scramble, auth_plugin, status) from the v10
+        greeting."""
+        if pkt[0] == 0xFF:
+            code = struct.unpack("<H", pkt[1:3])[0]
+            raise MyError(code, pkt[3:].decode("utf-8", "replace"))
+        if pkt[0] != 10:
+            raise ConnectionError(f"unsupported handshake v{pkt[0]}")
+        i = pkt.index(b"\x00", 1) + 1  # server version string
+        i += 4  # thread id
+        part1 = pkt[i:i + 8]
+        i += 8 + 1  # filler
+        cap = struct.unpack("<H", pkt[i:i + 2])[0]
+        i += 2
+        plugin = "mysql_native_password"
+        part2 = b""
+        status = 0
+        auth_len = 0
+        if len(pkt) > i:
+            i += 1  # charset
+            status = struct.unpack("<H", pkt[i:i + 2])[0]
+            i += 2
+            cap |= struct.unpack("<H", pkt[i:i + 2])[0] << 16
+            i += 2
+            auth_len = pkt[i]
+            i += 1 + 10  # reserved
+            if cap & CLIENT_SECURE_CONNECTION:
+                n = max(13, auth_len - 8)
+                part2 = pkt[i:i + n]
+                i += n
+            if cap & CLIENT_PLUGIN_AUTH:
+                end = pkt.find(b"\x00", i)
+                plugin = pkt[i:end if end >= 0 else len(pkt)].decode()
+        # The scramble is exactly auth_len-1 bytes (the field includes a
+        # trailing NUL) — slicing, NOT rstrip: a nonce whose last random
+        # byte is 0x00 must keep it or auth fails ~1/256 of connects.
+        total = (auth_len - 1) if auth_len > 0 else 20
+        scramble = (part1 + part2)[:max(total, 8)]
+        return scramble, plugin, status
+
+    def _connect(self):
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+        self._seq = 0
+        try:
+            scramble, plugin, self.status = self._parse_handshake(
+                self._read_packet()
+            )
+            if plugin not in ("mysql_native_password", ""):
+                # Ask for native password via auth-switch below; most
+                # servers honor the client's requested plugin.
+                plugin = "mysql_native_password"
+            caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION |
+                    CLIENT_PLUGIN_AUTH)
+            if self.database:
+                caps |= CLIENT_CONNECT_WITH_DB
+            token = _native_password_token(self.password, scramble)
+            resp = struct.pack("<IIB23x", caps, 1 << 24, 45)  # utf8mb4
+            resp += self.user.encode() + b"\x00"
+            resp += bytes((len(token),)) + token
+            if self.database:
+                resp += self.database.encode() + b"\x00"
+            resp += b"mysql_native_password\x00"
+            self._send_packet(resp)
+            pkt = self._read_packet()
+            if pkt and pkt[0] == 0xFE:  # AuthSwitchRequest
+                end = pkt.index(b"\x00", 1)
+                want = pkt[1:end].decode()
+                if want != "mysql_native_password":
+                    raise ConnectionError(
+                        f"unsupported auth plugin {want}"
+                    )
+                # Exactly 20 scramble bytes + trailing NUL — sliced, not
+                # rstripped (see _parse_handshake).
+                new_scramble = pkt[end + 1:end + 21]
+                self._send_packet(
+                    _native_password_token(self.password, new_scramble)
+                )
+                pkt = self._read_packet()
+            self._check_ok(pkt)
+        except Exception:
+            self._teardown()
+            raise
+
+    @staticmethod
+    def _lenenc(pkt: bytes, i: int) -> tuple[int, int]:
+        b = pkt[i]
+        if b < 0xFB:
+            return b, i + 1
+        if b == 0xFC:
+            return struct.unpack("<H", pkt[i + 1:i + 3])[0], i + 3
+        if b == 0xFD:
+            return int.from_bytes(pkt[i + 1:i + 4], "little"), i + 4
+        return struct.unpack("<Q", pkt[i + 1:i + 9])[0], i + 9
+
+    def _check_ok(self, pkt: bytes):
+        if pkt and pkt[0] == 0xFF:
+            code = struct.unpack("<H", pkt[1:3])[0]
+            msg = pkt[3:].decode("utf-8", "replace")
+            if msg.startswith("#") and len(msg) >= 6:
+                msg = msg[6:]  # strip SQL-state marker
+            raise MyError(code, msg)
+        if not pkt or pkt[0] not in (0x00, 0xFE):
+            raise ConnectionError(f"unexpected mysql reply {pkt[:1]!r}")
+        if pkt[0] == 0x00 and len(pkt) >= 5:
+            # OK: header, lenenc affected rows, lenenc insert id, then
+            # the status flags this client's escaping mode follows.
+            _, i = self._lenenc(pkt, 1)
+            _, i = self._lenenc(pkt, i)
+            if len(pkt) >= i + 2:
+                self.status = struct.unpack("<H", pkt[i:i + 2])[0]
+
+    def close(self):
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._seq = 0
+                    self._send_packet(b"\x01")  # COM_QUIT
+                except OSError:
+                    pass
+            self._teardown()
+
+    def _teardown(self):
+        for attr in ("_rfile", "_sock"):
+            obj = getattr(self, attr)
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+
+    # --- commands ---
+
+    def _roundtrip(self, com: bytes):
+        self._seq = 0
+        self._send_packet(com)
+        self._check_ok(self._read_packet())
+
+    def query(self, sql: str):
+        """COM_QUERY for statements that return OK (INSERT/DELETE/DDL —
+        the whole target surface). Retry discipline matches RespClient:
+        one fresh-connection retry when a POOLED socket is dead at send
+        time (safe: the target's statements are idempotent upserts/
+        deletes/creates), never after the server may have executed."""
+        with self._mu:
+            for attempt in (0, 1):
+                fresh = self._sock is None
+                if fresh:
+                    self._connect()
+                try:
+                    self._roundtrip(b"\x03" + sql.encode())
+                    return
+                except MyError:
+                    raise
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    if fresh or attempt:
+                        raise
+                    continue
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def ping(self) -> bool:
+        try:
+            with self._mu:
+                if self._sock is None:
+                    self._connect()
+                try:
+                    self._roundtrip(b"\x0e")  # COM_PING
+                except (OSError, ConnectionError):
+                    # A dead pooled socket must not poison every later
+                    # ping: drop it and probe once on a fresh connect —
+                    # otherwise is_active() stays false after a server
+                    # restart until some query repairs the pool.
+                    self._teardown()
+                    self._connect()
+                    self._roundtrip(b"\x0e")
+            return True
+        except (OSError, ConnectionError, MyError, ValueError):
+            with self._mu:
+                self._teardown()
+            return False
+
+
+def parse_dsn(dsn: str) -> dict:
+    """Parse go-sql-driver DSN `user:pass@tcp(host:port)/dbname` (the
+    format notify_mysql's dsn_string uses, ref mysql.go MySQLArgs)."""
+    out = {"host": "127.0.0.1", "port": 3306, "user": "root",
+           "password": "", "dbname": ""}
+    rest = dsn
+    if "@" in rest:
+        cred, _, rest = rest.rpartition("@")
+        user, _, pwd = cred.partition(":")
+        if user:
+            out["user"] = user
+        out["password"] = pwd
+    if "/" in rest:
+        addr, _, db = rest.partition("/")
+        out["dbname"] = db.partition("?")[0]
+    else:
+        addr = rest
+    if addr.startswith("tcp(") and addr.endswith(")"):
+        addr = addr[4:-1]
+    if addr:
+        host, _, port = addr.rpartition(":")
+        if port.isdigit() and host:
+            out["host"], out["port"] = host, int(port)
+        elif addr:
+            out["host"] = addr
+    return out

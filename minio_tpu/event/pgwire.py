@@ -1,0 +1,303 @@
+"""Minimal PostgreSQL frontend over a raw socket — the wire layer for
+PostgresTarget (ref pkg/event/target/postgresql.go, which links
+lib/pq; the notification target only needs startup + auth + simple
+query, so no driver is required — the same approach as resp.py).
+
+Implements protocol 3.0: StartupMessage, authentication (trust,
+cleartext password, MD5, SCRAM-SHA-256 per RFC 7677), and the simple
+query subprotocol ('Q' -> CommandComplete/ReadyForQuery). Values are
+inlined as escaped literals: the target only ever writes
+server-generated JSON and keys, and the escaper doubles quotes the way
+libpq's PQescapeStringConn does with standard_conforming_strings=on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+
+
+class PgError(RuntimeError):
+    """Server ErrorResponse; .fields holds the code->value map."""
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(
+            f"{fields.get('S', 'ERROR')} {fields.get('C', '')}: "
+            f"{fields.get('M', 'unknown')}"
+        )
+
+
+def escape_literal(s: str) -> str:
+    """Single-quoted literal with quotes doubled. NUL cannot appear in a
+    Postgres string at all — reject rather than truncate silently."""
+    if "\x00" in s:
+        raise ValueError("NUL byte in SQL literal")
+    return "'" + s.replace("'", "''") + "'"
+
+
+def escape_ident(s: str) -> str:
+    return '"' + s.replace('"', '""') + '"'
+
+
+class PgClient:
+    """One pooled connection; a lock serializes query round trips."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, timeout: float = 5.0):
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._mu = threading.Lock()
+
+    # --- framing ---
+
+    def _send_startup(self):
+        params = {"user": self.user, "database": self.database,
+                  "client_encoding": "UTF8",
+                  "application_name": "minio-tpu"}
+        body = b"".join(
+            k.encode() + b"\x00" + v.encode() + b"\x00"
+            for k, v in params.items()
+        ) + b"\x00"
+        pkt = struct.pack("!ii", 8 + len(body), 196608) + body
+        self._sock.sendall(pkt)
+
+    def _send_msg(self, type_: bytes, payload: bytes):
+        self._sock.sendall(
+            type_ + struct.pack("!i", 4 + len(payload)) + payload
+        )
+
+    def _read_msg(self) -> tuple[bytes, bytes]:
+        head = self._rfile.read(5)
+        if len(head) != 5:
+            raise ConnectionError("short pg message header")
+        type_, ln = head[:1], struct.unpack("!i", head[1:])[0]
+        payload = self._rfile.read(ln - 4)
+        if len(payload) != ln - 4:
+            raise ConnectionError("short pg message body")
+        return type_, payload
+
+    # --- auth ---
+
+    @staticmethod
+    def _md5_response(user: str, password: str, salt: bytes) -> bytes:
+        inner = hashlib.md5(password.encode() + user.encode()).hexdigest()
+        outer = hashlib.md5(inner.encode() + salt).hexdigest()
+        return b"md5" + outer.encode() + b"\x00"
+
+    def _scram(self, mechs: list[str]):
+        """SCRAM-SHA-256 (RFC 5802/7677) over the SASL messages."""
+        if "SCRAM-SHA-256" not in mechs:
+            raise ConnectionError(f"unsupported SASL mechanisms {mechs}")
+        import base64
+
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        gs2 = "n,,"
+        client_first_bare = f"n=,r={nonce}"  # user comes from startup msg
+        first = (gs2 + client_first_bare).encode()
+        self._send_msg(
+            b"p",
+            b"SCRAM-SHA-256\x00" + struct.pack("!i", len(first)) + first,
+        )
+        type_, payload = self._read_msg()
+        if type_ == b"E":
+            raise PgError(self._parse_error(payload))
+        code = struct.unpack("!i", payload[:4])[0]
+        if type_ != b"R" or code != 11:
+            raise ConnectionError(f"expected SASLContinue, got {type_} {code}")
+        server_first = payload[4:].decode()
+        attrs = dict(p.split("=", 1) for p in server_first.split(","))
+        r, s, i = attrs["r"], attrs["s"], int(attrs["i"])
+        if not r.startswith(nonce):
+            raise ConnectionError("SCRAM server nonce mismatch")
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password.encode(), base64.b64decode(s), i
+        )
+        client_key = hmac.digest(salted, b"Client Key", "sha256")
+        stored_key = hashlib.sha256(client_key).digest()
+        channel = base64.b64encode(gs2.encode()).decode()
+        client_final_bare = f"c={channel},r={r}"
+        auth_msg = ",".join(
+            [client_first_bare, server_first, client_final_bare]
+        ).encode()
+        sig = hmac.digest(stored_key, auth_msg, "sha256")
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = (
+            client_final_bare + ",p=" + base64.b64encode(proof).decode()
+        ).encode()
+        self._send_msg(b"p", final)
+        type_, payload = self._read_msg()
+        if type_ == b"E":
+            raise PgError(self._parse_error(payload))
+        code = struct.unpack("!i", payload[:4])[0]
+        if type_ != b"R" or code != 12:
+            raise ConnectionError(f"expected SASLFinal, got {type_} {code}")
+        sattrs = dict(p.split("=", 1) for p in payload[4:].decode().split(","))
+        server_key = hmac.digest(salted, b"Server Key", "sha256")
+        want_v = base64.b64encode(
+            hmac.digest(server_key, auth_msg, "sha256")
+        ).decode()
+        if sattrs.get("v") != want_v:
+            raise ConnectionError("SCRAM server signature mismatch")
+
+    @staticmethod
+    def _parse_error(payload: bytes) -> dict:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+        return fields
+
+    def _connect(self):
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+        try:
+            self._send_startup()
+            while True:
+                type_, payload = self._read_msg()
+                if type_ == b"E":
+                    raise PgError(self._parse_error(payload))
+                if type_ == b"R":
+                    code = struct.unpack("!i", payload[:4])[0]
+                    if code == 0:  # AuthenticationOk
+                        continue
+                    if code == 3:  # cleartext
+                        self._send_msg(
+                            b"p", self.password.encode() + b"\x00"
+                        )
+                    elif code == 5:  # md5
+                        self._send_msg(b"p", self._md5_response(
+                            self.user, self.password, payload[4:8]
+                        ))
+                    elif code == 10:  # SASL
+                        mechs = [
+                            m.decode() for m in payload[4:].split(b"\x00")
+                            if m
+                        ]
+                        self._scram(mechs)
+                    else:
+                        raise ConnectionError(
+                            f"unsupported pg auth code {code}"
+                        )
+                elif type_ in (b"S", b"K", b"N"):
+                    continue  # ParameterStatus / BackendKeyData / Notice
+                elif type_ == b"Z":  # ReadyForQuery
+                    return
+                else:
+                    raise ConnectionError(
+                        f"unexpected pg message {type_!r} during startup"
+                    )
+        except Exception:
+            self._teardown()
+            raise
+
+    def close(self):
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._send_msg(b"X", b"")  # Terminate
+                except OSError:
+                    pass
+            self._teardown()
+
+    def _teardown(self):
+        for attr in ("_rfile", "_sock"):
+            obj = getattr(self, attr)
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+
+    # --- simple query ---
+
+    def _roundtrip_query(self, sql: str) -> list[str]:
+        self._send_msg(b"Q", sql.encode() + b"\x00")
+        tags: list[str] = []
+        err: PgError | None = None
+        while True:
+            type_, payload = self._read_msg()
+            if type_ == b"C":  # CommandComplete
+                tags.append(payload.rstrip(b"\x00").decode())
+            elif type_ == b"E":
+                err = PgError(self._parse_error(payload))
+            elif type_ == b"Z":  # ReadyForQuery: transaction boundary
+                if err is not None:
+                    raise err
+                return tags
+            # T/D/I/N/S (rows, notices, empty) are skipped: the target
+            # never SELECTs.
+
+    def query(self, sql: str) -> list[str]:
+        """Run one simple query; returns CommandComplete tags. Same
+        retry discipline as RespClient.command: a dead pooled socket
+        detected at SEND time retries once on a fresh connection;
+        failures after the query may have executed never retry."""
+        with self._mu:
+            for attempt in (0, 1):
+                fresh = self._sock is None
+                if fresh:
+                    self._connect()
+                try:
+                    return self._roundtrip_query(sql)
+                except PgError:
+                    raise
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    if fresh or attempt:
+                        raise
+                    # Stale pooled socket: whether the query reached the
+                    # server is unknowable, but the target's statements
+                    # are idempotent (UPSERT / DELETE / CREATE IF NOT
+                    # EXISTS / append-only INSERT of the same event), so
+                    # one retry on a fresh connection is safe.
+                    continue
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def ping(self) -> bool:
+        try:
+            self.query("")  # empty query -> EmptyQueryResponse + Z
+            return True
+        except (OSError, ConnectionError, PgError, ValueError):
+            return False
+
+
+def parse_conn_string(conn: str) -> dict:
+    """Parse either a postgres:// URL or a key=value DSN into
+    {host, port, user, password, dbname} (libpq's two accepted forms,
+    ref postgresql.go PostgresConnectionString)."""
+    out = {"host": "127.0.0.1", "port": 5432, "user": "postgres",
+           "password": "", "dbname": "postgres"}
+    if conn.startswith(("postgres://", "postgresql://")):
+        import urllib.parse
+
+        u = urllib.parse.urlsplit(conn)
+        if u.hostname:
+            out["host"] = u.hostname
+        if u.port:
+            out["port"] = u.port
+        if u.username:
+            out["user"] = urllib.parse.unquote(u.username)
+        if u.password:
+            out["password"] = urllib.parse.unquote(u.password)
+        if u.path.lstrip("/"):
+            out["dbname"] = u.path.lstrip("/")
+        return out
+    for part in conn.split():
+        k, _, v = part.partition("=")
+        v = v.strip("'")
+        if k == "port":
+            out["port"] = int(v)
+        elif k in out:
+            out[k] = v
+    return out

@@ -3,11 +3,12 @@ trimmed target set (webhook/mysql/postgresql/redis,
 pkg/event/target/*.go) plus the persistent queue store
 (pkg/event/target/queuestore.go) used to survive target downtime.
 
-WebhookTarget is fully functional (stdlib HTTP). The DB/Redis targets
-implement the same config surface and queueing but require their wire
-clients at send time; without them events stay queued — matching the
-reference's behavior when a target is unreachable.
-"""
+All four deliver LIVE: webhook over stdlib HTTP, and the three
+server-protocol targets over raw-socket wire clients (resp.py RESP,
+pgwire.py Postgres frontend/backend protocol, mywire.py MySQL
+client/server protocol) — no external drivers. While a target is down,
+events queue durably and drain in order on reconnect, matching the
+reference's store-and-replay."""
 
 from __future__ import annotations
 
@@ -141,44 +142,219 @@ class WebhookTarget(Target):
             conn.close()
 
 
-class _DBTargetBase(Target):
-    """Config-compatible SQL database targets. The reference links
-    native mysql/postgres drivers; this image has none, so for these
-    two, events queue durably until a driver-equipped process drains
-    them — an operator configuring notify_mysql / notify_postgres gets
-    a growing queue_dir and NO live delivery (documented in
-    config/config.py kvs help). Redis is NOT in this class: its wire
-    protocol needs no driver, so RedisTarget delivers live."""
+class _SQLTargetBase(Target):
+    """Shared send logic for the SQL targets (the reference's
+    postgresql.go/mysql.go send() pair): format=namespace upserts
+    {"Records":[event]} under bucket/object and deletes ONLY on the
+    exact s3:ObjectRemoved:Delete; format=access appends
+    (event_time, {"Records":[event]}) rows. Both speak their server's
+    native wire protocol directly (pgwire.py / mywire.py) — no driver,
+    same approach as the Redis RESP client."""
 
-    driver = "unavailable"
+    driver = "sql"
+
+    def __init__(self, arn: str, table: str,
+                 store: QueueStore | None = None, fmt: str = "namespace"):
+        super().__init__(arn, store)
+        if not table.strip():
+            raise ValueError(f"{arn}: table is required")
+        if fmt not in ("namespace", "access"):
+            raise ValueError(f"{arn}: unrecognized format {fmt!r}")
+        self.table = table
+        self.format = fmt
+        self._table_ready = False
+
+    # subclass surface -------------------------------------------------
+    def _ping(self) -> bool:
+        raise NotImplementedError
+
+    def _exec(self, sql: str) -> None:
+        raise NotImplementedError
+
+    def _create_table_sql(self) -> str:
+        raise NotImplementedError
+
+    def _upsert_sql(self, key: str, data: str) -> str:
+        raise NotImplementedError
+
+    def _delete_sql(self, key: str) -> str:
+        raise NotImplementedError
+
+    def _insert_sql(self, ts: str, data: str) -> str:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
 
     def is_active(self) -> bool:
-        return False
+        return self._ping()
+
+    def _ensure_table(self):
+        """CREATE TABLE IF NOT EXISTS once per process (the reference
+        probes with `SELECT 1 FROM t` then creates, mysql.go:75,
+        postgresql.go createTable)."""
+        if not self._table_ready:
+            self._exec(self._create_table_sql())
+            self._table_ready = True
+
+    def _pre_send(self) -> None:
+        """Hook: establish the session before statements are BUILT (the
+        MySQL escaper needs the server's reported sql_mode flags)."""
 
     def send_now(self, event: dict) -> None:
-        raise RuntimeError(
-            f"{self.driver} client not available in this runtime"
-        )
+        self._pre_send()
+        self._ensure_table()
+        records = event.get("Records") or [event]
+        name = event.get("EventName", "")
+        key = event.get("Key", "")
+        data = json.dumps({"Records": records})
+        if self.format == "access":
+            ts = records[0].get("eventTime", "") if records else ""
+            self._exec(self._insert_sql(ts, data))
+            return
+        if name == "s3:ObjectRemoved:Delete":
+            self._exec(self._delete_sql(key))
+        else:
+            self._exec(self._upsert_sql(key, data))
 
 
-class MySQLTarget(_DBTargetBase):
+class MySQLTarget(_SQLTargetBase):
     driver = "mysql"
 
     def __init__(self, arn: str, dsn: str, table: str,
-                 store: QueueStore | None = None):
-        super().__init__(arn, store)
+                 store: QueueStore | None = None, fmt: str = "namespace"):
+        super().__init__(arn, table, store, fmt)
+        from .mywire import MyClient, parse_dsn
+
+        if not dsn.strip():
+            raise ValueError(f"{arn}: notify_mysql dsn_string is required")
         self.dsn = dsn
-        self.table = table
+        cfg = parse_dsn(dsn)
+        self._client = MyClient(cfg["host"], cfg["port"], cfg["user"],
+                                cfg["password"], cfg["dbname"])
+
+    def _ping(self) -> bool:
+        return self._client.ping()
+
+    def _pre_send(self) -> None:
+        if self._client._sock is None and not self._client.ping():
+            raise ConnectionError("mysql server unreachable")
+
+    def _exec(self, sql: str) -> None:
+        from .mywire import MyError
+
+        try:
+            self._client.query(sql)
+        except MyError as exc:
+            # 1050 = table already exists (racing CREATE) — benign.
+            if exc.code != 1050:
+                raise
+
+    def _ident(self) -> str:
+        from .mywire import escape_ident
+
+        return escape_ident(self.table)
+
+    def _lit(self, s: str) -> str:
+        from .mywire import escape_literal
+
+        # Escaping mode follows the server's reported status flags
+        # (NO_BACKSLASH_ESCAPES sessions reject backslash sequences).
+        return escape_literal(s, self._client.no_backslash_escapes)
+
+    def _create_table_sql(self) -> str:
+        # ref mysql.go:77-83 (generated key_hash column keeps the
+        # primary key under the 3072-byte index limit).
+        return (
+            f"CREATE TABLE IF NOT EXISTS {self._ident()} ("
+            "key_name VARCHAR(3072) NOT NULL, "
+            "key_hash CHAR(64) GENERATED ALWAYS AS "
+            "(SHA2(key_name, 256)) STORED NOT NULL PRIMARY KEY, "
+            "VALUE JSON) CHARACTER SET = utf8mb4 "
+            "COLLATE = utf8mb4_bin ROW_FORMAT = DYNAMIC"
+            if self.format == "namespace" else
+            f"CREATE TABLE IF NOT EXISTS {self._ident()} ("
+            "event_time DATETIME NOT NULL, event_data JSON) "
+            "ROW_FORMAT = DYNAMIC"
+        )
+
+    def _upsert_sql(self, key: str, data: str) -> str:
+        return (f"INSERT INTO {self._ident()} (key_name, VALUE) VALUES "
+                f"({self._lit(key)}, {self._lit(data)}) "
+                f"ON DUPLICATE KEY UPDATE VALUE=VALUES(VALUE)")
+
+    def _delete_sql(self, key: str) -> str:
+        return (f"DELETE FROM {self._ident()} "
+                f"WHERE key_hash = SHA2({self._lit(key)}, 256)")
+
+    def _insert_sql(self, ts: str, data: str) -> str:
+        # MySQL DATETIME takes 'YYYY-MM-DD hh:mm:ss'; the S3 event time
+        # is RFC3339 — normalize like the go driver does.
+        ts = ts.replace("T", " ").rstrip("Z").partition(".")[0]
+        return (f"INSERT INTO {self._ident()} (event_time, event_data) "
+                f"VALUES ({self._lit(ts)}, {self._lit(data)})")
+
+    def close(self):
+        self._client.close()
 
 
-class PostgresTarget(_DBTargetBase):
+class PostgresTarget(_SQLTargetBase):
     driver = "postgresql"
 
     def __init__(self, arn: str, conn_string: str, table: str,
-                 store: QueueStore | None = None):
-        super().__init__(arn, store)
+                 store: QueueStore | None = None, fmt: str = "namespace"):
+        super().__init__(arn, table, store, fmt)
+        from .pgwire import PgClient, parse_conn_string
+
+        if not conn_string.strip():
+            raise ValueError(
+                f"{arn}: notify_postgres connection_string is required"
+            )
         self.conn_string = conn_string
-        self.table = table
+        cfg = parse_conn_string(conn_string)
+        self._client = PgClient(cfg["host"], cfg["port"], cfg["user"],
+                                cfg["password"], cfg["dbname"])
+
+    def _ping(self) -> bool:
+        return self._client.ping()
+
+    def _exec(self, sql: str) -> None:
+        self._client.query(sql)
+
+    def _ident(self) -> str:
+        from .pgwire import escape_ident
+
+        return escape_ident(self.table)
+
+    def _lit(self, s: str) -> str:
+        from .pgwire import escape_literal
+
+        return escape_literal(s)
+
+    def _create_table_sql(self) -> str:
+        # ref postgresql.go:77-78.
+        return (
+            f"CREATE TABLE IF NOT EXISTS {self._ident()} "
+            "(KEY VARCHAR PRIMARY KEY, VALUE JSONB)"
+            if self.format == "namespace" else
+            f"CREATE TABLE IF NOT EXISTS {self._ident()} "
+            "(event_time TIMESTAMP WITH TIME ZONE NOT NULL, "
+            "event_data JSONB)"
+        )
+
+    def _upsert_sql(self, key: str, data: str) -> str:
+        return (f"INSERT INTO {self._ident()} (KEY, VALUE) VALUES "
+                f"({self._lit(key)}, {self._lit(data)}) "
+                f"ON CONFLICT (KEY) DO UPDATE SET VALUE = EXCLUDED.value")
+
+    def _delete_sql(self, key: str) -> str:
+        return f"DELETE FROM {self._ident()} WHERE KEY = {self._lit(key)}"
+
+    def _insert_sql(self, ts: str, data: str) -> str:
+        return (f"INSERT INTO {self._ident()} (event_time, event_data) "
+                f"VALUES ({self._lit(ts)}, {self._lit(data)})")
+
+    def close(self):
+        self._client.close()
 
 
 class RedisTarget(Target):
@@ -275,26 +451,28 @@ def targets_from_config(config, region: str = "us-east-1",
             tid = "" if target_id == "_" else target_id
             arn = f"arn:minio:sqs:{region}:{tid or '1'}:{kind}"
             store = store_for(kind, tid, kvs.get("queue_dir", ""))
-            if cls is MySQLTarget:
-                out[arn] = cls(arn, kvs.get("dsn_string", ""),
-                               kvs.get("table", ""), store)
-            elif cls is PostgresTarget:
-                out[arn] = cls(arn, kvs.get("connection_string", ""),
-                               kvs.get("table", ""), store)
-            else:
-                try:
+            try:
+                if cls is MySQLTarget:
+                    out[arn] = cls(arn, kvs.get("dsn_string", ""),
+                                   kvs.get("table", ""), store,
+                                   fmt=kvs.get("format", "namespace"))
+                elif cls is PostgresTarget:
+                    out[arn] = cls(arn, kvs.get("connection_string", ""),
+                                   kvs.get("table", ""), store,
+                                   fmt=kvs.get("format", "namespace"))
+                else:
                     out[arn] = cls(arn, kvs.get("address", ""),
                                    kvs.get("key", ""),
                                    kvs.get("format", "namespace"), store,
                                    password=kvs.get("password", ""))
-                except ValueError as exc:
-                    # A persisted-but-invalid target config (the admin
-                    # API accepted it before validation) must not
-                    # crash-loop the whole server at boot: skip the
-                    # target loudly.
-                    import sys
+            except ValueError as exc:
+                # A persisted-but-invalid target config (the admin
+                # API accepted it before validation) must not
+                # crash-loop the whole server at boot: skip the
+                # target loudly.
+                import sys
 
-                    sys.stderr.write(
-                        f"minio-tpu: skipping invalid target {arn}: {exc}\n"
-                    )
+                sys.stderr.write(
+                    f"minio-tpu: skipping invalid target {arn}: {exc}\n"
+                )
     return out

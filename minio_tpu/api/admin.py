@@ -38,6 +38,9 @@ class AdminHandlers:
         self.tiers = tiers
         self.logger = logger
         self.kms = kms
+        from ..background.healseq import AllHealState
+
+        self.heal_state = AllHealState()
         self.started = time.time()
 
     # --- routing ---
@@ -404,7 +407,23 @@ class AdminHandlers:
     # --- heal / locks / trace / service ---
 
     def heal(self, ctx) -> Response:
-        # POST /minio/admin/v3/heal/<bucket>/<prefix>
+        """POST /minio/admin/v3/heal/<bucket>/<prefix> — background heal
+        sequences (ref cmd/admin-heal-ops.go LaunchNewHealSequence):
+
+        - no clientToken: start a sequence, return its token at once;
+        - clientToken=<t>: poll status, consuming buffered items;
+        - forceStop=true: stop every sequence under the path;
+        - forceStart=true: replace a running sequence on the same path.
+
+        The background walk yields to foreground S3 traffic (config
+        heal.max_io in-flight gate) and rate-limits per object (config
+        heal.max_sleep), ref cmd/background-heal-ops.go:57-93."""
+        from ..background.healseq import (
+            HealAlreadyRunning,
+            HealNoSuchSequence,
+            HealOverlap,
+        )
+
         rest = ctx.path[len(ADMIN_PREFIX) + len("/heal"):].strip("/")
         bucket, _, prefix = rest.partition("/")
         if not bucket:
@@ -413,26 +432,77 @@ class AdminHandlers:
                 self.ol, "heal_format"
             ) else {}
             return self._json({"healSequence": "format", "result": result})
-        healed = []
-        failed = []
-        marker = ""
-        while True:
-            res = self.ol.list_objects(
-                bucket, prefix=prefix, marker=marker, max_keys=1000
+        if ctx.qdict.get("forceStop", "") == "true":
+            stopped = self.heal_state.stop(bucket, prefix)
+            return self._json({"stopped": stopped})
+        token = ctx.qdict.get("clientToken", "")
+        if token:
+            try:
+                return self._json(
+                    self.heal_state.status(bucket, prefix, token)
+                )
+            except HealNoSuchSequence:
+                raise S3Error(
+                    "InvalidArgument",
+                    f"no heal sequence for {bucket}/{prefix} "
+                    f"with that token",
+                ) from None
+        # Validate the bucket BEFORE launching: a typo must be a 404 on
+        # the POST, not a background sequence that dies unobserved.
+        try:
+            self.ol.get_bucket_info(bucket)
+        except Exception as exc:  # noqa: BLE001 — mapped to S3 error
+            raise S3Error("NoSuchBucket", f"{bucket}: {exc}") from exc
+        try:
+            seq = self.heal_state.launch(
+                self.ol, bucket, prefix,
+                force_start=ctx.qdict.get("forceStart", "") == "true",
+                client_address=getattr(ctx, "remote_addr", ""),
+                remove_dangling=ctx.qdict.get("remove", "") == "true",
+                dry_run=ctx.qdict.get("dryRun", "") == "true",
+                io_gate=self._heal_io_gate(),
+                max_sleep_s=self._heal_max_sleep_s(),
             )
-            for oi in res.objects:
-                try:
-                    self.ol.heal_object(bucket, oi.name)
-                    healed.append(oi.name)
-                except Exception as exc:  # noqa: BLE001 per-object status
-                    failed.append({"object": oi.name, "error": str(exc)})
-            if not res.is_truncated:
-                break
-            marker = res.next_marker
+        except (HealAlreadyRunning, HealOverlap) as exc:
+            raise S3Error("InvalidArgument", str(exc)) from exc
         return self._json({
-            "healSequence": f"{bucket}/{prefix}",
-            "healed": healed, "failed": failed,
+            "clientToken": seq.token,
+            "clientAddress": seq.client_address,
+            "startTime": seq.start_time,
         })
+
+    def _heal_config(self) -> dict:
+        if self.config_sys is None:
+            return {}
+        try:
+            return dict(self.config_sys.config.get("heal"))
+        except ValueError:
+            return {}
+
+    def _heal_io_gate(self):
+        from ..background.healseq import make_io_gate
+
+        kvs = self._heal_config()
+        try:
+            max_io = int(kvs.get("max_io", "10") or "10")
+        except ValueError:
+            max_io = 10
+        if self.metrics is None:
+            return None
+        return make_io_gate(
+            lambda: self.metrics.gauge("s3_requests_inflight"), max_io
+        )
+
+    def _heal_max_sleep_s(self) -> float:
+        from ..utils import parse_duration_s
+
+        kvs = self._heal_config()
+        # max_sleep bounds the per-object pause; the sequence uses a
+        # small fraction so "1s" doesn't turn a 1k-object bucket into a
+        # 1000 s heal (the reference's dynamic sleeper also scales down
+        # under idle).
+        secs = parse_duration_s(kvs.get("max_sleep", "1s"), default=1.0)
+        return secs / 100
 
     def top_locks(self, ctx) -> Response:
         if self.notification is not None:

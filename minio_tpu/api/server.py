@@ -329,19 +329,7 @@ def _check_rejected_apis(method: str, q: dict, is_object: bool):
             raise S3Error("NotImplemented", f"{method} ?{sub}")
 
 
-def _parse_duration_s(text: str) -> float | None:
-    """'10s' / '1m' / '1h' / bare seconds -> seconds; None if bad."""
-    t = (text or "").strip().lower()
-    mult = 1.0
-    for suffix, m in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
-        if t.endswith(suffix):
-            t = t[: -len(suffix)]
-            mult = m
-            break
-    try:
-        return float(t) * mult
-    except ValueError:
-        return None
+from ..utils import parse_duration_s as _parse_duration_s
 
 
 # S3 header-size contract (ref cmd/generic-handlers.go:55-93

@@ -3,7 +3,8 @@ admin heal sequences, erasure-set sweeps, stale upload cleanup
 (reference: cmd/data-scanner.go, cmd/background-heal-ops.go,
 cmd/global-heal.go, cmd/admin-heal-ops.go)."""
 
-from .heal import HealSequence, HealState, MRFHealer, heal_erasure_set
+from .heal import MRFHealer, heal_erasure_set
+from .healseq import AllHealState, HealSequence
 from .monitor import DiskMonitor
 from .newdisk import FreshDiskHealer, HealingTracker
 from .tracker import DataUpdateTracker
@@ -18,5 +19,5 @@ __all__ = [
     "DataScanner", "DataUsageInfo", "DynamicSleeper", "parse_lifecycle",
     "DataUpdateTracker", "DiskMonitor",
     "FreshDiskHealer", "HealingTracker",
-    "HealSequence", "HealState", "MRFHealer", "heal_erasure_set",
+    "AllHealState", "HealSequence", "MRFHealer", "heal_erasure_set",
 ]

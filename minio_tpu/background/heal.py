@@ -1,17 +1,13 @@
-"""Background heal services: the MRF (most-recently-failed) drain loop,
-the fresh-disk / erasure-set sweep, and admin-driven heal sequences with
-status polling — behavioral parity with the reference's
-cmd/background-heal-ops.go (IO-idle gated queue), cmd/global-heal.go
-(healErasureSet), cmd/erasure-sets.go mrfOperations, and
-cmd/admin-heal-ops.go (healSequence registry).
+"""Background heal services: the MRF (most-recently-failed) drain loop
+and the fresh-disk / erasure-set sweep — behavioral parity with the
+reference's cmd/erasure-sets.go mrfOperations and cmd/global-heal.go
+(healErasureSet). Admin-driven heal sequences (token start/poll/stop,
+IO gating, rate limits — cmd/admin-heal-ops.go) live in healseq.py.
 """
 
 from __future__ import annotations
 
 import threading
-import time
-import uuid
-from dataclasses import dataclass, field
 
 
 class MRFHealer:
@@ -57,112 +53,6 @@ class MRFHealer:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-
-
-@dataclass
-class HealSequence:
-    """One admin heal run with live status (ref cmd/admin-heal-ops.go:394
-    healSequence). Runs in a thread; clients poll status()."""
-
-    bucket: str
-    prefix: str = ""
-    remove_dangling: bool = False
-    client_token: str = field(default_factory=lambda: uuid.uuid4().hex)
-    started_ns: int = field(default_factory=time.time_ns)
-    ended_ns: int = 0
-    scanned: int = 0
-    healed: int = 0
-    failed: list = field(default_factory=list)
-    state: str = "running"  # running | stopped | finished | errored
-
-    def status(self) -> dict:
-        return {
-            "clientToken": self.client_token,
-            "bucket": self.bucket,
-            "prefix": self.prefix,
-            "state": self.state,
-            "scanned": self.scanned,
-            "healed": self.healed,
-            "failed": self.failed,
-            "startedNs": self.started_ns,
-            "endedNs": self.ended_ns,
-        }
-
-
-class HealState:
-    """Registry of running/finished heal sequences
-    (ref cmd/admin-heal-ops.go:88 allHealState)."""
-
-    def __init__(self, object_layer):
-        self.ol = object_layer
-        self._mu = threading.Lock()
-        self._sequences: dict[str, HealSequence] = {}
-
-    def launch(self, bucket: str, prefix: str = "",
-               remove_dangling: bool = False) -> HealSequence:
-        seq = HealSequence(bucket, prefix, remove_dangling)
-        path = f"{bucket}/{prefix}"
-        with self._mu:
-            cur = self._sequences.get(path)
-            if cur is not None and cur.state == "running":
-                return cur  # one sequence per path (ref :278)
-            self._sequences[path] = seq
-
-        def run():
-            try:
-                self._run(seq)
-                seq.state = "finished"
-            except Exception as exc:  # noqa: BLE001 - recorded in status
-                seq.state = "errored"
-                seq.failed.append({"error": str(exc)})
-            seq.ended_ns = time.time_ns()
-
-        threading.Thread(target=run, daemon=True).start()
-        return seq
-
-    def _run(self, seq: HealSequence):
-        if hasattr(self.ol, "heal_bucket"):
-            try:
-                self.ol.heal_bucket(seq.bucket)
-            except Exception as exc:  # noqa: BLE001
-                seq.failed.append({"bucket": seq.bucket, "error": str(exc)})
-        marker = ""
-        while seq.state == "running":
-            res = self.ol.list_objects(
-                seq.bucket, prefix=seq.prefix, marker=marker, max_keys=1000
-            )
-            for oi in res.objects:
-                if seq.state != "running":
-                    break
-                seq.scanned += 1
-                try:
-                    self.ol.heal_object(
-                        seq.bucket, oi.name,
-                        remove_dangling=seq.remove_dangling,
-                    )
-                    seq.healed += 1
-                except Exception as exc:  # noqa: BLE001 per-object
-                    seq.failed.append(
-                        {"object": oi.name, "error": str(exc)}
-                    )
-            if not res.is_truncated:
-                break
-            marker = res.next_marker
-
-    def get(self, bucket: str, prefix: str = "") -> HealSequence | None:
-        with self._mu:
-            return self._sequences.get(f"{bucket}/{prefix}")
-
-    def stop_sequence(self, bucket: str, prefix: str = "") -> bool:
-        seq = self.get(bucket, prefix)
-        if seq is not None and seq.state == "running":
-            seq.state = "stopped"
-            return True
-        return False
-
-    def all_status(self) -> list[dict]:
-        with self._mu:
-            return [s.status() for s in self._sequences.values()]
 
 
 def heal_erasure_set(object_layer, buckets: list[str] | None = None) -> dict:

@@ -263,24 +263,32 @@ class MyClient:
     def query(self, sql: str):
         """COM_QUERY for statements that return OK (INSERT/DELETE/DDL —
         the whole target surface). Retry discipline matches RespClient:
-        one fresh-connection retry when a POOLED socket is dead at send
-        time (safe: the target's statements are idempotent upserts/
-        deletes/creates), never after the server may have executed."""
+        one fresh-connection retry when a POOLED socket is dead at SEND
+        time; a failure while READING the reply never retries — the
+        server may have executed the statement, and re-sending would
+        duplicate non-idempotent access-format INSERTs (the event
+        requeues instead)."""
         with self._mu:
             for attempt in (0, 1):
                 fresh = self._sock is None
                 if fresh:
                     self._connect()
                 try:
-                    self._roundtrip(b"\x03" + sql.encode())
+                    self._seq = 0
+                    self._send_packet(b"\x03" + sql.encode())
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    if fresh or attempt:
+                        raise
+                    continue  # stale pooled socket: one fresh retry
+                try:
+                    self._check_ok(self._read_packet())
                     return
                 except MyError:
                     raise
                 except (OSError, ConnectionError):
                     self._teardown()
-                    if fresh or attempt:
-                        raise
-                    continue
+                    raise
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def ping(self) -> bool:

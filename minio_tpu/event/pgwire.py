@@ -221,8 +221,7 @@ class PgClient:
 
     # --- simple query ---
 
-    def _roundtrip_query(self, sql: str) -> list[str]:
-        self._send_msg(b"Q", sql.encode() + b"\x00")
+    def _read_query_result(self) -> list[str]:
         tags: list[str] = []
         err: PgError | None = None
         while True:
@@ -241,27 +240,29 @@ class PgClient:
     def query(self, sql: str) -> list[str]:
         """Run one simple query; returns CommandComplete tags. Same
         retry discipline as RespClient.command: a dead pooled socket
-        detected at SEND time retries once on a fresh connection;
-        failures after the query may have executed never retry."""
+        detected at SEND time retries once on a fresh connection; a
+        failure while READING the result never retries — the server may
+        have executed the statement, and re-sending would duplicate
+        non-idempotent access-format INSERTs (events requeue instead)."""
         with self._mu:
             for attempt in (0, 1):
                 fresh = self._sock is None
                 if fresh:
                     self._connect()
                 try:
-                    return self._roundtrip_query(sql)
-                except PgError:
-                    raise
+                    self._send_msg(b"Q", sql.encode() + b"\x00")
                 except (OSError, ConnectionError):
                     self._teardown()
                     if fresh or attempt:
                         raise
-                    # Stale pooled socket: whether the query reached the
-                    # server is unknowable, but the target's statements
-                    # are idempotent (UPSERT / DELETE / CREATE IF NOT
-                    # EXISTS / append-only INSERT of the same event), so
-                    # one retry on a fresh connection is safe.
-                    continue
+                    continue  # stale pooled socket: one fresh retry
+                try:
+                    return self._read_query_result()
+                except PgError:
+                    raise
+                except (OSError, ConnectionError):
+                    self._teardown()
+                    raise
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def ping(self) -> bool:
@@ -293,11 +294,46 @@ def parse_conn_string(conn: str) -> dict:
         if u.path.lstrip("/"):
             out["dbname"] = u.path.lstrip("/")
         return out
-    for part in conn.split():
-        k, _, v = part.partition("=")
-        v = v.strip("'")
+    for k, v in _dsn_pairs(conn):
         if k == "port":
             out["port"] = int(v)
         elif k in out:
             out[k] = v
     return out
+
+
+def _dsn_pairs(conn: str):
+    """Tokenize libpq key=value DSN syntax: values may be single-quoted
+    and contain spaces; '' inside quotes is an escaped quote
+    (libpq conninfo_parse)."""
+    i, n = 0, len(conn)
+    while i < n:
+        while i < n and conn[i].isspace():
+            i += 1
+        if i >= n:
+            return
+        eq = conn.find("=", i)
+        if eq < 0:
+            return
+        key = conn[i:eq].strip()
+        i = eq + 1
+        if i < n and conn[i] == "'":
+            i += 1
+            val = []
+            while i < n:
+                if conn[i] == "'":
+                    if i + 1 < n and conn[i + 1] == "'":
+                        val.append("'")
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                val.append(conn[i])
+                i += 1
+            yield key, "".join(val)
+        else:
+            j = i
+            while j < n and not conn[j].isspace():
+                j += 1
+            yield key, conn[i:j]
+            i = j

@@ -191,18 +191,56 @@ class AdminClient:
     # --- heal (ref madmin/heal-commands.go) ---
 
     def heal(self, bucket: str = "", prefix: str = "",
-             recursive: bool = True, dry_run: bool = False) -> dict:
-        path = "/heal"
-        if bucket:
-            path += f"/{bucket}"
-            if prefix:
-                path += f"/{prefix}"
+             recursive: bool = True, dry_run: bool = False,
+             force_start: bool = False) -> dict:
+        """Start a background heal sequence; returns {clientToken, ...}
+        immediately (ref madmin Heal with clientToken='')."""
         q = []
         if recursive:
             q.append(("recursive", "true"))
         if dry_run:
             q.append(("dryRun", "true"))
-        return self._call("POST", path, q)
+        if force_start:
+            q.append(("forceStart", "true"))
+        return self._call("POST", self._heal_path(bucket, prefix), q)
+
+    def heal_status(self, bucket: str, prefix: str = "",
+                    client_token: str = "") -> dict:
+        """Poll a running sequence; consumes its buffered items."""
+        return self._call("POST", self._heal_path(bucket, prefix),
+                          [("clientToken", client_token)])
+
+    def heal_stop(self, bucket: str, prefix: str = "") -> dict:
+        return self._call("POST", self._heal_path(bucket, prefix),
+                          [("forceStop", "true")])
+
+    def heal_wait(self, bucket: str, prefix: str = "",
+                  client_token: str = "", timeout: float = 60.0,
+                  poll_s: float = 0.05) -> dict:
+        """Poll until the sequence ends; returns the final status with
+        all items accumulated (the `mc admin heal` follow loop)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        items: list = []
+        while True:
+            st = self.heal_status(bucket, prefix, client_token)
+            items.extend(st.get("Items", []))
+            if st.get("Summary") != "running":
+                st["Items"] = items
+                return st
+            if _time.time() > deadline:
+                raise TimeoutError(f"heal {bucket}/{prefix} still running")
+            _time.sleep(poll_s)
+
+    @staticmethod
+    def _heal_path(bucket: str, prefix: str) -> str:
+        path = "/heal"
+        if bucket:
+            path += f"/{bucket}"
+            if prefix:
+                path += f"/{prefix}"
+        return path
 
     # --- locks / trace / logs (ref madmin/top-commands.go) ---
 

@@ -51,6 +51,12 @@ class Metrics:
             key = _label_key(labels)
             series[key] = series.get(key, 0.0) + delta
 
+    def gauge(self, name: str, **labels) -> float:
+        """Current gauge value (0.0 when the series never fired) — the
+        read side the heal IO gate samples for in-flight requests."""
+        with self._mu:
+            return self._gauges.get(name, {}).get(_label_key(labels), 0.0)
+
     def observe(self, name: str, value: float, **labels):
         with self._mu:
             series = self._hists.setdefault(name, {})

@@ -11,7 +11,7 @@ import os
 import time
 
 from .api import S3Server
-from .background import DataScanner, DiskMonitor, HealState, MRFHealer
+from .background import DataScanner, DiskMonitor, MRFHealer
 from .bucket import BucketMetadataSys
 from .config import ConfigSys
 from .event import EventNotifier, targets_from_config
@@ -218,7 +218,6 @@ class Server:
         )
 
         # --- background services (ref initAutoHeal/initDataScanner) ---
-        self.heal_state = HealState(self.object_layer)
         self.mrf = MRFHealer(
             self.object_layer, metrics=self.metrics, logger=self.logger
         )
@@ -309,6 +308,9 @@ class Server:
             tier_engine=self.tier_engine, tiers=self.tiers,
             logger=self.logger,
         )
+        # One heal-sequence registry for the deployment — the admin API
+        # owns it (background/healseq.py AllHealState).
+        self.heal_state = self.s3.admin.heal_state
         # Scrape-time gauge collector over every live subsystem (the
         # reference computes most v2 metrics in the handler from global
         # state; ref cmd/metrics-v2.go).

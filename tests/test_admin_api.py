@@ -5,6 +5,7 @@ bus."""
 
 import http.client
 import json
+import time
 import urllib.parse
 
 import pytest
@@ -230,10 +231,36 @@ def test_data_usage_and_heal(stack):
     status, body = req(srv, "GET", "/minio/admin/v3/datausage")
     usage = json.loads(body)
     assert usage["bucketsUsage"]["healbkt"]["objectsCount"] == 2
+    # Background sequence: start returns a token immediately, polls
+    # consume per-object items until the walk finishes
+    # (ref cmd/admin-heal-ops.go LaunchNewHealSequence).
     status, body = req(srv, "POST", "/minio/admin/v3/heal/healbkt")
     assert status == 200
-    healed = json.loads(body)["healed"]
-    assert set(healed) >= {"a.bin", "b.bin"}
+    token = json.loads(body)["clientToken"]
+    assert token
+    deadline = time.time() + 30
+    items = []
+    while True:
+        status, body = req(
+            srv, "POST", "/minio/admin/v3/heal/healbkt",
+            query=[("clientToken", token)],
+        )
+        assert status == 200
+        st = json.loads(body)
+        items.extend(st["Items"])
+        if st["Summary"] != "running":
+            break
+        assert time.time() < deadline, "heal sequence never finished"
+        time.sleep(0.05)
+    assert st["Summary"] == "finished"
+    assert st["NumHealed"] == 2 and st["NumFailed"] == 0
+    assert {i["object"] for i in items} == {"a.bin", "b.bin"}
+    # Items were consumed by the polls: a fresh poll returns none.
+    status, body = req(
+        srv, "POST", "/minio/admin/v3/heal/healbkt",
+        query=[("clientToken", token)],
+    )
+    assert json.loads(body)["Items"] == []
 
 
 def test_service_action(stack):

@@ -12,7 +12,7 @@ import pytest
 
 from minio_tpu.background import (
     DataScanner,
-    HealState,
+    AllHealState,
     MRFHealer,
     heal_erasure_set,
     parse_lifecycle,
@@ -246,17 +246,16 @@ def test_heal_sequence_status(tmp_path):
     ol.make_bucket("hsbkt")
     for i in range(3):
         ol.put_object("hsbkt", f"h{i}.bin", io.BytesIO(b"z" * 100), 100)
-    hs = HealState(ol)
-    seq = hs.launch("hsbkt")
-    deadline = time.time() + 10
-    while seq.state == "running" and time.time() < deadline:
-        time.sleep(0.05)
-    st = seq.status()
-    assert st["state"] == "finished"
-    assert st["scanned"] == 3 and st["healed"] == 3
+    hs = AllHealState()
+    seq = hs.launch(ol, "hsbkt")
+    seq.join(10)
+    st = hs.status("hsbkt", "", seq.token)
+    assert st["Summary"] == "finished"
+    assert st["NumScanned"] == 3 and st["NumHealed"] == 3
     # relaunching a finished sequence starts a new one
-    seq2 = hs.launch("hsbkt")
-    assert seq2.client_token != "" and hs.all_status()
+    seq2 = hs.launch(ol, "hsbkt")
+    seq2.join(10)
+    assert seq2.token and seq2.token != seq.token
 
 
 def test_heal_erasure_set_sweep(tmp_path):

@@ -1,0 +1,250 @@
+"""Background admin heal sequences (ref cmd/admin-heal-ops.go:278-474,
+cmd/background-heal-ops.go:57-93): token start/poll/stop lifecycle,
+overlap rejection, the foreground-IO gate, and the headline scenario —
+a 1k-object heal running while concurrent GETs stay fast."""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from minio_tpu.background.healseq import (
+    AllHealState,
+    HealAlreadyRunning,
+    HealOverlap,
+    HealNoSuchSequence,
+    make_io_gate,
+)
+from minio_tpu.utils import parse_duration_s
+
+
+class _FakeOL:
+    """Object layer stub: N objects, records heal order, optional
+    per-object failures and latency."""
+
+    def __init__(self, n=10, fail=(), delay=0.0):
+        self.names = [f"obj-{i:04d}" for i in range(n)]
+        self.fail = set(fail)
+        self.delay = delay
+        self.healed: list[str] = []
+
+    def list_objects(self, bucket, prefix="", marker="", max_keys=1000):
+        names = [n for n in self.names if n.startswith(prefix) and n > marker]
+        page = names[:max_keys]
+
+        class R:
+            objects = [type("O", (), {"name": n})() for n in page]
+            is_truncated = len(names) > max_keys
+            next_marker = page[-1] if page else ""
+
+        return R()
+
+    def heal_object(self, bucket, name, version_id="",
+                    remove_dangling=False):
+        if self.delay:
+            time.sleep(self.delay)
+        if name in self.fail:
+            raise RuntimeError(f"cannot heal {name}")
+        self.healed.append(name)
+
+
+def test_parse_duration():
+    assert parse_duration_s("1s") == 1.0
+    assert parse_duration_s("100ms") == 0.1
+    assert parse_duration_s("2m") == 120.0
+    assert parse_duration_s("0.5") == 0.5
+    assert parse_duration_s("junk", default=3.0) == 3.0
+
+
+def test_sequence_lifecycle_and_item_consumption():
+    ol = _FakeOL(n=25, fail={"obj-0003"})
+    state = AllHealState()
+    seq = state.launch(ol, "bkt")
+    assert seq.token
+    seq.join(10)
+    st = state.status("bkt", "", seq.token)
+    assert st["Summary"] == "finished"
+    assert st["NumScanned"] == 25
+    assert st["NumHealed"] == 24
+    assert st["NumFailed"] == 1
+    failed = [i for i in st["Items"] if i["detail"] == "failed"]
+    assert [i["object"] for i in failed] == ["obj-0003"]
+    # Items are consumed by the poll (ref PopHealStatusJSON).
+    assert state.status("bkt", "", seq.token)["Items"] == []
+    with pytest.raises(HealNoSuchSequence):
+        state.status("bkt", "", "bogus-token")
+
+
+def test_overlap_and_force_start():
+    ol = _FakeOL(n=500, delay=0.005)  # slow walk keeps it running
+    state = AllHealState()
+    seq = state.launch(ol, "bkt")
+    try:
+        with pytest.raises(HealAlreadyRunning):
+            state.launch(ol, "bkt")
+        # A sequence under a running one's path (either direction)
+        # overlaps (ref LaunchNewHealSequence overlap check).
+        with pytest.raises(HealOverlap):
+            state.launch(ol, "bkt", "obj-00")
+        seq2 = state.launch(ol, "bkt", force_start=True)
+        seq.join(5)
+        assert seq.status == "stopped"
+        # forceStart also supersedes OVERLAPPING sequences, both
+        # directions (ref LaunchNewHealSequence + stopHealSequence).
+        seq3 = state.launch(ol, "bkt", "obj-00", force_start=True)
+        seq2.join(5)
+        assert seq2.status == "stopped"
+        seq3.stop()
+        seq3.join(5)
+    finally:
+        state.stop("bkt")
+
+
+def test_force_stop():
+    ol = _FakeOL(n=2000, delay=0.002)
+    state = AllHealState()
+    seq = state.launch(ol, "bkt")
+    time.sleep(0.05)
+    stopped = state.stop("bkt")
+    assert stopped == ["bkt"]
+    seq.join(5)
+    assert seq.status == "stopped"
+    st = state.status("bkt", "", seq.token)
+    assert st["Summary"] == "stopped"
+    assert 0 < st["NumScanned"] < 2000
+
+
+def test_dry_run_touches_nothing():
+    ol = _FakeOL(n=10)
+    seq = AllHealState().launch(ol, "bkt", dry_run=True)
+    seq.join(5)
+    assert ol.healed == []
+    assert seq.scanned == 10
+
+
+def test_io_gate_yields_to_foreground():
+    """With requests in flight the gate wait-loops; it releases as soon
+    as traffic drains, and gives up after max_wait."""
+    inflight = [5]
+    gate = make_io_gate(lambda: inflight[0], max_io=2, max_wait_s=5.0,
+                        tick_s=0.01)
+    stop = threading.Event()
+    t0 = time.monotonic()
+    threading.Timer(0.15, lambda: inflight.__setitem__(0, 0)).start()
+    gate(stop)
+    waited = time.monotonic() - t0
+    assert 0.1 < waited < 2.0  # waited for the drain, not max_wait
+    # Bounded: permanently-busy server does not wedge the heal.
+    inflight[0] = 99
+    t0 = time.monotonic()
+    gate_short = make_io_gate(lambda: inflight[0], max_io=2,
+                              max_wait_s=0.1, tick_s=0.01)
+    gate_short(stop)
+    assert time.monotonic() - t0 < 1.0
+    # max_io<=0 disables the gate entirely (run at full speed).
+    assert make_io_gate(lambda: 0, max_io=0) is None
+
+
+def test_heal_rate_limit_spacing():
+    ol = _FakeOL(n=10)
+    state = AllHealState()
+    t0 = time.monotonic()
+    seq = state.launch(ol, "bkt", max_sleep_s=0.01)
+    seq.join(10)
+    assert time.monotonic() - t0 >= 0.09  # >= (n-1) * sleep
+
+
+# ---------------------------------------------------------------------------
+# headline: 1k-object heal under concurrent GET traffic over real HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from minio_tpu.api import S3Server
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.object.pools import ErasureServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.object.types import ObjectOptions
+    from minio_tpu.storage.local import LocalStorage
+
+    tmp = tmp_path_factory.mktemp("healseq")
+    disks = [LocalStorage(str(tmp / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="8c9f2d31-4f2e-4d69-92f5-926a51824ed0",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    iam = IAMSys("tpuadmin", "tpuadmin-secret-key")
+    srv = S3Server(ol, iam, BucketMetadataSys(ol)).start()
+    ol.make_bucket("big")
+    for i in range(1000):
+        ol.put_object("big", f"o{i:04d}", io.BytesIO(b"x" * 256), 256,
+                      ObjectOptions())
+    yield srv, ol
+    srv.stop()
+
+
+def _admin(srv, method, path, query=()):
+    import http.client
+
+    from minio_tpu.api.sign import sign_v4_request
+
+    conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+    q = list(query)
+    hdrs = sign_v4_request("tpuadmin-secret-key", "tpuadmin", method,
+                           srv.endpoint, path, q, {}, b"")
+    full = path + (("?" + "&".join(f"{k}={v}" for k, v in q)) if q else "")
+    conn.request(method, full, body=b"", headers=hdrs)
+    r = conn.getresponse()
+    data = r.read()
+    conn.close()
+    return r.status, data
+
+
+def test_heal_nonexistent_bucket_404(stack):
+    srv, _ = stack
+    status, body = _admin(srv, "POST", "/minio/admin/v3/heal/no-such-bkt")
+    assert status == 404 and b"NoSuchBucket" in body
+
+
+def test_thousand_object_heal_with_concurrent_gets(stack):
+    srv, ol = stack
+    status, body = _admin(srv, "POST", "/minio/admin/v3/heal/big")
+    assert status == 200, body
+    token = json.loads(body)["clientToken"]
+
+    # Foreground GETs while the sequence walks: each must stay fast
+    # (the heal yields via the in-flight gate + rate sleeper).
+    lat = []
+    for i in range(40):
+        t0 = time.monotonic()
+        st, data = _admin(srv, "GET", f"/big/o{i:04d}")
+        lat.append(time.monotonic() - t0)
+        assert st == 200 and data == b"x" * 256
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    assert p50 < 0.25, f"GET p50 {p50 * 1e3:.1f} ms under background heal"
+
+    deadline = time.time() + 120
+    items = []
+    while True:
+        st, body = _admin(
+            srv, "POST", "/minio/admin/v3/heal/big",
+            query=[("clientToken", token)],
+        )
+        assert st == 200
+        s = json.loads(body)
+        items.extend(s["Items"])
+        if s["Summary"] != "running":
+            break
+        assert time.time() < deadline, "1k heal never finished"
+        time.sleep(0.1)
+    assert s["Summary"] == "finished"
+    assert s["NumScanned"] == 1000 and s["NumFailed"] == 0

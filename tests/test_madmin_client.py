@@ -86,7 +86,9 @@ def test_heal_and_quota(adm, stack):
 
     ol.put_object("madmbkt", "obj1", io.BytesIO(b"z" * 2048), 2048)
     res = adm.heal("madmbkt")
-    assert "healed" in res
+    final = adm.heal_wait("madmbkt", client_token=res["clientToken"])
+    assert final["Summary"] == "finished"
+    assert {i["object"] for i in final["Items"]} == {"obj1"}
     adm.set_bucket_quota("madmbkt", 1 << 30)
     q = adm.get_bucket_quota("madmbkt")
     assert q.get("quota") == 1 << 30

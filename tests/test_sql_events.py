@@ -490,6 +490,9 @@ def test_parse_conn_string():
     got = parse_conn_string("postgres://u:p%40ss@db:5433/events")
     assert got["password"] == "p@ss" and got["port"] == 5433
     assert parse_conn_string("")["port"] == 5432
+    # libpq quoting: values with spaces and '' escapes survive.
+    got = parse_conn_string("host=db user=u password='p ss''x' dbname=d")
+    assert got["password"] == "p ss'x" and got["host"] == "db"
 
 
 # ---------------------------------------------------------------------------

@@ -870,8 +870,26 @@ class S3ApiHandlers:
         return self._xml_subresource(ctx, "tagging_xml", "NoSuchTagSet")
 
     def bucket_lifecycle(self, ctx) -> Response:
+        def validate():
+            # Full rule validation at write time (ref lifecycle.go
+            # ParseLifecycleConfig + Validate) — an invalid document
+            # must 400 here, never silently no-op in the scanner.
+            # Unparseable XML is MalformedXML (the AWS code for it);
+            # well-formed-but-invalid rules are InvalidArgument.
+            from ..bucket.lifecycle import Lifecycle, LifecycleError
+
+            try:
+                lc = Lifecycle.parse(ctx.body.decode())
+            except LifecycleError as exc:
+                raise S3Error("MalformedXML", str(exc)) from exc
+            try:
+                lc.validate()
+            except LifecycleError as exc:
+                raise S3Error("InvalidArgument", str(exc)) from exc
+
         return self._xml_subresource(
-            ctx, "lifecycle_xml", "NoSuchLifecycleConfiguration"
+            ctx, "lifecycle_xml", "NoSuchLifecycleConfiguration",
+            pre_put=validate,
         )
 
     def bucket_encryption(self, ctx) -> Response:

@@ -80,49 +80,21 @@ class DynamicSleeper:
         return done
 
 
-def parse_lifecycle(xml_text: str) -> list[dict]:
-    """Parse ILM rules: Expiration Days and Transition Days/StorageClass
-    on an optional prefix filter (subset of pkg/bucket/lifecycle)."""
-    if not xml_text:
-        return []
+def parse_lifecycle(xml_text: str):
+    """Parse ILM rules into the full engine (bucket/lifecycle.py —
+    Days/Date, Prefix/Tag/And filters, ExpiredObjectDeleteMarker,
+    NewerNoncurrentVersions). Unparseable stored XML yields an empty
+    rule set: the scanner must keep cycling, and the write path already
+    validates (api PutBucketLifecycle)."""
+    from ..bucket.lifecycle import Lifecycle, LifecycleError
+
     try:
-        root = ET.fromstring(xml_text)
-    except ET.ParseError:
-        return []
-    ns = ""
-    if root.tag.startswith("{"):
-        ns = root.tag[: root.tag.index("}") + 1]
-    rules = []
-    for rule in root.iter(f"{ns}Rule"):
-        status = rule.findtext(f"{ns}Status", "")
-        if status != "Enabled":
-            continue
-        prefix = (
-            rule.findtext(f"{ns}Filter/{ns}Prefix")
-            or rule.findtext(f"{ns}Prefix") or ""
-        )
-        exp_days = rule.findtext(f"{ns}Expiration/{ns}Days")
-        trans_days = rule.findtext(f"{ns}Transition/{ns}Days")
-        trans_sc = rule.findtext(f"{ns}Transition/{ns}StorageClass") or ""
-        noncur = rule.findtext(
-            f"{ns}NoncurrentVersionExpiration/{ns}NoncurrentDays"
-        )
-        del_marker = (rule.findtext(
-            f"{ns}Expiration/{ns}ExpiredObjectDeleteMarker"
-        ) or "").strip().lower() == "true"
-        abort_days = rule.findtext(
-            f"{ns}AbortIncompleteMultipartUpload/{ns}DaysAfterInitiation"
-        )
-        rules.append({
-            "prefix": prefix,
-            "expire_days": int(exp_days) if exp_days else None,
-            "transition_days": int(trans_days) if trans_days else None,
-            "transition_tier": trans_sc,
-            "noncurrent_days": int(noncur) if noncur else None,
-            "expired_delete_marker": del_marker,
-            "abort_mpu_days": int(abort_days) if abort_days else None,
-        })
-    return rules
+        # Best-effort: an older write path may have stored rules today's
+        # strict parser rejects — drop those individually, never the
+        # whole rule set (one bad rule must not stop valid retention).
+        return Lifecycle.parse(xml_text, best_effort=True)
+    except LifecycleError:
+        return Lifecycle([])
 
 
 class DataScanner:
@@ -224,9 +196,10 @@ class DataScanner:
                 if self.metrics is not None:
                     self.metrics.inc("scanner_buckets_skipped_total")
                 continue
-            rules = []
-            if self.bm is not None:
-                rules = parse_lifecycle(self.bm.get(b.name).lifecycle_xml)
+            rules = parse_lifecycle(
+                self.bm.get(b.name).lifecycle_xml
+                if self.bm is not None else ""
+            )
             bu = BucketUsage()
             marker = ""
             while True:
@@ -251,10 +224,9 @@ class DataScanner:
             # Version-level ILM (noncurrent expiry, orphan delete
             # markers) + rule-driven multipart abort run per bucket
             # only when a rule asks for them.
-            if any(r["noncurrent_days"] is not None
-                   or r["expired_delete_marker"] for r in rules):
+            if rules.any_noncurrent_or_marker_rules():
                 self._versions_sweep(b.name, rules, now_ns)
-            if any(r["abort_mpu_days"] is not None for r in rules):
+            if rules.any_abort_mpu_rules():
                 self._abort_stale_uploads(b.name, rules, now_ns)
             usage.buckets_usage[b.name] = bu
             usage.objects_total_count += bu.objects_count
@@ -273,35 +245,30 @@ class DataScanner:
             )
         return usage
 
-    def _apply_lifecycle(self, bucket: str, oi, rules: list[dict],
-                         now_ns: int) -> bool:
+    def _apply_lifecycle(self, bucket: str, oi, rules, now_ns: int) -> bool:
         from .. import tier as tiermod
 
-        age_days = (now_ns - oi.mod_time_ns) / 1e9 / 86400
-        for r in rules:
-            if r["prefix"] and not oi.name.startswith(r["prefix"]):
-                continue
-            if r["expire_days"] is not None and age_days >= r["expire_days"]:
-                try:
-                    self.ol.delete_object(bucket, oi.name)
-                    if self.metrics is not None:
-                        self.metrics.inc("ilm_expired_total")
-                    return True
-                except StorageError as exc:
-                    if self.logger is not None:
-                        self.logger.log_once_if(exc, f"ilm:{bucket}")
-            if (r.get("transition_days") is not None
-                    and r.get("transition_tier")
-                    and self.tier_engine is not None
-                    and age_days >= r["transition_days"]
-                    and not tiermod.is_transitioned(oi.user_defined)):
-                try:
-                    self.tier_engine.transition(
-                        bucket, oi.name, r["transition_tier"]
-                    )
-                except Exception as exc:  # noqa: BLE001 - retried next cycle
-                    if self.logger is not None:
-                        self.logger.log_once_if(exc, f"tier:{bucket}")
+        now_s = now_ns / 1e9
+        if rules.expire_current(oi.name, oi.user_defined,
+                                oi.mod_time_ns, now_s):
+            try:
+                self.ol.delete_object(bucket, oi.name)
+                if self.metrics is not None:
+                    self.metrics.inc("ilm_expired_total")
+                return True
+            except StorageError as exc:
+                if self.logger is not None:
+                    self.logger.log_once_if(exc, f"ilm:{bucket}")
+        tier_name = rules.transition_tier_due(
+            oi.name, oi.user_defined, oi.mod_time_ns, now_s
+        )
+        if (tier_name and self.tier_engine is not None
+                and not tiermod.is_transitioned(oi.user_defined)):
+            try:
+                self.tier_engine.transition(bucket, oi.name, tier_name)
+            except Exception as exc:  # noqa: BLE001 - retried next cycle
+                if self.logger is not None:
+                    self.logger.log_once_if(exc, f"tier:{bucket}")
         # Expired restored copies fall back to metadata-only.
         if (self.tier_engine is not None
                 and tiermod.is_transitioned(oi.user_defined)):
@@ -313,22 +280,23 @@ class DataScanner:
                     self.logger.log_once_if(exc, f"tier-expire:{bucket}")
         return False
 
-    def _versions_sweep(self, bucket: str, rules: list[dict],
-                        now_ns: int):
+    def _versions_sweep(self, bucket: str, rules, now_ns: int):
         """Version-level lifecycle (ref applyVersionActions,
         cmd/data-scanner.go): expire NONCURRENT versions past
-        NoncurrentDays, and remove a latest delete marker whose key has
-        no other versions (ExpiredObjectDeleteMarker).
+        NoncurrentDays (keeping the NewerNoncurrentVersions newest
+        ones), and remove a latest delete marker whose key has no other
+        versions (ExpiredObjectDeleteMarker).
 
         Correctness notes: noncurrent age is measured from when the
         version BECAME noncurrent — its successor's mod time — never
         its own write time (AWS semantics; anything else deletes
         retained versions early). A page may split one key's versions,
-        so the successor time carries across pages, and the orphan-
-        marker decision always re-verifies the key with a targeted
-        listing instead of trusting page-local grouping."""
+        so the successor time AND the noncurrent-rank both carry across
+        pages, and the orphan-marker decision always re-verifies the
+        key with a targeted listing instead of trusting page-local
+        grouping."""
         key_marker = vid_marker = ""
-        carry_key, carry_mtime = "", None
+        carry_key, carry_mtime, carry_rank = "", None, 0
         while True:
             res = self.ol.list_object_versions(
                 bucket, key_marker=key_marker,
@@ -342,24 +310,25 @@ class DataScanner:
             # listing, which would skip the rest of its key this cycle.
             survivor_key, survivor_vid = key_marker, vid_marker
             deleted_last = False
+            rank_by_key: dict[str, int] = {}
             for key, versions in by_key.items():
-                matched = [
-                    r for r in rules
-                    if not r["prefix"] or key.startswith(r["prefix"])
-                ]
-                if not matched:
+                noncur_limit, keep_newer = rules.noncurrent_policy(key)
+                wants_marker = rules.wants_delete_marker_cleanup(key)
+                if noncur_limit is None and not wants_marker:
                     continue
                 # Versions are newest-first within a key; the successor
                 # of versions[i] is versions[i-1] (or the carry from the
                 # previous page when the key was split).
                 prev_mtime = carry_mtime if key == carry_key else None
+                rank = carry_rank if key == carry_key else 0
                 for v in versions:
                     expired = False
                     if not v.is_latest and prev_mtime is not None:
+                        rank += 1  # 1 = newest noncurrent version
                         noncur_days = (now_ns - prev_mtime) / 1e9 / 86400
-                        if any(r["noncurrent_days"] is not None
-                               and noncur_days >= r["noncurrent_days"]
-                               for r in matched):
+                        if (noncur_limit is not None
+                                and noncur_days >= noncur_limit
+                                and rank > keep_newer):
                             self._delete_version(bucket, key, v.version_id)
                             expired = True
                     prev_mtime = v.mod_time_ns
@@ -369,10 +338,9 @@ class DataScanner:
                         survivor_key, survivor_vid = key, v.version_id
                         if v is res.versions[-1]:
                             deleted_last = False
+                rank_by_key[key] = rank
                 if (len(versions) == 1 and versions[0].is_latest
-                        and versions[0].delete_marker
-                        and any(r["expired_delete_marker"]
-                                for r in matched)):
+                        and versions[0].delete_marker and wants_marker):
                     # Page-local view says orphan; CONFIRM with a
                     # targeted listing before destroying the marker — a
                     # page boundary can hide the key's older versions.
@@ -389,6 +357,7 @@ class DataScanner:
             if res.versions:
                 last = res.versions[-1]
                 carry_key, carry_mtime = last.name, last.mod_time_ns
+                carry_rank = rank_by_key.get(last.name, 0)
             if not res.is_truncated:
                 return
             if deleted_last:
@@ -413,8 +382,7 @@ class DataScanner:
             if self.logger is not None:
                 self.logger.log_once_if(exc, f"ilm-version:{bucket}")
 
-    def _abort_stale_uploads(self, bucket: str, rules: list[dict],
-                             now_ns: int):
+    def _abort_stale_uploads(self, bucket: str, rules, now_ns: int):
         """AbortIncompleteMultipartUpload (ref lifecycle rule applied in
         cleanupStaleUploads with per-bucket expiry). Each upload is
         judged by the rules whose PREFIX matches it — a short-fuse rule
@@ -430,14 +398,10 @@ class DataScanner:
         for es, ((b, o, upload_id), started_ns) in self._cycle_uploads:
             if b != bucket:
                 continue
-            matched_days = [
-                r["abort_mpu_days"] for r in rules
-                if r["abort_mpu_days"] is not None
-                and (not r["prefix"] or o.startswith(r["prefix"]))
-            ]
-            if not matched_days:
+            days = rules.abort_mpu_after_days(o)
+            if days is None:
                 continue
-            cutoff_ns = min(matched_days) * 86400 * 10 ** 9
+            cutoff_ns = days * 86400 * 10 ** 9
             if now_ns - started_ns < cutoff_ns:
                 continue
             try:

@@ -198,11 +198,9 @@ def test_parse_lifecycle_and_expiry(tmp_path):
         <Expiration><Days>1</Days></Expiration></Rule>
     </LifecycleConfiguration>"""
     rules = parse_lifecycle(xml_text)
-    assert rules == [{"prefix": "tmp/", "expire_days": 1,
-                      "transition_days": None, "transition_tier": "",
-                      "noncurrent_days": None,
-                      "expired_delete_marker": False,
-                      "abort_mpu_days": None}]
+    assert len(rules.active) == 1  # Disabled rule inactive
+    (r,) = rules.active
+    assert r.filter.prefix == "tmp/" and r.expire_days == 1
 
     ol, _ = make_layer(tmp_path)
     ol.make_bucket("ilmbkt")
@@ -210,13 +208,13 @@ def test_parse_lifecycle_and_expiry(tmp_path):
     bm.update("ilmbkt", "lifecycle_xml", xml_text)
     ol.put_object("ilmbkt", "tmp/old.bin", io.BytesIO(b"x"), 1)
     ol.put_object("ilmbkt", "keep/new.bin", io.BytesIO(b"y"), 1)
-    # age the tmp object 2 days by rewriting its mod time in the usage scan
     scanner = DataScanner(ol, bucket_meta=bm)
-    # monkeypatch: backdate via direct metadata rewrite is complex; instead
-    # shrink the rule to 0 days which expires immediately
+    # Swap the Days rule for a PAST Date rule (backdating object
+    # mod-times is complex): Date rules fire once now >= date.
     bm.update(
-        "ilmbkt", "lifecycle_xml", xml_text.replace("<Days>1</Days>",
-                                                    "<Days>0</Days>")
+        "ilmbkt", "lifecycle_xml",
+        xml_text.replace("<Days>1</Days>",
+                         "<Date>2020-01-01T00:00:00Z</Date>"),
     )
     usage = scanner.scan_cycle()
     names = {

@@ -1,0 +1,483 @@
+"""Full lifecycle rule engine (bucket/lifecycle.py) — table-driven
+parse/validate/decision tests modeled on the reference's
+pkg/bucket/lifecycle/{lifecycle,rule,filter,expiration}_test.go case
+lists, plus scanner end-to-end runs for Tag-filtered expiry and
+NewerNoncurrentVersions retention."""
+
+from __future__ import annotations
+
+import datetime
+import io
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.bucket.lifecycle import (
+    DAY_S,
+    Lifecycle,
+    LifecycleError,
+    TAGS_META_KEY,
+    object_tags,
+)
+
+NOW = time.time()
+
+
+def _lc(body: str) -> Lifecycle:
+    return Lifecycle.parse(
+        f"<LifecycleConfiguration>{body}</LifecycleConfiguration>"
+    )
+
+
+RULE_OK = ("<Rule><ID>r1</ID><Status>Enabled</Status>"
+           "<Filter><Prefix>logs/</Prefix></Filter>"
+           "<Expiration><Days>30</Days></Expiration></Rule>")
+
+
+# ---------------------------------------------------------------------------
+# parsing (ref lifecycle_test.go TestParseAndValidateLifecycleConfig)
+# ---------------------------------------------------------------------------
+
+def test_parse_prefix_filter():
+    lc = _lc(RULE_OK)
+    (r,) = lc.rules
+    assert r.rule_id == "r1" and r.filter.prefix == "logs/"
+    assert r.expire_days == 30 and not r.filter.tags
+
+
+def test_parse_legacy_toplevel_prefix():
+    lc = _lc("<Rule><Status>Enabled</Status><Prefix>old/</Prefix>"
+             "<Expiration><Days>1</Days></Expiration></Rule>")
+    assert lc.rules[0].filter.prefix == "old/"
+
+
+def test_parse_tag_filter():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Filter><Tag><Key>env</Key><Value>dev</Value></Tag></Filter>"
+             "<Expiration><Days>1</Days></Expiration></Rule>")
+    assert lc.rules[0].filter.tags == {"env": "dev"}
+
+
+def test_parse_and_filter():
+    lc = _lc("<Rule><Status>Enabled</Status><Filter><And>"
+             "<Prefix>tmp/</Prefix>"
+             "<Tag><Key>a</Key><Value>1</Value></Tag>"
+             "<Tag><Key>b</Key><Value>2</Value></Tag>"
+             "</And></Filter>"
+             "<Expiration><Days>1</Days></Expiration></Rule>")
+    (r,) = lc.rules
+    assert r.filter.prefix == "tmp/"
+    assert r.filter.tags == {"a": "1", "b": "2"}
+
+
+def test_parse_rejects_mixed_filter_forms():
+    with pytest.raises(LifecycleError):
+        _lc("<Rule><Status>Enabled</Status><Filter>"
+            "<Prefix>x/</Prefix><Tag><Key>k</Key><Value>v</Value></Tag>"
+            "</Filter><Expiration><Days>1</Days></Expiration></Rule>")
+    with pytest.raises(LifecycleError):
+        _lc("<Rule><Status>Enabled</Status><Filter>"
+            "<Prefix>x/</Prefix><And><Prefix>y/</Prefix></And>"
+            "</Filter><Expiration><Days>1</Days></Expiration></Rule>")
+
+
+def test_parse_rejects_duplicate_and_tags():
+    with pytest.raises(LifecycleError):
+        _lc("<Rule><Status>Enabled</Status><Filter><And>"
+            "<Tag><Key>k</Key><Value>1</Value></Tag>"
+            "<Tag><Key>k</Key><Value>2</Value></Tag>"
+            "</And></Filter>"
+            "<Expiration><Days>1</Days></Expiration></Rule>")
+
+
+def test_parse_date_must_be_midnight_utc():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Expiration><Date>2026-01-01T00:00:00Z</Date></Expiration>"
+             "</Rule>")
+    assert lc.rules[0].expire_date == datetime.datetime(
+        2026, 1, 1, tzinfo=datetime.timezone.utc
+    ).timestamp()
+    with pytest.raises(LifecycleError):
+        _lc("<Rule><Status>Enabled</Status>"
+            "<Expiration><Date>2026-01-01T13:30:00Z</Date></Expiration>"
+            "</Rule>")
+
+
+def test_disabled_rules_kept_but_inactive():
+    lc = _lc(RULE_OK + RULE_OK.replace("Enabled", "Disabled")
+             .replace("r1", "r2"))
+    assert len(lc.rules) == 2  # validate() still sees Disabled rules
+    assert len(lc.active) == 1  # decisions only walk Enabled
+    lc.validate()  # all-rules validation incl. the Disabled one
+    # A config whose only rule is Disabled is VALID (standard S3
+    # workflow: flip Status off without losing the document).
+    _lc(RULE_OK.replace("Enabled", "Disabled")).validate()
+    old = int((NOW - 90 * DAY_S) * 1e9)
+    assert not _lc(RULE_OK.replace("Enabled", "Disabled")).expire_current(
+        "logs/x", {}, old, NOW
+    )
+
+
+def test_malformed_xml_raises():
+    with pytest.raises(LifecycleError):
+        Lifecycle.parse("<LifecycleConfiguration><Rule>")
+
+
+def test_parse_namespaced_document():
+    """AWS SDKs send xmlns-qualified documents; every nested field must
+    resolve through the namespace."""
+    lc = Lifecycle.parse(
+        '<LifecycleConfiguration '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        "<Rule><ID>ns</ID><Status>Enabled</Status>"
+        "<Filter><And><Prefix>p/</Prefix>"
+        "<Tag><Key>k</Key><Value>v</Value></Tag></And></Filter>"
+        "<Expiration><Days>7</Days></Expiration>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>3</NoncurrentDays>"
+        "</NoncurrentVersionExpiration>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    (r,) = lc.active
+    assert r.rule_id == "ns" and r.expire_days == 7
+    assert r.filter.prefix == "p/" and r.filter.tags == {"k": "v"}
+    assert r.noncurrent_days == 3
+    lc.validate()
+
+
+def test_non_integer_fields_raise_lifecycle_error():
+    with pytest.raises(LifecycleError, match="integer"):
+        _lc("<Rule><Status>Enabled</Status>"
+            "<Expiration><Days>abc</Days></Expiration></Rule>")
+
+
+def test_best_effort_parse_salvages_valid_rules():
+    """The scanner's read path drops individually-bad stored rules
+    instead of disabling the whole document."""
+    doc = (
+        "<Rule><ID>bad</ID><Status>Enabled</Status>"
+        "<Expiration><Days>oops</Days></Expiration></Rule>" + RULE_OK
+    )
+    with pytest.raises(LifecycleError):
+        _lc(doc)
+    lc = Lifecycle.parse(
+        f"<LifecycleConfiguration>{doc}</LifecycleConfiguration>",
+        best_effort=True,
+    )
+    assert [r.rule_id for r in lc.active] == ["r1"]
+
+
+def test_validate_rejects_nonpositive_noncurrent():
+    with pytest.raises(LifecycleError, match="NoncurrentDays"):
+        _lc("<Rule><ID>a</ID><Status>Enabled</Status>"
+            "<NoncurrentVersionExpiration><NoncurrentDays>-1"
+            "</NoncurrentDays></NoncurrentVersionExpiration></Rule>"
+            ).validate()
+
+
+# ---------------------------------------------------------------------------
+# validation (ref rule_test.go / expiration_test.go cases)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("body,msg", [
+    # Days and Date mutually exclusive
+    ("<Rule><ID>a</ID><Status>Enabled</Status><Expiration>"
+     "<Days>1</Days><Date>2026-01-01T00:00:00Z</Date>"
+     "</Expiration></Rule>", "mutually exclusive"),
+    # Days must be positive
+    ("<Rule><ID>a</ID><Status>Enabled</Status>"
+     "<Expiration><Days>0</Days></Expiration></Rule>", "positive"),
+    # Transition requires StorageClass
+    ("<Rule><ID>a</ID><Status>Enabled</Status>"
+     "<Transition><Days>1</Days></Transition></Rule>", "StorageClass"),
+    # ExpiredObjectDeleteMarker + tag filter
+    ("<Rule><ID>a</ID><Status>Enabled</Status>"
+     "<Filter><Tag><Key>k</Key><Value>v</Value></Tag></Filter>"
+     "<Expiration><ExpiredObjectDeleteMarker>true"
+     "</ExpiredObjectDeleteMarker></Expiration></Rule>", "Tag"),
+    # NewerNoncurrentVersions requires NoncurrentDays
+    ("<Rule><ID>a</ID><Status>Enabled</Status>"
+     "<NoncurrentVersionExpiration><NewerNoncurrentVersions>3"
+     "</NewerNoncurrentVersions></NoncurrentVersionExpiration></Rule>",
+     "NoncurrentDays"),
+    # rule with no action
+    ("<Rule><ID>a</ID><Status>Enabled</Status>"
+     "<Filter><Prefix>x/</Prefix></Filter></Rule>", "no action"),
+])
+def test_validate_rejects(body, msg):
+    with pytest.raises(LifecycleError, match=msg):
+        _lc(body).validate()
+
+
+def test_validate_duplicate_rule_ids():
+    with pytest.raises(LifecycleError, match="duplicate rule ID"):
+        _lc(RULE_OK + RULE_OK).validate()
+
+
+def test_validate_empty():
+    with pytest.raises(LifecycleError):
+        _lc("").validate()
+
+
+def test_validate_accepts_full_rule_set():
+    _lc("<Rule><ID>a</ID><Status>Enabled</Status>"
+        "<Filter><And><Prefix>p/</Prefix>"
+        "<Tag><Key>k</Key><Value>v</Value></Tag></And></Filter>"
+        "<Expiration><Days>10</Days></Expiration>"
+        "<Transition><Days>3</Days><StorageClass>COLD</StorageClass>"
+        "</Transition>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>5</NoncurrentDays>"
+        "<NewerNoncurrentVersions>2</NewerNoncurrentVersions>"
+        "</NoncurrentVersionExpiration>"
+        "<AbortIncompleteMultipartUpload><DaysAfterInitiation>7"
+        "</DaysAfterInitiation></AbortIncompleteMultipartUpload>"
+        "</Rule>").validate()
+
+
+# ---------------------------------------------------------------------------
+# decisions (ref TestComputeActions case table)
+# ---------------------------------------------------------------------------
+
+def _tags_meta(**tags):
+    return {TAGS_META_KEY: urllib.parse.urlencode(list(tags.items()))}
+
+
+def test_expire_days_midnight_truncation():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Expiration><Days>1</Days></Expiration></Rule>")
+    mod_ns = int((NOW - 36 * 3600) * 1e9)  # 1.5 days old
+    assert lc.expire_current("o", {}, mod_ns, NOW) == (
+        # due at the first UTC midnight >= mod+1d; check both sides
+        NOW >= ((int((mod_ns / 1e9 + DAY_S) // DAY_S) +
+                 (1 if (mod_ns / 1e9 + DAY_S) % DAY_S else 0)) * DAY_S)
+    )
+    # 3 days old: unambiguously past any midnight boundary.
+    assert lc.expire_current("o", {}, int((NOW - 3 * DAY_S) * 1e9), NOW)
+    # 1 hour old: never.
+    assert not lc.expire_current("o", {}, int((NOW - 3600) * 1e9), NOW)
+
+
+def test_expire_date_rules():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Expiration><Date>2020-01-01T00:00:00Z</Date></Expiration>"
+             "</Rule>")
+    assert lc.expire_current("o", {}, int(NOW * 1e9), NOW)
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Expiration><Date>2199-01-01T00:00:00Z</Date></Expiration>"
+             "</Rule>")
+    assert not lc.expire_current("o", {}, int((NOW - 9 * DAY_S) * 1e9), NOW)
+
+
+def test_expire_tag_filtered():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Filter><Tag><Key>env</Key><Value>dev</Value></Tag></Filter>"
+             "<Expiration><Date>2020-01-01T00:00:00Z</Date></Expiration>"
+             "</Rule>")
+    old = int((NOW - 9 * DAY_S) * 1e9)
+    assert lc.expire_current("o", _tags_meta(env="dev"), old, NOW)
+    assert not lc.expire_current("o", _tags_meta(env="prod"), old, NOW)
+    assert not lc.expire_current("o", {}, old, NOW)  # untagged
+
+
+def test_expire_and_filter_needs_all():
+    lc = _lc("<Rule><Status>Enabled</Status><Filter><And>"
+             "<Prefix>tmp/</Prefix>"
+             "<Tag><Key>a</Key><Value>1</Value></Tag>"
+             "<Tag><Key>b</Key><Value>2</Value></Tag></And></Filter>"
+             "<Expiration><Date>2020-01-01T00:00:00Z</Date></Expiration>"
+             "</Rule>")
+    old = int((NOW - 9 * DAY_S) * 1e9)
+    assert lc.expire_current("tmp/x", _tags_meta(a="1", b="2"), old, NOW)
+    assert not lc.expire_current("tmp/x", _tags_meta(a="1"), old, NOW)
+    assert not lc.expire_current("other/x", _tags_meta(a="1", b="2"),
+                                 old, NOW)
+
+
+def test_transition_date_and_tier():
+    lc = _lc("<Rule><Status>Enabled</Status>"
+             "<Transition><Date>2020-01-01T00:00:00Z</Date>"
+             "<StorageClass>GLACIER</StorageClass></Transition></Rule>")
+    assert lc.transition_tier_due("o", {}, int(NOW * 1e9), NOW) == "GLACIER"
+    assert _lc("<Rule><Status>Enabled</Status>"
+               "<Transition><Days>9000</Days>"
+               "<StorageClass>GLACIER</StorageClass></Transition></Rule>"
+               ).transition_tier_due("o", {}, int(NOW * 1e9), NOW) is None
+
+
+def test_noncurrent_policy_aggregation():
+    lc = _lc(
+        "<Rule><Status>Enabled</Status><Filter><Prefix>a/</Prefix></Filter>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>10</NoncurrentDays>"
+        "</NoncurrentVersionExpiration></Rule>"
+        "<Rule><Status>Enabled</Status><Filter><Prefix>a/b</Prefix></Filter>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>4</NoncurrentDays>"
+        "<NewerNoncurrentVersions>2</NewerNoncurrentVersions>"
+        "</NoncurrentVersionExpiration></Rule>"
+        # tag-filtered noncurrent rules never apply
+        "<Rule><Status>Enabled</Status>"
+        "<Filter><Tag><Key>k</Key><Value>v</Value></Tag></Filter>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>1</NoncurrentDays>"
+        "</NoncurrentVersionExpiration></Rule>"
+    )
+    assert lc.noncurrent_policy("a/b/x") == (4, 2)
+    assert lc.noncurrent_policy("a/zzz") == (10, 0)
+    assert lc.noncurrent_policy("other") == (None, 0)
+
+
+def test_delete_marker_and_abort_mpu_prefix_scope():
+    lc = _lc(
+        "<Rule><Status>Enabled</Status><Filter><Prefix>logs/</Prefix>"
+        "</Filter><Expiration><ExpiredObjectDeleteMarker>true"
+        "</ExpiredObjectDeleteMarker></Expiration></Rule>"
+        "<Rule><Status>Enabled</Status><Filter><Prefix>up/</Prefix>"
+        "</Filter><AbortIncompleteMultipartUpload><DaysAfterInitiation>5"
+        "</DaysAfterInitiation></AbortIncompleteMultipartUpload></Rule>"
+        "<Rule><Status>Enabled</Status><Filter><Prefix>up/x/</Prefix>"
+        "</Filter><AbortIncompleteMultipartUpload><DaysAfterInitiation>2"
+        "</DaysAfterInitiation></AbortIncompleteMultipartUpload></Rule>"
+    )
+    assert lc.wants_delete_marker_cleanup("logs/app.log")
+    assert not lc.wants_delete_marker_cleanup("data/app.log")
+    assert lc.abort_mpu_after_days("up/x/f") == 2
+    assert lc.abort_mpu_after_days("up/y") == 5
+    assert lc.abort_mpu_after_days("elsewhere") is None
+
+
+def test_object_tags_decode():
+    assert object_tags(_tags_meta(a="1", b="x y")) == {"a": "1", "b": "x y"}
+    assert object_tags({}) == {}
+    assert object_tags(None) == {}
+
+
+# ---------------------------------------------------------------------------
+# scanner end-to-end: tag-filtered expiry + NewerNoncurrentVersions
+# ---------------------------------------------------------------------------
+
+DEP = "12ab34cd-1111-2222-3333-abcdabcdabcd"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.object.pools import ErasureServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.storage.local import LocalStorage
+
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(disks, 4, deployment_id=DEP, pool_index=0)
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    bm = BucketMetadataSys(ol)
+    return ol, bm
+
+
+def test_scanner_tag_filtered_expiry(stack):
+    from minio_tpu.background.scanner import DataScanner
+    from minio_tpu.object.types import ObjectOptions
+
+    ol, bm = stack
+    ol.make_bucket("tagbkt")
+    bm.update("tagbkt", "lifecycle_xml", (
+        "<LifecycleConfiguration><Rule><ID>dev-only</ID>"
+        "<Status>Enabled</Status>"
+        "<Filter><Tag><Key>env</Key><Value>dev</Value></Tag></Filter>"
+        "<Expiration><Date>2020-01-01T00:00:00Z</Date></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    ))
+    dev_tags = {TAGS_META_KEY: "env=dev"}
+    ol.put_object("tagbkt", "dev.bin", io.BytesIO(b"d"), 1,
+                  ObjectOptions(user_defined=dict(dev_tags)))
+    ol.put_object("tagbkt", "prod.bin", io.BytesIO(b"p"), 1,
+                  ObjectOptions(user_defined={TAGS_META_KEY: "env=prod"}))
+    ol.put_object("tagbkt", "untagged.bin", io.BytesIO(b"u"), 1)
+    DataScanner(ol, bucket_meta=bm).scan_cycle()
+    names = {o.name for o in ol.list_objects("tagbkt", max_keys=10).objects}
+    assert names == {"prod.bin", "untagged.bin"}
+
+
+def test_scanner_newer_noncurrent_versions_retention(stack):
+    """NewerNoncurrentVersions keeps the N newest noncurrent versions
+    even when NoncurrentDays would expire them: 6 versions (current +
+    5 noncurrent, successively aged), NoncurrentDays=1, keep 2."""
+    from minio_tpu.background.scanner import DataScanner
+    from minio_tpu.object.types import ObjectOptions
+
+    ol, bm = stack
+    ol.make_bucket("nnv")
+    bm.update("nnv", "versioning_xml", (
+        "<VersioningConfiguration><Status>Enabled</Status>"
+        "</VersioningConfiguration>"
+    ))
+    bm.update("nnv", "lifecycle_xml", (
+        "<LifecycleConfiguration><Rule><ID>nnv</ID>"
+        "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>1</NoncurrentDays>"
+        "<NewerNoncurrentVersions>2</NewerNoncurrentVersions>"
+        "</NoncurrentVersionExpiration></Rule></LifecycleConfiguration>"
+    ))
+    day_ns = 86400 * 10 ** 9
+    # Ages: 10d .. 6d noncurrent (each superseded days ago -> all past
+    # NoncurrentDays=1), then the current version.
+    for age in (10, 9, 8, 7, 6, 0):
+        ol.put_object(
+            "nnv", "doc", io.BytesIO(b"v%02d" % age), 3,
+            ObjectOptions(versioned=True,
+                          mod_time_ns=time.time_ns() - age * day_ns),
+        )
+    DataScanner(ol, bucket_meta=bm).scan_cycle()
+    res = ol.list_object_versions("nnv", prefix="doc", max_keys=50)
+    mine = [v for v in res.versions if v.name == "doc"]
+    # Current + the 2 newest noncurrent (7d, 8d) survive; 9d/10d expire.
+    # (The 6d version became noncurrent when current was written — 0
+    # days noncurrent, rank 1; 7d is rank 2; both inside keep window.)
+    assert len(mine) == 3, [v.mod_time_ns for v in mine]
+    assert sum(v.is_latest for v in mine) == 1
+
+
+def test_put_lifecycle_validation_over_http(stack):
+    """Invalid documents 400 at PutBucketLifecycle; valid ones persist
+    (ref PutBucketLifecycleHandler -> ParseLifecycleConfig.Validate)."""
+    import http.client
+
+    from minio_tpu.api import S3Server
+    from minio_tpu.api.sign import sign_v4_request
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.bucket import BucketMetadataSys
+
+    ol, bm = stack
+    srv = S3Server(ol, IAMSys("ak-lifec", "sk-lifec-secret"), bm).start()
+    try:
+        def put_lc(body: bytes):
+            conn = http.client.HTTPConnection(srv.endpoint, timeout=10)
+            q = [("lifecycle", "")]
+            hdrs = sign_v4_request("sk-lifec-secret", "ak-lifec", "PUT",
+                                   srv.endpoint, "/lcbkt", q, {}, body)
+            conn.request("PUT", "/lcbkt?lifecycle=", body=body,
+                         headers=hdrs)
+            r = conn.getresponse()
+            data = r.read()
+            conn.close()
+            return r.status, data
+
+        conn = http.client.HTTPConnection(srv.endpoint, timeout=10)
+        hdrs = sign_v4_request("sk-lifec-secret", "ak-lifec", "PUT",
+                               srv.endpoint, "/lcbkt", [], {}, b"")
+        conn.request("PUT", "/lcbkt", body=b"", headers=hdrs)
+        assert conn.getresponse().status == 200
+        conn.close()
+
+        bad = (b"<LifecycleConfiguration><Rule><ID>x</ID>"
+               b"<Status>Enabled</Status><Expiration><Days>0</Days>"
+               b"</Expiration></Rule></LifecycleConfiguration>")
+        status, data = put_lc(bad)
+        assert status == 400 and b"positive" in data
+        good = (b"<LifecycleConfiguration><Rule><ID>x</ID>"
+                b"<Status>Enabled</Status><Filter><Prefix>l/</Prefix>"
+                b"</Filter><Expiration><Days>5</Days></Expiration>"
+                b"</Rule></LifecycleConfiguration>")
+        status, _ = put_lc(good)
+        assert status == 200
+        assert "Days>5" in bm.get("lcbkt").lifecycle_xml
+    finally:
+        srv.stop()

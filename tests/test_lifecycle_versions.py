@@ -32,11 +32,11 @@ LC_XML = """<LifecycleConfiguration>
 
 def test_parse_extended_rules():
     rules = parse_lifecycle(LC_XML)
-    assert rules == [{
-        "prefix": "", "expire_days": None, "transition_days": None,
-        "transition_tier": "", "noncurrent_days": 7,
-        "expired_delete_marker": True, "abort_mpu_days": 3,
-    }]
+    (r,) = rules.rules
+    assert r.filter.prefix == "" and r.expire_days is None
+    assert r.noncurrent_days == 7
+    assert r.expired_object_delete_marker is True
+    assert r.abort_mpu_days == 3
 
 
 @pytest.fixture()

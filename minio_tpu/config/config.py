@@ -43,7 +43,7 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "identity_openid": {"config_url": "", "client_id": "", "jwks": "", "hmac_secret": "", "claim_name": "policy"},
     "identity_ldap": {"server_addr": "", "user_dn_search_base_dn": ""},
     "policy_opa": {"url": "", "auth_token": ""},
-    "kms_kes": {"endpoint": "", "key_name": ""},
+    "kms_kes": {"endpoint": "", "key_name": "", "cert_file": "", "key_file": "", "capath": "", "insecure": "off"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},

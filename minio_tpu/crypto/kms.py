@@ -30,6 +30,18 @@ def _context_aad(context: dict | None) -> bytes:
     return json.dumps(context or {}, sort_keys=True).encode()
 
 
+def validate_key_id(key_id: str):
+    """One key-id rule for every backend (local + KES)."""
+    if not key_id or "/" in key_id:
+        raise KMSError("InvalidArgument", f"bad key id {key_id!r}")
+
+
+def render_key_list(keys: dict[str, int]) -> list[dict]:
+    return [
+        {"name": k, "createdNs": ts} for k, ts in sorted(keys.items())
+    ]
+
+
 class LocalKMS:
     """In-process KMS keyed off operator secret material.
 
@@ -71,8 +83,7 @@ class LocalKMS:
     # --- key registry (ref KES CreateKey / ListKeys) ---
 
     def create_key(self, key_id: str):
-        if not key_id or "/" in key_id:
-            raise KMSError("InvalidArgument", f"bad key id {key_id!r}")
+        validate_key_id(key_id)
         with self._lock:
             if key_id in self._keys:
                 raise KMSError("KeyAlreadyExists", key_id)
@@ -81,10 +92,7 @@ class LocalKMS:
 
     def list_keys(self) -> list[dict]:
         with self._lock:
-            return [
-                {"name": k, "createdNs": ts}
-                for k, ts in sorted(self._keys.items())
-            ]
+            return render_key_list(self._keys)
 
     def has_key(self, key_id: str) -> bool:
         with self._lock:

@@ -335,12 +335,14 @@ class Server:
         self.started_ns = time.time_ns()
 
     def _build_kms(self):
-        """LocalKMS whose key registry lives under `.minio.sys` in the
-        object layer (key NAMES only; material derives from the root
-        secret — ref pkg/kms + admin KMS key surface)."""
+        """KES-backed KMS when kms_kes.endpoint is configured (mTLS
+        client to an external KES server, ref cmd/crypto/kes.go);
+        otherwise LocalKMS whose key registry lives under `.minio.sys`
+        in the object layer (key NAMES only; material derives from the
+        root secret — ref pkg/kms + admin KMS key surface)."""
         import io as _io
 
-        from .crypto.kms import LocalKMS
+        from .crypto.kes import kms_from_config
         from .utils.errors import StorageError
 
         ol = self.object_layer
@@ -363,9 +365,9 @@ class Server:
                     ol.put_object(".minio.sys", self.PATH,
                                   _io.BytesIO(data), len(data))
 
-        return LocalKMS(
+        return kms_from_config(
+            self.config_sys.config.get("kms_kes"),
             self.root_password,
-            self.config_sys.config.get("kms_kes").get("key_name", ""),
             persist=_Persist(),
         )
 

@@ -1,0 +1,297 @@
+"""KES-compatible external KMS (crypto/kes.py) against an in-test fake
+KES server speaking real HTTPS + mTLS and the /v1/key API
+(ref cmd/crypto/kes.go kesClient) — wire round trips, error mapping,
+endpoint failover, the unseal cache, config-driven backend selection,
+and SSE-KMS over the S3 API with the KES backend."""
+
+from __future__ import annotations
+
+import base64
+import http.server
+import io
+import json
+import os
+import ssl
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.crypto.kes import KESClient, KESKMS, kms_from_config
+from minio_tpu.crypto.kms import KMSError, LocalKMS
+from minio_tpu.utils.certs import generate_self_signed
+
+
+class FakeKES:
+    """Real HTTPS server with required client certs, sealing data keys
+    with per-name AES-GCM masters like a real KES would."""
+
+    def __init__(self, tmpdir: str, require_client_cert: bool = True):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        self.cert_file, self.key_file = generate_self_signed(
+            os.path.join(tmpdir, "srv"), ["127.0.0.1", "localhost"]
+        )
+        # Client identity: its own self-signed pair; the server trusts
+        # exactly that cert (mTLS pinning, how KES identity works).
+        self.client_cert, self.client_key = generate_self_signed(
+            os.path.join(tmpdir, "cli"), ["kes-client"]
+        )
+        self.keys: dict[str, bytes] = {"mtpu-default-key": os.urandom(32)}
+        self.decrypt_calls = 0
+        fake = self
+        aesgcm = AESGCM
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: D102 - quiet
+                pass
+
+            def _json(self, code: int, obj: dict):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/version":
+                    self._json(200, {"version": "fake-kes-0.1"})
+                else:
+                    self._json(404, {"message": "unknown path"})
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(ln) or b"{}")
+                parts = self.path.split("/")
+                # /v1/key/<op>/<name>
+                if len(parts) != 5 or parts[1] != "v1" or parts[2] != "key":
+                    self._json(404, {"message": "unknown path"})
+                    return
+                op, name = parts[3], urllib.parse.unquote(parts[4])
+                if op == "create":
+                    if name in fake.keys:
+                        self._json(409, {"message": "key already exists"})
+                        return
+                    fake.keys[name] = os.urandom(32)
+                    self._json(200, {})
+                    return
+                master = fake.keys.get(name)
+                if master is None:
+                    self._json(404, {"message": "key does not exist"})
+                    return
+                if op == "generate":
+                    ctx = base64.b64decode(body.get("context", "") or "")
+                    pk = os.urandom(32)
+                    nonce = os.urandom(12)
+                    sealed = nonce + aesgcm(master).encrypt(nonce, pk, ctx)
+                    self._json(200, {
+                        "plaintext": base64.b64encode(pk).decode(),
+                        "ciphertext": base64.b64encode(sealed).decode(),
+                    })
+                elif op == "decrypt":
+                    fake.decrypt_calls += 1
+                    ctx = base64.b64decode(body.get("context", "") or "")
+                    sealed = base64.b64decode(body["ciphertext"])
+                    try:
+                        pk = aesgcm(master).decrypt(
+                            sealed[:12], sealed[12:], ctx
+                        )
+                    except Exception:  # noqa: BLE001 -> KES 403
+                        self._json(
+                            403, {"message": "decryption failed"}
+                        )
+                        return
+                    self._json(200, {
+                        "plaintext": base64.b64encode(pk).decode(),
+                    })
+                else:
+                    self._json(404, {"message": "unknown op"})
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        if require_client_cert:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_verify_locations(self.client_cert)
+        self._httpd.socket = ctx.wrap_socket(
+            self._httpd.socket, server_side=True
+        )
+        self.port = self._httpd.server_address[1]
+        self.endpoint = f"https://127.0.0.1:{self.port}"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture(scope="module")
+def kes(tmp_path_factory):
+    srv = FakeKES(str(tmp_path_factory.mktemp("kes")))
+    yield srv
+    srv.stop()
+
+
+def _client(kes, **kw):
+    return KESClient(
+        [kes.endpoint], cert_file=kes.client_cert,
+        key_file=kes.client_key, ca_path=kes.cert_file, **kw,
+    )
+
+
+def test_kes_create_generate_decrypt_roundtrip(kes):
+    kms = KESKMS(_client(kes), "mtpu-default-key")
+    kms.create_key("tenant-z")
+    pk, sealed = kms.generate_data_key("tenant-z", {"bucket": "b"})
+    assert len(pk) == 32
+    assert kms.decrypt_data_key("tenant-z", sealed, {"bucket": "b"}) == pk
+    assert {e["name"] for e in kms.list_keys()} >= {
+        "mtpu-default-key", "tenant-z"
+    }
+    assert kms.has_key("tenant-z")
+    st = kms.status()
+    assert st["backend"] == "kes" and st["version"] == "fake-kes-0.1"
+    assert all(k["healthy"] for k in st["keys"])
+
+
+def test_kes_error_mapping(kes):
+    kms = KESKMS(_client(kes))
+    with pytest.raises(KMSError) as ei:
+        kms.generate_data_key("no-such-key")
+    assert ei.value.code == "KeyNotFound"
+    with pytest.raises(KMSError) as ei:
+        kms.create_key("mtpu-default-key")
+    assert ei.value.code == "KeyAlreadyExists"
+    pk, sealed = kms.generate_data_key(context={"a": "1"})
+    with pytest.raises(KMSError) as ei:
+        kms.decrypt_data_key("", sealed, {"a": "WRONG"})
+    assert ei.value.code == "AccessDenied"
+    assert not kms.has_key("definitely-absent")
+
+
+def test_kes_unseal_cache(kes):
+    kms = KESKMS(_client(kes))
+    pk, sealed = kms.generate_data_key(context={"o": "x"})
+    before = kes.decrypt_calls
+    for _ in range(5):
+        assert kms.decrypt_data_key("", sealed, {"o": "x"}) == pk
+    # One wire round trip; four cache hits.
+    assert kes.decrypt_calls == before + 1
+
+
+def test_kes_requires_client_cert(kes):
+    bare = KESClient([kes.endpoint], ca_path=kes.cert_file)
+    with pytest.raises(KMSError) as ei:
+        bare.create_key("nope")
+    assert ei.value.code in ("KMSNotReachable", "AccessDenied")
+
+
+def test_kes_endpoint_failover(kes):
+    client = KESClient(
+        ["https://127.0.0.1:1", kes.endpoint],  # first endpoint dead
+        cert_file=kes.client_cert, key_file=kes.client_key,
+        ca_path=kes.cert_file,
+    )
+    pk, ct = client.generate_data_key("mtpu-default-key", b"{}")
+    assert client.decrypt_data_key("mtpu-default-key", ct, b"{}") == pk
+
+
+def test_scheme_less_endpoint_normalized():
+    c = KESClient(["kes.local:7373", " https://other:7373 "])
+    assert c.endpoints == ["https://kes.local:7373", "https://other:7373"]
+
+
+def test_corrupt_seal_maps_to_access_denied(kes):
+    kms = KESKMS(_client(kes))
+    with pytest.raises(KMSError) as ei:
+        kms.decrypt_data_key("", "!!!not-base64!!!")
+    assert ei.value.code == "AccessDenied"
+
+
+def test_has_key_raises_when_unreachable():
+    kms = KESKMS(KESClient(["https://127.0.0.1:1"], timeout=0.3))
+    with pytest.raises(KMSError) as ei:
+        kms.has_key("some-key")
+    assert ei.value.code == "KMSNotReachable"
+
+
+def test_connection_reuse(kes):
+    """The client keeps one pooled connection per endpoint instead of a
+    fresh mTLS handshake per op."""
+    c = _client(kes)
+    c.create_key("reuse-a")
+    conn1 = c._conns[c.endpoints[0]]
+    c.generate_data_key("reuse-a", b"{}")
+    assert c._conns[c.endpoints[0]] is conn1
+
+
+def test_kms_from_config_selects_backend(kes, tmp_path):
+    kms = kms_from_config(
+        {"endpoint": kes.endpoint, "key_name": "cfg-key",
+         "cert_file": kes.client_cert, "key_file": kes.client_key,
+         "capath": kes.cert_file},
+        "rootsecret",
+    )
+    assert isinstance(kms, KESKMS) and kms.default_key_id == "cfg-key"
+    local = kms_from_config({"endpoint": ""}, "rootsecret")
+    assert isinstance(local, LocalKMS)
+
+
+def test_sse_kms_over_s3_with_kes_backend(kes, tmp_path):
+    """The full SSE-KMS path (PUT aws:kms -> sealed data key in object
+    metadata -> GET decrypts via KES) with the external backend."""
+    import http.client
+
+    from minio_tpu.api import S3Server
+    from minio_tpu.api.sign import sign_v4_request
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.crypto.sse import SSEConfig
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.object.pools import ErasureServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.storage.local import LocalStorage
+
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="77ab34cd-1111-2222-3333-abcdabcdabcd",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    sse = SSEConfig("rootsecret", kms=KESKMS(_client(kes)))
+    srv = S3Server(ol, IAMSys("kesak", "kes-secret-key"),
+                   BucketMetadataSys(ol), sse_config=sse).start()
+    try:
+        def req(method, path, body=b"", headers=None):
+            conn = http.client.HTTPConnection(srv.endpoint, timeout=30)
+            h = sign_v4_request("kes-secret-key", "kesak", method,
+                                srv.endpoint, path, [],
+                                dict(headers or {}), body)
+            conn.request(method, path, body=body, headers=h)
+            r = conn.getresponse()
+            data = r.read()
+            conn.close()
+            return r.status, dict(r.getheaders()), data
+
+        assert req("PUT", "/kesbkt")[0] == 200
+        body = b"external-kms-protected" * 400
+        st, h, _ = req(
+            "PUT", "/kesbkt/doc.bin", body=body,
+            headers={"x-amz-server-side-encryption": "aws:kms"},
+        )
+        assert st == 200, h
+        assert h.get("x-amz-server-side-encryption") == "aws:kms"
+        st, h, got = req("GET", "/kesbkt/doc.bin")
+        assert st == 200 and got == body
+        # Stored bytes are NOT the plaintext (sanity: encryption real).
+        raw = io.BytesIO()
+        ol.get_object("kesbkt", "doc.bin", raw)
+        assert body not in raw.getvalue()
+    finally:
+        srv.stop()

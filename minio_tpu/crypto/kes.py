@@ -54,53 +54,80 @@ class KESClient:
             self._ctx.verify_mode = ssl.CERT_NONE
         if cert_file:
             self._ctx.load_cert_chain(cert_file, key_file or None)
-        # One persistent keep-alive connection per endpoint (the
-        # reference's http.Client pools the same way) — a fresh mTLS
-        # handshake per KMS op would add 2+ RTTs to every SSE-KMS PUT.
-        self._conns: dict[str, http.client.HTTPSConnection] = {}
-        self._mu = threading.Lock()
+        # Keep-alive connection POOL per endpoint (the reference's
+        # http.Client pools the same way) — a fresh mTLS handshake per
+        # KMS op would add 2+ RTTs to every SSE-KMS PUT, and a single
+        # shared connection (or a client-wide lock around the round
+        # trip) would serialize all encrypted traffic behind the
+        # slowest request.
+        self._pool: dict[str, list] = {}
+        self._mu = threading.Lock()  # guards the pool map only
 
-    def _conn_for(self, ep: str) -> http.client.HTTPSConnection:
-        conn = self._conns.get(ep)
-        if conn is None:
-            host = urllib.parse.urlsplit(ep).netloc
-            conn = http.client.HTTPSConnection(
-                host, timeout=self.timeout, context=self._ctx
-            )
-            self._conns[ep] = conn
-        return conn
+    POOL_MAX_IDLE = 8
 
-    def _drop_conn(self, ep: str):
-        conn = self._conns.pop(ep, None)
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
+    def _acquire(self, ep: str) -> http.client.HTTPSConnection:
+        with self._mu:
+            idle = self._pool.get(ep)
+            if idle:
+                return idle.pop()
+        host = urllib.parse.urlsplit(ep).netloc
+        return http.client.HTTPSConnection(
+            host, timeout=self.timeout, context=self._ctx
+        )
+
+    def _release(self, ep: str, conn):
+        with self._mu:
+            idle = self._pool.setdefault(ep, [])
+            if len(idle) < self.POOL_MAX_IDLE:
+                idle.append(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         last: Exception | None = None
         headers = {"Content-Type": "application/json"} if body else {}
-        with self._mu:
-            for ep in self.endpoints:
-                # Two tries per endpoint: a pooled keep-alive socket may
-                # have idled out — retry once on a fresh connection (the
-                # key API is idempotent: create/generate/decrypt).
-                for attempt in (0, 1):
-                    conn = self._conn_for(ep)
+        for ep in self.endpoints:
+            # Two tries per endpoint: a pooled keep-alive socket may
+            # have idled out — retry once on a fresh connection.
+            for attempt in (0, 1):
+                conn = self._acquire(ep)
+                try:
+                    conn.request(method, path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (OSError, ssl.SSLError,
+                        http.client.HTTPException) as exc:
+                    last = exc
                     try:
-                        conn.request(method, path, body=body,
-                                     headers=headers)
-                        resp = conn.getresponse()
-                        data = resp.read()
-                    except (OSError, ssl.SSLError,
-                            http.client.HTTPException) as exc:
-                        last = exc
-                        self._drop_conn(ep)
-                        continue
-                    if resp.status // 100 != 2:
-                        raise self._api_error(resp.status, data)
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                if resp.status == 409 and attempt == 1:
+                    # The retried request's FIRST send may have executed
+                    # before its connection died — a conflict on the
+                    # retry means /v1/key/create already succeeded, not
+                    # a genuine duplicate (create is the only 409 op).
+                    self._release(ep, conn)
                     return data
+                if resp.status >= 500:
+                    # Server-side failure: fall through to the next
+                    # endpoint like a connection error — 4xx stays
+                    # terminal (the answer won't differ on a replica).
+                    last = self._api_error(resp.status, data)
+                    self._release(ep, conn)
+                    break
+                if resp.status // 100 != 2:
+                    self._release(ep, conn)
+                    raise self._api_error(resp.status, data)
+                self._release(ep, conn)
+                return data
+        if isinstance(last, KMSError):
+            raise last
         raise KMSError(
             "KMSNotReachable",
             f"no KES endpoint reachable: {last}",

@@ -185,6 +185,33 @@ class IAMSys:
                 self.group_policy = d.get("groups", {})
                 self.group_members = d.get("members", {})
 
+    def reload(self):
+        """Rebuild in-memory state from the backend — the invalidation
+        entry point the etcd watch (iam/etcd.py) and peer notifications
+        drive (ref iam-etcd-store.go watch loop -> reload). STS
+        credentials and their session policies are memory-only and
+        survive the reload."""
+        with self._lock:
+            sts_mappings = {
+                k: v for k, v in self.user_policy.items() if k in self.sts
+            }
+            # Keyed off LIVE STS creds, never the "sts-" name prefix: a
+            # persisted admin policy that happens to start with "sts-"
+            # must reload from the backend, not resurrect stale.
+            sts_policies = {
+                name: self.policies[name]
+                for name in (f"sts-{k}" for k in self.sts)
+                if name in self.policies
+            }
+            self.users = {}
+            self.policies = dict(CANNED_POLICIES)
+            self.user_policy = {}
+            self.group_policy = {}
+            self.group_members = {}
+            self.load()
+            self.policies.update(sts_policies)
+            self.user_policy.update(sts_mappings)
+
     def _persist_mappings(self):
         # Temp (STS) access keys never persist: their mappings die with
         # the credential, not with the store.

@@ -192,11 +192,32 @@ class Server:
         # --- subsystems (ref initAllSubsystems) ---
         self.trace = TraceHub()
         self.logger = Logger()
+        # IAM backend: etcd when configured (env MTPU_ETCD_ENDPOINTS /
+        # config subsystem `etcd`, ref cmd/etcd.go + iam-etcd-store.go),
+        # else the object layer. etcd config must come from env here:
+        # IAM initializes before the persisted config loads, exactly
+        # like the reference reads etcd env ahead of initAllSubsystems.
+        from .config.config import Config as _Cfg
+
+        etcd_kvs = _Cfg().get("etcd")
+        self._iam_watcher = None
+        if (etcd_kvs.get("endpoints", "") or "").strip():
+            from .iam.etcd import EtcdIAMBackend, EtcdKV
+
+            iam_store = EtcdIAMBackend(
+                EtcdKV(etcd_kvs["endpoints"].split(",")),
+                etcd_kvs.get("path_prefix", ""),
+            )
+        else:
+            iam_store = ObjectStoreBackend(self.object_layer)
         self.iam = IAMSys(
-            self.root_user, self.root_password,
-            store=ObjectStoreBackend(self.object_layer),
+            self.root_user, self.root_password, store=iam_store,
         )
         self.iam.load()
+        if hasattr(iam_store, "start_watch"):
+            # Watch-driven cross-node invalidation: any node's IAM write
+            # reloads every node's cache within the watch latency.
+            self._iam_watcher = iam_store.start_watch(self.iam.reload)
         self.bucket_meta = BucketMetadataSys(self.object_layer)
         self.config_sys = ConfigSys(
             self.object_layer, secret=self.root_password
@@ -566,6 +587,8 @@ class Server:
         return self
 
     def stop(self):
+        if self._iam_watcher is not None:
+            self._iam_watcher.stop()
         self.s3.stop()
         self.scanner.stop()
         self.mrf.stop()

@@ -221,13 +221,13 @@ def test_has_key_raises_when_unreachable():
 
 
 def test_connection_reuse(kes):
-    """The client keeps one pooled connection per endpoint instead of a
-    fresh mTLS handshake per op."""
+    """The client pools keep-alive connections per endpoint instead of
+    a fresh mTLS handshake per op."""
     c = _client(kes)
     c.create_key("reuse-a")
-    conn1 = c._conns[c.endpoints[0]]
+    conn1 = c._pool[c.endpoints[0]][0]
     c.generate_data_key("reuse-a", b"{}")
-    assert c._conns[c.endpoints[0]] is conn1
+    assert c._pool[c.endpoints[0]][0] is conn1
 
 
 def test_kms_from_config_selects_backend(kes, tmp_path):

@@ -60,6 +60,7 @@ API_ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("SignatureVersionNotSupported", "The authorization mechanism you have provided is not supported.", HTTPStatus.BAD_REQUEST),
     _E("SlowDown", "Resource requested is unreadable, please reduce your request rate.", HTTPStatus.SERVICE_UNAVAILABLE),
     _E("MetadataTooLarge", "Your metadata headers exceed the maximum allowed metadata size.", HTTPStatus.BAD_REQUEST),
+    _E("InsecureSSECustomerRequest", "Requests specifying Server Side Encryption with Customer provided keys must be made over a secure connection.", HTTPStatus.BAD_REQUEST),
     _E("XAmzContentSHA256Mismatch", "The provided 'x-amz-content-sha256' header does not match what was computed.", HTTPStatus.BAD_REQUEST),
     _E("AuthHeaderMalformed", "The authorization header is malformed.", HTTPStatus.BAD_REQUEST),
     _E("CredMalformed", "The credential is malformed.", HTTPStatus.BAD_REQUEST),

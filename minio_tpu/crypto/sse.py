@@ -45,6 +45,13 @@ HDR_SSE_KMS_CONTEXT = "x-amz-server-side-encryption-context"
 HDR_SSEC_COPY_ALGO = (
     "x-amz-copy-source-server-side-encryption-customer-algorithm"
 )
+# Prefixes covering EVERY SSE-C header (algorithm/key/key-md5, direct
+# and copy-source) — what the TLS-only guard matches on, like the
+# reference's crypto.SSEC.IsRequested/SSECopy.IsRequested.
+HDR_SSEC_PREFIX = "x-amz-server-side-encryption-customer-"
+HDR_SSEC_COPY_PREFIX = (
+    "x-amz-copy-source-server-side-encryption-customer-"
+)
 
 
 class SSEError(Exception):

@@ -538,6 +538,81 @@ def test_sts_session_policy_restricts_not_escalates(server, bucket):
     assert temp.request("PUT", f"/{bucket}/escalate.txt", body=b"x")[0] == 403
 
 
+# ---------- generic middleware parity (ref cmd/routers.go:41-80) ----------
+
+
+def test_crossdomain_xml_served_unauthenticated(client):
+    st, h, body = client.request("GET", "/crossdomain.xml",
+                                 anonymous=True)
+    assert st == 200 and b"cross-domain-policy" in body
+    assert "xml" in h.get("Content-Type", "")
+
+
+def test_ssec_over_plaintext_rejected(client, bucket, monkeypatch):
+    """SSE-C key material must never travel a non-TLS connection
+    (ref generic-handlers.go setSSETLSHandler)."""
+    import base64 as _b64
+
+    # Other test modules opt into the proxy-terminated escape hatch.
+    monkeypatch.delenv("MTPU_ALLOW_INSECURE_SSEC", raising=False)
+
+    key = _b64.b64encode(b"K" * 32).decode()
+    st, _, body = client.request(
+        "PUT", f"/{bucket}/ssec.bin", body=b"x",
+        headers={
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key": key,
+        },
+    )
+    assert st == 400 and b"InsecureSSECustomerRequest" in body
+    st, _, body = client.request(
+        "PUT", f"/{bucket}/dst.bin",
+        headers={
+            "x-amz-copy-source": f"/{bucket}/ssec.bin",
+            "x-amz-copy-source-server-side-encryption-customer-algorithm":
+                "AES256",
+        },
+    )
+    assert st == 400 and b"InsecureSSECustomerRequest" in body
+
+
+def test_oversized_content_length_rejected_early(client, bucket):
+    """Declared bodies beyond 5 TiB + form headroom are rejected from
+    the header, never read (ref setRequestSizeLimitHandler)."""
+    import http.client as _hc
+
+    headers = sign_v4_request(
+        SECRET, ACCESS, "PUT", client.host, f"/{bucket}/huge.bin",
+        [], {}, b"",
+    )
+    headers["Content-Length"] = str(6 * 1024 ** 4)
+    conn = _hc.HTTPConnection(client.host, timeout=30)
+    try:
+        conn.putrequest("PUT", f"/{bucket}/huge.bin",
+                        skip_accept_encoding=True)
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        conn.endheaders()
+        # Server must answer from the headers alone, and sever the
+        # connection (unread body bytes would desync keep-alive).
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 400 and b"EntityTooLarge" in body
+        assert resp.getheader("Connection") == "close"
+    finally:
+        conn.close()
+
+
+def test_security_and_cache_headers(client, bucket):
+    st, h, _ = client.request("GET", f"/{bucket}", query=[("location", "")])
+    assert h.get("X-Content-Type-Options") == "nosniff"
+    assert h.get("Content-Security-Policy") == "block-all-mixed-content"
+    assert h.get("x-amz-request-id")
+    # Console pages never cache; S3 data-plane responses are untouched.
+    st, h, _ = client.request("GET", "/minio/console/", anonymous=True)
+    assert h.get("Cache-Control") == "no-store"
+
+
 # ---------- security regression tests (round-2 advisor findings) ----------
 
 

@@ -11,6 +11,14 @@ import sys
 
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _allow_insecure_ssec(monkeypatch):
+    # Test servers speak plain HTTP; SSE-C is normally TLS-only
+    # (setSSETLSHandler parity) — opt out like a proxy-terminated
+    # deploy, scoped to THIS module's tests only.
+    monkeypatch.setenv("MTPU_ALLOW_INSECURE_SSEC", "1")
+
 from minio_tpu.api import S3Server
 from minio_tpu.bucket import BucketMetadataSys
 from minio_tpu.config.config import ConfigSys

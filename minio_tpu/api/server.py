@@ -175,6 +175,34 @@ class Sha256VerifyReader:
         return buf
 
 
+class _BodyCounter:
+    """Innermost body wrapper counting WIRE bytes consumed — the error
+    path severs keep-alive only when unread bytes would desync the
+    stream (see _write)."""
+
+    __slots__ = ("_src", "consumed")
+
+    def __init__(self, src):
+        self._src = src
+        self.consumed = 0
+
+    def read(self, n: int = -1) -> bytes:
+        buf = self._src.read(n)
+        self.consumed += len(buf)
+        return buf
+
+    def readinto(self, b) -> int:
+        ri = getattr(self._src, "readinto", None)
+        if ri is not None:
+            n = ri(b) or 0
+        else:
+            buf = self._src.read(len(b))
+            n = len(buf)
+            b[:n] = buf
+        self.consumed += n
+        return n
+
+
 class RequestContext:
     """Parsed request handed to handlers."""
 
@@ -187,8 +215,13 @@ class RequestContext:
         self.qdict = dict(query)
         self.headers = {k.lower(): v for k, v in headers.items()}
         self.raw_headers = dict(headers)
-        self.body_reader = body_reader
+        self._body_counter = _BodyCounter(body_reader)
+        self.body_reader = self._body_counter
         self.content_length = content_length
+        # content_length is rewritten to the DECODED length for
+        # aws-chunked bodies; the wire length is what the counter
+        # measures against.
+        self.wire_length = content_length
         self._body: bytes | None = None
         self.request_id = uuid.uuid4().hex[:16].upper()
         parts = path.lstrip("/").split("/", 1)
@@ -920,12 +953,15 @@ class S3Server:
     def _write(self, h: BaseHTTPRequestHandler, ctx: RequestContext,
                resp: Response):
         try:
-            if resp.status >= 400 and ctx.content_length:
+            if (resp.status >= 400 and ctx.wire_length
+                    and ctx._body_counter.consumed < ctx.wire_length):
                 # Error responses may fire before the request body was
-                # read (header-only rejects like EntityTooLarge /
+                # fully read (header-only rejects like EntityTooLarge /
                 # InsecureSSECustomerRequest): unread body bytes on a
                 # keep-alive HTTP/1.1 stream would parse as the NEXT
-                # request line — sever instead of desync.
+                # request line — sever instead of desync. A fully-
+                # consumed body (BadDigest after hashing, malformed-XML
+                # POSTs) keeps the pooled connection alive.
                 h.close_connection = True
             h.send_response(resp.status)
             headers = dict(resp.headers)

@@ -308,7 +308,7 @@ class ErasureObjects(MultipartMixin):
 
         tmp_id = new_uuid()
         data_dir = new_uuid()
-        tee = TeeMD5Reader(reader)
+        tee = TeeMD5Reader(reader, size=size)
 
         # Physical per-shard file size (erasure shard + bitrot frames):
         # known up front for sized PUTs, lets O_DIRECT disks fallocate.

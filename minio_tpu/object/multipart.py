@@ -154,7 +154,7 @@ class MultipartMixin:
         erasure = self._object_erasure(k, m)
         disks_by_shard = shuffle_disks(self.disks, fi.erasure.distribution)
 
-        tee = TeeMD5Reader(reader)
+        tee = TeeMD5Reader(reader, size=size)
         # Stage under a tmp name: a re-upload of an existing part number
         # must not clobber the journaled shards until it fully verifies
         # (digest + length), or an aborted retry destroys committed data.

@@ -5,6 +5,8 @@ equivalents of the reference's cmd/object-api-datatypes.go structures.
 from __future__ import annotations
 
 import hashlib
+import os
+import threading
 from dataclasses import dataclass, field
 
 from ..storage.fileinfo import FileInfo
@@ -152,18 +154,73 @@ def compute_etag(data_md5: bytes | None, parts: int = 0) -> str:
 
 
 class TeeMD5Reader:
-    """Wrap a reader, computing md5/size as data flows through — a minimal
-    stand-in for the reference's pkg/hash.Reader."""
+    """Wrap a reader, computing md5/size as data flows through — the
+    stand-in for the reference's pkg/hash.Reader.
 
-    def __init__(self, src):
+    On multicore hosts the md5 (the S3 ETag contract, the dominant
+    serial PUT stage: measured 0.66 GB/s vs encode 11/write 4 on the r4
+    bench host) is PIPELINED: buffers hand off to one hashing thread
+    through a small bounded queue, so hashing batch N overlaps encoding
+    and writing batch N+1 and the PUT ceiling moves from the serial sum
+    of stages toward the slowest single stage. hashlib releases the GIL
+    for >2 KiB updates, so the overlap is real OS-level parallelism. On
+    a 1-core host the overlap cannot exist (measured 0.99x) and inline
+    hashing avoids the queue tax."""
+
+    # Bounded handoff: at most N in-flight buffers so a slow hasher
+    # applies backpressure instead of buffering the whole object.
+    QUEUE_DEPTH = 4
+    # Below this the md5 is microseconds: thread spawn + queue handoff
+    # would cost more than they could ever overlap.
+    PIPELINE_MIN_SIZE = 4 << 20
+
+    def __init__(self, src, pipelined: bool | None = None,
+                 size: int | None = None):
         self._src = src
         self._md5 = hashlib.md5()
         self.bytes_read = 0
+        if pipelined is None:
+            big = size is None or size < 0 or size >= self.PIPELINE_MIN_SIZE
+            pipelined = big and (os.cpu_count() or 1) > 1
+        self._queue = None
+        if pipelined:
+            import queue as _qm
+            import weakref
+
+            q = _qm.Queue(maxsize=self.QUEUE_DEPTH)
+            self._queue = q
+            # The worker closes over (queue, md5) — NOT self — so an
+            # abandoned reader (error path that never reaches md5_hex)
+            # gets garbage-collected, firing the finalizer that shuts
+            # the thread down instead of leaking it on q.get().
+            self._worker = threading.Thread(
+                target=self._hash_loop, args=(q, self._md5),
+                name="mtpu-md5", daemon=True,
+            )
+            self._worker.start()
+            self._finalizer = weakref.finalize(self, q.put, None)
+
+    @staticmethod
+    def _hash_loop(q, md5):
+        while True:
+            buf = q.get()
+            try:
+                if buf is None:
+                    return
+                md5.update(buf)
+            finally:
+                q.task_done()
+
+    def _ingest(self, buf):
+        if self._queue is not None:
+            self._queue.put(buf)
+        else:
+            self._md5.update(buf)
 
     def read(self, n: int = -1) -> bytes:
         buf = self._src.read(n)
         if buf:
-            self._md5.update(buf)
+            self._ingest(buf)  # bytes are immutable: no copy needed
             self.bytes_read += len(buf)
         return buf
 
@@ -176,16 +233,27 @@ class TeeMD5Reader:
         if src_readinto is not None:
             n = src_readinto(view)
             if n:
-                self._md5.update(view[:n])
+                # The caller owns (and will reuse) this buffer — the
+                # async hasher needs a snapshot. bytes() is a ~9 GB/s
+                # memcpy; the hash it unblocks is 0.66 GB/s.
+                self._ingest(bytes(view[:n]) if self._queue is not None
+                             else view[:n])
                 self.bytes_read += n
             return n or 0
         buf = self._src.read(len(view))
         n = len(buf)
         if n:
             view[:n] = buf
-            self._md5.update(buf)
+            self._ingest(buf)
             self.bytes_read += n
         return n
 
     def md5_hex(self) -> str:
+        if self._queue is not None:
+            # Drain the pipeline exactly once; subsequent calls read the
+            # settled digest.
+            self._finalizer.detach()
+            self._queue.put(None)
+            self._worker.join()
+            self._queue = None
         return self._md5.hexdigest()

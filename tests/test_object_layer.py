@@ -253,3 +253,95 @@ def test_empty_object(layer):
     z.put_object("bkt", "empty", io.BytesIO(b""), 0)
     assert z.get_object_bytes("bkt", "empty") == b""
     assert z.get_object_info("bkt", "empty").size == 0
+
+
+# ---------- pipelined ETag hashing (r5 PUT-stage overlap) ----------
+
+
+def test_tee_md5_pipelined_matches_inline():
+    """The pipelined (worker-thread) hasher produces the identical
+    digest as inline hashing through read() AND readinto() — including
+    when the caller clobbers the readinto buffer immediately after
+    consumption (the async snapshot contract)."""
+    import hashlib
+
+    from minio_tpu.object.types import TeeMD5Reader
+
+    data = os.urandom(5 << 20)
+    want = hashlib.md5(data).hexdigest()
+    for pipelined in (False, True):
+        t = TeeMD5Reader(io.BytesIO(data), pipelined=pipelined)
+        got = b""
+        while True:
+            chunk = t.read(1 << 20)
+            if not chunk:
+                break
+            got += chunk
+        assert got == data
+        assert t.md5_hex() == want
+        assert t.md5_hex() == want  # idempotent after drain
+
+        t2 = TeeMD5Reader(io.BytesIO(data), pipelined=pipelined)
+        buf = bytearray(1 << 20)
+        while True:
+            n = t2.readinto(buf)
+            if not n:
+                break
+            buf[:n] = b"\x00" * n  # clobber after the pipeline consumed
+        assert t2.bytes_read == len(data)
+        assert t2.md5_hex() == want, f"pipelined={pipelined}"
+
+
+def test_tee_md5_abandoned_reader_stops_worker():
+    """An error path that never reaches md5_hex must not leak the
+    hashing thread: GC of the reader shuts it down."""
+    import gc
+    import threading
+    import time
+
+    from minio_tpu.object.types import TeeMD5Reader
+
+    before = threading.active_count()
+    t = TeeMD5Reader(io.BytesIO(os.urandom(1 << 20)), pipelined=True)
+    t.read(1 << 20)
+    del t
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline and threading.active_count() > before:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
+def test_put_uses_pipelined_etag_correctly(tmp_path):
+    """End-to-end: a PUT through the object layer with the pipelined
+    hasher forced on yields the correct S3 ETag."""
+    import hashlib
+
+    from minio_tpu.object import types as types_mod
+
+    ol, _ = (lambda r: (r[0], r[1]))(make_pools(tmp_path))
+    ol.make_bucket("pipetag")
+    data = os.urandom(3 << 20)
+    orig = types_mod.TeeMD5Reader
+
+    class ForcedPipelined(orig):
+        def __init__(self, src, pipelined=None, size=None):
+            super().__init__(src, pipelined=True, size=size)
+
+    types_mod.TeeMD5Reader = ForcedPipelined
+    try:
+        import minio_tpu.object.erasure_objects as eo
+
+        saved = eo.TeeMD5Reader
+        eo.TeeMD5Reader = ForcedPipelined
+        try:
+            oi = ol.put_object("pipetag", "obj", io.BytesIO(data),
+                               len(data), ObjectOptions())
+        finally:
+            eo.TeeMD5Reader = saved
+    finally:
+        types_mod.TeeMD5Reader = orig
+    assert oi.etag == hashlib.md5(data).hexdigest()
+    sink = io.BytesIO()
+    ol.get_object("pipetag", "obj", sink)
+    assert sink.getvalue() == data

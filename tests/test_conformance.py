@@ -456,3 +456,227 @@ def test_upload_part_copy_conditionals(cl):
     assert st == 412 and _err_code(body) == "PreconditionFailed"
     cl.request("DELETE", f"/{BKT}/pc-obj",
                query=[("uploadId", upload_id)])
+
+
+# --- r5 SDK-grade depth: listing interactions, UploadPartCopy ranges,
+# presigned flows (ref cmd/server_test.go TestListObjectsHandler /
+# TestCopyObjectPartHandler / presigned cases) ---
+
+LIST_KEYS = [
+    "photos/2021/a.jpg",
+    "photos/2021/b.jpg",
+    "photos/2022/c.jpg",
+    "photos/top.jpg",
+    "videos/v1.mp4",
+    "sp ace/uni✓.bin",
+    "zz-last.txt",
+]
+
+
+def _seed_listing(cl):
+    for k in LIST_KEYS:
+        st, _, _ = cl.request("PUT", f"/{BKT}/{k}", body=b"x")
+        assert st == 200, k
+
+
+def _xml(body: bytes):
+    return ET.fromstring(body)
+
+
+def _by_local(root, tag):
+    # iter() has no {*} wildcard support — match on the local name.
+    return [el for el in root.iter() if _tag(el) == tag]
+
+
+def _text(root, tag):
+    return root.findtext(tag) or root.findtext("{*}" + tag)
+
+
+def _contents_keys(root):
+    return [el.findtext("{*}Key") or el.findtext("Key")
+            for el in _by_local(root, "Contents")]
+
+
+def _common_prefixes(root):
+    return [el.findtext("{*}Prefix") or el.findtext("Prefix")
+            for el in _by_local(root, "CommonPrefixes")]
+
+
+def test_listing_delimiter_prefix_interactions(cl):
+    _seed_listing(cl)
+    # Top-level delimiter grouping (v2).
+    st, _, body = cl.request(
+        "GET", f"/{BKT}", query=[("list-type", "2"), ("delimiter", "/")]
+    )
+    assert st == 200
+    root = _xml(body)
+    prefixes = set(_common_prefixes(root))
+    assert {"photos/", "videos/", "sp ace/", "dir/"} <= prefixes
+    keys = set(_contents_keys(root))
+    assert "zz-last.txt" in keys
+    assert not any(k.startswith("photos/") for k in keys)
+    # prefix + delimiter: directs contents vs deeper groups.
+    st, _, body = cl.request(
+        "GET", f"/{BKT}",
+        query=[("list-type", "2"), ("delimiter", "/"),
+               ("prefix", "photos/")],
+    )
+    root = _xml(body)
+    assert set(_common_prefixes(root)) == {"photos/2021/", "photos/2022/"}
+    assert set(_contents_keys(root)) == {"photos/top.jpg"}
+
+
+def test_listing_v1_marker_pagination(cl):
+    _seed_listing(cl)
+    seen = []
+    marker = ""
+    for _ in range(50):
+        q = [("max-keys", "2")]
+        if marker:
+            q.append(("marker", marker))
+        st, _, body = cl.request("GET", f"/{BKT}", query=q)
+        assert st == 200
+        root = _xml(body)
+        page = _contents_keys(root)
+        assert len(page) <= 2
+        seen += page
+        if _text(root, "IsTruncated") != "true":
+            break
+        assert page, "truncated page returned no keys"
+        # NextMarker is only guaranteed WITH a delimiter; without one
+        # clients continue from the last key returned (AWS semantics).
+        marker = page[-1]
+    assert seen == sorted(set(seen))  # lexicographic order, NO dups
+    assert set(seen) == set(LIST_KEYS) | {OBJ}
+
+
+def test_listing_v2_continuation_pagination(cl):
+    _seed_listing(cl)
+    seen = []
+    token = ""
+    for _ in range(50):
+        q = [("list-type", "2"), ("max-keys", "3")]
+        if token:
+            q.append(("continuation-token", token))
+        st, _, body = cl.request("GET", f"/{BKT}", query=q)
+        assert st == 200
+        root = _xml(body)
+        seen += _contents_keys(root)
+        if _text(root, "IsTruncated") != "true":
+            break
+        token = _text(root, "NextContinuationToken")
+        assert token
+    assert seen == sorted(set(seen))
+    assert set(seen) == set(LIST_KEYS) | {OBJ}
+
+
+def test_listing_start_after_and_encoding(cl):
+    _seed_listing(cl)
+    st, _, body = cl.request(
+        "GET", f"/{BKT}",
+        query=[("list-type", "2"), ("start-after", "videos/")],
+    )
+    root = _xml(body)
+    assert set(_contents_keys(root)) == {"videos/v1.mp4", "zz-last.txt"}
+    # encoding-type=url percent-encodes keys (space, unicode).
+    st, _, body = cl.request(
+        "GET", f"/{BKT}",
+        query=[("list-type", "2"), ("encoding-type", "url"),
+               ("prefix", "sp ace/")],
+    )
+    root = _xml(body)
+    keys = _contents_keys(root)
+    assert len(keys) == 1
+    assert "%20" in keys[0] or "+" in keys[0]
+    assert "✓" not in keys[0]
+    import urllib.parse as _up
+
+    assert _up.unquote_plus(keys[0]) == "sp ace/uni✓.bin"
+
+
+def test_upload_part_copy_ranges(cl):
+    src = b"".join(bytes([i % 251]) * 4096 for i in range(1600))  # 6.25 MiB
+    assert cl.request("PUT", f"/{BKT}/range-src", body=src)[0] == 200
+    st, _, body = cl.request("POST", f"/{BKT}/assembled",
+                             query=[("uploads", "")])
+    assert st == 200
+    up = _text(_xml(body), "UploadId")
+    cut = 5 * 1024 * 1024
+    etags = []
+    for num, rng in ((1, f"bytes=0-{cut - 1}"),
+                     (2, f"bytes={cut}-{len(src) - 1}")):
+        st, h, body = cl.request(
+            "PUT", f"/{BKT}/assembled",
+            query=[("partNumber", str(num)), ("uploadId", up)],
+            headers={"x-amz-copy-source": f"/{BKT}/range-src",
+                     "x-amz-copy-source-range": rng},
+        )
+        assert st == 200, (rng, body)
+        etags.append(_text(_xml(body), "ETag").strip('"'))
+    # Malformed range -> InvalidArgument; out-of-bounds -> 416-class.
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/assembled",
+        query=[("partNumber", "3"), ("uploadId", up)],
+        headers={"x-amz-copy-source": f"/{BKT}/range-src",
+                 "x-amz-copy-source-range": "bytes=nope"},
+    )
+    assert st == 400 and _err_code(body) == "InvalidArgument"
+    st, _, body = cl.request(
+        "PUT", f"/{BKT}/assembled",
+        query=[("partNumber", "3"), ("uploadId", up)],
+        headers={"x-amz-copy-source": f"/{BKT}/range-src",
+                 "x-amz-copy-source-range":
+                     f"bytes={len(src) + 10}-{len(src) + 20}"},
+    )
+    assert st in (400, 416), body
+    complete = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags)
+    ) + "</CompleteMultipartUpload>"
+    st, _, body = cl.request(
+        "POST", f"/{BKT}/assembled", query=[("uploadId", up)],
+        body=complete.encode(),
+    )
+    assert st == 200, body
+    st, _, got = cl.request("GET", f"/{BKT}/assembled")
+    assert st == 200 and got == src
+
+
+def test_presigned_get_put_and_expiry(cl):
+    import http.client as _hc
+
+    from minio_tpu.api.sign import presign_v4
+
+    host = cl.host
+    # Presigned PUT uploads without an Authorization header.
+    qs = presign_v4(SECRET, ACCESS, "PUT", host, f"/{BKT}/pre-up.bin")
+    conn = _hc.HTTPConnection(host, timeout=10)
+    conn.request("PUT", f"/{BKT}/pre-up.bin?{qs}", body=b"via-presign")
+    assert conn.getresponse().status == 200
+    conn.close()
+    # Presigned GET returns it.
+    qs = presign_v4(SECRET, ACCESS, "GET", host, f"/{BKT}/pre-up.bin")
+    conn = _hc.HTTPConnection(host, timeout=10)
+    conn.request("GET", f"/{BKT}/pre-up.bin?{qs}")
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b"via-presign"
+    conn.close()
+    # Expired URL -> 403 (ref cmd/signature-v4.go doesPresignedSignatureMatch).
+    import datetime as _dt
+
+    old = _dt.datetime.now(_dt.timezone.utc) - _dt.timedelta(seconds=120)
+    qs = presign_v4(SECRET, ACCESS, "GET", host, f"/{BKT}/pre-up.bin",
+                    expires=60, now=old)
+    conn = _hc.HTTPConnection(host, timeout=10)
+    conn.request("GET", f"/{BKT}/pre-up.bin?{qs}")
+    r = conn.getresponse()
+    body = r.read()
+    assert r.status == 403, body
+    conn.close()
+    # Tampered signature -> 403.
+    qs = presign_v4(SECRET, ACCESS, "GET", host, f"/{BKT}/pre-up.bin")
+    bad = qs[:-6] + "abcdef"
+    conn = _hc.HTTPConnection(host, timeout=10)
+    conn.request("GET", f"/{BKT}/pre-up.bin?{bad}")
+    assert conn.getresponse().status == 403
+    conn.close()

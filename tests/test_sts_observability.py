@@ -178,6 +178,12 @@ def test_profiling_and_healthinfo_and_audit(srv):
     assert info["host"]["cpus"] >= 1
     assert len(info["disks"]) == 4
     assert all(d["state"] == "ok" for d in info["disks"])
+    # SMART subset per block device (ref pkg/smart; sysfs-level —
+    # every entry is a dict with at least its source marker, plus
+    # identity/thermal attrs wherever the platform exposes them).
+    for bd in info["sys"]["block_devices"]:
+        assert isinstance(bd["smart"], dict)
+        assert bd["smart"].get("source") == "sysfs"
 
 
 def test_trace_full_call_records_and_verbose_bodies(tmp_path):

@@ -162,7 +162,8 @@ def test_scanner_applies_transition_rule(stack, tmp_path):
     bm.update("auto", "lifecycle_xml", (
         '<LifecycleConfiguration><Rule><Status>Enabled</Status>'
         '<Filter><Prefix></Prefix></Filter>'
-        '<Transition><Days>0</Days><StorageClass>COLD</StorageClass>'
+        '<Transition><Date>2020-01-01T00:00:00Z</Date>'
+            '<StorageClass>COLD</StorageClass>'
         '</Transition></Rule></LifecycleConfiguration>'
     ))
     scanner = DataScanner(ol, bucket_meta=bm, tier_engine=engine)

@@ -10,6 +10,16 @@ from __future__ import annotations
 import os
 
 
+def _read_sysfs(path: str) -> str:
+    """One sysfs/proc file -> stripped text ('' on any error) — the one
+    reader every probe in this module goes through."""
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except (OSError, UnicodeDecodeError):
+        return ""
+
+
 def mounts() -> list[dict]:
     """Parsed /proc/mounts (device, mountpoint, fstype, options) —
     pkg/disk.GetInfo's mount table, minus pseudo filesystems."""
@@ -43,11 +53,7 @@ def block_devices() -> list[dict]:
         return out
 
     def read(dev, rel):
-        try:
-            with open(f"/sys/block/{dev}/{rel}") as f:
-                return f.read().strip()
-        except OSError:
-            return ""
+        return _read_sysfs(f"/sys/block/{dev}/{rel}")
 
     for dev in names:
         if dev.startswith(("loop", "ram", "zram")):
@@ -73,14 +79,7 @@ def smart_info(dev: str) -> dict:
     identity (vendor/serial/firmware), NVMe thermal + capacity state
     under hwmon/nvme class dirs, and error counters where present."""
     base = f"/sys/block/{dev}"
-
-    def read(path):
-        try:
-            with open(path) as f:
-                return f.read().strip()
-        except OSError:
-            return ""
-
+    read = _read_sysfs
     out: dict = {"source": "sysfs"}
     for key, rel in (
         ("vendor", "device/vendor"),
@@ -184,11 +183,7 @@ def net_interfaces() -> list[dict]:
         return out
     for dev in names:
         def read(rel, d=dev):
-            try:
-                with open(f"/sys/class/net/{d}/{rel}") as f:
-                    return f.read().strip()
-            except OSError:
-                return ""
+            return _read_sysfs(f"/sys/class/net/{d}/{rel}")
 
         spd = read("speed")
         out.append({

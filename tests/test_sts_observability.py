@@ -240,3 +240,48 @@ def test_trace_full_call_records_and_verbose_bodies(tmp_path):
         assert not srv.trace.any_verbose  # unsubscribe cleared it
     finally:
         srv.stop()
+
+
+def test_smart_info_structure(tmp_path):
+    """smart_info is meaningful independent of host block layout: a
+    synthetic sysfs tree exercises identity, thermal, and the sparse-
+    device note paths (the healthinfo loop above is vacuous on hosts
+    with no real disks)."""
+    from minio_tpu.utils import sysinfo
+
+    dev = tmp_path / "sda"
+    (dev / "device" / "hwmon" / "hwmon0").mkdir(parents=True)
+    (dev / "device" / "vendor").write_text("ACME\n")
+    (dev / "device" / "serial").write_text("SN123\n")
+    (dev / "device" / "hwmon" / "hwmon0" / "temp1_input").write_text(
+        "36500\n"
+    )
+    orig = sysinfo._read_sysfs
+
+    def fake_read(path):
+        return orig(path.replace("/sys/block/sda", str(dev)))
+
+    import os as _os
+    from unittest import mock
+
+    real_listdir = _os.listdir
+
+    def fake_listdir(path):
+        if str(path).startswith("/sys/block/sda"):
+            return real_listdir(
+                str(path).replace("/sys/block/sda", str(dev))
+            )
+        return real_listdir(path)
+
+    sysinfo._read_sysfs = fake_read
+    try:
+        with mock.patch("os.listdir", side_effect=fake_listdir):
+            got = sysinfo.smart_info("sda")
+    finally:
+        sysinfo._read_sysfs = orig
+    assert got["source"] == "sysfs"
+    assert got["vendor"] == "ACME" and got["serial"] == "SN123"
+    assert got["temp_c"] == 36.5
+    # A device exposing nothing gets the explicit note, never a bare {}.
+    empty = sysinfo.smart_info("definitely-not-a-device")
+    assert empty["source"] == "sysfs" and "note" in empty

@@ -218,6 +218,10 @@ class RequestContext:
         self._body_counter = _BodyCounter(body_reader)
         self.body_reader = self._body_counter
         self.content_length = content_length
+        # What the client signed over: equals `path` for path-style;
+        # _handle overrides it with the pre-rewrite path for
+        # virtual-host requests.
+        self.auth_path = path
         # content_length is rewritten to the DECODED length for
         # aws-chunked bodies; the wire length is what the counter
         # measures against.
@@ -439,8 +443,20 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0, metrics=None,
                  trace=None, config_sys=None, notification=None,
                  sse_config=None, quota=None, tier_engine=None,
-                 tiers=None, logger=None, tls=None):
+                 tiers=None, logger=None, tls=None,
+                 domains: list[str] | None = None):
         from ..replication import ReplicationPool
+
+        # Virtual-host-style bucket addressing: Host = <bucket>.<domain>
+        # rewrites to path-style (ref cmd/handler-utils.go getResource,
+        # MINIO_DOMAIN). `minio.<domain>` is reserved for path-style.
+        if domains is None:
+            domains = [
+                d.strip().lower().strip(".")
+                for d in os.environ.get("MTPU_DOMAIN", "").split(",")
+                if d.strip()
+            ]
+        self.domains = domains
 
         self.repl_pool = ReplicationPool(
             object_layer, bucket_meta, sse_config=sse_config
@@ -573,6 +589,33 @@ class S3Server:
 
     # --- request pipeline ---
 
+    def _resolve_vhost(self, host: str, path: str) -> str:
+        """Host `<bucket>.<domain>[:port]` -> `/<bucket><path>`
+        (ref handler-utils.go getResource). `minio.<domain>` stays
+        path-style so operators keep a path-style endpoint under the
+        same domain, and console/admin/health prefixes are never
+        bucket-rewritten."""
+        if not self.domains or not host:
+            return path
+        # Reserved route namespaces (health probes, metrics scrapes,
+        # console, crossdomain) answer the same on every vhost — never
+        # bucket-rewritten (the reference excludes them from bucket-DNS
+        # routing the same way).
+        if (path == "/crossdomain.xml" or path == "/minio"
+                or path.startswith("/minio/")):
+            return path
+        host = host.rsplit(":", 1)[0].lower() if host.count(":") <= 1 \
+            else host.lower()  # bare IPv6 hosts carry multiple colons
+        for domain in self.domains:
+            if host == f"minio.{domain}" or host == domain:
+                continue
+            suffix = "." + domain
+            if host.endswith(suffix):
+                bucket = host[: -len(suffix)]
+                if bucket and "." not in bucket:
+                    return f"/{bucket}{path}"
+        return path
+
     def _handle(self, h: BaseHTTPRequestHandler):
         parsed = urllib.parse.urlsplit(h.path)
         query = urllib.parse.parse_qsl(
@@ -584,10 +627,15 @@ class S3Server:
             LimitedReader(h.rfile, content_length)
             if content_length is not None else io.BytesIO(b"")
         )
+        raw_path = urllib.parse.unquote(parsed.path)
+        path = self._resolve_vhost(h.headers.get("Host", ""), raw_path)
         ctx = RequestContext(
-            h.command, urllib.parse.unquote(parsed.path), query,
+            h.command, path, query,
             dict(h.headers), body_reader, content_length,
         )
+        # Signatures are computed by clients over the path AS SENT —
+        # for virtual-host requests that excludes the bucket.
+        ctx.auth_path = raw_path
         import time as _time
 
         t0 = _time.monotonic_ns()
@@ -784,7 +832,8 @@ class S3Server:
             if ctx.method not in ("GET", "HEAD"):
                 raise S3Error("MethodNotAllowed", ctx.method)
             auth_result = authenticate(
-                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+                self.iam, ctx.method, ctx.auth_path, ctx.query,
+                ctx.raw_headers
             )
             self.admin.authorize(auth_result, "metrics_snapshot")
             return self.admin.metrics_snapshot(ctx)
@@ -806,7 +855,8 @@ class S3Server:
                 return handle_sts(ctx, self.iam, "",
                                   config=self.handlers.config)
             auth_result = authenticate(
-                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+                self.iam, ctx.method, ctx.auth_path, ctx.query,
+                ctx.raw_headers
             )
             if auth_result.is_anonymous:
                 raise S3Error("AccessDenied", "STS requires signature")
@@ -818,7 +868,8 @@ class S3Server:
             name = self.admin.route(ctx)
             ctx.api_name = f"admin:{name}"
             auth_result = authenticate(
-                self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+                self.iam, ctx.method, ctx.auth_path, ctx.query,
+                ctx.raw_headers
             )
             if auth_result.auth == AUTH_STREAMING:
                 raise S3Error("NotImplemented", "streaming admin request")
@@ -869,7 +920,8 @@ class S3Server:
             # authTypePostPolicy branch).
             return self.handlers.post_policy_object(ctx)
         auth_result = authenticate(
-            self.iam, ctx.method, ctx.path, ctx.query, ctx.raw_headers
+            self.iam, ctx.method, ctx.auth_path, ctx.query,
+            ctx.raw_headers
         )
         action = _ACTIONS.get(name, "s3:*")
         if ctx.method in ("PUT", "POST", "DELETE"):

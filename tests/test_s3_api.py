@@ -876,3 +876,91 @@ def test_list_multipart_uploads_encoding_type(client, bucket):
     assert st == 200
     assert b"<EncodingType>url</EncodingType>" in raw
     assert b"mp%20enc%2Bkey" in raw
+
+
+def test_virtual_host_style_addressing(tmp_path):
+    """Host: <bucket>.<domain> requests resolve to the bucket with
+    signatures verified over the path AS SENT (ref handler-utils.go
+    getResource + MINIO_DOMAIN); minio.<domain> stays path-style."""
+    import http.client as _hc
+
+    from minio_tpu.api import S3Server
+    from minio_tpu.api.sign import presign_v4
+    from minio_tpu.bucket import BucketMetadataSys
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.object.pools import ErasureServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.storage.local import LocalStorage
+
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    sets = ErasureSets(
+        disks, 4, deployment_id="0dddba52-4f2e-4d69-92f5-926a51824ff1",
+        pool_index=0,
+    )
+    sets.init_format()
+    ol = ErasureServerPools([sets])
+    srv = S3Server(ol, IAMSys(ACCESS, SECRET), BucketMetadataSys(ol),
+                   domains=["dev.example"]).start()
+    try:
+        real_host = srv.endpoint
+        port = real_host.rsplit(":", 1)[1]
+
+        def vreq(method, vhost, path, body=b"", query=None, sign=True):
+            q = query or []
+            conn = _hc.HTTPConnection(real_host, timeout=10)
+            if sign:
+                # The client signs over the VIRTUAL host + bucket-less
+                # path, exactly as an SDK in virtual-host mode would.
+                hdrs = sign_v4_request(SECRET, ACCESS, method, vhost,
+                                       path, q, {}, body)
+            else:
+                hdrs = {"Host": vhost}
+            hdrs["Host"] = vhost
+            full = path + (("?" + urllib.parse.urlencode(q)) if q else "")
+            conn.request(method, full, body=body, headers=hdrs)
+            r = conn.getresponse()
+            data = r.read()
+            conn.close()
+            return r.status, data
+
+        vhost = f"vbkt.dev.example:{port}"
+        st, body = vreq("PUT", vhost, "/")  # CreateBucket, vhost style
+        assert st == 200, body
+        st, _ = vreq("PUT", vhost, "/hello.txt", body=b"vhost!")
+        assert st == 200
+        st, data = vreq("GET", vhost, "/hello.txt")
+        assert st == 200 and data == b"vhost!"
+        # Same object is visible path-style.
+        cl = Client(srv)
+        st, _, data = cl.request("GET", "/vbkt/hello.txt")
+        assert st == 200 and data == b"vhost!"
+        # Listing via vhost root.
+        st, data = vreq("GET", vhost, "/", query=[("list-type", "2")])
+        assert st == 200 and b"hello.txt" in data
+        # Presigned URL in virtual-host form.
+        qs = presign_v4(SECRET, ACCESS, "GET", vhost, "/hello.txt")
+        conn = _hc.HTTPConnection(real_host, timeout=10)
+        conn.request("GET", f"/hello.txt?{qs}", headers={"Host": vhost})
+        r = conn.getresponse()
+        assert r.status == 200 and r.read() == b"vhost!"
+        conn.close()
+        # minio.<domain> is reserved: stays path-style.
+        mhost = f"minio.dev.example:{port}"
+        st, data = vreq("GET", mhost, "/vbkt/hello.txt")
+        assert st == 200 and data == b"vhost!"
+        # Reserved route namespaces answer on EVERY vhost, never
+        # bucket-rewritten: health stays unauthenticated 200.
+        conn = _hc.HTTPConnection(real_host, timeout=10)
+        conn.request("GET", "/minio/health/live", headers={"Host": vhost})
+        assert conn.getresponse().status == 200
+        conn.close()
+        # Hosts under a NON-configured domain never rewrite: the same
+        # bucket-like label resolves path-style only.
+        ohost = f"vbkt.other.example:{port}"
+        st, data = vreq("GET", ohost, "/vbkt/hello.txt")
+        assert st == 200 and data == b"vhost!"
+        st, data = vreq("GET", ohost, "/hello.txt")
+        assert st == 404 and b"NoSuchBucket" in data
+    finally:
+        srv.stop()

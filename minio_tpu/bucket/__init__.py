@@ -1,6 +1,10 @@
 """Per-bucket configuration subsystems (metadata, policy, versioning,
 lifecycle, quota — reference: cmd/bucket-metadata-sys.go, pkg/bucket/*)."""
 
+from .lifecycle import Lifecycle, LifecycleError, Rule, RuleFilter
 from .metadata import BucketMetadata, BucketMetadataSys
 
-__all__ = ["BucketMetadata", "BucketMetadataSys"]
+__all__ = [
+    "BucketMetadata", "BucketMetadataSys",
+    "Lifecycle", "LifecycleError", "Rule", "RuleFilter",
+]

@@ -1,6 +1,8 @@
 """Server-side encryption (SSE-C / SSE-S3 envelope crypto) — reference:
 cmd/encryption-v1.go, cmd/crypto/."""
 
+from .kes import KESClient, KESKMS, kms_from_config
+from .kms import KMSError, LocalKMS
 from .sse import (
     SSEConfig,
     SSEError,
@@ -14,4 +16,5 @@ from .sse import (
 __all__ = [
     "SSEConfig", "SSEError", "is_encrypted", "parse_ssec_key",
     "resolve_decryption_key", "setup_encryption", "wants_sse_s3",
+    "KESClient", "KESKMS", "kms_from_config", "KMSError", "LocalKMS",
 ]

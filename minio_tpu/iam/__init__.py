@@ -1,10 +1,12 @@
 """Identity and access management: policy engine, users/groups/service
 accounts, STS credentials (reference: cmd/iam.go, pkg/iam/policy)."""
 
+from .etcd import EtcdIAMBackend, EtcdKV
 from .policy import CANNED_POLICIES, Args, Policy, Statement
 from .store import Credentials, IAMStore, IAMSys, ObjectStoreBackend
 
 __all__ = [
     "CANNED_POLICIES", "Args", "Policy", "Statement",
     "Credentials", "IAMStore", "IAMSys", "ObjectStoreBackend",
+    "EtcdIAMBackend", "EtcdKV",
 ]

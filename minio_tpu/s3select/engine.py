@@ -389,6 +389,90 @@ def _fmt_ts(t) -> str:
     return s.replace("+00:00", "Z")
 
 
+def _extract_part(part: str, t) -> int:
+    """EXTRACT(part FROM ts) (ref timestampfuncs.go extract)."""
+    if part == "year":
+        return t.year
+    if part == "month":
+        return t.month
+    if part == "day":
+        return t.day
+    if part == "hour":
+        return t.hour
+    if part == "minute":
+        return t.minute
+    if part == "second":
+        return t.second
+    off = t.utcoffset()
+    secs = int(off.total_seconds()) if off is not None else 0
+    # Truncate toward zero like the reference's Go integer division:
+    # -05:30 is hour -5 / minute -30, never floor's -6 / +30.
+    sign, mag = (-1, -secs) if secs < 0 else (1, secs)
+    if part == "timezone_hour":
+        return sign * (mag // 3600)
+    if part == "timezone_minute":
+        return sign * ((mag % 3600) // 60)
+    raise SQLError(f"EXTRACT: unknown part {part!r}")
+
+
+def _date_add(part: str, qty: float, t):
+    """DATE_ADD(part, qty, ts): calendar add for YEAR/MONTH/DAY,
+    duration add below that (ref timestampfuncs.go dateAdd)."""
+    import datetime as _dt
+
+    q = int(qty)
+    if part == "year":
+        return _replace_ymd(t, t.year + q, t.month, t.day)
+    if part == "month":
+        m = t.month - 1 + q
+        return _replace_ymd(t, t.year + m // 12, m % 12 + 1, t.day)
+    if part == "day":
+        return t + _dt.timedelta(days=q)
+    if part == "hour":
+        return t + _dt.timedelta(hours=q)
+    if part == "minute":
+        return t + _dt.timedelta(minutes=q)
+    if part == "second":
+        return t + _dt.timedelta(seconds=q)
+    raise SQLError(f"DATE_ADD: unknown part {part!r}")
+
+
+def _replace_ymd(t, year: int, month: int, day: int):
+    """Calendar-safe replace: Jan 31 + 1 MONTH clamps to the target
+    month's last day (Go's AddDate normalizes Feb 31 -> Mar 2/3; AWS
+    clamps — we follow AWS since SQL users expect month arithmetic,
+    and the reference's behavior here is an acknowledged Go artifact)."""
+    import calendar
+
+    day = min(day, calendar.monthrange(year, month)[1])
+    return t.replace(year=year, month=month, day=day)
+
+
+def _date_diff(part: str, t1, t2) -> int:
+    """DATE_DIFF(part, ts1, ts2) (ref timestampfuncs.go dateDiff):
+    YEAR counts whole anniversary years, MONTH counts calendar-month
+    boundaries, DAY/HOUR/MINUTE/SECOND are truncated duration."""
+    if t2 < t1:
+        return -_date_diff(part, t2, t1)
+    dur_s = (t2 - t1).total_seconds()
+    if part == "year":
+        dy = t2.year - t1.year
+        if (t2.month, t2.day) >= (t1.month, t1.day):
+            return dy
+        return dy - 1
+    if part == "month":
+        return (t2.year * 12 + t2.month) - (t1.year * 12 + t1.month)
+    if part == "day":
+        return int(dur_s // 86400)
+    if part == "hour":
+        return int(dur_s // 3600)
+    if part == "minute":
+        return int(dur_s // 60)
+    if part == "second":
+        return int(dur_s)
+    raise SQLError(f"DATE_DIFF: unknown part {part!r}")
+
+
 def _query_utcnow() -> str:
     import datetime as _dt
 
@@ -512,6 +596,49 @@ def _scalar_fn_values(term, batch: _Batch) -> tuple[np.ndarray, str]:
                       and str(a[i]) == str(b[i])) else a[i]
              for i in range(n)], dtype=object,
         ), "any"
+    if name == "extract":
+        part = args[0][1]
+        src = vals(args[1])
+        return np.array(
+            [None if v is None else _extract_part(part, _parse_ts(str(v)))
+             for v in src], dtype=object,
+        ), "num"
+    if name == "date_add":
+        part = args[0][1]
+        qty = vals(args[1])
+        src = vals(args[2])
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if qty[i] is None or src[i] is None:
+                out[i] = None
+                continue
+            try:
+                q = float(qty[i])
+            except (TypeError, ValueError):
+                raise SQLError(
+                    "DATE_ADD: QUANTITY must be numeric"
+                ) from None
+            try:
+                out[i] = _fmt_ts(
+                    _date_add(part, q, _parse_ts(str(src[i])))
+                )
+            except SQLError:
+                raise
+            except (OverflowError, ValueError) as exc:
+                # Unrepresentable results (huge/inf quantities, dates
+                # past year 9999) are the CLIENT's error, never a 500.
+                raise SQLError(f"DATE_ADD: {exc}") from exc
+        return out, "str"
+    if name == "date_diff":
+        part = args[0][1]
+        a = vals(args[1])
+        b = vals(args[2])
+        return np.array(
+            [None if (a[i] is None or b[i] is None)
+             else _date_diff(part, _parse_ts(str(a[i])),
+                             _parse_ts(str(b[i])))
+             for i in range(n)], dtype=object,
+        ), "num"
     raise SQLError(f"unsupported function {name!r}")
 
 

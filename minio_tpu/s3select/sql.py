@@ -14,7 +14,10 @@ Supported:
            FROM n [FOR m] | s, n[, m]) | CHAR_LENGTH(s) |
            CHARACTER_LENGTH(s) | LOWER(s) | UPPER(s) | TRIM([BOTH|
            LEADING|TRAILING] [chars FROM] s) | UTCNOW() |
-           TO_TIMESTAMP(s) | COALESCE(a, b, ...) | NULLIF(a, b)
+           TO_TIMESTAMP(s) | COALESCE(a, b, ...) | NULLIF(a, b) |
+           EXTRACT(YEAR|MONTH|DAY|HOUR|MINUTE|SECOND|TIMEZONE_HOUR|
+           TIMEZONE_MINUTE FROM ts) | DATE_ADD(part, qty, ts) |
+           DATE_DIFF(part, ts1, ts2)
            (ref pkg/s3select/sql/funceval.go:37-69, stringfuncs.go,
            timestampfuncs.go)
   expr  := comparisons (= != <> < <= > >=), LIKE, IN (...),
@@ -58,6 +61,9 @@ _KEYWORDS = {
     "to_timestamp", "coalesce", "nullif", "for", "both", "leading",
     "trailing", "int", "integer", "float", "decimal", "numeric", "string",
     "bool", "boolean", "timestamp",
+    "extract", "date_add", "date_diff",
+    "year", "month", "day", "hour", "minute", "second",
+    "timezone_hour", "timezone_minute",
 }
 
 _AGGS = {"count", "sum", "avg", "min", "max"}
@@ -66,7 +72,15 @@ _AGGS = {"count", "sum", "avg", "min", "max"}
 _SCALAR_FNS = {
     "cast", "substring", "char_length", "character_length", "lower",
     "upper", "trim", "utcnow", "to_timestamp", "coalesce", "nullif",
+    "extract", "date_add", "date_diff",
 }
+
+# Date parts accepted by EXTRACT / DATE_ADD / DATE_DIFF
+# (ref pkg/s3select/sql/parser.go Timeword set; the TZ parts are
+# EXTRACT-only like the reference).
+_TIME_PARTS = {"year", "month", "day", "hour", "minute", "second",
+               "timezone_hour", "timezone_minute"}
+_ARITH_TIME_PARTS = _TIME_PARTS - {"timezone_hour", "timezone_minute"}
 
 _CAST_TYPES = {
     "int": "int", "integer": "int", "float": "float", "decimal": "float",
@@ -243,6 +257,30 @@ class _Parser:
                 raise SQLError("SUBSTRING needs (s FROM n [FOR m])")
             close()
             return ("fn", "substring", args)
+        if fn == "extract":
+            # EXTRACT(YEAR FROM ts) — timeword, then FROM, then operand
+            # (ref parser.go ExtractFunc).
+            k, v = self.next()
+            if k != "kw" or v not in _TIME_PARTS:
+                raise SQLError(f"EXTRACT: unknown date part {v!r}")
+            self.expect_kw("from")
+            arg = self.operand(alias)
+            close()
+            return ("fn", "extract", [("lit", v), arg])
+        if fn in ("date_add", "date_diff"):
+            # DATE_ADD(DAY, qty, ts) / DATE_DIFF(DAY, ts1, ts2)
+            # (ref parser.go DateAddFunc/DateDiffFunc).
+            k, v = self.next()
+            if k != "kw" or v not in _ARITH_TIME_PARTS:
+                raise SQLError(f"{fn.upper()}: unknown date part {v!r}")
+            if not self.accept_op(","):
+                raise SQLError(f"{fn.upper()}: expected ,")
+            a2 = self.operand(alias)
+            if not self.accept_op(","):
+                raise SQLError(f"{fn.upper()}: expected ,")
+            a3 = self.operand(alias)
+            close()
+            return ("fn", fn, [("lit", v), a2, a3])
         if fn == "trim":
             mode = "both"
             k, v = self.peek()

@@ -582,3 +582,106 @@ def test_quote_fields_always():
     assert SelectRequest.from_xml(xml).output_quote_fields == "ALWAYS"
     with pytest.raises(SQLError):
         SelectRequest.from_xml(xml.replace(b"ALWAYS", b"SOMETIMES"))
+
+
+def test_extract_parts():
+    """EXTRACT(part FROM ts) (ref sql/timestampfuncs.go extract)."""
+    out, _ = _run("SELECT EXTRACT(YEAR FROM TO_TIMESTAMP("
+                  "'2026-07-30T15:42:10Z')) FROM S3Object LIMIT 1")
+    assert out.strip() == "2026"
+    for part, want in (("MONTH", "7"), ("DAY", "30"), ("HOUR", "15"),
+                       ("MINUTE", "42"), ("SECOND", "10"),
+                       ("TIMEZONE_HOUR", "0"), ("TIMEZONE_MINUTE", "0")):
+        out, _ = _run(f"SELECT EXTRACT({part} FROM TO_TIMESTAMP("
+                      f"'2026-07-30T15:42:10Z')) FROM S3Object LIMIT 1")
+        assert out.strip() == want, part
+    # Offset timestamps expose their zone.
+    out, _ = _run("SELECT EXTRACT(TIMEZONE_HOUR FROM TO_TIMESTAMP("
+                  "'2026-07-30T15:42:10+05:30')) FROM S3Object LIMIT 1")
+    assert out.strip() == "5"
+    out, _ = _run("SELECT EXTRACT(TIMEZONE_MINUTE FROM TO_TIMESTAMP("
+                  "'2026-07-30T15:42:10+05:30')) FROM S3Object LIMIT 1")
+    assert out.strip() == "30"
+    # Negative offsets truncate toward zero (Go semantics): -05:30 is
+    # hour -5 / minute -30, never floor's -6 / +30.
+    out, _ = _run("SELECT EXTRACT(TIMEZONE_HOUR FROM TO_TIMESTAMP("
+                  "'2026-07-30T15:42:10-05:30')) FROM S3Object LIMIT 1")
+    assert out.strip() == "-5"
+    out, _ = _run("SELECT EXTRACT(TIMEZONE_MINUTE FROM TO_TIMESTAMP("
+                  "'2026-07-30T15:42:10-05:30')) FROM S3Object LIMIT 1")
+    assert out.strip() == "-30"
+
+
+def test_date_add():
+    """DATE_ADD(part, qty, ts) (ref sql/timestampfuncs.go dateAdd)."""
+    cases = [
+        ("YEAR", "1", "2027-07-30T00:00:00Z"),
+        ("MONTH", "7", "2027-02-28T00:00:00Z"),  # Jul 30 +7mo clamps
+        ("DAY", "3", "2026-08-02T00:00:00Z"),
+        ("HOUR", "26", "2026-07-31T02:00:00Z"),
+        ("MINUTE", "-90", "2026-07-29T22:30:00Z"),
+        ("SECOND", "61", "2026-07-30T00:01:01Z"),
+    ]
+    for part, qty, want in cases:
+        out, _ = _run(f"SELECT DATE_ADD({part}, {qty}, TO_TIMESTAMP("
+                      f"'2026-07-30')) FROM S3Object LIMIT 1")
+        assert out.strip() == want, (part, qty, out)
+
+
+def test_date_diff():
+    """DATE_DIFF(part, ts1, ts2) (ref sql/timestampfuncs.go dateDiff):
+    YEAR counts whole anniversary years, MONTH calendar boundaries,
+    smaller parts truncate the duration; reversed operands negate."""
+    cases = [
+        ("YEAR", "2025-08-01", "2026-07-30", "0"),   # not a full year yet
+        ("YEAR", "2025-07-30", "2026-07-30", "1"),
+        ("MONTH", "2026-01-31", "2026-02-01", "1"),  # calendar boundary
+        ("DAY", "2026-07-28T12:00:00Z", "2026-07-30T11:00:00Z", "1"),
+        ("HOUR", "2026-07-30T00:00:00Z", "2026-07-30T02:30:00Z", "2"),
+        ("MINUTE", "2026-07-30T00:00:00Z", "2026-07-30T00:01:59Z", "1"),
+        ("SECOND", "2026-07-30T00:00:00Z", "2026-07-30T00:00:42Z", "42"),
+    ]
+    for part, t1, t2, want in cases:
+        out, _ = _run(
+            f"SELECT DATE_DIFF({part}, TO_TIMESTAMP('{t1}'), "
+            f"TO_TIMESTAMP('{t2}')) FROM S3Object LIMIT 1"
+        )
+        assert out.strip() == want, (part, t1, t2, out)
+    out, _ = _run(
+        "SELECT DATE_DIFF(DAY, TO_TIMESTAMP('2026-07-30'), "
+        "TO_TIMESTAMP('2026-07-20')) FROM S3Object LIMIT 1"
+    )
+    assert out.strip() == "-10"
+
+
+def test_date_add_overflow_is_client_error():
+    """Huge/unrepresentable quantities raise SQLError (a 4xx), never an
+    uncaught OverflowError."""
+    import pytest as _pt
+
+    for qty in ("999999999999", "99999999999999999999"):
+        with _pt.raises(SQLError):
+            _run(f"SELECT DATE_ADD(DAY, {qty}, TO_TIMESTAMP("
+                 f"'2026-01-01')) FROM S3Object LIMIT 1")
+
+
+def test_date_fns_in_where():
+    """Date functions compose with WHERE like any scalar."""
+    out, _ = _run(
+        "SELECT name FROM S3Object WHERE "
+        "EXTRACT(YEAR FROM TO_TIMESTAMP('2026-07-30')) = 2026 LIMIT 1"
+    )
+    assert out.strip() == "alice"
+
+
+def test_date_fn_parse_errors():
+    import pytest as _pt
+
+    from minio_tpu.s3select.sql import SQLError, parse
+
+    with _pt.raises(SQLError):
+        parse("SELECT EXTRACT(EPOCH FROM x) FROM S3Object")
+    with _pt.raises(SQLError):
+        parse("SELECT DATE_ADD(TIMEZONE_HOUR, 1, x) FROM S3Object")
+    with _pt.raises(SQLError):
+        parse("SELECT DATE_DIFF(DAY, x) FROM S3Object")

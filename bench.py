@@ -97,9 +97,13 @@ def _mk_set(root: str, n_disks: int, parity: int):
     return es, disks
 
 
-def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
-    """Host-fed 12+4 streaming encode into bitrot writers on real files —
-    the reference's BenchmarkErasureEncode conditions."""
+def _hostfed_encode_best(root: str, prefix: str, payload: bytes, reps: int,
+                         mk_src, finish=None,
+                         telemetry: str = "put") -> float:
+    """Best-of-reps GB/s for a host-fed 12+4 encode_stream into
+    streaming bitrot writers on real files — the shared scaffolding
+    behind the headline number and the pipelined-PUT stage measurement
+    (16 disks, per-rep sinks, timing, per-rep shard cleanup)."""
     from minio_tpu.erasure.bitrot import BitrotAlgorithm, StreamingBitrotWriter
     from minio_tpu.erasure.codec import Erasure
     from minio_tpu.erasure.streaming import encode_stream
@@ -107,14 +111,12 @@ def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
 
     erasure = Erasure(12, 4, MIB)
     disks = [
-        LocalStorage(os.path.join(root, f"enc{i}"), endpoint=f"e{i}")
+        LocalStorage(os.path.join(root, f"{prefix}{i}"),
+                     endpoint=f"{prefix}{i}")
         for i in range(16)
     ]
     for d in disks:
         d.make_vol("bench")
-    payload = np.random.default_rng(0).integers(
-        0, 256, total_mib * MIB, np.uint8
-    ).tobytes()
     best = 0.0
     for rep in range(reps):
         sinks = [
@@ -125,8 +127,11 @@ def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
             StreamingBitrotWriter(s, BitrotAlgorithm.HIGHWAYHASH256S)
             for s in sinks
         ]
+        src = mk_src()
         t0 = time.perf_counter()
-        encode_stream(erasure, io.BytesIO(payload), writers, 13)
+        encode_stream(erasure, src, writers, 13, telemetry=telemetry)
+        if finish is not None:
+            finish(src)
         dt = time.perf_counter() - t0
         for s in sinks:
             s.close()
@@ -137,8 +142,18 @@ def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
             except Exception:  # noqa: BLE001
                 pass
     for i in range(16):
-        _cleanup(os.path.join(root, f"enc{i}"))
+        _cleanup(os.path.join(root, f"{prefix}{i}"))
     return best
+
+
+def bench_headline_encode(root: str, total_mib: int = 64, reps: int = 3):
+    """Host-fed 12+4 streaming encode into bitrot writers on real files —
+    the reference's BenchmarkErasureEncode conditions."""
+    payload = np.random.default_rng(0).integers(
+        0, 256, total_mib * MIB, np.uint8
+    ).tobytes()
+    return _hostfed_encode_best(root, "enc", payload, reps,
+                                lambda: io.BytesIO(payload))
 
 
 def bench_encode_only(total_mib: int = 64, reps: int = 3) -> float:
@@ -437,6 +452,28 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
         pair_inv = 1.0 / out["md5_gbps"] + 1.0 / out["encode_gbps"]
         inv_pipe = (inv - pair_inv) + pair_inv / max(speedup, 1.0)
         out["model_put_gbps_pipelined"] = round(1.0 / inv_pipe, 3)
+    # The REAL pipelined PUT stream end to end: TeeMD5Reader →
+    # encode_stream on the staged pipeline (pipeline/executor.py:
+    # source-read ∥ md5 ∥ encode ∥ bitrot-frame ∥ shard-write over
+    # pooled strip buffers) → bitrot writers on real files. GB/s of
+    # INPUT bytes — directly comparable to model_put_gbps: exceeding it
+    # means the stages genuinely overlap instead of running
+    # back-to-back.
+    from minio_tpu.object.types import TeeMD5Reader
+
+    pdir = os.path.join(root, "stages-pipe")
+    out["pipeline_put_gbps"] = round(_hostfed_encode_best(
+        pdir, "pipe", payload, 3,
+        lambda: TeeMD5Reader(_ZeroCopyReader(payload), size=nbytes),
+        finish=lambda tee: tee.md5_hex(),  # PUT drains the hash pre-commit
+        telemetry="bench-put",
+    ), 3)
+    _cleanup(pdir)
+    # Per-stage telemetry of those runs (items/busy/starve/stall per
+    # stage) — the same counters the metrics endpoint exports.
+    from minio_tpu.pipeline import stage_stats_snapshot
+
+    out["pipeline_stages"] = stage_stats_snapshot("bench-put")
     return out
 
 

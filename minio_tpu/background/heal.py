@@ -57,7 +57,18 @@ class MRFHealer:
 
 def heal_erasure_set(object_layer, buckets: list[str] | None = None) -> dict:
     """Full sweep heal of every object (fresh-disk path,
-    ref cmd/global-heal.go:154 healErasureSet)."""
+    ref cmd/global-heal.go:154 healErasureSet).
+
+    Runs on the staged pipeline (pipeline/executor.py): the listing
+    walk (metacache/disk IO) feeds a bounded queue that the heal stage
+    (shard reads + reconstruction + writes) drains, so enumerating the
+    next listing page overlaps healing the previous one — on a fresh
+    disk with millions of objects the sweep is otherwise serialized on
+    alternating list/heal IO. Bounded depth keeps at most one page of
+    names in memory; a heal failure is counted, never fatal (parity
+    with the reference's per-object error tolerance)."""
+    from ..pipeline import Pipeline, Stage
+
     result = {"buckets": 0, "objects": 0, "failed": 0}
     names = buckets
     if names is None:
@@ -65,20 +76,38 @@ def heal_erasure_set(object_layer, buckets: list[str] | None = None) -> dict:
             b.name for b in object_layer.list_buckets()
             if not b.name.startswith(".")
         ]
-    for bucket in names:
-        result["buckets"] += 1
-        marker = ""
-        while True:
-            res = object_layer.list_objects(
-                bucket, marker=marker, max_keys=1000
-            )
-            for oi in res.objects:
-                try:
-                    object_layer.heal_object(bucket, oi.name)
-                    result["objects"] += 1
-                except Exception:  # noqa: BLE001 count failures
-                    result["failed"] += 1
-            if not res.is_truncated:
-                break
-            marker = res.next_marker
+
+    def listing():
+        for bucket in names:
+            result["buckets"] += 1
+            marker = ""
+            while True:
+                res = object_layer.list_objects(
+                    bucket, marker=marker, max_keys=1000
+                )
+                for oi in res.objects:
+                    yield (bucket, oi.name)
+                if not res.is_truncated:
+                    break
+                marker = res.next_marker
+
+    def heal_one(item):
+        bucket, name = item
+        try:
+            object_layer.heal_object(bucket, name)
+            result["objects"] += 1
+        except Exception:  # noqa: BLE001 count failures
+            result["failed"] += 1
+        return item
+
+    from ..utils.fanout import SINGLE_CORE
+
+    if SINGLE_CORE:
+        # Same fanout policy as the erasure drivers: stage threads on a
+        # single core only add dispatch cost over the serial sweep.
+        for item in listing():
+            heal_one(item)
+    else:
+        Pipeline("heal-sweep", [Stage("heal", heal_one)],
+                 queue_depth=64).run(listing())
     return result

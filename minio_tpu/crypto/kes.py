@@ -89,31 +89,46 @@ class KESClient:
     def _request(self, method: str, path: str, body: bytes | None = None):
         last: Exception | None = None
         headers = {"Content-Type": "application/json"} if body else {}
+        # True once ANY attempt (this endpoint or an earlier one) wrote
+        # its request bytes but lost the response — from then on the
+        # server may have executed the operation.
+        maybe_executed = False
         for ep in self.endpoints:
             # Two tries per endpoint: a pooled keep-alive socket may
             # have idled out — retry once on a fresh connection.
             for attempt in (0, 1):
                 conn = self._acquire(ep)
+                sent = False
                 try:
                     conn.request(method, path, body=body,
                                  headers=headers)
+                    sent = True
                     resp = conn.getresponse()
                     data = resp.read()
                 except (OSError, ssl.SSLError,
                         http.client.HTTPException) as exc:
                     last = exc
+                    if sent:
+                        maybe_executed = True
                     try:
                         conn.close()
                     except OSError:
                         pass
                     continue
-                if resp.status == 409 and attempt == 1:
-                    # The retried request's FIRST send may have executed
-                    # before its connection died — a conflict on the
-                    # retry means /v1/key/create already succeeded, not
-                    # a genuine duplicate (create is the only 409 op).
+                if resp.status == 409 and maybe_executed:
+                    # An earlier send of THIS request executed before
+                    # its connection died — a conflict now means
+                    # /v1/key/create already succeeded, not a genuine
+                    # duplicate (create is the only 409 op). KES
+                    # replicas share the key store, so the earlier
+                    # send may have landed on a different endpoint.
+                    # Guarded on maybe_executed: with no bytes ever on
+                    # the wire before, a 409 is a real KeyAlreadyExists
+                    # and falls through to the error path below. The
+                    # KES error body is NOT a success payload —
+                    # swallow it.
                     self._release(ep, conn)
-                    return data
+                    return b""
                 if resp.status >= 500:
                     # Server-side failure: fall through to the next
                     # endpoint like a connection error — 4xx stays

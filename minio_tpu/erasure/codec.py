@@ -235,9 +235,20 @@ class Erasure:
         Fusing the HighwayHash-256 of every output shard into the same
         dispatch replaces the reference's per-shard host hashing inside
         parallelWriter (cmd/erasure-encode.go:93 + bitrot-streaming.go:48).
+
+        `blocks` may already be a DEVICE array — the pipelined host-feed
+        stage (ops/rs_pallas.HostFeed) stages the H2D transfer of batch
+        N+1 while batch N computes; coercing it through numpy here would
+        silently pull it back to the host and undo the overlap.
         """
-        blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+        staged_on_device = not isinstance(blocks, np.ndarray) and hasattr(
+            blocks, "block_until_ready"
+        )
+        if not staged_on_device:
+            blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
         engine = _select_engine(blocks.shape[-1])
+        if staged_on_device and engine != "device":
+            blocks = np.asarray(blocks)  # tiny-shard fallback: host engines
         if engine == "native":
             # Synchronous but fast (GFNI/SSSE3); the writers hash each
             # shard with the native AVX2 HighwayHash, so no fused-digest

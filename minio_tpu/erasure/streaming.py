@@ -121,38 +121,172 @@ class ParallelWriter:
         self._fanout(do)
 
 
+class _StripFiller:
+    """Reads a byte stream into [k, B*S] strip buffers, preserving the
+    split/zero-pad semantics of Erasure.split. Shared by the serial and
+    pipelined encode drivers so their tail/empty-object handling cannot
+    drift.
+
+    readinto sources scatter straight into the strip rows (one copy);
+    others take the read()+scatter fallback. A short trailing read comes
+    back as `tail` bytes for the host encode_data path; a zero-byte
+    stream yields the empty-object sentinel tail b"" exactly once."""
+
+    def __init__(self, erasure: Erasure, src, batch_blocks: int):
+        self.src = src
+        self.batch_blocks = batch_blocks
+        self.k = erasure.data_blocks
+        self.shard = erasure.shard_size()
+        self.block_size = erasure.block_size
+        self.pad = self.k * self.shard - self.block_size  # last-row zero pad
+        self.can_readinto = hasattr(src, "readinto")
+        self.eof = False
+        self.produced = False  # anything (strips or tail) handed out yet
+
+    def _fill_block(self, buf: np.ndarray, col: int) -> int:
+        """Read one block directly into buf[:, col:col+shard]; returns
+        bytes read (0 on EOF, < block_size on a short tail read that the
+        caller must re-handle via the bytes path)."""
+        got = 0
+        k, shard, pad = self.k, self.shard, self.pad
+        for j in range(k):
+            want = shard if j < k - 1 else shard - pad
+            view = memoryview(buf[j, col: col + want])
+            while want:
+                n = self.src.readinto(view[len(view) - want:])
+                if not n:
+                    return got
+                got += n
+                want -= n
+        if pad:
+            buf[k - 1, col + shard - pad: col + shard] = 0
+        return got
+
+    def fill(self, buf: np.ndarray) -> tuple[int, bytes | None]:
+        """Fill up to batch_blocks blocks into `buf`; returns (nb, tail).
+        Sets self.eof when the source is exhausted."""
+        nb = 0
+        tail: bytes | None = None
+        k, shard, block_size = self.k, self.shard, self.block_size
+        while nb < self.batch_blocks:
+            if self.can_readinto:
+                col = nb * shard
+                got = self._fill_block(buf, col)
+                if got < block_size:
+                    self.eof = True
+                    if got or (not nb and not self.produced):
+                        # Reassemble the short tail for the bytes path.
+                        parts = []
+                        left = got
+                        for j in range(k):
+                            take = min(left, shard)
+                            parts.append(buf[j, col: col + take].tobytes())
+                            left -= take
+                            if left == 0:
+                                break
+                        tail = b"".join(parts)
+                    break
+            else:
+                b = _read_full(self.src, block_size)
+                if len(b) < block_size:
+                    self.eof = True
+                    if b or (not nb and not self.produced):
+                        tail = b
+                    break
+                arr = np.frombuffer(b, dtype=np.uint8)
+                col = nb * shard
+                for j in range(k):
+                    row = arr[j * shard: (j + 1) * shard]
+                    buf[j, col: col + len(row)] = row
+                    if len(row) < shard:
+                        buf[j, col + len(row): col + shard] = 0
+            nb += 1
+        if nb or tail is not None:
+            self.produced = True
+        return nb, tail
+
+
 def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
-                  batch_blocks: int = 8) -> int:
+                  batch_blocks: int = 8, telemetry: str = "put") -> int:
     """Read the full stream, erasure-encode, fan out to bitrot writers.
 
     Returns total bytes consumed (ref Erasure.Encode,
     cmd/erasure-encode.go:73-109).
 
-    TPU-shaped pipeline (SURVEY §7.2(4)): `batch_blocks` full blocks are
-    dispatched to the device as one [B, k, S] batch — parity matmul AND
-    the per-shard HighwayHash fused in one compiled unit — and the
-    dispatch is ASYNC: while the device computes batch N, the host fans
-    out the writes of batch N-1 and reads batch N+1 from the source.
-    The short tail block is encoded alone on the host.
+    On multicore hosts both engines run on the staged pipeline
+    (pipeline/executor.py): source-read ∥ md5 (delegated from
+    TeeMD5Reader into its own stage) ∥ GF encode ∥
+    bitrot-frame+shard-write run as overlapped stages over pooled strip
+    buffers, with bounded queues for backpressure and first-error
+    cancellation. `telemetry` labels the per-stage counters ("put",
+    "multipart", ...) on the metrics endpoint. A single-core host keeps
+    the serial drivers — stage threads there only add dispatch cost
+    (the measured fanout policy in utils/fanout.py).
     """
     from .codec import _select_engine
 
     writer = ParallelWriter(writers, quorum)
-    block_size = erasure.block_size
     shard = erasure.shard_size()
-    if _select_engine(shard) == "native":
-        # Host-native engine: the batched strip pipeline (no device
-        # round-trip to overlap; one GFNI encode + one framing call per
-        # shard per batch).
-        return _encode_stream_native(
-            erasure, src, writer, batch_blocks
-        )
-    total = 0
-    k = erasure.data_blocks
     want_digests = any(
         getattr(w, "device_hashable", False) for w in writers if w is not None
     )
+    engine = _select_engine(shard)
+    if engine == "native":
+        # Host-native engine: the batched strip path (one GFNI encode +
+        # one framing call per shard per batch).
+        if _SINGLE_CORE:
+            return _encode_stream_native(erasure, src, writer, batch_blocks)
+        return _encode_stream_native_pipelined(
+            erasure, src, writer, batch_blocks, telemetry
+        )
+    if _SINGLE_CORE:
+        return _encode_stream_batched(
+            erasure, src, writer, batch_blocks, want_digests
+        )
+    return _encode_stream_batched_pipelined(
+        erasure, src, writer, batch_blocks, want_digests, engine, telemetry
+    )
+
+
+def _gather_batches(src, block_size: int, batch_blocks: int):
+    """Yield (full_blocks, tail) gathers for the block-list drivers: up
+    to batch_blocks full byte blocks per item, plus the short trailing
+    read as `tail` (b"" is the empty-object sentinel, emitted exactly
+    once; None when the stream ended on a block boundary). The single
+    owner of the gather/tail/sentinel logic for both batched drivers —
+    _StripFiller is its strip-layout counterpart."""
     eof = False
+    produced = False
+    while not eof:
+        bufs: list[bytes] = []
+        while len(bufs) < batch_blocks:
+            b = _read_full(src, block_size)
+            if len(b) < block_size:
+                eof = True
+                if b or (not produced and not bufs):
+                    bufs.append(b)  # short tail / empty-object sentinel
+                break
+            bufs.append(b)
+        if not bufs:
+            break
+        produced = True
+        full = [b for b in bufs if len(b) == block_size]
+        tail = next((b for b in bufs if len(b) < block_size), None)
+        yield (full, tail)
+
+
+def _encode_stream_batched(erasure: Erasure, src, writer: ParallelWriter,
+                           batch_blocks: int, want_digests: bool) -> int:
+    """Serial driver for the device/numpy engines (SURVEY §7.2(4)):
+    `batch_blocks` full blocks ship to the device as one [B, k, S] batch
+    — parity matmul AND the per-shard HighwayHash fused in one compiled
+    unit — and the dispatch is ASYNC: while the device computes batch N,
+    the host fans out the writes of batch N-1 and reads batch N+1. The
+    short tail block is encoded alone on the host."""
+    total = 0
+    block_size = erasure.block_size
+    k = erasure.data_blocks
+    shard = erasure.shard_size()
     pending = None  # (data [B,k,S], parity_future, hashes_future, n_blocks)
 
     def flush(p) -> None:
@@ -171,21 +305,7 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
             writer.write(blocks, digests)
             total += block_size
 
-    while not eof:
-        # Gather up to batch_blocks full blocks.
-        bufs: list[bytes] = []
-        while len(bufs) < batch_blocks:
-            buf = _read_full(src, block_size)
-            if len(buf) < block_size:
-                eof = True
-                if buf or (total == 0 and not bufs):
-                    bufs.append(buf)  # short tail, or empty-object sentinel
-                break
-            bufs.append(buf)
-        if not bufs:
-            break
-
-        full = [b for b in bufs if len(b) == block_size]
+    for full, tail in _gather_batches(src, block_size, batch_blocks):
         if full:
             # Each block zero-pads to k*shard (split semantics) before the
             # [B, k, S] batch is shipped to the device.
@@ -199,97 +319,179 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
             if pending is not None:
                 flush(pending)  # overlap: batch N computes while N-1 writes
             pending = (data, parity_f, hashes_f, len(full))
-        # Tail (or empty-object sentinel): host path, after the batches.
-        for b in bufs:
-            if len(b) == block_size:
-                continue
+        if tail is not None:
+            # Tail (or empty-object sentinel): host path, after the batches.
             if pending is not None:
                 flush(pending)
                 pending = None
-            blocks = erasure.encode_data(b)
-            writer.write(blocks)
-            total += len(b)
+            writer.write(erasure.encode_data(tail))
+            total += len(tail)
     if pending is not None:
         flush(pending)
     return total
 
 
+def _encode_stream_batched_pipelined(erasure: Erasure, src,
+                                     writer: ParallelWriter,
+                                     batch_blocks: int, want_digests: bool,
+                                     engine: str, telemetry: str) -> int:
+    """Pipelined driver for the device/numpy engines: read → pack →
+    host-feed (double-buffered H2D staging, ops/rs_pallas.HostFeed) →
+    fused dispatch → flush+write as overlapped stages. The H2D transfer
+    of batch N+1 proceeds while the MXU computes batch N and the host
+    writes batch N-1 — device feeding is no longer serialized on any
+    single host thread."""
+    from ..pipeline import SKIP, Pipeline, Stage, shared_pool
+
+    block_size = erasure.block_size
+    k = erasure.data_blocks
+    shard = erasure.shard_size()
+    md5_update = None
+    if hasattr(src, "delegate_hashing"):
+        src, md5_update = src.delegate_hashing()
+    # Capacity covers the max in-flight window (one buffer per stage +
+    # one per queue + the feeder's) so steady state never drops a
+    # buffer past the freelist and re-faults it next batch.
+    pool = shared_pool(
+        ("blocks", batch_blocks, k, shard),
+        lambda: np.empty((batch_blocks, k * shard), dtype=np.uint8),
+        capacity=8, name="blocks",
+    )
+    totals = {"bytes": 0}
+
+    def md5_stage(item):
+        full, tail = item
+        for b in full:
+            md5_update(b)
+        if tail:
+            md5_update(tail)
+        return item
+
+    def pack(item):
+        full, tail = item
+        if not full:
+            return (None, None, tail)
+        buf = pool.acquire()
+        for bi, b in enumerate(full):
+            row = buf[bi]
+            row[:block_size] = np.frombuffer(b, dtype=np.uint8)
+            row[block_size:] = 0  # split's zero pad (buffers are recycled)
+        data = buf[: len(full)].reshape(len(full), k, shard)
+        return (buf, data, tail)
+
+    feed = None
+    if engine == "device":
+        from ..ops.rs_pallas import HostFeed
+
+        feed = HostFeed()
+
+    def h2d(item):
+        buf, data, tail = item
+        if data is None or feed is None:
+            return item
+        return (buf, feed(data), tail)
+
+    def dispatch(item):
+        buf, data, tail = item
+        if data is None:
+            return (None, None, None, None, tail)
+        parity_f, hashes_f = erasure.encode_batch_async(
+            data, with_hashes=want_digests
+        )
+        return (buf, data, parity_f, hashes_f, tail)
+
+    def flush(item):
+        buf, data, parity_f, hashes_f, tail = item
+        out = 0
+        try:
+            if data is not None:
+                # D2H only the parity/hashes; the data shards are still
+                # host-resident in the pooled buffer.
+                parity = np.asarray(parity_f)
+                hashes = (np.asarray(hashes_f) if hashes_f is not None
+                          else None)
+                n = parity.shape[0]
+                host = buf[:n].reshape(n, k, shard)
+                for bi in range(n):
+                    blocks = (
+                        [host[bi, j] for j in range(erasure.data_blocks)]
+                        + [parity[bi, j]
+                           for j in range(erasure.parity_blocks)]
+                    )
+                    digests = (
+                        [hashes[bi, j].tobytes()
+                         for j in range(erasure.total_shards)]
+                        if hashes is not None else None
+                    )
+                    writer.write(blocks, digests)
+                    out += block_size
+        finally:
+            pool.release(buf)
+        if tail is not None:
+            writer.write(erasure.encode_data(tail))
+            out += len(tail)
+        totals["bytes"] += out
+        return out or SKIP
+
+    def run_inline(item):
+        if md5_update is not None:
+            md5_stage(item)
+        out = dispatch(h2d(pack(item)))
+        flush(out)
+
+    # Single-batch streams gain nothing from a linear pipeline (the one
+    # item passes through the stages back-to-back either way): run the
+    # stages inline, no thread spin-up. The first gather alone decides
+    # — a short gather (partial batch or tail present) means the stream
+    # ended inside it, so no second serial read delays the pipeline.
+    src_iter = _gather_batches(src, block_size, batch_blocks)
+    try:
+        first = next(src_iter)
+    except StopIteration:
+        return 0
+    if len(first[0]) < batch_blocks or first[1] is not None:
+        run_inline(first)
+        return totals["bytes"]
+
+    def source_from_peeked():
+        yield first
+        yield from src_iter
+
+    stages = []
+    if md5_update is not None:
+        stages.append(Stage("md5", md5_stage,
+                            bytes_of=lambda it: sum(len(b)
+                                                    for b in it[0])))
+    stages.append(Stage("pack", pack))
+    if feed is not None:
+        stages.append(Stage(feed.name, h2d,
+                            bytes_of=lambda it: it[1].nbytes))
+    stages += [
+        Stage("dispatch", dispatch),
+        Stage("flush-write", flush, bytes_of=int),
+    ]
+    Pipeline(telemetry, stages, queue_depth=1,
+             pools=[pool]).run(source_from_peeked())
+    return totals["bytes"]
+
+
 def _encode_stream_native(erasure: Erasure, src, writer: ParallelWriter,
                           batch_blocks: int) -> int:
-    """Strip-based host pipeline: gather B full blocks as [k, B*S] strips
-    (columns of the GF matmul are independent, so B blocks fuse into one
-    2-D native encode), then one framing+write call per shard. Python
-    per-block work drops to a single scatter copy."""
+    """Serial strip driver for the host-native engine (single-core
+    hosts): gather B full blocks as [k, B*S] strips (columns of the GF
+    matmul are independent, so B blocks fuse into one 2-D native
+    encode), then one framing+write call per shard. Python per-block
+    work drops to a single scatter copy."""
     from ..ops import gf_native
 
     total = 0
-    block_size = erasure.block_size
     k = erasure.data_blocks
     m = erasure.parity_blocks
     shard = erasure.shard_size()
+    filler = _StripFiller(erasure, src, batch_blocks)
     buf = np.empty((k, batch_blocks * shard), dtype=np.uint8)
-    eof = False
-    wrote_anything = False
-
-    # readinto scatters source bytes straight into the strip rows (one
-    # copy); readers without readinto take the read()+scatter fallback.
-    can_readinto = hasattr(src, "readinto")
-    pad = k * shard - block_size  # split's zero pad, lives in the last row
-
-    def _fill_block(col: int) -> int:
-        """Read one block directly into buf[:, col:col+shard]; returns
-        bytes read (0 on EOF, < block_size on a short tail read that the
-        caller must re-handle via the bytes path)."""
-        got = 0
-        for j in range(k):
-            want = shard if j < k - 1 else shard - pad
-            view = memoryview(buf[j, col: col + want])
-            while want:
-                n = src.readinto(view[len(view) - want:])
-                if not n:
-                    return got
-                got += n
-                want -= n
-        if pad:
-            buf[k - 1, col + shard - pad: col + shard] = 0
-        return got
-
-    while not eof:
-        nb = 0
-        tail: bytes | None = None
-        while nb < batch_blocks:
-            if can_readinto:
-                col = nb * shard
-                got = _fill_block(col)
-                if got < block_size:
-                    eof = True
-                    if got or (total == 0 and not nb and not wrote_anything):
-                        # Reassemble the short tail for the bytes path.
-                        parts = []
-                        left = got
-                        for j in range(k):
-                            take = min(left, shard)
-                            parts.append(buf[j, col: col + take].tobytes())
-                            left -= take
-                            if left == 0:
-                                break
-                        tail = b"".join(parts)
-                    break
-            else:
-                b = _read_full(src, block_size)
-                if len(b) < block_size:
-                    eof = True
-                    if b or (total == 0 and not nb and not wrote_anything):
-                        tail = b
-                    break
-                arr = np.frombuffer(b, dtype=np.uint8)
-                col = nb * shard
-                for j in range(k):
-                    row = arr[j * shard: (j + 1) * shard]
-                    buf[j, col: col + len(row)] = row
-                    if len(row) < shard:
-                        buf[j, col + len(row): col + shard] = 0
-            nb += 1
+    while not filler.eof:
+        nb, tail = filler.fill(buf)
         if nb:
             strips = buf[:, : nb * shard]
             parity = gf_native.apply_matrix(erasure._parity_mat, strips)
@@ -298,14 +500,148 @@ def _encode_stream_native(erasure: Erasure, src, writer: ParallelWriter,
                 + [parity[i] for i in range(m)],
                 shard,
             )
-            total += nb * block_size
-            wrote_anything = True
+            total += nb * erasure.block_size
         if tail is not None:
-            blocks = erasure.encode_data(tail)
-            writer.write(blocks)
+            writer.write(erasure.encode_data(tail))
             total += len(tail)
-            wrote_anything = True
     return total
+
+
+def _encode_stream_native_pipelined(erasure: Erasure, src,
+                                    writer: ParallelWriter,
+                                    batch_blocks: int,
+                                    telemetry: str) -> int:
+    """Pipelined strip driver for the host-native engine — the PUT hot
+    path on every bench host. Overlapped stages over pooled [k, B*S]
+    strip buffers:
+
+        source-read (feeder thread)
+          → md5 (delegated from TeeMD5Reader; digests the strip rows)
+            → GF encode (native GFNI/SSSE3, releases the GIL)
+              → bitrot-frame + shard-write (native hh256_frame + fd
+                writes through the IO pool)
+
+    so the md5/encode/frame/write stages that BENCH_r05 measured
+    back-to-back (md5_overlap_speedup 0.978) proceed concurrently;
+    bounded queues give backpressure against a slow disk, and a write
+    failure past quorum cancels the read/encode stages promptly.
+
+    When `src` is a TeeMD5Reader it delegates hashing to a dedicated
+    md5 stage that digests the pooled strip buffers directly (in
+    stream order, zero copies) — the tee's own per-read snapshot+queue
+    handoff measures SLOWER than the hash itself under GIL contention,
+    while a whole-batch update releases the GIL for ~8 MiB at a time."""
+    from ..ops import gf_native
+    from ..pipeline import Pipeline, Stage, shared_pool
+
+    k = erasure.data_blocks
+    m = erasure.parity_blocks
+    shard = erasure.shard_size()
+    block_size = erasure.block_size
+    md5_update = None
+    if hasattr(src, "delegate_hashing"):
+        src, md5_update = src.delegate_hashing()
+    filler = _StripFiller(erasure, src, batch_blocks)
+    # Capacity covers the max in-flight window at queue_depth=1 (one
+    # buffer per stage + one per queue + the feeder's) so steady state
+    # never drops a buffer past the freelist and re-faults it.
+    pool = shared_pool(
+        ("strips", k, batch_blocks, shard),
+        lambda: np.empty((k, batch_blocks * shard), dtype=np.uint8),
+        capacity=8, name="strips",
+    )
+    totals = {"bytes": 0}
+
+    def strips_source():
+        while not filler.eof:
+            buf = pool.acquire()
+            nb, tail = filler.fill(buf)
+            if nb == 0:
+                pool.release(buf)
+                if tail is None:
+                    break
+                yield (None, 0, tail)
+            else:
+                yield (buf, nb, tail)
+
+    def md5_stage(item):
+        # Digest the original stream bytes from the strip layout: per
+        # block, rows j hold consecutive byte ranges (the split
+        # semantics), so walking rows in order reproduces the stream.
+        buf, nb, tail = item
+        for b in range(nb):
+            col = b * shard
+            left = block_size
+            for j in range(k):
+                take = min(left, shard)
+                md5_update(buf[j, col: col + take])
+                left -= take
+                if left == 0:
+                    break
+        if tail:
+            md5_update(tail)
+        return item
+
+    def encode(item):
+        buf, nb, tail = item
+        parity = None
+        if nb:
+            parity = gf_native.apply_matrix(
+                erasure._parity_mat, buf[:, : nb * shard]
+            )
+        tail_blocks = erasure.encode_data(tail) if tail is not None else None
+        return (buf, nb, parity, tail, tail_blocks)
+
+    def frame_write(item):
+        buf, nb, parity, tail, tail_blocks = item
+        out = 0
+        try:
+            if nb:
+                strips = buf[:, : nb * shard]
+                writer.write_strips(
+                    [strips[j] for j in range(k)]
+                    + [parity[i] for i in range(m)],
+                    shard,
+                )
+                out += nb * block_size
+        finally:
+            pool.release(buf)
+        if tail_blocks is not None:
+            writer.write(tail_blocks)
+            out += len(tail)
+        totals["bytes"] += out
+        return out
+
+    # First batch fills on the CALLER's thread. If the whole stream fit
+    # in it, a linear pipeline would process the single item through
+    # its stages back-to-back anyway — zero overlap to win — so skip
+    # the thread spin-up and run the stages inline (keeps small-object
+    # PUT latency at the serial driver's level).
+    buf0 = pool.acquire()
+    nb0, tail0 = filler.fill(buf0)
+    first = (buf0, nb0, tail0)
+    if filler.eof:
+        if nb0 or tail0 is not None:
+            if md5_update is not None:
+                md5_stage(first)
+            frame_write(encode(first))
+        else:
+            pool.release(buf0)
+        return totals["bytes"]
+
+    def source_from_first():
+        yield first
+        yield from strips_source()
+
+    stages = []
+    if md5_update is not None:
+        stages.append(Stage("md5", md5_stage,
+                            bytes_of=lambda it: it[1] * block_size))
+    stages += [Stage("encode", encode),
+               Stage("frame-write", frame_write, bytes_of=int)]
+    Pipeline(telemetry, stages, queue_depth=1, pools=[pool],
+             ).run(source_from_first())
+    return totals["bytes"]
 
 
 def _read_full(src, n: int) -> bytes:
@@ -478,7 +814,8 @@ class ParallelReader:
 
 def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
                   length: int, total_length: int,
-                  prefer: list[bool] | None = None) -> tuple[int, Exception | None]:
+                  prefer: list[bool] | None = None,
+                  telemetry: str = "get") -> tuple[int, Exception | None]:
     """Read k-of-n shards, reconstruct as needed, write the byte range
     [offset, offset+length) to `writer`.
 
@@ -486,6 +823,12 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     ErrFileCorrupt if some source failed but the read succeeded — the
     caller queues a heal, like cmd/erasure-object.go:324-338.
     (ref Erasure.Decode, cmd/erasure-decode.go:205-283)
+
+    On multicore hosts the block loop runs on the staged pipeline
+    (pipeline/executor.py): shard-read+bitrot-verify of block N+1 and
+    decode of block N overlap the client write of block N-1, with
+    bounded queues so a slow client applies backpressure instead of
+    buffering the object in memory.
     """
     if offset < 0 or length < 0 or offset + length > total_length:
         raise ErrInvalidArgument("bad range")
@@ -499,16 +842,11 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     block_size = erasure.block_size
     start_block = offset // block_size
     end_block = (offset + length) // block_size
-    # Exact number of blocks the loop below will consume (the end block
-    # contributes none when the range ends on a block boundary) — bounds
-    # the reader's prefetch so a small range-GET reads no extra chunks.
-    n_reads = end_block - start_block + 1
-    if end_block > start_block and (offset + length) % block_size == 0:
-        n_reads -= 1
-    reader.set_blocks_wanted(n_reads)
-
-    bytes_written = 0
-    heal_hint: Exception | None = None
+    # Per-block (offset, length) geometry, precomputed so the serial and
+    # pipelined drivers consume the identical schedule; its length also
+    # bounds the reader's prefetch so a small range-GET reads no extra
+    # chunks.
+    geoms: list[tuple[int, int]] = []
     for block in range(start_block, end_block + 1):
         if start_block == end_block:
             block_offset = offset % block_size
@@ -524,18 +862,49 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
             block_length = block_size
         if block_length == 0:
             break
+        geoms.append((block_offset, block_length))
+    reader.set_blocks_wanted(len(geoms))
 
-        bufs = reader.read()
+    bytes_written = 0
+    heal_hint: Exception | None = None
+
+    def note_heal() -> None:
+        nonlocal heal_hint
         if reader.saw_missing and heal_hint is None:
             heal_hint = ErrFileNotFound("shard missing during read")
         if reader.saw_corrupt and heal_hint is None:
             heal_hint = ErrFileCorrupt("bitrot during read")
 
-        erasure.decode_data_blocks(bufs)
-        n = _write_data_blocks(
-            writer, bufs, erasure.data_blocks, block_offset, block_length
-        )
-        bytes_written += n
+    # <=2 blocks: read-ahead can overlap at most one handoff — not
+    # worth the per-request thread spin-up (the small-object/range-GET
+    # fast path stays identical to the serial driver).
+    if _SINGLE_CORE or len(geoms) <= 2:
+        for block_offset, block_length in geoms:
+            bufs = reader.read()
+            note_heal()
+            erasure.decode_data_blocks(bufs)
+            bytes_written += _write_data_blocks(
+                writer, bufs, erasure.data_blocks, block_offset, block_length
+            )
+    else:
+        from ..pipeline import Pipeline, Stage
+
+        def decode(gb):
+            geom, bufs = gb
+            erasure.decode_data_blocks(bufs)
+            return gb
+
+        pipe = Pipeline(telemetry, [
+            Stage("shard-read", lambda geom: (geom, reader.read())),
+            Stage("decode", decode, bytes_of=lambda gb: gb[0][1]),
+        ], queue_depth=2)
+        # The client write stays on the CALLER's thread — response
+        # framing and socket state must not move across threads.
+        for (block_offset, block_length), bufs in pipe.results(geoms):
+            note_heal()
+            bytes_written += _write_data_blocks(
+                writer, bufs, erasure.data_blocks, block_offset, block_length
+            )
 
     if bytes_written != length:
         raise ErrLessData(f"wrote {bytes_written}, want {length}")
@@ -575,13 +944,19 @@ def _write_data_blocks(dst, blocks: list, data_blocks: int,
     return written
 
 
-def heal_stream(erasure: Erasure, writers: list, readers: list, part_size: int):
+def heal_stream(erasure: Erasure, writers: list, readers: list,
+                part_size: int, telemetry: str = "heal"):
     """Reconstruct a part onto stale-disk writers: decode every block from
     the surviving readers and write ONLY the missing shards, with write
     quorum 1 (ref Erasure.Heal, cmd/erasure-lowlevel-heal.go:28-48).
 
     `writers` has one entry per shard position; non-None entries are the
-    stale disks to fill."""
+    stale disks to fill.
+
+    On multicore hosts the loop runs on the staged pipeline: shard
+    reads of block N+1 and GF reconstruction of block N overlap the
+    stale-disk writes of block N-1, so heal throughput is bounded by
+    the slowest stage rather than their sum."""
     targets = [i for i, w in enumerate(writers) if w is not None]
     if not targets:
         return
@@ -590,8 +965,23 @@ def heal_stream(erasure: Erasure, writers: list, readers: list, part_size: int):
         (part_size + erasure.block_size - 1) // erasure.block_size
         if part_size > 0 else 0
     )
-    for _ in range(total_blocks):
-        bufs = reader.read()
-        shards = erasure.reconstruct_targets(bufs, targets)
+    reader.set_blocks_wanted(total_blocks)
+
+    def write_targets(shards) -> None:
         for t_i, t in enumerate(targets):
             writers[t].write(np.asarray(shards[t_i]).tobytes())
+
+    if _SINGLE_CORE or total_blocks <= 2:
+        for _ in range(total_blocks):
+            bufs = reader.read()
+            write_targets(erasure.reconstruct_targets(bufs, targets))
+        return
+    from ..pipeline import Pipeline, Stage
+
+    pipe = Pipeline(telemetry, [
+        Stage("shard-read", lambda _i: reader.read()),
+        Stage("reconstruct",
+              lambda bufs: erasure.reconstruct_targets(bufs, targets)),
+    ], queue_depth=2)
+    for shards in pipe.results(range(total_blocks)):
+        write_targets(shards)

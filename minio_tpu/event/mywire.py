@@ -35,6 +35,34 @@ class MyError(RuntimeError):
         super().__init__(f"mysql error {code}: {message}")
 
 
+class MyAuthError(MyError):
+    """The server demands an auth plugin this client does not speak
+    (e.g. caching_sha2_password, the MySQL 8.0+ account default).
+
+    A PERMANENT configuration error, not an outage: retrying can never
+    succeed, so ping() re-raises it instead of reporting the target as
+    merely inactive — otherwise notify_mysql silently degrades to
+    queue-only forever while docs advertise live delivery."""
+
+    def __init__(self, plugin: str):
+        # 2059 = CR_AUTH_PLUGIN_CANNOT_LOAD, the client-side code the
+        # real libmysql reports for an unusable plugin.
+        super().__init__(2059, (
+            f"server requires unsupported auth plugin {plugin!r}; "
+            "create the notify_mysql account WITH "
+            "mysql_native_password (see docs/DEPLOYMENT.md)"
+        ))
+        self.plugin = plugin
+
+
+class MyModeChanged(RuntimeError):
+    """Raised by query(expected_nbe=...) when the session's
+    NO_BACKSLASH_ESCAPES flag no longer matches the mode a statement's
+    literals were escaped for (a transparent reconnect landed on a
+    session with different sql_mode). The statement was NOT sent; the
+    caller rebuilds it against the current mode and retries."""
+
+
 def escape_literal(s: str, no_backslash_escapes: bool = False) -> str:
     """Quote a string literal for the session's active escaping mode.
     Doubling ' is valid in BOTH modes; backslash sequences are only
@@ -190,9 +218,7 @@ class MyClient:
                 end = pkt.index(b"\x00", 1)
                 want = pkt[1:end].decode()
                 if want != "mysql_native_password":
-                    raise ConnectionError(
-                        f"unsupported auth plugin {want}"
-                    )
+                    raise MyAuthError(want)
                 # Exactly 20 scramble bytes + trailing NUL — sliced, not
                 # rstripped (see _parse_handshake).
                 new_scramble = pkt[end + 1:end + 21]
@@ -260,19 +286,32 @@ class MyClient:
         self._send_packet(com)
         self._check_ok(self._read_packet())
 
-    def query(self, sql: str):
+    def query(self, sql: str, expected_nbe: bool | None = None):
         """COM_QUERY for statements that return OK (INSERT/DELETE/DDL —
         the whole target surface). Retry discipline matches RespClient:
         one fresh-connection retry when a POOLED socket is dead at SEND
         time; a failure while READING the reply never retries — the
         server may have executed the statement, and re-sending would
         duplicate non-idempotent access-format INSERTs (the event
-        requeues instead)."""
+        requeues instead).
+
+        `expected_nbe` pins the NO_BACKSLASH_ESCAPES mode the caller's
+        literals were escaped for: if (re)connecting lands on a session
+        whose mode differs, MyModeChanged raises BEFORE the statement
+        is sent — executing it would corrupt values, and in the
+        NBE→default direction a backslash-terminated attacker key can
+        swallow the closing quote."""
         with self._mu:
             for attempt in (0, 1):
                 fresh = self._sock is None
                 if fresh:
                     self._connect()
+                if (expected_nbe is not None
+                        and self.no_backslash_escapes != expected_nbe):
+                    raise MyModeChanged(
+                        "session NO_BACKSLASH_ESCAPES flag changed; "
+                        "rebuild the statement"
+                    )
                 try:
                     self._seq = 0
                     self._send_packet(b"\x03" + sql.encode())
@@ -307,6 +346,13 @@ class MyClient:
                     self._connect()
                     self._roundtrip(b"\x0e")
             return True
+        except MyAuthError:
+            # Permanent misconfiguration (unsupported auth plugin):
+            # surface it — a False here would silently demote the
+            # target to queue-only with no operator-visible signal.
+            with self._mu:
+                self._teardown()
+            raise
         except (OSError, ConnectionError, MyError, ValueError):
             with self._mu:
                 self._teardown()

@@ -207,14 +207,26 @@ class _SQLTargetBase(Target):
         name = event.get("EventName", "")
         key = event.get("Key", "")
         data = json.dumps({"Records": records})
+        # Statements go through _exec_stmt as BUILDERS, not strings: the
+        # MySQL literal escaper follows the session's reported sql_mode
+        # flags, and a transparent reconnect inside query() can land on
+        # a session whose mode differs from the one the statement was
+        # built for — the target then rebuilds against the new mode
+        # instead of executing a mis-escaped statement.
         if self.format == "access":
             ts = records[0].get("eventTime", "") if records else ""
-            self._exec(self._insert_sql(ts, data))
+            self._exec_stmt(lambda: self._insert_sql(ts, data))
             return
         if name == "s3:ObjectRemoved:Delete":
-            self._exec(self._delete_sql(key))
+            self._exec_stmt(lambda: self._delete_sql(key))
         else:
-            self._exec(self._upsert_sql(key, data))
+            self._exec_stmt(lambda: self._upsert_sql(key, data))
+
+    def _exec_stmt(self, build) -> None:
+        """Build + execute one statement. Subclasses whose escaping is
+        session-mode-dependent override this to rebuild on a mode
+        change."""
+        self._exec(build())
 
 
 class MySQLTarget(_SQLTargetBase):
@@ -239,15 +251,41 @@ class MySQLTarget(_SQLTargetBase):
         if self._client._sock is None and not self._client.ping():
             raise ConnectionError("mysql server unreachable")
 
-    def _exec(self, sql: str) -> None:
+    def _exec(self, sql: str, expected_nbe: bool | None = None) -> None:
         from .mywire import MyError
 
         try:
-            self._client.query(sql)
+            # MyModeChanged (a RuntimeError, not a MyError) propagates
+            # to _exec_stmt's rebuild loop untouched.
+            self._client.query(sql, expected_nbe=expected_nbe)
         except MyError as exc:
             # 1050 = table already exists (racing CREATE) — benign.
             if exc.code != 1050:
                 raise
+
+    def _exec_stmt(self, build) -> None:
+        """Escaping mode is sampled at statement-BUILD time, but
+        query() can transparently reconnect to a session whose
+        NO_BACKSLASH_ESCAPES flag differs (sql_mode changed server-side
+        between sessions). query(expected_nbe=...) refuses to send in
+        that case; rebuild against the session's new mode and retry.
+        Two mode flips in a row means the server is flapping — give up
+        and let the event requeue."""
+        from .mywire import MyModeChanged
+
+        last: Exception | None = None
+        for _ in range(2):
+            mode = self._client.no_backslash_escapes
+            sql = build()
+            try:
+                self._exec(sql, expected_nbe=mode)
+                return
+            except MyModeChanged as exc:
+                last = exc
+                continue
+        raise ConnectionError(
+            f"mysql session escaping mode kept changing: {last}"
+        )
 
     def _ident(self) -> str:
         from .mywire import escape_ident

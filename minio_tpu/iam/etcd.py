@@ -120,17 +120,29 @@ class EtcdKV:
         """Blocking watch loop: call `on_event(type, key, value)` per
         change under prefix until stop_event is set. Reconnects on
         stream errors (the reference's watch loop does the same,
-        iam-etcd-store.go watch retry)."""
+        iam-etcd-store.go watch retry), rotating through the endpoint
+        list across attempts so watch-driven IAM invalidation fails
+        over like the KV path — pinned to endpoints[0], a single dead
+        node would silently stop invalidation cluster-wide while
+        reads/writes kept working."""
+        attempt = 0
         while not stop_event.is_set():
             try:
-                self._watch_once(prefix, on_event, stop_event)
+                self._watch_once(prefix, on_event, stop_event,
+                                 self.endpoints[attempt % len(self.endpoints)])
             except (OSError, http.client.HTTPException, EtcdError,
                     ValueError):
+                attempt += 1
                 if stop_event.wait(0.2):
                     return
+            else:
+                # Clean stream close (server-side rotation): retry the
+                # SAME endpoint first — it answered fine until now.
+                continue
 
-    def _watch_once(self, prefix: bytes, on_event, stop_event):
-        ep = self.endpoints[0]
+    def _watch_once(self, prefix: bytes, on_event, stop_event,
+                    ep: str | None = None):
+        ep = ep or self.endpoints[0]
         u = urllib.parse.urlsplit(ep)
         cls = (http.client.HTTPSConnection if u.scheme == "https"
                else http.client.HTTPConnection)

@@ -342,10 +342,12 @@ class ErasureObjects(MultipartMixin):
 
         try:
             if _SINGLE_CORE:
-                total = encode_stream(erasure, tee, writers, write_quorum)
+                total = encode_stream(erasure, tee, writers, write_quorum,
+                                      telemetry="put")
             else:
                 with _encode_slot():
-                    total = encode_stream(erasure, tee, writers, write_quorum)
+                    total = encode_stream(erasure, tee, writers,
+                                          write_quorum, telemetry="put")
         except Exception:
             # Close abandoned sinks BEFORE the tmp cleanup: raw-fd
             # (O_DIRECT) sinks hold an fd + staging buffer that GC may
@@ -711,7 +713,8 @@ class ErasureObjects(MultipartMixin):
                     till_offset, erasure.shard_size(),
                 )
             _, hint = decode_stream(
-                erasure, writer, readers, part_offset, part_length, part.size
+                erasure, writer, readers, part_offset, part_length,
+                part.size, telemetry="get",
             )
             if hint is not None and heal_hint is None:
                 heal_hint = hint
@@ -970,7 +973,8 @@ class ErasureObjects(MultipartMixin):
                         writers[s] = StreamingBitrotWriter(
                             sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
                         )
-                    heal_stream(erasure, writers, readers, part.size)
+                    heal_stream(erasure, writers, readers, part.size,
+                                telemetry="heal")
                 except Exception:
                     # Writer creation OR the heal itself failed: close
                     # whatever sinks exist (O_DIRECT fds must not wait

@@ -204,11 +204,13 @@ class MultipartMixin:
         try:
             if _SINGLE_CORE:
                 # Already inside the whole-part slot from put_object_part.
-                total = encode_stream(erasure, tee, writers, write_quorum)
+                total = encode_stream(erasure, tee, writers, write_quorum,
+                                      telemetry="multipart")
             else:
                 with _encode_slot():
                     total = encode_stream(erasure, tee, writers,
-                                          write_quorum)
+                                          write_quorum,
+                                          telemetry="multipart")
         except Exception:
             _drop_tmp()
             raise

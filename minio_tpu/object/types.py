@@ -183,22 +183,29 @@ class TeeMD5Reader:
             big = size is None or size < 0 or size >= self.PIPELINE_MIN_SIZE
             pipelined = big and (os.cpu_count() or 1) > 1
         self._queue = None
-        if pipelined:
-            import queue as _qm
-            import weakref
+        # The hashing thread starts LAZILY on the first ingested buffer:
+        # the staged encode pipeline (erasure/streaming.py) calls
+        # delegate_hashing() before ever reading, and an eager thread
+        # here would be spawned and joined having hashed nothing on
+        # every large PUT.
+        self._want_pipeline = bool(pipelined)
 
-            q = _qm.Queue(maxsize=self.QUEUE_DEPTH)
-            self._queue = q
-            # The worker closes over (queue, md5) — NOT self — so an
-            # abandoned reader (error path that never reaches md5_hex)
-            # gets garbage-collected, firing the finalizer that shuts
-            # the thread down instead of leaking it on q.get().
-            self._worker = threading.Thread(
-                target=self._hash_loop, args=(q, self._md5),
-                name="mtpu-md5", daemon=True,
-            )
-            self._worker.start()
-            self._finalizer = weakref.finalize(self, q.put, None)
+    def _start_worker(self):
+        import queue as _qm
+        import weakref
+
+        q = _qm.Queue(maxsize=self.QUEUE_DEPTH)
+        self._queue = q
+        # The worker closes over (queue, md5) — NOT self — so an
+        # abandoned reader (error path that never reaches md5_hex)
+        # gets garbage-collected, firing the finalizer that shuts
+        # the thread down instead of leaking it on q.get().
+        self._worker = threading.Thread(
+            target=self._hash_loop, args=(q, self._md5),
+            name="mtpu-md5", daemon=True,
+        )
+        self._worker.start()
+        self._finalizer = weakref.finalize(self, q.put, None)
 
     @staticmethod
     def _hash_loop(q, md5):
@@ -212,6 +219,8 @@ class TeeMD5Reader:
                 q.task_done()
 
     def _ingest(self, buf):
+        if self._want_pipeline and self._queue is None:
+            self._start_worker()
         if self._queue is not None:
             self._queue.put(buf)
         else:
@@ -235,9 +244,11 @@ class TeeMD5Reader:
             if n:
                 # The caller owns (and will reuse) this buffer — the
                 # async hasher needs a snapshot. bytes() is a ~9 GB/s
-                # memcpy; the hash it unblocks is 0.66 GB/s.
-                self._ingest(bytes(view[:n]) if self._queue is not None
-                             else view[:n])
+                # memcpy; the hash it unblocks is 0.66 GB/s. Decide on
+                # _want_pipeline, not _queue: the lazy worker starts
+                # inside _ingest, AFTER this choice.
+                snapshot = self._want_pipeline or self._queue is not None
+                self._ingest(bytes(view[:n]) if snapshot else view[:n])
                 self.bytes_read += n
             return n or 0
         buf = self._src.read(len(view))
@@ -247,6 +258,27 @@ class TeeMD5Reader:
             self._ingest(buf)
             self.bytes_read += n
         return n
+
+    def delegate_hashing(self):
+        """Hand hashing to an external pipeline stage: returns
+        (inner_source, md5_update) and stops this reader's own
+        ingestion (including the per-buffer hashing thread, whose
+        per-chunk snapshot copy + queue handoff measure SLOWER than the
+        hash itself under GIL contention — the staged encode pipeline
+        instead hashes whole pooled strip buffers in stream order, one
+        handoff per batch).
+
+        The caller guarantees md5_update sees exactly the source's
+        bytes in order; md5_hex() afterwards returns the settled digest
+        as usual. bytes_read stops advancing — callers of the delegated
+        form use the pipeline's own byte count."""
+        self._want_pipeline = False
+        if self._queue is not None:
+            self._finalizer.detach()
+            self._queue.put(None)
+            self._worker.join()
+            self._queue = None
+        return self._src, self._md5.update
 
     def md5_hex(self) -> str:
         if self._queue is not None:

@@ -96,6 +96,14 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
     ("node_cpu_seconds_total", "gauge", "Process CPU time"),
 ]
 
+# Per-stage pipeline telemetry (pipeline/metrics.py): the erasure hot
+# paths (put/get/heal/multipart + the device host feed) flush their
+# stage counters through the same registry, so the descriptors join
+# the catalog here and render on the same endpoints.
+from ..pipeline.metrics import PIPELINE_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += PIPELINE_DESCRIPTORS
+
 
 def describe_all(metrics) -> None:
     for name, _type, help_text in DESCRIPTORS:

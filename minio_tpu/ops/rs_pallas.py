@@ -143,6 +143,35 @@ def apply_gf_matrix_pallas(bitmat, shards, tile: int = DEFAULT_TILE,
     return out.reshape(*lead, bitmat.shape[0] // 8, s)
 
 
+class HostFeed:
+    """Pipelined host→device staging stage for the device encode engine.
+
+    BENCH_r05's device_stream_hostfed_gbps (0.016) is feed-bound: the
+    encode loop did H2D, dispatch and D2H from ONE host thread, so the
+    tunnel sat idle while the host packed or flushed. Run as a stage of
+    pipeline/executor.Pipeline, this callable moves the H2D copy onto
+    its own worker: the transfer of batch N+1 overlaps the MXU compute
+    of batch N and the host write fan-out of batch N-1 — double
+    buffering falls out of the executor's bounded queues (queue_depth=1
+    keeps exactly one staged batch ahead).
+
+    The transfer is COMPLETED inside the stage (block_until_ready):
+    returning a lazy handle would make the dispatch stage pay the wait
+    and re-serialize the feed. Per-stage items/bytes/timing telemetry
+    comes from the executor's StageStats, not from this class.
+    """
+
+    def __init__(self, name: str = "h2d"):
+        self.name = name
+
+    def __call__(self, batch):
+        import jax
+
+        dev = jax.device_put(batch)
+        dev.block_until_ready()
+        return dev
+
+
 @functools.cache
 def pallas_supported() -> bool:
     """True when the default backend compiles AND runs this kernel.

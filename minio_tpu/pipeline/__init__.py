@@ -1,0 +1,40 @@
+"""Staged streaming pipeline: composable stages connected by bounded
+queues with backpressure, a recycling buffer pool, a thread-per-stage
+executor with first-error cancellation and deterministic draining, and
+per-stage telemetry exported through the observability metrics registry.
+
+This is the structural backbone of the erasure hot paths: PUT
+(source-read ∥ md5 ∥ encode ∥ bitrot-frame ∥ shard-write), GET's
+prefetching decode/bitrot-verify path, heal reconstruction, and the
+device engine's double-buffered host feed (ops/rs_pallas.HostFeed). The
+motivating measurement (BENCH_r05): encode runs at 11 GB/s but e2e PUT
+models at 0.45 GB/s because the stages run back-to-back —
+md5_overlap_speedup 0.978 means ZERO overlap. Once the GF kernel is
+fast, pipeline structure, not the codec, dominates throughput
+(arXiv:2108.02692); the same staged overlap discipline feeds the TPU
+path.
+"""
+
+from .buffers import BufferPool, shared_pool
+from .executor import Pipeline, PipelineCancelled
+from .metrics import (
+    get_registry,
+    pool_stats_snapshot,
+    set_registry,
+    stage_stats_snapshot,
+)
+from .stage import END_OF_STREAM, SKIP, Stage
+
+__all__ = [
+    "BufferPool",
+    "END_OF_STREAM",
+    "Pipeline",
+    "PipelineCancelled",
+    "SKIP",
+    "Stage",
+    "get_registry",
+    "pool_stats_snapshot",
+    "set_registry",
+    "shared_pool",
+    "stage_stats_snapshot",
+]

@@ -1,0 +1,103 @@
+"""BufferPool: a recycling arena of identical block-sized buffers.
+
+The erasure hot paths move the stream in multi-MiB strip buffers; with
+stages overlapped, several batches are in flight at once, and a fresh
+`np.empty((k, B*S))` per batch costs a page-fault pass over the whole
+allocation (measured in write_frames — the same reuse trick lives
+there). The pool allocates each buffer ONCE and recycles it:
+steady-state throughput does zero allocations, and the `allocated`
+high-water mark is bounded by the pipeline depth, not the stream
+length.
+
+acquire() never blocks: when the freelist is empty it allocates a fresh
+buffer (and counts it), so a cancelled pipeline that leaks its in-flight
+buffers can never deadlock the next run — leaked buffers are simply
+garbage-collected and the pool refills. release() keeps at most
+`capacity` buffers on the freelist; extras are dropped to the GC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class BufferPool:
+    """Thread-safe freelist of interchangeable buffers.
+
+    factory   -- zero-arg callable producing one buffer (e.g. a
+                 lambda over np.empty or bytearray).
+    capacity  -- max buffers kept on the freelist; also the expected
+                 steady-state allocation count (pipeline depth + in-
+                 flight stages).
+    name      -- telemetry label.
+    """
+
+    def __init__(self, factory: Callable, capacity: int = 4,
+                 name: str = "pool"):
+        self._factory = factory
+        self.capacity = capacity
+        self.name = name
+        self._free: list = []
+        self._mu = threading.Lock()
+        # Stats: allocated only ever grows (high-water mark of live
+        # buffers); reused counts freelist hits — the no-growth-under-
+        # steady-state assertion is `allocated` flat while `reused`
+        # climbs.
+        self.allocated = 0
+        self.reused = 0
+        self.in_use = 0
+
+    def acquire(self):
+        with self._mu:
+            if self._free:
+                buf = self._free.pop()
+                self.reused += 1
+                self.in_use += 1
+                return buf
+            self.allocated += 1
+            self.in_use += 1
+        # Allocation happens OUTSIDE the lock: faulting in a multi-MiB
+        # buffer must not serialize concurrent acquirers.
+        return self._factory()
+
+    def release(self, buf) -> None:
+        if buf is None:
+            return
+        with self._mu:
+            self.in_use = max(0, self.in_use - 1)
+            if len(self._free) < self.capacity:
+                self._free.append(buf)
+            # else: drop to GC — the pool never grows past capacity.
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "allocated": self.allocated,
+                "reused": self.reused,
+                "in_use": self.in_use,
+                "free": len(self._free),
+                "capacity": self.capacity,
+            }
+
+
+# Process-shared pools keyed by buffer geometry: every PUT of one
+# erasure config recycles the SAME arena, so steady-state traffic does
+# zero strip allocations — a per-stream pool would still pay the full
+# buffer fault-in on every object.
+_shared: dict[tuple, BufferPool] = {}
+_shared_mu = threading.Lock()
+
+
+def shared_pool(key: tuple, factory: Callable, capacity: int = 6,
+                name: str = "") -> BufferPool:
+    """Get-or-create the process-wide pool for `key` (a hashable
+    geometry tuple; the factory must produce interchangeable buffers
+    for that key)."""
+    with _shared_mu:
+        pool = _shared.get(key)
+        if pool is None:
+            pool = BufferPool(factory, capacity,
+                              name=name or "-".join(map(str, key)))
+            _shared[key] = pool
+        return pool

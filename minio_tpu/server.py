@@ -128,6 +128,14 @@ class Server:
         # Metrics come up first so the storage layer can record per-op
         # counters from the very first format read.
         self.metrics = Metrics()
+        # The erasure hot paths flush per-stage pipeline telemetry
+        # (put/get/heal stage timings, queue depths, buffer-pool reuse)
+        # through this process-global hook — plumbing a registry handle
+        # down into erasure/streaming.py would thread it through every
+        # call site.
+        from .pipeline import metrics as pipeline_metrics
+
+        pipeline_metrics.set_registry(self.metrics)
         self.storage_server = None
         self.peer_server = None
         self.lock_server = None

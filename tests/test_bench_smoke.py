@@ -40,3 +40,44 @@ def test_heal_bench_survives_reps(tmp_path):
 
     v = bench.bench_config3_heal(str(tmp_path), reps=2)
     assert v > 0.001
+
+
+def test_put_stages_reports_pipelined_path(tmp_path):
+    """The pipeline executor drives the bench's real pipelined PUT
+    measurement: pipeline_put_gbps must come from actual encode_stream
+    runs (with per-stage telemetry), and the overlap figure must be
+    present for the acceptance gate to read."""
+    import bench
+
+    # >1 batch (8 blocks @1MiB): single-batch streams short-circuit to
+    # the inline path, which records no pipeline stage stats.
+    stages = bench.bench_put_stages(str(tmp_path), total_mib=12)
+    assert stages.get("pipeline_put_gbps", 0) > 0.01, stages
+    assert "md5_overlap_speedup" in stages
+    import os
+
+    if (os.cpu_count() or 1) > 1:
+        # Multicore: some pipelined driver ran for real — its stage
+        # counters must be present. Which stages exist depends on the
+        # engine (native: encode/frame-write; device/numpy batched:
+        # dispatch/flush-write), so assert on the shared labels.
+        pstages = {k: v for k, v in
+                   stages.get("pipeline_stages", {}).items()
+                   if k.startswith("bench-put/")}
+        assert pstages, stages.get("pipeline_stages")
+        assert any(v["items"] > 0 for v in pstages.values()), pstages
+
+
+def test_pipeline_executor_smoke():
+    """Fast end-to-end of the executor itself (the machinery every
+    bench pipeline number rides on): ordering, telemetry, completion."""
+    from minio_tpu.pipeline import Pipeline, Stage
+
+    pipe = Pipeline("smoke", [
+        Stage("a", lambda x: x + 1),
+        Stage("b", lambda x: x * 3, bytes_of=lambda x: 8),
+    ], queue_depth=2)
+    assert list(pipe.results(range(16))) == [(x + 1) * 3 for x in range(16)]
+    stats = pipe.stage_stats()
+    assert stats["a"]["items"] == 16
+    assert stats["b"]["bytes"] == 16 * 8

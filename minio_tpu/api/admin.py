@@ -92,6 +92,9 @@ class AdminHandlers:
             ("PUT", "add-tier"): "add_tier",
             ("GET", "list-tiers"): "list_tiers",
             ("DELETE", "remove-tier"): "remove_tier",
+            ("GET", "faults"): "faults_status",
+            ("POST", "faults"): "faults_arm",
+            ("DELETE", "faults"): "faults_disarm",
         }
         name = table.get((m, head))
         if name is None:
@@ -142,6 +145,9 @@ class AdminHandlers:
         "replication_resync": "admin:ReplicationDiff",
         "replication_resync_status": "admin:ReplicationDiff",
         "bandwidth_report": "admin:BandwidthMonitor",
+        "faults_status": "admin:ServerInfo",
+        "faults_arm": "admin:ServiceRestart",
+        "faults_disarm": "admin:ServiceRestart",
     }
 
     def authorize(self, auth_result, name: str):
@@ -186,20 +192,31 @@ class AdminHandlers:
                 if d is None:
                     disks.append({"state": "offline"})
                     continue
+                hi = getattr(d, "health_info", None)
+                hi = hi() if callable(hi) else None
                 try:
                     di = d.disk_info()
-                    disks.append({
+                    entry = {
                         "endpoint": di.endpoint,
                         "state": "ok",
                         "totalspace": di.total,
                         "availspace": di.free,
                         "usedspace": di.used,
-                    })
+                    }
                 except Exception as exc:  # noqa: BLE001 per-disk state
-                    disks.append({
+                    entry = {
                         "endpoint": d.endpoint(), "state": "offline",
                         "error": str(exc),
-                    })
+                    }
+                if hi is not None:
+                    # In-band health tracker: circuit-breaker state, op
+                    # timeouts, in-flight tokens (a latched drive shows
+                    # state=faulty here even while disk_info still
+                    # answers via the probe path).
+                    entry["health"] = hi
+                    if hi["state"] == "faulty":
+                        entry["state"] = "faulty"
+                disks.append(entry)
         return self._json({"disks": disks})
 
     def data_usage_info(self, ctx) -> Response:
@@ -242,6 +259,49 @@ class AdminHandlers:
             200, {"Content-Type": "text/plain; version=0.0.4"},
             self.metrics.render_prometheus().encode(),
         )
+
+    # --- fault injection (chaos drills; minio_tpu/faults) ---
+
+    def faults_status(self, ctx) -> Response:
+        from .. import faults
+
+        return self._json({
+            "enabled": faults.enabled(),
+            "armed": faults.status(),
+        })
+
+    def faults_arm(self, ctx) -> Response:
+        """Arm a seeded fault schedule on one disk endpoint. Body:
+        {"endpoint": "...", "seed": 0, "specs": [{"kind": "hang",
+        "ops": ["shard_write"], "calls": [3], "probability": 0.1,
+        "latency_s": 0.5, "error": "ErrDiskNotFound"}, ...]}.
+        Requires MTPU_FAULT_INJECTION=1 — a production server must not
+        be one mis-addressed request away from injected hangs."""
+        from .. import faults
+
+        if not faults.enabled():
+            raise S3Error(
+                "NotImplemented",
+                "fault injection disabled; set MTPU_FAULT_INJECTION=1",
+            )
+        try:
+            spec = json.loads(ctx.body.decode() or "{}")
+            endpoint = spec["endpoint"]
+            sched = faults.arm(endpoint, {
+                "seed": spec.get("seed", 0),
+                "specs": spec.get("specs", []),
+            })
+        except (KeyError, ValueError, TypeError) as exc:
+            raise S3Error("InvalidArgument", f"fault spec: {exc}") from exc
+        return self._json({"armed": endpoint, "schedule": sched.status()})
+
+    def faults_disarm(self, ctx) -> Response:
+        """Disarm one endpoint's schedule (?endpoint=...) or all of
+        them; releases any threads blocked in injected hangs."""
+        from .. import faults
+
+        endpoint = ctx.qdict.get("endpoint") or None
+        return self._json({"disarmed": faults.disarm(endpoint)})
 
     # --- config KV ---
 

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import threading
 
+from ..utils.errors import ErrObjectNotFound, ErrVersionNotFound
+
 
 class MRFHealer:
     """Drain per-set MRF queues (partial writes that met quorum but
@@ -28,10 +30,21 @@ class MRFHealer:
             for es in pool.sets:
                 for bucket, object_, version_id in es.drain_mrf():
                     try:
-                        es.heal_object(bucket, object_, version_id)
+                        # remove_dangling: MRF entries include deletes a
+                        # straggler disk missed — the leftover copy is
+                        # sub-quorum dangling garbage that must be
+                        # purged, not requeued forever as a quorum
+                        # failure (ref isObjectDangling purge).
+                        es.heal_object(bucket, object_, version_id,
+                                       remove_dangling=True)
                         healed += 1
                         if self.metrics is not None:
                             self.metrics.inc("mrf_healed_total")
+                    except (ErrObjectNotFound, ErrVersionNotFound):
+                        # Nothing left to heal anywhere reachable (e.g.
+                        # a delete that every live disk applied): drop
+                        # the entry — requeueing would spin forever.
+                        continue
                     except Exception as exc:  # noqa: BLE001 requeue
                         es.queue_mrf(bucket, object_, version_id)
                         if self.logger is not None:

@@ -24,6 +24,21 @@ from collections import deque
 # unbounded like a shared fixed pool that hung probes would exhaust.
 PROBE_TIMEOUT_S = 20.0
 
+# A probe thread that NEVER returns (storage call wedged below any RPC
+# timeout) would otherwise pin _pending[key] forever: no new probe is
+# ever submitted for that slot, so a recovered or replaced disk could
+# never be re-admitted without a process restart. Past this age the
+# pending entry is evicted and probing resumes; the zombie thread's
+# eventual result (if any) is discarded via its generation token.
+PROBE_PENDING_MAX_AGE_S = 6 * PROBE_TIMEOUT_S
+
+# At most this many evicted-but-still-running probe threads may exist
+# per slot: a disk wedged in D-state must not leak one daemon thread
+# per eviction window forever. Past the cap, eviction pauses until one
+# zombie finally returns (a slot with this many consecutive wedged
+# probes is latched offline regardless).
+PROBE_MAX_ZOMBIES = 4
+
 
 def _probe(disk) -> bool:
     try:
@@ -51,29 +66,61 @@ class DiskMonitor:
         # (id(set), slot) -> disk object pulled from that slot.
         self._offline: dict[tuple[int, int], object] = {}
         self._fails: dict[tuple[int, int], int] = {}
-        # key -> completed probe result; _pending[key] = probe start time.
+        # key -> completed probe result; _pending[key] = (gen, start
+        # time). The generation token lets an evicted (zombie) probe's
+        # late result be told apart from the live probe's.
         self._results: dict[tuple[int, int], bool] = {}
-        self._pending: dict[tuple[int, int], float] = {}
+        self._pending: dict[tuple[int, int], tuple[int, float]] = {}
+        self._probe_gen = 0
+        # key -> count of evicted probe threads that never returned yet.
+        self._zombies: dict[tuple[int, int], int] = {}
         self._state_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.events: deque[tuple[str, str]] = deque(maxlen=256)
 
     def _submit_probe(self, key: tuple[int, int], disk) -> None:
+        now = time.monotonic()
         with self._state_lock:
-            started = self._pending.get(key)
-            if started is not None:
-                # Previous probe still in flight. Hung past the deadline
-                # counts as a failed probe each sweep (feeding the offline
-                # threshold) but we never stack a second thread per slot.
-                if time.monotonic() - started > PROBE_TIMEOUT_S:
-                    self._results[key] = False
-                return
-            self._pending[key] = time.monotonic()
+            entry = self._pending.get(key)
+            if entry is not None:
+                _gen, started = entry
+                age = now - started
+                if (age <= PROBE_PENDING_MAX_AGE_S
+                        or self._zombies.get(key, 0) >= PROBE_MAX_ZOMBIES):
+                    # Previous probe still in flight (or the zombie
+                    # budget for this slot is spent). Hung past the
+                    # deadline counts as a failed probe each sweep
+                    # (feeding the offline threshold) but we never stack
+                    # threads beyond the zombie cap.
+                    if age > PROBE_TIMEOUT_S:
+                        self._results[key] = False
+                    return
+                # Evict: the old probe is a zombie (its thread may never
+                # return). This sweep still counts the hang as a failed
+                # probe (age is far past PROBE_TIMEOUT_S — the eviction
+                # sweep must feed the offline threshold like any other
+                # over-deadline sweep), then a fresh probe starts; the
+                # zombie's late result is discarded by generation.
+                self._results[key] = False
+                self._zombies[key] = self._zombies.get(key, 0) + 1
+            self._probe_gen += 1
+            gen = self._probe_gen
+            self._pending[key] = (gen, now)
 
         def run():
             ok = _probe(disk)
             with self._state_lock:
+                cur = self._pending.get(key)
+                if cur is None or cur[0] != gen:
+                    # Evicted while we hung: a newer probe owns the key.
+                    # This zombie has returned — refund its budget slot.
+                    z = self._zombies.get(key, 0)
+                    if z > 1:
+                        self._zombies[key] = z - 1
+                    else:
+                        self._zombies.pop(key, None)
+                    return
                 self._results[key] = ok
                 self._pending.pop(key, None)
 

@@ -46,6 +46,18 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "kms_kes": {"endpoint": "", "key_name": "", "cert_file": "", "key_file": "", "capath": "", "insecure": "off"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "drive": {
+        # In-band hung-drive tolerance (ref the "drive" subsystem's
+        # max_timeout + cmd/xl-storage-disk-id-check.go deadlines).
+        "enable": "on",
+        "op_deadline": "30s",        # wall clock per metadata/data op
+        "long_op_deadline": "120s",  # walk_dir / read_file_stream / create
+        "hedge_delay": "150ms",      # GET: dispatch parity after this wait
+        "straggler_grace": "2s",     # fan-out wait past write quorum
+        "breaker_threshold": "3",    # consecutive timeouts before latch
+        "probe_interval": "5s",      # faulty-disk re-admission probe
+        "max_inflight": "16",        # per-disk in-flight token budget
+    },
     "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
     "scanner": {"delay": "10", "max_wait": "15s", "cycle": "1m"},
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "", "queue_dir": "", "queue_limit": "0"},
@@ -68,6 +80,7 @@ HELP: dict[str, str] = {
     "kms_kes": "enable external MinIO key encryption service",
     "logger_webhook": "send server logs to webhook endpoints",
     "audit_webhook": "send audit logs to webhook endpoints",
+    "drive": "tune hung-drive tolerance: per-op deadlines, hedged reads, circuit breaker",
     "heal": "manage object healing frequency and bitrot verification",
     "scanner": "manage namespace scanning for usage calculation, lifecycle, healing",
     "notify_webhook": "publish bucket notifications to webhook endpoints",

@@ -39,6 +39,28 @@ _lock_pool = _TPE(max_workers=32, thread_name_prefix="mtpu-dsync")
 _refresh_pool = _TPE(max_workers=8, thread_name_prefix="mtpu-dsync-ref")
 _unlock_pool = _TPE(max_workers=16, thread_name_prefix="mtpu-dsync-unl")
 
+# Unlock RPCs that failed at the transport (peer dead/partitioned):
+# each one leaks its grant server-side until lock expiry, invisibly
+# extending holds. Counted here (module counter for tests) and exported
+# as mtpu_dsync_unlock_failures_total when a registry is installed, so
+# a leaked-lock storm shows up on the metrics endpoint instead of as
+# mystery contention.
+_metrics = None
+UNLOCK_FAILURES = {"total": 0}
+_unlock_fail_mu = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    _metrics = registry
+
+
+def _note_unlock_failures(n: int, resource: str) -> None:
+    with _unlock_fail_mu:
+        UNLOCK_FAILURES["total"] += n
+    if _metrics is not None:
+        _metrics.inc("dsync_unlock_failures_total", n)
+
 # One shared refresher thread ticks every second over ALL held mutexes
 # and refreshes each at ITS OWN cadence (the reference runs one
 # goroutine per held lock; a registry + ticker gives the same
@@ -223,19 +245,27 @@ class _LockerClient:
         )
 
     def call(self, method: str, resource: str, uid: str, owner: str) -> bool:
+        return self.call2(method, resource, uid, owner)[0]
+
+    def call2(self, method: str, resource: str, uid: str,
+              owner: str) -> tuple[bool, Exception | None]:
+        """(ok, transport_error): a False with error=None means the
+        peer ANSWERED no-grant; error!=None means the RPC itself failed
+        — for unlock, the distinction between 'nothing to release' and
+        'grant leaked until expiry'."""
         if self._local is not None:
             fn = getattr(self._local, method)
             if method == "force_unlock":
-                return fn(resource)
+                return fn(resource), None
             if method in ("unlock", "refresh"):
-                return fn(resource, uid)
-            return fn(resource, uid, owner)
+                return fn(resource, uid), None
+            return fn(resource, uid, owner), None
         try:
             return bool(self._client.call(method, {
                 "resource": resource, "uid": uid, "owner": owner,
-            })["ok"])
-        except RPCError:
-            return False
+            })["ok"]), None
+        except RPCError as exc:
+            return False, exc
 
 
 class DRWMutex:
@@ -309,7 +339,18 @@ class DRWMutex:
         # holds and feed acquisition storms) AND off the refresh pool
         # (an unlock storm against a dead peer must never starve the
         # refreshes keeping held locks alive).
-        self._call_all("unlock", self.uid, pool=_unlock_pool)
+        uid = self.uid
+        outcomes = list(_unlock_pool.map(
+            lambda loc: loc.call2("unlock", self.resource, uid, self.owner),
+            self.lockers,
+        )) if len(self.lockers) > 1 else [
+            self.lockers[0].call2("unlock", self.resource, uid, self.owner)
+        ]
+        failed = sum(1 for _ok, err in outcomes if err is not None)
+        if failed:
+            # Each failed unlock RPC leaks its grant until server-side
+            # expiry — export the count so leak storms are visible.
+            _note_unlock_failures(failed, self.resource)
         self.uid = ""
 
     def force_unlock(self):

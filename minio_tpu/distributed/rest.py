@@ -13,6 +13,7 @@ import hashlib
 import hmac
 import http.client
 import json
+import logging
 import threading
 import time
 import urllib.parse
@@ -21,6 +22,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import msgpack
 
 TOKEN_VALIDITY_S = 15 * 60
+
+_log = logging.getLogger("minio_tpu.rpc")
 
 
 class RPCError(Exception):
@@ -238,6 +241,14 @@ class RPCClient:
         self._last_check = 0.0
         self._lock = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
+        # Serializes the lazy reconnect probe: without it, racing
+        # threads reading .online double-probe the peer and clobber
+        # _last_check (losing the 1s backoff).
+        self._probe_lock = threading.Lock()
+        # "" | "net: ..." | "auth: ..." — the last probe's failure
+        # class, so an auth problem (clock skew, secret mismatch) is
+        # distinguishable from a plain network outage.
+        self.last_probe_error = ""
 
     # --- connection pool ---
 
@@ -267,14 +278,45 @@ class RPCClient:
 
     @property
     def online(self) -> bool:
-        if not self._online and time.time() - self._last_check > 1.0:
-            # lazy reconnect probe (ref: HealthCheckFn + 1s backoff)
+        if self._online:
+            return True
+        if time.time() - self._last_check <= 1.0:
+            return False
+        # Lazy reconnect probe (ref: HealthCheckFn + 1s backoff). The
+        # probe is network I/O inside a property getter, so it MUST be
+        # single-flight: one thread probes, the others return the
+        # current state instead of stacking probes and clobbering the
+        # backoff stamp.
+        if not self._probe_lock.acquire(blocking=False):
+            return self._online
+        try:
+            if self._online or time.time() - self._last_check <= 1.0:
+                return self._online
             self._last_check = time.time()
             try:
                 self.call("ping")
                 self._online = True
-            except Exception:
-                pass
+                self.last_probe_error = ""
+            except RPCError as exc:
+                if exc.kind == "AccessDenied":
+                    # The peer IS reachable but rejects our cluster
+                    # token (secret mismatch / clock skew past token
+                    # validity). Reporting this as a plain "offline"
+                    # sends operators chasing the network; log the real
+                    # cause once per transition.
+                    if not self.last_probe_error.startswith("auth"):
+                        _log.warning(
+                            "peer %s rejects cluster token (%s): check "
+                            "shared secret / clock skew, not the network",
+                            self.endpoint_str, exc.message,
+                        )
+                    self.last_probe_error = f"auth: {exc.message}"
+                else:
+                    self.last_probe_error = f"net: {exc.message}"
+            except Exception as exc:  # noqa: BLE001 - probe best effort
+                self.last_probe_error = f"net: {exc}"
+        finally:
+            self._probe_lock.release()
         return self._online
 
     def mark_offline(self):

@@ -16,13 +16,17 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..storage.diskcheck import ROBUST
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
     ErrDiskNotFound,
+    ErrDiskOpTimeout,
     ErrErasureReadQuorum,
     ErrFileCorrupt,
     ErrFileNotFound,
@@ -38,14 +42,49 @@ from .codec import Erasure
 _io_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-io")
 
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
+from ..utils.fanout import QuorumFanout, StragglerCompensator
 from ..utils.fanout import is_local_sink as _is_local_sink
+
+# Robustness telemetry: module counters always tick (tests read them
+# directly); a registry handle installed at server boot mirrors them
+# onto the metrics endpoint (same pattern as pipeline/metrics.py).
+_stats_lock = threading.Lock()
+STATS = {"hedged_reads_total": 0, "fanout_stragglers_total": 0}
+_metrics = None
+
+# Detached stragglers keep occupying their _io_pool worker (possibly
+# forever); the compensator raises the pool ceiling while they do, so
+# healthy fan-outs never lose concurrency to a wedged drive.
+_io_compensator = StragglerCompensator(_io_pool)
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    _metrics = registry
+
+
+def record_stat(name: str, n: int = 1) -> None:
+    with _stats_lock:
+        STATS[name] += n
+    if _metrics is not None:
+        _metrics.inc(name, n)
 
 
 class ParallelWriter:
     """Write shard blocks to k+m writers in parallel, tolerating failures
-    down to write_quorum (ref cmd/erasure-encode.go:29-70)."""
+    down to write_quorum (ref cmd/erasure-encode.go:29-70).
 
-    def __init__(self, writers: list, write_quorum: int):
+    Quorum-wait fan-out: each dispatch returns as soon as write-quorum
+    successes land plus a short straggler grace; writers still in flight
+    past that point are DETACHED — they finish (or hang) in background,
+    their slot is nil'd so later blocks and the commit skip them, and
+    the shard heals via MRF. A hung drive therefore costs a PUT at most
+    (op deadline + straggler grace), never an unbounded stall (ref the
+    diskHealthTracker deadlines of cmd/xl-storage-disk-id-check.go)."""
+
+    def __init__(self, writers: list, write_quorum: int,
+                 op_deadline_s: float | None = None,
+                 straggler_grace_s: float | None = None):
         # NOTE: the caller's list is mutated — failed writers are nil'd in
         # place so upper layers (putObject commit, MRF) observe mid-stream
         # failures, exactly like the reference's shared writers slice
@@ -53,41 +92,74 @@ class ParallelWriter:
         self.writers = writers
         self.write_quorum = write_quorum
         self.errs: list = [None] * len(writers)
+        self._op_deadline_s = op_deadline_s
+        self._grace_s = straggler_grace_s
+        # Persistent detach state: a writer detached on one block stays
+        # detached for the rest of the stream.
+        self._fan = QuorumFanout(_io_pool, _io_compensator)
 
     def write(self, blocks: list, digests: list | None = None):
-        def do(i):
-            try:
-                w = self.writers[i]
-                if digests is not None and hasattr(w, "write_with_digest"):
-                    w.write_with_digest(blocks[i], digests[i])
-                else:
-                    w.write(blocks[i])
-                self.errs[i] = None
-            except Exception as exc:  # noqa: BLE001 - collected for quorum
-                self.errs[i] = exc
-                self.writers[i] = None
-
-        self._fanout(do)
-
-    def _fanout(self, do):
-        """Dispatch do(i) across writers: remote sinks through the pool,
-        local sinks inline on single-core hosts (fanout cost > overlap
-        gain there)."""
-        futures = []
-        inline = []
-        for i in range(len(self.writers)):
+        def attempt(i):
             w = self.writers[i]
+            if digests is not None and hasattr(w, "write_with_digest"):
+                w.write_with_digest(blocks[i], digests[i])
+            else:
+                w.write(blocks[i])
+
+        self._fanout(attempt)
+
+    def _fanout(self, attempt):
+        """Dispatch attempt(i) across writers: remote sinks through the
+        pool, local sinks inline on single-core hosts (fanout cost >
+        overlap gain there). Waits for quorum + grace, not for every
+        writer (QuorumFanout owns the detach protocol)."""
+        deadline_s = (self._op_deadline_s if self._op_deadline_s is not None
+                      else ROBUST.op_deadline_s)
+        grace_s = (self._grace_s if self._grace_s is not None
+                   else ROBUST.straggler_grace_s)
+        pending: set[int] = set()
+        inline: list[int] = []
+        for i, w in enumerate(self.writers):
+            if i in self._fan.detached:
+                continue  # straggler from an earlier block; errs latched
             if w is None:
-                self.errs[i] = ErrDiskNotFound(f"writer {i}")
+                if self.errs[i] is None:
+                    self.errs[i] = ErrDiskNotFound(f"writer {i}")
                 continue
             if _SINGLE_CORE and _is_local_sink(getattr(w, "_sink", w)):
                 inline.append(i)
             else:
-                futures.append(_io_pool.submit(do, i))
-        for i in inline:
-            do(i)
-        for f in futures:
-            f.result()
+                pending.add(i)
+
+        def record(i, err):
+            if err is None:
+                self.errs[i] = None
+            else:
+                self.errs[i] = err
+                self.writers[i] = None
+
+        def on_detach(i):
+            # errs[i] stays a timeout (the writer missed later blocks
+            # regardless) and the nil'd slot routes the shard to MRF.
+            self.errs[i] = ErrDiskOpTimeout(
+                f"writer {i} straggling past write quorum"
+            )
+            self.writers[i] = None
+
+        self._fan.dispatch(
+            attempt, pending, inline, self.write_quorum,
+            deadline_s, grace_s,
+            count_ok=lambda: sum(
+                1 for j in range(len(self.errs))
+                if self.errs[j] is None and j not in pending
+            ),
+            record=record,
+            on_detach=on_detach,
+            skip=lambda i: self.writers[i] is None,
+            on_stragglers=lambda n: record_stat(
+                "fanout_stragglers_total", n
+            ),
+        )
 
         nil_count = sum(1 for e in self.errs if e is None)
         if nil_count >= self.write_quorum:
@@ -104,21 +176,16 @@ class ParallelWriter:
         call (StreamingBitrotWriter.write_frames). One task per shard per
         batch instead of one per shard per block — the Python-overhead
         fix for the host-fed pipeline."""
-        def do(i):
-            try:
-                w = self.writers[i]
-                if hasattr(w, "write_frames"):
-                    w.write_frames(strips[i], chunk_size)
-                else:
-                    strip = memoryview(strips[i])
-                    for off in range(0, len(strip), chunk_size):
-                        w.write(strip[off:off + chunk_size])
-                self.errs[i] = None
-            except Exception as exc:  # noqa: BLE001 - collected for quorum
-                self.errs[i] = exc
-                self.writers[i] = None
+        def attempt(i):
+            w = self.writers[i]
+            if hasattr(w, "write_frames"):
+                w.write_frames(strips[i], chunk_size)
+            else:
+                strip = memoryview(strips[i])
+                for off in range(0, len(strip), chunk_size):
+                    w.write(strip[off:off + chunk_size])
 
-        self._fanout(do)
+        self._fanout(attempt)
 
 
 class _StripFiller:
@@ -737,14 +804,16 @@ class ParallelReader:
             self._queue.append([None] * len(self.readers))
             return
 
-        import threading
-
-        lock = threading.Lock()
+        cv = threading.Condition()
         results: dict[int, list] = {}  # buf_idx -> per-block chunks
-        state = {"next": 0}
+        state = {"next": 0, "active": 0, "closed": False,
+                 "progress": time.monotonic()}
+        inflight: set[int] = set()   # reader idx currently mid-read
+        abandoned: set[int] = set()  # hedged past; late results dropped
+        parked: dict[int, object] = {}  # abandoned idx -> its reader
 
         def try_next() -> int | None:
-            with lock:
+            with cv:
                 i = state["next"]
                 if i >= len(self.readers):
                     return None
@@ -758,9 +827,28 @@ class ParallelReader:
                     i = try_next()
                     continue
                 buf_idx = self.reader_to_buf[i]
+                with cv:
+                    # closed-check and inflight-entry are one atomic
+                    # step: once the batch is closed, a worker that has
+                    # not yet STARTED its read must not touch the reader
+                    # — the caller is about to advance the offset, and a
+                    # late read against the new offset with this batch's
+                    # lengths would interleave two reads on one stream.
+                    # Its untouched reader stays in the rotation.
+                    if state["closed"]:
+                        return
+                    inflight.add(i)
                 try:
                     chunks = rr.read_chunks(self.offset, lengths)
                 except Exception as exc:  # noqa: BLE001 - classified below
+                    with cv:
+                        inflight.discard(i)
+                        if i in abandoned:
+                            abandoned.discard(i)
+                            parked.pop(i, None)  # failed late: dropped
+                            _io_compensator.released()
+                            cv.notify_all()
+                            return
                     if isinstance(exc, ErrFileNotFound):
                         self.saw_missing = True
                     elif isinstance(exc, ErrFileCorrupt):
@@ -770,9 +858,36 @@ class ParallelReader:
                     self.errs[i] = exc
                     i = try_next()
                     continue
-                with lock:
-                    results[buf_idx] = chunks
+                with cv:
+                    inflight.discard(i)
+                    if i in abandoned:
+                        abandoned.discard(i)
+                        _io_compensator.released()
+                        # The late read still completed THIS batch's
+                        # schedule, so the reader's stream position is
+                        # exactly the next batch's offset: if no further
+                        # batch has advanced past it, the slow-but-alive
+                        # reader REJOINS the rotation instead of forcing
+                        # reconstruction for the rest of the stream.
+                        rr2 = parked.pop(i, None)
+                        if (rr2 is not None and self.readers[i] is None
+                                and getattr(rr2, "_curr", None)
+                                == self.offset):
+                            self.readers[i] = rr2
+                            self.errs[i] = None
+                    else:
+                        results[buf_idx] = chunks
+                        state["progress"] = time.monotonic()
+                    cv.notify_all()
                 return
+
+        def worker(i: int):
+            try:
+                run(i)
+            finally:
+                with cv:
+                    state["active"] -= 1
+                    cv.notify_all()
 
         first = []
         for _ in range(self.data_blocks):
@@ -784,17 +899,72 @@ class ParallelReader:
         ):
             for i in first:
                 run(i)
+            # Late escalation: if failures left us short but readers
+            # remain untried, keep going serially (no hedging on one
+            # core — there is no thread to overlap the wait with).
+            while (len(results) < self.data_blocks
+                   and state["next"] < len(self.readers)):
+                i = try_next()
+                if i is not None:
+                    run(i)
         else:
-            futures = [_io_pool.submit(run, i) for i in first]
-            for f in futures:
-                f.result()
-
-        # Late escalation: if concurrent failures left us short but readers
-        # remain untried, keep going serially.
-        while len(results) < self.data_blocks and state["next"] < len(self.readers):
-            i = try_next()
-            if i is not None:
-                run(i)
+            with cv:
+                state["active"] = len(first)
+            for i in first:
+                _io_pool.submit(worker, i)
+            hedge_s = ROBUST.hedge_delay_s
+            deadline = time.monotonic() + ROBUST.long_op_deadline_s
+            last_hedge = 0.0
+            state["progress"] = time.monotonic()
+            with cv:
+                while len(results) < self.data_blocks:
+                    if (state["active"] == 0
+                            and state["next"] >= len(self.readers)):
+                        break  # everyone finished/failed; nothing to try
+                    now = time.monotonic()
+                    if now >= deadline:
+                        break
+                    # STALL-based hedging: fire only when no result has
+                    # arrived for a full hedge window (a batch that is
+                    # merely slower than hedge_delay but making steady
+                    # progress must not pay read amplification).
+                    fire_at = max(state["progress"], last_hedge) + hedge_s
+                    if now >= fire_at:
+                        # A preferred shard is stalled: dispatch the next
+                        # untried (parity) reader instead of blocking on
+                        # it (hedged read; the erasure-decoding dual of
+                        # proceeding once any k of n shards arrive).
+                        last_hedge = now
+                        j = try_next()
+                        if j is not None:
+                            state["active"] += 1
+                            record_stat("hedged_reads_total")
+                            _io_pool.submit(worker, j)
+                        continue
+                    cv.wait(min(fire_at, deadline) - now)
+                # Close the batch: workers that have not started their
+                # read exit at the closed-check, readers untouched.
+                # Readers still MID-read are abandoned: their stream is
+                # parked on THIS batch's offsets, so reusing them next
+                # batch would interleave two reads on one stream. Drop
+                # them from the rotation — slow is not missing, so no
+                # heal hint, and a late result is simply discarded. Each
+                # abandoned worker still pins a pool thread until its
+                # read returns; compensate the pool ceiling meanwhile.
+                state["closed"] = True
+                for j in list(inflight):
+                    abandoned.add(j)
+                    inflight.discard(j)
+                    _io_compensator.parked()
+                    if self.errs[j] is None:
+                        self.errs[j] = ErrDiskOpTimeout(
+                            f"shard reader {j} abandoned past hedge"
+                        )
+                    # Parked, not destroyed: if its in-flight read
+                    # completes while the stream position still lines up
+                    # with the rotation, the reader rejoins (see run()).
+                    parked[j] = self.readers[j]
+                    self.readers[j] = None
 
         if len(results) < self.data_blocks:
             err = reduce_read_quorum_errs(

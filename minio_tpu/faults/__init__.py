@@ -1,0 +1,19 @@
+"""Fault injection subsystem: deterministic error/latency/hang/bitrot
+schedules over any StorageAPI, armable at runtime (admin `faults`
+endpoint). See injector.py."""
+
+from .injector import (  # noqa: F401
+    MAX_HANG_S,
+    FaultDisk,
+    FaultSchedule,
+    FaultSpec,
+    FaultStream,
+    FaultWriter,
+    NaughtyDisk,
+    NaughtyWriter,
+    arm,
+    disarm,
+    enabled,
+    hang_disk,
+    status,
+)

@@ -1,0 +1,419 @@
+"""Deterministic fault injection over StorageAPI — the promotion of the
+test-only NaughtyDisk (ref naughtyDisk, /root/reference/cmd/
+naughty-disk_test.go) into a first-class subsystem: seeded per-op
+error/latency/hang/bitrot schedules wrapping any StorageAPI, armable at
+RUNTIME through the process-wide registry (admin `faults` endpoint) so
+chaos drills run against a live server, not only unit tests.
+
+Two wrappers:
+- NaughtyDisk — the original scripted-call-number decorator, kept
+  verbatim for the existing scenario tests (one shared counter, exact
+  call numbers, optional default error after the script).
+- FaultDisk — schedule-driven: each op consults a FaultSchedule (its
+  own, or whatever is armed in the registry for its endpoint), which
+  matches FaultSpecs by op name / call number / seeded probability and
+  injects an error, a latency sleep, an indefinite-until-disarmed hang,
+  or bitrot (corrupted read bytes).
+
+Hangs block on the schedule's release event, so `disarm()` (or the
+admin DELETE) frees every stuck thread deterministically; a hard cap
+(MAX_HANG_S) bounds leakage if a schedule is never disarmed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from ..utils import errors as _errors
+from ..utils.errors import ErrDiskNotFound
+
+# Identity helpers never count as operations.
+_NON_OPS = {"endpoint", "hostname", "is_local", "is_online", "set_online"}
+
+# Safety cap on an armed hang: a forgotten schedule must not pin pool
+# threads forever in CI.
+MAX_HANG_S = 120.0
+
+_ENV_FLAG = "MTPU_FAULT_INJECTION"
+
+
+def enabled() -> bool:
+    """Whether the SERVER wires FaultDisk into its disk stack (tests
+    construct FaultDisk directly and need no flag)."""
+    return os.environ.get(_ENV_FLAG, "") not in ("", "0", "off")
+
+
+# ---------------------------------------------------------------------------
+# schedules
+
+
+class FaultSpec:
+    """One injection rule: which ops / call numbers / probability, and
+    what to do when it fires."""
+
+    KINDS = ("error", "latency", "hang", "bitrot")
+
+    def __init__(self, kind: str, ops=None, calls=None,
+                 probability: float = 0.0, latency_s: float = 0.0,
+                 error: Exception | type | str | None = None):
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self.kind = kind
+        self.ops = frozenset(ops) if ops else None
+        self.calls = frozenset(calls) if calls else None
+        self.probability = float(probability)
+        self.latency_s = float(latency_s)
+        self.error = error
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(
+            d.get("kind", "error"),
+            ops=d.get("ops"),
+            calls=d.get("calls"),
+            probability=d.get("probability", 0.0),
+            latency_s=d.get("latency_s", 0.0),
+            error=d.get("error"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "ops": sorted(self.ops) if self.ops else None,
+            "calls": sorted(self.calls) if self.calls else None,
+            "probability": self.probability,
+            "latency_s": self.latency_s,
+            "error": (self.error if isinstance(self.error, str)
+                      else getattr(self.error, "__name__",
+                                   None if self.error is None
+                                   else type(self.error).__name__)),
+        }
+
+    def matches(self, op: str, call_n: int, rng: random.Random) -> bool:
+        if self.ops is not None and op not in self.ops:
+            return False
+        if self.calls is not None:
+            return call_n in self.calls
+        if self.probability:
+            return rng.random() < self.probability
+        return True  # no call filter, no probability: every matching op
+
+    def make_error(self) -> Exception:
+        err = self.error
+        if err is None:
+            return ErrDiskNotFound("injected fault")
+        if isinstance(err, Exception):
+            return err
+        if isinstance(err, str):
+            cls = getattr(_errors, err, None)
+            if cls is None or not (isinstance(cls, type)
+                                   and issubclass(cls, Exception)):
+                return ErrDiskNotFound(f"injected fault ({err})")
+            return cls("injected fault")
+        return err("injected fault")
+
+
+class FaultSchedule:
+    """Seeded, deterministic fault schedule: one shared call counter
+    across all ops of the wrapped disk (the NaughtyDisk convention), a
+    seeded RNG for probabilistic specs, and a release event that
+    disarm() sets to free in-flight hangs."""
+
+    def __init__(self, specs=(), seed: int = 0):
+        self.specs = [s if isinstance(s, FaultSpec) else FaultSpec.from_dict(s)
+                      for s in specs]
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._calls = 0
+        self._lock = threading.Lock()
+        self._released = threading.Event()
+        self.active = True
+        self.fired = 0
+
+    @property
+    def calls(self) -> int:
+        return self._calls
+
+    def disarm(self) -> None:
+        self.active = False
+        self._released.set()
+
+    def _match(self, op: str) -> FaultSpec | None:
+        with self._lock:
+            self._calls += 1
+            n = self._calls
+            if not self.active:
+                return None
+            for spec in self.specs:
+                if spec.matches(op, n, self._rng):
+                    self.fired += 1
+                    return spec
+            return None
+
+    def apply(self, op: str) -> str | None:
+        """Consult the schedule for one op. Raises for `error`, sleeps
+        for `latency`, blocks until disarm for `hang`; returns "bitrot"
+        when the caller (a read wrapper) should corrupt its payload."""
+        spec = self._match(op)
+        if spec is None:
+            return None
+        if spec.kind == "error":
+            raise spec.make_error()
+        if spec.kind == "latency":
+            # Interruptible: disarm mid-sleep releases the thread.
+            self._released.wait(timeout=spec.latency_s)
+            return None
+        if spec.kind == "hang":
+            self._released.wait(timeout=MAX_HANG_S)
+            if not self.active:
+                return None
+            raise ErrDiskNotFound(f"injected hang on {op} hit MAX_HANG_S")
+        return "bitrot"
+
+    def status(self) -> dict:
+        return {
+            "seed": self.seed,
+            "calls": self._calls,
+            "fired": self.fired,
+            "active": self.active,
+            "specs": [s.to_dict() for s in self.specs],
+        }
+
+
+# ---------------------------------------------------------------------------
+# runtime registry (admin-armable)
+
+_REG_LOCK = threading.Lock()
+_REGISTRY: dict[str, FaultSchedule] = {}
+
+
+def arm(endpoint: str, schedule: FaultSchedule | dict) -> FaultSchedule:
+    """Arm a schedule for every FaultDisk whose endpoint matches. A
+    previously armed schedule for the endpoint is disarmed first (its
+    hung threads release)."""
+    if isinstance(schedule, dict):
+        schedule = FaultSchedule(
+            schedule.get("specs", ()), seed=schedule.get("seed", 0)
+        )
+    with _REG_LOCK:
+        old = _REGISTRY.get(endpoint)
+        _REGISTRY[endpoint] = schedule
+    if old is not None:
+        old.disarm()
+    return schedule
+
+
+def disarm(endpoint: str | None = None) -> list[str]:
+    """Disarm one endpoint's schedule (or ALL when endpoint is None),
+    releasing any threads blocked in injected hangs."""
+    with _REG_LOCK:
+        if endpoint is None:
+            dropped = dict(_REGISTRY)
+            _REGISTRY.clear()
+        else:
+            sched = _REGISTRY.pop(endpoint, None)
+            dropped = {endpoint: sched} if sched is not None else {}
+    for sched in dropped.values():
+        sched.disarm()
+    return sorted(dropped)
+
+
+def status() -> dict:
+    with _REG_LOCK:
+        return {ep: s.status() for ep, s in _REGISTRY.items()}
+
+
+def _lookup(endpoint: str) -> FaultSchedule | None:
+    with _REG_LOCK:
+        return _REGISTRY.get(endpoint)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+
+
+class FaultWriter:
+    """File-writer wrapper: each write() consults the schedule (op
+    `shard_write`), so a disk can die or hang BETWEEN two blocks of one
+    streaming encode."""
+
+    def __init__(self, inner, disk: "FaultDisk"):
+        self._inner = inner
+        self._disk = disk
+
+    def write(self, data):
+        sched = self._disk._sched()
+        if sched is not None:
+            sched.apply("shard_write")
+        return self._inner.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def close(self):
+        try:
+            self._inner.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class FaultStream:
+    """Read-stream wrapper: each read() consults the schedule (op
+    `stream_read`); a `bitrot` verdict flips the first byte so the
+    bitrot verification layer must catch it."""
+
+    def __init__(self, inner, disk: "FaultDisk"):
+        self._inner = inner
+        self._disk = disk
+
+    def read(self, n: int = -1):
+        sched = self._disk._sched()
+        verdict = sched.apply("stream_read") if sched is not None else None
+        out = self._inner.read(n)
+        if verdict == "bitrot" and out:
+            out = bytes([out[0] ^ 0xFF]) + out[1:]
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def close(self):
+        try:
+            self._inner.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class FaultDisk:
+    """Schedule-driven StorageAPI decorator. `schedule` pins a local
+    schedule; without one, every call looks up the registry by endpoint,
+    which is how the admin endpoint arms faults on a live server."""
+
+    def __init__(self, disk, schedule: FaultSchedule | None = None):
+        self._disk = disk
+        self._schedule = schedule
+
+    def _sched(self) -> FaultSchedule | None:
+        if self._schedule is not None:
+            return self._schedule
+        try:
+            return _lookup(self._disk.endpoint())
+        except Exception:  # noqa: BLE001 - endpoint() is metadata-only
+            return None
+
+    def arm(self, schedule: FaultSchedule | dict) -> FaultSchedule:
+        if isinstance(schedule, dict):
+            schedule = FaultSchedule(
+                schedule.get("specs", ()), seed=schedule.get("seed", 0)
+            )
+        self._schedule = schedule
+        return schedule
+
+    def disarm(self) -> None:
+        if self._schedule is not None:
+            self._schedule.disarm()
+            self._schedule = None
+
+    def unwrap(self):
+        return self._disk
+
+    def __getattr__(self, name):
+        attr = getattr(self._disk, name)
+        if name in _NON_OPS or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            sched = self._sched()
+            verdict = sched.apply(name) if sched is not None else None
+            out = attr(*args, **kwargs)
+            if name == "create_file_writer":
+                return FaultWriter(out, self)
+            if name == "read_file_stream":
+                return FaultStream(out, self)
+            if verdict == "bitrot" and name in ("read_all", "read_file") \
+                    and out:
+                out = bytes([out[0] ^ 0xFF]) + out[1:]
+            return out
+
+        return wrapped
+
+
+# ---------------------------------------------------------------------------
+# the original scripted decorator (kept verbatim for scenario tests)
+
+
+class NaughtyWriter:
+    """File-writer wrapper: each write() consults the same script, so a
+    disk can die BETWEEN two blocks of one streaming encode."""
+
+    def __init__(self, inner, naughty: "NaughtyDisk"):
+        self._inner = inner
+        self._naughty = naughty
+
+    def write(self, data):
+        self._naughty._maybe_raise()
+        return self._inner.write(data)
+
+    def close(self):
+        try:
+            self._inner.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class NaughtyDisk:
+    """StorageAPI decorator with per-call-number scripted errors (ref
+    naughtyDisk, cmd/naughty-disk_test.go:29-44). Every API call
+    increments one shared counter; if the counter has a scripted error,
+    that call raises it; otherwise, when a default error is set, calls
+    AFTER the script raise the default (a disk that dies and stays
+    dead)."""
+
+    def __init__(self, disk, errors: dict[int, Exception] | None = None,
+                 default: Exception | None = None):
+        self._disk = disk
+        self._errors = dict(errors or {})
+        self._default = default
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    @property
+    def calls(self) -> int:
+        return self._calls
+
+    def _maybe_raise(self):
+        with self._lock:
+            self._calls += 1
+            n = self._calls
+        err = self._errors.get(n)
+        if err is not None:
+            raise err
+        if self._default is not None and self._errors and \
+                n > max(self._errors):
+            raise self._default
+        if self._default is not None and not self._errors:
+            raise self._default
+
+    def __getattr__(self, name):
+        attr = getattr(self._disk, name)
+        if name in _NON_OPS or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            self._maybe_raise()
+            out = attr(*args, **kwargs)
+            if name == "create_file_writer":
+                return NaughtyWriter(out, self)
+            return out
+
+        return wrapped
+
+
+def hang_disk(disk, ops=None) -> tuple[FaultDisk, FaultSchedule]:
+    """Convenience: wrap `disk` so the given ops (default: all) hang
+    until the returned schedule is disarmed — the canonical hung-NFS
+    drill."""
+    sched = FaultSchedule([FaultSpec("hang", ops=ops)])
+    return FaultDisk(disk, sched), sched

@@ -62,7 +62,13 @@ BLOCK_SIZE_V2 = 1 << 20  # erasure block size, ref cmd/object-api-common.go:39
 _obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
 
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
+from ..utils.fanout import StragglerCompensator
 from ..utils.fanout import encode_slot as _encode_slot
+
+# Commit/delete stragglers detached by _quorum_fanout keep occupying
+# their _obj_pool worker until the hung call returns; compensate the
+# ceiling meanwhile so healthy fan-outs keep full concurrency.
+_obj_compensator = StragglerCompensator(_obj_pool)
 
 
 def _close_sinks(sinks):
@@ -86,6 +92,64 @@ def _fanout(fn, n: int, disks: list):
             fn(i)
     else:
         list(_obj_pool.map(fn, range(n)))
+
+
+def _quorum_fanout(attempt, n: int, disks: list, errs: list, quorum: int,
+                   op_deadline_s: float | None = None,
+                   straggler_grace_s: float | None = None) -> None:
+    """Quorum-wait fan-out for commit/delete paths: run attempt(i)
+    (which RAISES on failure) for i in range(n), recording errs[i], and
+    return as soon as `quorum` successes land plus a short straggler
+    grace. Disks still in flight past that are detached: errs[i]
+    becomes ErrDiskOpTimeout (quorum-ignored, like an offline disk) and
+    a late result is discarded — the caller's MRF/heal machinery repairs
+    whatever the straggler missed. A hung drive therefore bounds a
+    commit at (op deadline + straggler grace) instead of wedging it
+    (ref the per-op deadlines of cmd/xl-storage-disk-id-check.go).
+
+    Known window: a detached straggler's rename can land AFTER the
+    caller released its per-object write lock, so one disk may briefly
+    carry metadata a racing newer write already superseded. Both commit
+    callers queue the object in MRF whenever errs is non-nil, and MRF
+    heal rewrites the minority disk to the quorum mod-time — the stale
+    copy never survives past the next drain."""
+    from ..erasure.streaming import record_stat
+    from ..storage.diskcheck import ROBUST
+    from ..utils.errors import ErrDiskOpTimeout
+    from ..utils.fanout import QuorumFanout
+
+    if _SINGLE_CORE and all(d is None or d.is_local() for d in disks):
+        # One core: serial inline execution, nothing to detach.
+        for i in range(n):
+            try:
+                attempt(i)
+            except Exception as exc:  # noqa: BLE001 - collected for quorum
+                errs[i] = exc
+        return
+
+    deadline_s = (op_deadline_s if op_deadline_s is not None
+                  else ROBUST.op_deadline_s)
+    grace_s = (straggler_grace_s if straggler_grace_s is not None
+               else ROBUST.straggler_grace_s)
+    pending = set(range(n))
+
+    def record(i, err):
+        if err is not None:
+            errs[i] = err
+
+    def on_detach(i):
+        errs[i] = ErrDiskOpTimeout(
+            f"disk {i} straggling past quorum commit"
+        )
+
+    QuorumFanout(_obj_pool, _obj_compensator).dispatch(
+        attempt, pending, (), quorum, deadline_s, grace_s,
+        count_ok=lambda: sum(1 for j in range(n)
+                             if errs[j] is None and j not in pending),
+        record=record,
+        on_detach=on_detach,
+        on_stragglers=lambda k: record_stat("fanout_stragglers_total", k),
+    )
 
 
 from .multipart import MultipartMixin
@@ -391,8 +455,7 @@ class ErasureObjects(MultipartMixin):
         def commit(i):
             disk = disks_by_shard[i]
             if disk is None or writers[i] is None:
-                errs[i] = ErrDiskNotFound(f"disk {i}")
-                return
+                raise ErrDiskNotFound(f"disk {i}")
             fi = FileInfo(
                 volume=bucket,
                 name=object_,
@@ -413,24 +476,35 @@ class ErasureObjects(MultipartMixin):
             fi.add_part(1, size, size)
             if inline:
                 fi.data = {1: sinks[i].getvalue()}
-            try:
-                disk.rename_data(
-                    SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi, bucket, object_
-                )
-            except Exception as exc:  # noqa: BLE001
-                errs[i] = exc
+            disk.rename_data(
+                SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi, bucket, object_
+            )
 
-        _fanout(commit, n, disks_by_shard)
+        # Commit fan-out waits for write quorum + straggler grace, not
+        # for every disk: a drive hung in rename_data is detached (its
+        # errs slot becomes a timeout) and the missed commit heals via
+        # the MRF queue below.
+        _quorum_fanout(commit, n, disks_by_shard, errs, write_quorum)
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             # Undo the renames that DID land (ref undoRename /
             # cmd/erasure-object.go:484): a sub-quorum commit must not
             # leave a readable object behind on the minority disks.
+            # Detached stragglers (ErrDiskOpTimeout) are included: their
+            # rename may have landed between detach and now, and a
+            # best-effort delete is deadline-bounded by the health
+            # wrapper. A rename that lands LATER still leaves a
+            # sub-quorum dangling version — the scanner's heal pass
+            # removes those (isObjectDangling semantics).
+            from ..utils.errors import ErrDiskOpTimeout as _ErrTimeout
+
             undo_fi = FileInfo(volume=bucket, name=object_,
                                version_id=version_id)
             for i, e in enumerate(errs):
-                if e is not None or disks_by_shard[i] is None:
+                if disks_by_shard[i] is None:
                     continue
+                if e is not None and not isinstance(e, _ErrTimeout):
+                    continue  # definite failure: nothing landed
                 try:
                     disks_by_shard[i].delete_version(bucket, object_, undo_fi)
                 except Exception:  # noqa: BLE001 - best effort
@@ -775,17 +849,20 @@ class ErasureObjects(MultipartMixin):
 
             def write_marker(i):
                 if self.disks[i] is None:
-                    errs[i] = ErrDiskNotFound(f"disk {i}")
-                    return
-                try:
-                    self.disks[i].write_metadata(bucket, object_, marker)
-                except Exception as exc:  # noqa: BLE001
-                    errs[i] = exc
+                    raise ErrDiskNotFound(f"disk {i}")
+                self.disks[i].write_metadata(bucket, object_, marker)
 
-            _fanout(write_marker, n, self.disks)
+            _quorum_fanout(write_marker, n, self.disks, errs, write_quorum)
             err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
             if err is not None:
                 raise err
+            if any(e is not None for e in errs):
+                # A straggler/offline disk missed the marker: queue MRF
+                # for the MARKER's version id so heal replicates that
+                # exact version — queueing "" (latest) would no-op if a
+                # newer write lands before the drain, leaving the
+                # marker permanently missing from that disk's history.
+                self.queue_mrf(bucket, object_, marker.version_id)
             oi = ObjectInfo(bucket=bucket, name=object_,
                             version_id=marker.version_id, delete_marker=True)
             return oi
@@ -796,17 +873,22 @@ class ErasureObjects(MultipartMixin):
 
         def do(i):
             if self.disks[i] is None:
-                errs[i] = ErrDiskNotFound(f"disk {i}")
-                return
-            try:
-                self.disks[i].delete_version(bucket, object_, fi)
-            except Exception as exc:  # noqa: BLE001
-                errs[i] = exc
+                raise ErrDiskNotFound(f"disk {i}")
+            self.disks[i].delete_version(bucket, object_, fi)
 
-        _fanout(do, n, self.disks)
+        # Quorum-wait: a hung drive must not wedge DELETEs either; the
+        # straggler's stale version is invisible (quorum reads pick the
+        # deleted majority) and heals on the next MRF/scanner pass.
+        _quorum_fanout(do, n, self.disks, errs, write_quorum)
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise self._to_object_err(err, bucket, object_, opts.version_id)
+        if any(not isinstance(e, (type(None), ErrFileNotFound,
+                                  ErrFileVersionNotFound)) for e in errs):
+            # A straggler/offline disk still holds the version the
+            # quorum deleted: queue MRF so heal (dangling removal)
+            # purges it before later failures could resurrect it.
+            self.queue_mrf(bucket, object_, opts.version_id)
         return ObjectInfo(bucket=bucket, name=object_, version_id=opts.version_id)
 
     def delete_objects(self, bucket: str, objects: list[str],

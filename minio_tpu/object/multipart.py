@@ -435,8 +435,7 @@ class MultipartMixin:
         def commit(shard_i):
             disk = disks_by_shard[shard_i]
             if disk is None:
-                errs[shard_i] = ErrDiskNotFound(f"shard {shard_i}")
-                return
+                raise ErrDiskNotFound(f"shard {shard_i}")
             f = FileInfo(
                 volume=bucket, name=object_, version_id=version_id,
                 data_dir=data_dir, mod_time_ns=mod_time_ns, size=total_size,
@@ -458,20 +457,28 @@ class MultipartMixin:
                 disk.delete(SYSTEM_META_BUCKET, f"{upload_path}/xl.meta")
             except Exception:  # noqa: BLE001
                 pass
-            try:
-                disk.rename_data(SYSTEM_META_BUCKET, upload_path, f, bucket, object_)
-            except Exception as exc:  # noqa: BLE001
-                errs[shard_i] = exc
+            disk.rename_data(SYSTEM_META_BUCKET, upload_path, f, bucket, object_)
 
         # The final rename_data fan-out commits the destination object's
         # xl.meta: hold the same per-object write lock as put_object so a
         # racing PutObject can't interleave into a mixed-mod-time quorum
         # (ref CompleteMultipartUpload NSLock, cmd/erasure-multipart.go:736).
+        # Quorum-wait: the commit returns at write quorum + straggler
+        # grace; a drive hung in rename_data is detached and its missed
+        # shard heals via MRF.
+        from .erasure_objects import _quorum_fanout
+
         with self._locked_write(bucket, object_):
-            list(_mp_pool.map(commit, range(len(disks_by_shard))))
+            _quorum_fanout(commit, len(disks_by_shard), disks_by_shard,
+                           errs, write_quorum)
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise err
+        if any(e is not None for e in errs):
+            # Partial commit (quorum met, stragglers/failures behind):
+            # queue MRF so the missing shards are rebuilt (ref
+            # addPartial, cmd/erasure-multipart.go).
+            self.queue_mrf(bucket, object_, version_id)
 
         out = FileInfo(
             volume=bucket, name=object_, version_id=version_id,

@@ -43,6 +43,24 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
     ("disks_offline_count", "gauge", "Offline disks in the deployment"),
     ("disk_offline_total", "counter", "Disk offline transitions"),
     ("disk_reconnect_total", "counter", "Disk reconnect events"),
+    # --- in-band disk health (circuit breaker / deadlines) ---
+    ("disk_health_state", "gauge",
+     "0 when healthy, 1 when latched faulty by the circuit breaker"),
+    ("disk_inflight", "gauge", "In-flight storage ops per disk"),
+    ("disk_op_timeouts_total", "counter",
+     "Storage ops abandoned at their wall-clock deadline"),
+    ("disk_inflight_rejected_total", "counter",
+     "Storage ops rejected because the per-disk token budget was full"),
+    ("disk_faulty_total", "counter",
+     "Circuit-breaker latch events (disk marked faulty)"),
+    ("disk_readmit_total", "counter",
+     "Faulty disks re-admitted by the background probe"),
+    ("hedged_reads_total", "counter",
+     "GET shard reads hedged onto parity past the hedge delay"),
+    ("fanout_stragglers_total", "counter",
+     "Erasure fan-out writers detached after write quorum"),
+    ("dsync_unlock_failures_total", "counter",
+     "dsync unlock RPCs that failed (grant leaks until expiry)"),
     # --- erasure/heal ---
     ("heal_objects_total", "counter", "Objects healed by trigger"),
     ("heal_failures_total", "counter", "Object heal failures"),
@@ -156,6 +174,16 @@ class MetricsCollector:
                     offline += 1
                     continue
                 ep = d.endpoint()
+                hi = getattr(d, "health_info", None)
+                hi = hi() if callable(hi) else None
+                if hi is not None:
+                    # Breaker/token state from the in-band tracker — no
+                    # RPC, just counters (ref the cached health state the
+                    # reference serves from xl-storage-disk-id-check).
+                    m.set_gauge("disk_health_state",
+                                1.0 if hi["state"] == "faulty" else 0.0,
+                                disk=ep)
+                    m.set_gauge("disk_inflight", hi["inflight"], disk=ep)
                 try:
                     online = d.is_online()
                 except Exception:  # noqa: BLE001

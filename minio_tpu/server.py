@@ -136,6 +136,20 @@ class Server:
         from .pipeline import metrics as pipeline_metrics
 
         pipeline_metrics.set_registry(self.metrics)
+        # Robustness telemetry (hedged reads, detached stragglers) and
+        # dsync unlock-failure counts flow through the same hooks.
+        from .distributed import dsync as _dsync
+        from .erasure import streaming as _streaming
+
+        _streaming.set_metrics(self.metrics)
+        _dsync.set_metrics(self.metrics)
+        # Hung-drive tolerance knobs (config subsystem `drive`): env
+        # overrides apply immediately; persisted operator values re-apply
+        # after config_sys.load() below.
+        from .config.config import Config as _DriveCfg
+        from .storage.diskcheck import configure_robustness
+
+        configure_robustness(_DriveCfg().get("drive"))
         self.storage_server = None
         self.peer_server = None
         self.lock_server = None
@@ -156,17 +170,13 @@ class Server:
             )
             all_eps = [ep for pool in layout["pools"] for ep in pool]
             distributed = any("://" in ep for ep in all_eps)
-            from .storage.diskcheck import MetricsDisk
-
             if distributed:
                 mk_disk = self._start_storage_plane(
                     all_eps, storage_address
                 )
             else:
                 def mk_disk(ep):
-                    return MetricsDisk(
-                        LocalStorage(ep, endpoint=ep), self.metrics
-                    )
+                    return self._wrap_disk(LocalStorage(ep, endpoint=ep), ep)
             pools = []
             for pi, endpoints in enumerate(layout["pools"]):
                 # Every disk is wrapped in the per-op metrics/disk-id
@@ -231,6 +241,11 @@ class Server:
             self.object_layer, secret=self.root_password
         )
         self.config_sys.load()
+        # Re-apply hung-drive knobs now that persisted operator values
+        # are available (env still wins inside Config.get).
+        from .storage.diskcheck import configure_robustness as _cfg_robust
+
+        _cfg_robust(self.config_sys.config.get("drive"))
         # Optional disk cache in front of the API's object layer (the
         # background services keep the raw layer, like the reference's
         # cacheObjects wrapping only the served ObjectLayer).
@@ -411,7 +426,6 @@ class Server:
             RemoteStorage,
             StorageRESTServer,
         )
-        from .storage.diskcheck import MetricsDisk
 
         if storage_address is None:
             raise ValueError(
@@ -458,13 +472,23 @@ class Server:
 
         def mk_disk(ep):
             if ep in local_by_ep:
-                return MetricsDisk(local_by_ep[ep], self.metrics)
+                return self._wrap_disk(local_by_ep[ep], ep)
             netloc, _ = _split_url(ep)
-            return MetricsDisk(
-                RemoteStorage(netloc, ep, secret), self.metrics
-            )
+            return self._wrap_disk(RemoteStorage(netloc, ep, secret), ep)
 
         return mk_disk
+
+    def _wrap_disk(self, raw, ep: str):
+        """Per-disk decorator stack: the env-gated fault injector
+        (chaos drills; minio_tpu/faults) innermost, then the metrics +
+        disk-id + health wrapper with its circuit breaker and per-op
+        deadlines (ref xl-storage-disk-id-check.go)."""
+        from . import faults
+        from .storage.diskcheck import DiskHealth, MetricsDisk
+
+        if faults.enabled():
+            raw = faults.FaultDisk(raw)
+        return MetricsDisk(raw, self.metrics, health=DiskHealth(ep))
 
     def _format_distributed(self, es, leader: bool):
         """Fresh-deployment format with cross-node coordination: the

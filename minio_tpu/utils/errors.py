@@ -17,6 +17,20 @@ class ErrDiskNotFound(StorageError):
     """Disk is offline / not found (ref: cmd/storage-errors.go errDiskNotFound)."""
 
 
+class ErrDiskFaulty(ErrDiskNotFound):
+    """Disk latched faulty by the health circuit breaker after repeated
+    op timeouts (ref: errFaultyDisk, cmd/xl-storage-disk-id-check.go).
+    Subclasses ErrDiskNotFound so every quorum reduction and fan-out
+    path treats a faulty disk exactly like an offline one."""
+
+
+class ErrDiskOpTimeout(ErrDiskFaulty):
+    """One storage op exceeded its wall-clock deadline (ref: the per-op
+    context deadlines of diskHealthTracker). The op may still complete
+    in the background; the caller must treat the disk as failed for
+    this op and let MRF/heal repair any missed write."""
+
+
 class ErrFileNotFound(StorageError):
     """File not found on disk (ref: errFileNotFound) — triggers missing-part heal."""
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import io
 import os
 import threading
+import time
 from contextlib import contextmanager
 
 SINGLE_CORE = (os.cpu_count() or 1) == 1
@@ -58,3 +59,155 @@ def is_local_sink(sink) -> bool:
         hasattr(sink, "fileno")
         or isinstance(sink, (io.BytesIO, io.BufferedWriter))
     )
+
+
+class StragglerCompensator:
+    """Keeps a fan-out ThreadPoolExecutor's HEALTHY capacity constant
+    while detached stragglers occupy workers, possibly forever (a write
+    wedged below any deadline — e.g. an NFS stall — blocks its pool
+    thread until the kernel gives up). Each parked straggler raises the
+    pool's worker ceiling by one so new fan-outs still get their full
+    concurrency; when the straggler finally returns the ceiling drops
+    back. Growth is capped so a pathological storm cannot spawn
+    unbounded threads — past the cap, stragglers start eating into
+    shared capacity again (and the health breaker has long since
+    latched the drive responsible)."""
+
+    def __init__(self, pool, max_extra: int = 256):
+        # Relies on ThreadPoolExecutor._max_workers being consulted on
+        # every submit (_adjust_thread_count); degrade to a no-op if a
+        # future CPython renames it.
+        self._pool = pool if hasattr(pool, "_max_workers") else None
+        self._max_extra = max_extra
+        self._extra = 0
+        self._applied = 0
+        self._mu = threading.Lock()
+
+    def _apply(self):
+        want = min(self._extra, self._max_extra)
+        delta = want - self._applied
+        if delta and self._pool is not None:
+            self._pool._max_workers += delta
+        self._applied = want
+
+    def parked(self):
+        with self._mu:
+            self._extra += 1
+            self._apply()
+
+    def released(self):
+        with self._mu:
+            self._extra -= 1
+            self._apply()
+
+
+def quorum_wait(cv, pending, count_ok, quorum, deadline_s, grace_s):
+    """The quorum-wait protocol shared by every erasure fan-out
+    (shard writes, commit renames, deletes): block on `cv` until
+    count_ok() reaches `quorum` plus one straggler grace, the fan-out
+    becomes quorum-IMPOSSIBLE (fail now — but only after one grace, so
+    tasks ms from settling still report true outcomes for cleanup
+    paths like undoRename), every task finished, or deadline_s
+    elapses. count_ok runs under cv. Whatever is left in `pending`
+    afterwards is the caller's to detach."""
+    deadline = time.monotonic() + deadline_s
+    grace_end = None
+    fail_end = None
+    with cv:
+        while pending:
+            now = time.monotonic()
+            ok = count_ok()
+            if ok >= quorum:
+                if grace_end is None:
+                    grace_end = now + grace_s
+                if now >= grace_end:
+                    break
+                cv.wait(grace_end - now)
+            elif ok + len(pending) < quorum:
+                if fail_end is None:
+                    fail_end = now + grace_s
+                if now >= fail_end:
+                    break
+                cv.wait(fail_end - now)
+            elif now >= deadline:
+                break
+            else:
+                cv.wait(deadline - now)
+
+
+class QuorumFanout:
+    """The detach state machine around quorum_wait, shared by the shard
+    -write fan-out (ParallelWriter) and the commit/delete fan-outs
+    (_quorum_fanout): dispatch attempt(i) for every index in `pending`
+    (plus `inline` synchronously), wait for quorum + grace, then detach
+    whatever is still in flight — stamping its outcome via on_detach,
+    pairing each parked straggler with one compensator release when its
+    worker finally frees, and discarding late results. One protocol,
+    one set of races to reason about.
+
+    `cv`/`detached`/`straggling` may be shared across dispatches (the
+    writer fan-out detaches persistently across blocks) or fresh per
+    call (one-shot commit fan-outs)."""
+
+    def __init__(self, pool, compensator, cv=None,
+                 detached=None, straggling=None):
+        self.pool = pool
+        self.comp = compensator
+        self.cv = cv if cv is not None else threading.Condition()
+        self.detached = detached if detached is not None else set()
+        self.straggling = straggling if straggling is not None else set()
+
+    def _release(self, i):
+        if i in self.straggling:
+            self.straggling.discard(i)
+            self.comp.released()
+
+    def dispatch(self, attempt, pending, inline, quorum,
+                 deadline_s, grace_s, *, count_ok, record,
+                 on_detach, skip=None, on_stragglers=None):
+        cv = self.cv
+        detached = self.detached
+
+        def run(i):
+            with cv:
+                # Detached (or skippable) while still QUEUED: never
+                # start work whose result is already discarded — a
+                # rename that has not begun must not land minutes after
+                # the caller's locks were released.
+                if i in detached or (skip is not None and skip(i)):
+                    pending.discard(i)
+                    self._release(i)
+                    cv.notify_all()
+                    return
+            err = None
+            try:
+                attempt(i)
+            except Exception as exc:  # noqa: BLE001 - collected for quorum
+                err = exc
+            with cv:
+                if i in detached:
+                    # Straggler finished after detach: result discarded
+                    # (its slot already carries the timeout; MRF/heal
+                    # repairs whatever it missed); worker freed.
+                    self._release(i)
+                    cv.notify_all()
+                    return
+                pending.discard(i)
+                record(i, err)
+                cv.notify_all()
+
+        for i in sorted(pending):
+            self.pool.submit(run, i)
+        for i in inline:
+            run(i)
+
+        quorum_wait(cv, pending, count_ok, quorum, deadline_s, grace_s)
+        with cv:
+            if pending and on_stragglers is not None:
+                on_stragglers(len(pending))
+            for i in list(pending):
+                detached.add(i)
+                self.straggling.add(i)
+                self.comp.parked()
+                on_detach(i)
+                pending.discard(i)

@@ -1,75 +1,6 @@
-"""NaughtyDisk: a StorageAPI decorator with per-call-number scripted
-errors (ref naughtyDisk, /root/reference/cmd/naughty-disk_test.go:29-44)
-— simulates disks dying mid-operation, at specific calls, or flapping.
+"""Back-compat shim: NaughtyDisk was promoted into the first-class
+fault-injection subsystem at minio_tpu/faults/ (seeded schedules,
+hang/latency/bitrot kinds, runtime arming via the admin `faults`
+endpoint). Import from there."""
 
-Semantics match the reference: every API call increments one shared
-counter; if the counter has a scripted error, that call raises it;
-otherwise, when a default error is set, calls AFTER the script raise
-the default (a disk that dies and stays dead)."""
-
-from __future__ import annotations
-
-import threading
-
-# Identity helpers never count as operations.
-_NON_OPS = {"endpoint", "hostname", "is_local", "is_online", "set_online"}
-
-
-class NaughtyWriter:
-    """File-writer wrapper: each write() consults the same script, so a
-    disk can die BETWEEN two blocks of one streaming encode."""
-
-    def __init__(self, inner, naughty: "NaughtyDisk"):
-        self._inner = inner
-        self._naughty = naughty
-
-    def write(self, data):
-        self._naughty._maybe_raise()
-        return self._inner.write(data)
-
-    def close(self):
-        try:
-            self._inner.close()
-        except Exception:  # noqa: BLE001
-            pass
-
-
-class NaughtyDisk:
-    def __init__(self, disk, errors: dict[int, Exception] | None = None,
-                 default: Exception | None = None):
-        self._disk = disk
-        self._errors = dict(errors or {})
-        self._default = default
-        self._calls = 0
-        self._lock = threading.Lock()
-
-    @property
-    def calls(self) -> int:
-        return self._calls
-
-    def _maybe_raise(self):
-        with self._lock:
-            self._calls += 1
-            n = self._calls
-        err = self._errors.get(n)
-        if err is not None:
-            raise err
-        if self._default is not None and self._errors and \
-                n > max(self._errors):
-            raise self._default
-        if self._default is not None and not self._errors:
-            raise self._default
-
-    def __getattr__(self, name):
-        attr = getattr(self._disk, name)
-        if name in _NON_OPS or not callable(attr):
-            return attr
-
-        def wrapped(*args, **kwargs):
-            self._maybe_raise()
-            out = attr(*args, **kwargs)
-            if name == "create_file_writer":
-                return NaughtyWriter(out, self)
-            return out
-
-        return wrapped
+from minio_tpu.faults import NaughtyDisk, NaughtyWriter  # noqa: F401

@@ -5,8 +5,35 @@ of the wedge-prone axon TPU tunnel.
 
 All the ordering-sensitive armor lives in minio_tpu.utils.jaxenv.force_cpu
 (shared with bench.py and __graft_entry__.dryrun_multichip).
+
+Also arms a per-test faulthandler watchdog: if any single test runs past
+the dump timeout (a hung drive path that escaped its deadline, a leaked
+lock), every thread's stack is dumped to stderr so the hang
+self-diagnoses instead of dying silently in the CI timeout.
 """
+
+import faulthandler
 
 from minio_tpu.utils.jaxenv import force_cpu
 
 force_cpu(8)
+
+# Well below the tier-1 suite timeout so the dump lands in the log while
+# the run is still alive; exit=False keeps pytest in control.
+_TEST_DUMP_TIMEOUT_S = 240.0
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/soak tests kept out of tier-1 "
+        "(run with -m slow)",
+    )
+
+
+def pytest_runtest_setup(item):
+    faulthandler.dump_traceback_later(_TEST_DUMP_TIMEOUT_S, exit=False)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    faulthandler.cancel_dump_traceback_later()

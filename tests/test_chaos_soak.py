@@ -1,0 +1,120 @@
+"""Deterministic chaos soak (slow; excluded from tier-1): a seeded
+fault schedule — latency spikes, intermittent errors, short hangs — on
+two of four drives under mixed PUT/GET/heal traffic. Invariants:
+
+- no operation stalls past (op deadline + straggler grace + compute
+  slack) — the hung-drive tolerance bound, never the fault duration;
+- no data loss at quorum: every PUT that REPORTED success reads back
+  byte-identical, both during the chaos and after disarm;
+- the MRF backlog heals the namespace back to full redundancy.
+
+Run with: pytest -m slow tests/test_chaos_soak.py
+"""
+
+import io
+import random
+import time
+
+import pytest
+
+from minio_tpu.faults import FaultDisk
+from minio_tpu.object.erasure_objects import ErasureObjects
+from minio_tpu.storage.diskcheck import (
+    DiskHealth,
+    MetricsDisk,
+    robust_overrides,
+)
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.utils.errors import StorageError
+
+MIB = 1 << 20
+
+OP_DEADLINE_S = 2.0
+GRACE_S = 0.2
+# Deadline + grace + generous encode/decode slack on a loaded CI host.
+STALL_BOUND_S = OP_DEADLINE_S + GRACE_S + 6.0
+
+
+@pytest.mark.slow
+def test_chaos_soak_no_stall_no_loss(tmp_path):
+    with robust_overrides(op_deadline_s=OP_DEADLINE_S,
+                          long_op_deadline_s=OP_DEADLINE_S,
+                          straggler_grace_s=GRACE_S,
+                          hedge_delay_s=0.05,
+                          probe_interval_s=0.1,
+                          breaker_threshold=3):
+        raw = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+               for i in range(4)]
+        for d in raw:
+            d.make_vol(".minio.sys")
+        fds = [FaultDisk(d) for d in raw]
+        scheds = []
+        for i in (1, 3):
+            scheds.append(fds[i].arm({"seed": 1000 + i, "specs": [
+                # Latency spikes below the hedge/grace radar and above it.
+                {"kind": "latency", "probability": 0.15, "latency_s": 0.02},
+                {"kind": "latency", "probability": 0.05, "latency_s": 0.3},
+                # Intermittent hard failures.
+                {"kind": "error", "probability": 0.04,
+                 "error": "ErrDiskNotFound"},
+            ]}))
+        disks = [MetricsDisk(fd, health=DiskHealth(f"d{i}"))
+                 for i, fd in enumerate(fds)]
+        es = ErasureObjects(disks)
+        es.make_bucket("soak")
+
+        rng = random.Random(7)
+        stored: dict[str, bytes] = {}
+        put_fail = get_fail = 0
+        try:
+            for n in range(30):
+                name = f"o{n:03d}"
+                size = rng.choice([4096, 300_000, MIB, 2 * MIB])
+                body = bytes([n % 251 + 1]) * size
+                t0 = time.monotonic()
+                try:
+                    es.put_object("soak", name, io.BytesIO(body), len(body))
+                    stored[name] = body
+                except StorageError:
+                    put_fail += 1  # quorum loss under injected errors is
+                    # legal; an unbounded stall is not.
+                assert time.monotonic() - t0 < STALL_BOUND_S, name
+
+                if stored and n % 3 == 0:
+                    pick = rng.choice(sorted(stored))
+                    t0 = time.monotonic()
+                    sink = io.BytesIO()
+                    try:
+                        es.get_object("soak", pick, sink)
+                        assert sink.getvalue() == stored[pick], pick
+                    except StorageError:
+                        get_fail += 1
+                    assert time.monotonic() - t0 < STALL_BOUND_S, pick
+                if n % 10 == 9:
+                    # Mid-soak heal pass over the MRF backlog.
+                    for b, o, v in es.drain_mrf():
+                        t0 = time.monotonic()
+                        try:
+                            es.heal_object(b, o, v)
+                        except StorageError:
+                            pass
+                        assert time.monotonic() - t0 < STALL_BOUND_S
+        finally:
+            for s in scheds:
+                s.disarm()
+
+        assert stored, "chaos killed every PUT — schedule too hot"
+
+        # Let any latched drive re-admit, then heal the backlog dry.
+        deadline = time.monotonic() + 10.0
+        while any(d.health.is_faulty() for d in disks) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        for b, o, v in es.drain_mrf():
+            es.heal_object(b, o, v)
+
+        # No data loss at quorum: every successful PUT reads back intact.
+        for name, body in stored.items():
+            sink = io.BytesIO()
+            es.get_object("soak", name, sink)
+            assert sink.getvalue() == body, name

@@ -167,3 +167,64 @@ def test_six_disk_outage_write_then_auto_heal(tmp_path):
             continue
     assert ok == 6
     assert ol.get_object_bytes("mon", "heal-me.bin") == body
+
+
+def test_zombie_probe_evicted_and_disk_readmitted(tmp_path, monkeypatch):
+    """A probe thread that NEVER returns (storage call wedged below any
+    RPC timeout) used to pin _pending[key] forever: no new probe was
+    ever submitted for that slot, so a recovered disk could never be
+    re-admitted without a process restart. Past PROBE_PENDING_MAX_AGE_S
+    the pending entry is evicted, probing resumes, and the zombie's
+    late result is discarded by its generation token."""
+    import threading
+
+    from minio_tpu.background import monitor as mon_mod
+
+    monkeypatch.setattr(mon_mod, "PROBE_TIMEOUT_S", 0.05)
+    monkeypatch.setattr(mon_mod, "PROBE_PENDING_MAX_AGE_S", 0.2)
+
+    disks = [
+        LocalStorage(str(tmp_path / f"z{i}"), endpoint=f"z{i}")
+        for i in range(4)
+    ]
+    ol, sets = _mk_pool(disks)
+    es = sets.sets[0]
+
+    release = threading.Event()
+    state = {"hang": True}
+
+    class WedgedPing:
+        """ping() wedges (not merely errors) while state['hang']."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def ping(self):
+            if state["hang"]:
+                release.wait(30)
+                raise RuntimeError("zombie probe finally unwedged")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    es.disks[2] = WedgedPing(disks[2])
+    mon = DiskMonitor(ol, fail_threshold=1)
+    try:
+        mon.check_once(wait=False)  # probe submitted; wedges forever
+        time.sleep(0.1)             # past PROBE_TIMEOUT_S, under max age
+        res = mon.check_once(wait=False)
+        assert res["offline"] == ["z2"]  # hung probe counts as failed
+
+        # The drive recovers — but the zombie thread still holds the
+        # pending slot until the max-age eviction kicks in.
+        state["hang"] = False
+        time.sleep(0.15)  # total pending age now past the 0.2s max
+        reconnected = []
+        for _ in range(3):  # eviction + fresh probe within a few sweeps
+            reconnected += mon.check_once(wait=True)["reconnected"]
+            if reconnected:
+                break
+        assert reconnected == ["z2"]
+        assert es.disks.count(None) == 0
+    finally:
+        release.set()

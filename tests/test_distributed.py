@@ -206,6 +206,57 @@ def test_dsync_force_unlock(lock_cluster):
     m2.unlock()
 
 
+def test_dsync_unlock_failure_counted(lock_cluster):
+    """An unlock RPC that fails at the transport leaks its grant until
+    server-side expiry — it must be counted (and exported as
+    mtpu_dsync_unlock_failures_total), not silently swallowed. A peer
+    that merely ANSWERS no-grant is not a leak and must not count."""
+    from minio_tpu.distributed import dsync as dsync_mod
+
+    ds, servers = lock_cluster
+
+    # Clean unlock against live peers counts nothing.
+    m2 = ds.new_mutex("leak/res2", refresh_interval=0.5)
+    assert m2.lock(timeout=2)
+    before = dsync_mod.UNLOCK_FAILURES["total"]
+    m2.unlock()
+    assert dsync_mod.UNLOCK_FAILURES["total"] == before
+
+    # A grant whose locker died before unlock DOES leak — and counts.
+    m = ds.new_mutex("leak/res", refresh_interval=0.5)
+    assert m.lock(timeout=2)
+    before = dsync_mod.UNLOCK_FAILURES["total"]
+    servers[0].stop()  # grant on server 0 now unreachable
+    m.unlock()
+    assert dsync_mod.UNLOCK_FAILURES["total"] == before + 1
+
+
+# ---------- RPC client health probe ----------
+
+def test_online_probe_classifies_auth_failure():
+    """A peer that is REACHABLE but rejects our cluster token must not
+    masquerade as a network outage: the lazy reconnect probe records an
+    auth-class failure (secret mismatch / clock skew)."""
+    srv = LockRESTServer("right-secret").start()
+    try:
+        cli = RPCClient(srv.endpoint, "/mtpu/lock/v1", "wrong-secret",
+                        timeout=2.0)
+        cli.mark_offline()
+        cli._last_check = 0.0  # skip the 1s probe backoff
+        assert cli.online is False
+        assert cli.last_probe_error.startswith("auth:")
+    finally:
+        srv.stop()
+
+
+def test_online_probe_classifies_network_failure():
+    cli = RPCClient("127.0.0.1:1", "/mtpu/lock/v1", SECRET, timeout=0.5)
+    cli.mark_offline()
+    cli._last_check = 0.0
+    assert cli.online is False
+    assert cli.last_probe_error.startswith("net:")
+
+
 # ---------- peer + bootstrap planes ----------
 
 def test_peer_mesh_and_notification_hub():

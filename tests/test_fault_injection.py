@@ -1,23 +1,36 @@
-"""Scripted fault injection via NaughtyDisk (ref naughtyDisk,
-cmd/naughty-disk_test.go) — the three scenarios the reference exercises
-with fakes: a disk dying MID-STREAM between blocks of one encode,
-quorum loss exactly at commit time, and degraded reads under flapping
-disks with ParallelReader escalation."""
+"""Fault injection over the minio_tpu/faults subsystem (promoted from
+the old tests/_naughty.py; ref naughtyDisk, cmd/naughty-disk_test.go).
+
+Scripted scenarios: a disk dying MID-STREAM between blocks of one
+encode, quorum loss exactly at commit time, degraded reads under
+flapping disks with ParallelReader escalation — plus the hung-drive
+scenarios: a drive hanging indefinitely mid-PUT (quorum-wait fan-out
+returns within deadline+grace), a slow shard beaten by a hedged parity
+read, and the health circuit breaker latching then re-admitting."""
 
 import io
+import time
 
 import pytest
 
+from minio_tpu.erasure import streaming as _streaming
+from minio_tpu.faults import FaultDisk, NaughtyDisk
 from minio_tpu.object.erasure_objects import ErasureObjects
+from minio_tpu.storage.diskcheck import (
+    DiskHealth,
+    MetricsDisk,
+    robust_overrides,
+)
 from minio_tpu.storage.local import LocalStorage
 from minio_tpu.utils.errors import (
+    ErrDiskFaulty,
     ErrDiskNotFound,
+    ErrDiskOpTimeout,
     ErrErasureWriteQuorum,
     ErrFileNotFound,
     ErrObjectNotFound,
     StorageError,
 )
-from tests._naughty import NaughtyDisk
 
 MIB = 1 << 20
 
@@ -195,3 +208,234 @@ def test_fresh_disk_heal_survives_flapping_source(tmp_path):
         sink = io.BytesIO()
         ol.get_object("flap", f"o{i:02d}", sink)
         assert sink.getvalue() == bytes([i]) * 32768, i
+
+
+# ---------------------------------------------------------------------------
+# the faults subsystem itself
+
+
+def test_registry_arms_faults_at_runtime(tmp_path):
+    """A FaultDisk without a pinned schedule consults the process-wide
+    registry by endpoint — the seam the admin `faults` endpoint uses to
+    arm chaos on a live server."""
+    import minio_tpu.faults as faults
+
+    raw = LocalStorage(str(tmp_path / "d0"), endpoint="d0")
+    raw.make_vol("v")
+    raw.write_all("v", "x", b"ok")
+    disk = FaultDisk(raw)  # no local schedule: registry-driven
+    assert disk.read_all("v", "x") == b"ok"
+    faults.arm("d0", {"specs": [{"kind": "error",
+                                 "error": "ErrDiskNotFound"}]})
+    try:
+        assert "d0" in faults.status()
+        with pytest.raises(ErrDiskNotFound):
+            disk.read_all("v", "x")
+    finally:
+        assert faults.disarm("d0") == ["d0"]
+    assert disk.read_all("v", "x") == b"ok"
+    assert faults.status() == {}
+
+
+def test_seeded_latency_and_bitrot_kinds(tmp_path):
+    """Latency sleeps are interruptible and deterministic under a seed;
+    bitrot flips read bytes so the verification layer must catch it."""
+    raw = LocalStorage(str(tmp_path / "d0"), endpoint="d0")
+    raw.make_vol("v")
+    raw.write_all("v", "x", b"payload")
+    disk = FaultDisk(raw)
+    sched = disk.arm({"seed": 3, "specs": [
+        {"kind": "latency", "ops": ["read_all"], "latency_s": 0.05},
+    ]})
+    t0 = time.monotonic()
+    assert disk.read_all("v", "x") == b"payload"
+    assert time.monotonic() - t0 >= 0.05
+    sched.disarm()
+
+    disk.arm({"specs": [{"kind": "bitrot", "ops": ["read_all"]}]})
+    assert disk.read_all("v", "x") != b"payload"  # first byte flipped
+    disk.disarm()
+    assert disk.read_all("v", "x") == b"payload"
+
+
+# ---------------------------------------------------------------------------
+# hung-drive tolerance (quorum-wait fan-out, hedged reads, breaker)
+
+
+def test_hung_writer_mid_put_returns_at_quorum(tmp_path):
+    """One drive hangs indefinitely on shard writes: the PUT must return
+    once write quorum + straggler grace pass (bounded by the knobs, not
+    the hang), remember the missed shard in MRF, and serve reads."""
+    disks = _disks(tmp_path, 4)
+    faulty = FaultDisk(disks[1])
+    sched = faulty.arm({"specs": [{"kind": "hang", "ops": ["shard_write"]}]})
+    es = ErasureObjects([disks[0], faulty, disks[2], disks[3]])
+    es.make_bucket("flt")
+    body = bytes(range(256)) * (3 * MIB // 256)
+    try:
+        with robust_overrides(op_deadline_s=5.0, straggler_grace_s=0.3):
+            t0 = time.monotonic()
+            es.put_object("flt", "hungput", io.BytesIO(body), len(body))
+            elapsed = time.monotonic() - t0
+        # Bounded by (deadline + grace), nowhere near the infinite hang;
+        # in practice quorum lands immediately and only the grace is paid.
+        assert elapsed < 5.0 + 0.3, elapsed
+        assert _get(es, "flt", "hungput") == body
+        with es._mrf_lock:
+            assert ("flt", "hungput", "") in list(es._mrf)
+    finally:
+        sched.disarm()
+    # With the fault disarmed, heal restores the 4th shard.
+    es2 = ErasureObjects(disks)
+    assert es2.heal_object("flt", "hungput")["healed"]
+    assert sum(1 for d in disks if _readable(d, "flt", "hungput")) == 4
+
+
+def test_hedged_get_beats_hung_shard(tmp_path):
+    """A drive hangs on read_file_stream for a shard the reader prefers:
+    after the hedge delay a parity shard is dispatched instead, and the
+    GET completes by reconstruction while the straggler is abandoned."""
+    disks = _disks(tmp_path, 4)
+    es_plain = ErasureObjects(disks)
+    es_plain.make_bucket("flt")
+    body = bytes(reversed(range(256))) * (2 * MIB // 256)
+    es_plain.put_object("flt", "hedged", io.BytesIO(body), len(body))
+
+    from minio_tpu.object.metadata import hash_order
+
+    distribution = hash_order("flt/hedged", 4)
+    slow_idx = distribution.index(1)  # the disk serving shard 1
+    wrapped = list(disks)
+    faulty = FaultDisk(disks[slow_idx])
+    sched = faulty.arm(
+        {"specs": [{"kind": "hang", "ops": ["read_file_stream"]}]}
+    )
+    wrapped[slow_idx] = faulty
+    es = ErasureObjects(wrapped)
+    hedges_before = _streaming.STATS["hedged_reads_total"]
+    try:
+        with robust_overrides(hedge_delay_s=0.05, long_op_deadline_s=10.0):
+            t0 = time.monotonic()
+            assert _get(es, "flt", "hedged") == body
+            elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, elapsed  # the hang alone would exceed this
+        assert _streaming.STATS["hedged_reads_total"] > hedges_before
+    finally:
+        sched.disarm()
+
+
+def test_fanout_fails_fast_when_quorum_impossible():
+    """Once enough writers have failed that write quorum is unreachable
+    even if every straggler succeeded, the fan-out must raise NOW — not
+    after burning the full op deadline on a hung writer."""
+    import threading
+
+    release = threading.Event()
+
+    class W:
+        def __init__(self, mode):
+            self.mode = mode
+
+        def write(self, _b):
+            if self.mode == "fail":
+                raise ErrFileNotFound("gone")
+            if self.mode == "hang":
+                release.wait(10)
+
+    from minio_tpu.erasure.streaming import ParallelWriter
+
+    writers = [W("ok"), W("hang"), W("fail"), W("fail")]
+    pw = ParallelWriter(writers, 3, op_deadline_s=30.0,
+                        straggler_grace_s=0.3)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(StorageError):
+            pw.write([b"x"] * 4)
+        # Quorum-impossible pays one straggler grace (so settling tasks
+        # report true outcomes for cleanup), never the 30s deadline.
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        release.set()
+
+
+def test_breaker_latches_and_probe_readmits(tmp_path):
+    """Consecutive op timeouts latch the disk faulty (ErrDiskFaulty,
+    instantly — no more deadline waits); once the fault clears, the
+    background probe re-admits it without a process restart."""
+    raw = LocalStorage(str(tmp_path / "d0"), endpoint="d0")
+    raw.make_vol("v")
+    raw.write_all("v", "x", b"payload")
+    faulty = FaultDisk(raw)
+    with robust_overrides(op_deadline_s=0.1, long_op_deadline_s=0.1,
+                          breaker_threshold=2, probe_interval_s=0.05):
+        health = DiskHealth("d0")
+        disk = MetricsDisk(faulty, health=health)
+        assert disk.read_all("v", "x") == b"payload"  # healthy baseline
+        sched = faulty.arm({"specs": [{"kind": "hang"}]})
+        for _ in range(2):
+            with pytest.raises(ErrDiskOpTimeout):
+                disk.read_all("v", "x")
+        assert health.is_faulty()
+        assert disk.health_info()["state"] == "faulty"
+        # Latched: fail-fast, no deadline wait burned per call.
+        t0 = time.monotonic()
+        with pytest.raises(ErrDiskFaulty):
+            disk.read_all("v", "x")
+        assert time.monotonic() - t0 < 0.05
+        # Clear the fault: the probe must re-admit within a few
+        # intervals (hung probe attempt releases on disarm).
+        sched.disarm()
+        deadline = time.monotonic() + 5.0
+        while health.is_faulty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not health.is_faulty()
+        assert disk.read_all("v", "x") == b"payload"
+        assert health.readmitted_total >= 1
+
+
+def test_hung_drive_end_to_end_put_get_latch_readmit_heal(tmp_path):
+    """Acceptance: one drive armed to hang indefinitely. A
+    quorum-satisfiable PUT and GET both complete within
+    (op deadline + straggler grace); the hung drive latches faulty and
+    is re-admitted by the probe after disarm; the missed shard heals
+    via MRF."""
+    with robust_overrides(op_deadline_s=1.0, long_op_deadline_s=1.0,
+                          straggler_grace_s=0.3, hedge_delay_s=0.05,
+                          breaker_threshold=1, probe_interval_s=0.1):
+        raw = _disks(tmp_path, 4)
+        fds = [FaultDisk(d) for d in raw]
+        wrapped = [MetricsDisk(fd, health=DiskHealth(f"d{i}"))
+                   for i, fd in enumerate(fds)]
+        es = ErasureObjects(wrapped)
+        es.make_bucket("flt")
+        sched = fds[1].arm({"specs": [{"kind": "hang"}]})  # every op hangs
+        body = b"\xa5" * (2 * MIB)
+        try:
+            t0 = time.monotonic()
+            es.put_object("flt", "e2e", io.BytesIO(body), len(body))
+            put_s = time.monotonic() - t0
+            # Writer open on the hung disk costs one op deadline, the
+            # fan-outs at most grace past quorum — never the hang.
+            assert put_s < 2 * (1.0 + 0.3) + 2.0, put_s
+            with es._mrf_lock:
+                assert ("flt", "e2e", "") in list(es._mrf)
+            assert wrapped[1].health_info()["state"] == "faulty"
+
+            t0 = time.monotonic()
+            assert _get(es, "flt", "e2e") == body
+            get_s = time.monotonic() - t0
+            # Latched disk fails fast: the GET never waits on the hang.
+            assert get_s < 1.0 + 0.3 + 1.0, get_s
+        finally:
+            sched.disarm()
+
+        # Probe re-admits the disk once the fault is gone.
+        deadline = time.monotonic() + 5.0
+        while wrapped[1].health.is_faulty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not wrapped[1].health.is_faulty()
+
+        # MRF-driven heal restores the missed shard onto the drive.
+        for bucket, obj, vid in es.drain_mrf():
+            es.heal_object(bucket, obj, vid)
+        assert sum(1 for d in raw if _readable(d, "flt", "e2e")) == 4

@@ -336,49 +336,58 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
             best = max(best, nbytes * scale / dt / 1e9)
         return round(best, 3)
 
-    # 1: stream read into the [k, B*S] strip buffer (readinto scatter).
-    buf = np.empty((12, 8 * S), dtype=np.uint8)
+    # 1: stream read into the block-major [B, k*S] strip buffer (one
+    # contiguous readinto per 1 MiB block — the production fill).
+    buf = np.empty((8, 12 * S), dtype=np.uint8)
 
     def fill():
         src = io.BytesIO(payload)
         for blk in range(total_mib):
-            col = (blk % 8) * S
-            for j in range(12):
-                src.readinto(memoryview(buf[j, col: col + S])[: MIB - j * S if j == 11 else S])
+            src.readinto(memoryview(buf[blk % 8])[:MIB])
 
     out["source_read_gbps"] = rate(fill)
-    # 2: content md5 (the S3 ETag contract; serial by construction).
+    # 2: content md5 (the S3 ETag contract; serial by construction —
+    # the hot path hashes the same contiguous block-sized views).
     out["md5_gbps"] = rate(lambda: hashlib.md5(payload))
-    # 3: GF(2^8) parity encode (native engine on [k, B*S] strips).
+    # 3: GF(2^8) parity encode (native engine, [B, k, S] batches as the
+    # block-major driver dispatches them).
+    blocks3 = buf.reshape(8, 12, S)
     out["encode_gbps"] = rate(
-        lambda: [gf_native.apply_matrix(er._parity_mat, buf)
+        lambda: [gf_native.apply_matrix_batch(er._parity_mat, blocks3)
                  for _ in range(total_mib // 8)]
     )
-    # 4: bitrot framing ([H||chunk]*, hash + copy, native).
+    # 4: bitrot frame digests — the vectored path hashes chunks in place
+    # (hh256_hash_strided), copying nothing; this is the hash-only cost
+    # the old frame+copy stage used to bundle with a full memcpy.
     lib = native.load()
     if lib is not None:
         row = np.ascontiguousarray(buf[0])
         n = row.size
         nch = (n + S - 1) // S
-        fout = np.empty(n + 32 * nch, dtype=np.uint8)
+        digs = np.empty((nch, 32), dtype=np.uint8)
         u8p = ctypes.POINTER(ctypes.c_uint8)
 
         def frame():
             for _ in range(nbytes // n):
-                lib.hh256_frame(hhmod.MAGIC_KEY, row.ctypes.data_as(u8p),
-                                n, S, fout.ctypes.data_as(u8p))
+                lib.hh256_hash_strided(hhmod.MAGIC_KEY,
+                                       row.ctypes.data_as(u8p), S, nch, S,
+                                       digs.ctypes.data_as(u8p))
 
         out["bitrot_frame_gbps"] = rate(frame)
-        # 5: framed shard write, raw fd (the write path after the
-        # buffered-IO fix).
+        # 5: vectored shard write — [digest||chunk] iovecs straight from
+        # the strip buffer via writev, the zero-copy write path.
         wdir = os.path.join(root, "stages")
         os.makedirs(wdir, exist_ok=True)
+        iov = []
+        for c in range(nch):
+            iov.append(memoryview(digs[c]))
+            iov.append(memoryview(row)[c * S: (c + 1) * S])
 
         def shard_write():
             fd = os.open(os.path.join(wdir, "w"),
                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
             for _ in range(nbytes // n):
-                os.write(fd, memoryview(fout))
+                os.writev(fd, iov)
             os.close(fd)
 
         out["shard_write_gbps"] = rate(shard_write)
@@ -412,6 +421,19 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
         (time.perf_counter() - t0) / reps * 1e6
     )
     _cleanup(mdir)
+    # 6b: inline small-object PUT p50 — the whole object (shards ≤ the
+    # inline threshold) commits as ONE xl.meta journal write per disk,
+    # no staged part files, no rename (MinIO smallFileThreshold parity).
+    idir = os.path.join(root, "stages-inline")
+    es_i, _ = _mk_set(idir, 4, 2)
+    small = os.urandom(64 << 10)
+    lat = []
+    for i in range(30):
+        t0 = time.perf_counter()
+        es_i.put_object("bench", f"inl{i}", io.BytesIO(small), len(small))
+        lat.append((time.perf_counter() - t0) * 1e6)
+    out["inline_put_64k_p50_us"] = round(statistics.median(lat))
+    _cleanup(idir)
     # The serial PUT model: input passes once through each byte-rate
     # stage (frame+write carry the 1.33x shard expansion).
     inv = 0.0
@@ -434,7 +456,7 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
         th = _th.Thread(target=lambda: hashlib.md5(payload))
         th.start()
         for _ in range(total_mib // 8):
-            gf_native.apply_matrix(er._parity_mat, buf)
+            gf_native.apply_matrix_batch(er._parity_mat, blocks3)
         th.join()
         return time.perf_counter() - t0
 
@@ -460,8 +482,10 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
     # means the stages genuinely overlap instead of running
     # back-to-back.
     from minio_tpu.object.types import TeeMD5Reader
+    from minio_tpu.pipeline.buffers import COPY
 
     pdir = os.path.join(root, "stages-pipe")
+    COPY.reset()
     out["pipeline_put_gbps"] = round(_hostfed_encode_best(
         pdir, "pipe", payload, 3,
         lambda: TeeMD5Reader(_ZeroCopyReader(payload), size=nbytes),
@@ -469,6 +493,15 @@ def bench_put_stages(root: str, total_mib: int = 32) -> dict:
         telemetry="bench-put",
     ), 3)
     _cleanup(pdir)
+    # Per-stage copy accounting of those runs: bytes each hot-path site
+    # copied (or freshly materialized). The zero-copy floor for this
+    # pipelined PUT is ONE source-read copy per input byte and nothing
+    # else — any other site growing here is a regression
+    # (pipeline/buffers.CopyCounters; asserted by test_bench_smoke).
+    cc = COPY.snapshot()
+    out["copy_counters"] = cc
+    moved = 3 * nbytes  # 3 reps of the payload
+    out["copies_per_input_byte"] = round(sum(cc.values()) / moved, 3)
     # Per-stage telemetry of those runs (items/busy/starve/stall per
     # stage) — the same counters the metrics endpoint exports.
     from minio_tpu.pipeline import stage_stats_snapshot

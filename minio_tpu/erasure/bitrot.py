@@ -114,43 +114,44 @@ class StreamingBitrotWriter:
         would permanently mis-frame e.g. a BLAKE2b-512 shard file)."""
         return self._algo is BitrotAlgorithm.HIGHWAYHASH256S
 
-    def write_frames(self, strip, chunk_size: int) -> int:
-        """Frame a whole strip of consecutive chunks ([H||chunk]* for each
-        chunk_size slice) in ONE native call and ONE sink write — the
-        batched fast path of the host-fed encode pipeline. Falls back to
-        the per-chunk write() when the native library (or the streaming
-        algorithm) is unavailable."""
-        strip = memoryview(strip)
-        n = len(strip)
+    def write_frames_vec(self, chunks: list, digests=None) -> int:
+        """Vectored zero-copy framing: emit [H(chunk)||chunk] for every
+        chunk WITHOUT materializing the framed strip. `chunks` are
+        buffer-protocol views (typically rows into the pooled block-major
+        strip buffer); `digests` is an optional [n, 32] uint8 array of
+        precomputed frame hashes (hash_strided_digests). With a vectored
+        sink the scatter-gather list goes straight to writev — no data
+        byte is copied in userspace; other sinks get paired write()
+        calls (still copy-free for buffer-protocol-aware sinks like
+        BytesIO and the raw-fd writers)."""
+        n = len(chunks)
         if n == 0:
             return 0
-        from .. import native
-
-        lib = native.load()
-        if lib is None or self._algo is not BitrotAlgorithm.HIGHWAYHASH256S:
-            written = 0
-            for off in range(0, n, chunk_size):
-                written += self.write(strip[off:off + chunk_size])
-            return written
-        import ctypes
-
-        n_chunks = ceil_frac(n, chunk_size)
-        src = np.frombuffer(strip, dtype=np.uint8)
-        need = n + 32 * n_chunks
-        # Reuse one framing buffer per writer: a fresh multi-MiB empty()
-        # per batch costs a page-fault pass over the whole buffer.
-        out = getattr(self, "_frame_buf", None)
-        if out is None or out.size < need:
-            out = np.empty(need, dtype=np.uint8)
-            self._frame_buf = out
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.hh256_frame(
-            highwayhash.MAGIC_KEY, src.ctypes.data_as(u8p), n, chunk_size,
-            out.ctypes.data_as(u8p),
-        )
-        self._sink.write(memoryview(out)[:need])
-        self.bytes_written += n
-        return n
+        if digests is None or self._algo is not BitrotAlgorithm.HIGHWAYHASH256S:
+            dig = []
+            for c in chunks:
+                h = self._algo.new()
+                h.update(c)
+                dig.append(h.digest())
+        else:
+            dig = digests
+        sink = self._sink
+        total = 0
+        writev = getattr(sink, "writev", None)
+        if writev is not None:
+            iov: list = [None] * (2 * n)
+            for i, c in enumerate(chunks):
+                iov[2 * i] = memoryview(dig[i]).cast("B")
+                iov[2 * i + 1] = c
+                total += len(c)
+            writev(iov)
+        else:
+            for i, c in enumerate(chunks):
+                sink.write(memoryview(dig[i]).cast("B"))
+                sink.write(c)
+                total += len(c)
+        self.bytes_written += total
+        return total
 
     def write_with_digest(self, chunk, digest: bytes) -> int:
         """Frame a chunk whose HighwayHash256 was already computed on the
@@ -217,8 +218,54 @@ class StreamingBitrotReader:
         self._till = ceil_frac(till_offset, shard_size) * algo.digest_size + till_offset
         self._rc = None
         self._curr = 0
+        self._ring: list | None = None
+        self._ring_i = 0
 
-    def read_at(self, offset: int, length: int) -> bytes:
+    def reuse_buffers(self, depth: int = 2) -> None:
+        """Opt into recycling read buffers: read_chunks fills a private
+        ring of `depth` buffers round-robin (readinto, no fresh bytes
+        per fetch) and returns memoryviews into them. ONLY valid when
+        the consumer fully drains each batch's views before `depth`
+        further batches are fetched — true for the serial decode/heal
+        drivers, whose sinks consume (or copy) every chunk before the
+        next reader fan-out. The pipelined GET path keeps several
+        batches in flight and must NOT enable this."""
+        if self._ring is None:
+            self._ring = [None] * max(2, depth)
+
+    def _read_phys(self, phys: int):
+        """Read `phys` framed bytes; returns a memoryview over either a
+        recycled ring buffer (readinto) or a fresh bytes object."""
+        from ..pipeline.buffers import copy_add
+
+        rc = self._rc
+        if self._ring is not None and hasattr(rc, "readinto"):
+            buf = self._ring[self._ring_i]
+            if buf is None or len(buf) < phys:
+                buf = bytearray(phys)
+                self._ring[self._ring_i] = buf
+            self._ring_i = (self._ring_i + 1) % len(self._ring)
+            view = memoryview(buf)[:phys]
+            got = 0
+            while got < phys:
+                n = rc.readinto(view[got:])
+                if not n:
+                    break
+                got += n
+            copy_add("get.source_read", got)
+            if got != phys:
+                raise ErrFileCorrupt("short framed read")
+            return view
+        raw = rc.read(phys)
+        copy_add("get.source_read", len(raw))
+        if len(raw) != phys:
+            raise ErrFileCorrupt("short framed read")
+        return memoryview(raw)
+
+    def read_at(self, offset: int, length: int):
+        """Read+verify one chunk. With reuse_buffers enabled the chunk
+        comes back as a memoryview into the recycled ring (same
+        consumption contract as read_chunks); otherwise fresh bytes."""
         if offset % self._shard_size != 0:
             raise ValueError("offset must be shard-aligned")
         if self._rc is None:
@@ -227,12 +274,18 @@ class StreamingBitrotReader:
             self._rc = self._open(stream_off, self._till - stream_off)
         if offset != self._curr:
             raise ValueError("non-sequential bitrot read")
-        hash_want = self._rc.read(self._algo.digest_size)
-        if len(hash_want) != self._algo.digest_size:
-            raise ErrFileCorrupt("short hash read")
-        buf = self._rc.read(length)
-        if len(buf) != length:
-            raise ErrFileCorrupt("short chunk read")
+        ds = self._algo.digest_size
+        if self._ring is not None and hasattr(self._rc, "readinto"):
+            mv = self._read_phys(ds + length)
+            hash_want = bytes(mv[:ds])
+            buf = mv[ds:]
+        else:
+            hash_want = self._rc.read(ds)
+            if len(hash_want) != ds:
+                raise ErrFileCorrupt("short hash read")
+            buf = self._rc.read(length)
+            if len(buf) != length:
+                raise ErrFileCorrupt("short chunk read")
         h = self._algo.new()
         h.update(buf)
         if h.digest() != hash_want:
@@ -260,13 +313,10 @@ class StreamingBitrotReader:
             raise ValueError("non-sequential bitrot read")
         ds = self._algo.digest_size
         phys = sum(lengths) + ds * len(lengths)
-        raw = self._rc.read(phys)
-        if len(raw) != phys:
-            raise ErrFileCorrupt("short framed read")
+        mv = self._read_phys(phys)
         from .. import native as _native
 
         lib = _native.load()
-        mv = memoryview(raw)
         out = []
         if (lib is not None
                 and self._algo is BitrotAlgorithm.HIGHWAYHASH256S
@@ -278,7 +328,7 @@ class StreamingBitrotReader:
 
             import numpy as np
 
-            arr = np.frombuffer(raw, dtype=np.uint8)
+            arr = np.frombuffer(mv, dtype=np.uint8)
             bad = lib.hh256_verify_frames(
                 highwayhash.MAGIC_KEY,
                 arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -346,6 +396,32 @@ def bitrot_verify(stream, want_size: int, part_size: int,
         h.update(buf)
         if h.digest() != hash_want:
             raise ErrFileCorrupt("streaming bitrot mismatch")
+
+
+def hash_strided_digests(arr: np.ndarray, byte_offset: int, stride: int,
+                         n: int, chunk: int,
+                         out: np.ndarray | None = None) -> np.ndarray | None:
+    """Frame digests for n chunk-sized slices at arr.base+offset+i*stride,
+    computed in ONE native call with zero data copies — the hashing half
+    of the vectored write path (write_frames_vec ships [digest||view]
+    pairs via writev). The block-major strip layout puts shard j's
+    consecutive bitrot chunks exactly at such a stride. Returns [n, 32]
+    uint8, or None when the native engine is unavailable (callers fall
+    back to per-chunk hashing inside write_frames_vec)."""
+    from .. import native as _native
+
+    lib = _native.load()
+    if lib is None or n <= 0:
+        return None
+    import ctypes
+
+    if out is None or out.shape[0] < n:
+        out = np.empty((n, 32), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    base = ctypes.cast(arr.ctypes.data + byte_offset, u8p)
+    lib.hh256_hash_strided(highwayhash.MAGIC_KEY, base, stride, n, chunk,
+                           out.ctypes.data_as(u8p))
+    return out[:n]
 
 
 def hash_shard_chunks(shards: np.ndarray, shard_size: int) -> np.ndarray:

@@ -170,34 +170,58 @@ class ParallelWriter:
         if err is not None:
             raise err
 
-    def write_strips(self, strips: list, chunk_size: int):
-        """Batched fan-out: strips[i] holds SEVERAL consecutive chunks for
-        shard i; each writer frames+writes its whole strip in one native
-        call (StreamingBitrotWriter.write_frames). One task per shard per
-        batch instead of one per shard per block — the Python-overhead
-        fix for the host-fed pipeline."""
+    def write_frame_batches(self, data_buf, parity, nb: int, k: int,
+                            m: int, shard: int):
+        """Zero-copy batched fan-out over the block-major strip buffer:
+        block bi's shard j lives at data_buf[bi, j*S:(j+1)*S] (parity at
+        parity[bi, j-k]), so shard j's consecutive bitrot chunks sit at
+        a fixed stride. Each writer's frame digests come from ONE native
+        strided-hash call and the [digest||chunk] pairs ship via the
+        sink's vectored writev — no data byte is copied between the
+        strip buffer and the kernel."""
+        from .bitrot import hash_strided_digests
+
+        row = data_buf.shape[1]  # k * shard bytes per block row
+
         def attempt(i):
             w = self.writers[i]
-            if hasattr(w, "write_frames"):
-                w.write_frames(strips[i], chunk_size)
+            if i < k:
+                chunks = [data_buf[bi, i * shard: (i + 1) * shard]
+                          for bi in range(nb)]
+                digests = hash_strided_digests(
+                    data_buf, i * shard, row, nb, shard
+                )
             else:
-                strip = memoryview(strips[i])
-                for off in range(0, len(strip), chunk_size):
-                    w.write(strip[off:off + chunk_size])
+                pi = i - k
+                chunks = [parity[bi, pi] for bi in range(nb)]
+                digests = hash_strided_digests(
+                    parity, pi * shard, m * shard, nb, shard
+                )
+            if hasattr(w, "write_frames_vec"):
+                w.write_frames_vec(chunks, digests)
+            else:
+                for c in chunks:
+                    w.write(c)
 
         self._fanout(attempt)
 
 
-class _StripFiller:
-    """Reads a byte stream into [k, B*S] strip buffers, preserving the
-    split/zero-pad semantics of Erasure.split. Shared by the serial and
-    pipelined encode drivers so their tail/empty-object handling cannot
-    drift.
+class _BlockFiller:
+    """Reads a byte stream into block-major [B, k*S] strip buffers: row
+    bi holds one whole erasure block's stream bytes followed by split()'s
+    zero pad. Shared by the serial and pipelined encode drivers so their
+    tail/empty-object handling cannot drift.
 
-    readinto sources scatter straight into the strip rows (one copy);
-    others take the read()+scatter fallback. A short trailing read comes
-    back as `tail` bytes for the host encode_data path; a zero-byte
-    stream yields the empty-object sentinel tail b"" exactly once."""
+    The block-major layout is what makes the downstream stages zero-copy
+    and GIL-free: the md5 stage digests ONE contiguous block-sized view
+    per block (hashlib releases the GIL for large updates), the GF
+    encode runs as a [B, k, S] batch, and shard j's bitrot chunks sit at
+    a fixed stride (row[j*S:(j+1)*S]) for the strided-hash + writev
+    writers. readinto sources fill each block row with one scatter-free
+    copy; others take the read()+copy fallback. A short trailing read
+    comes back as `tail` bytes for the host encode_data path; a
+    zero-byte stream yields the empty-object sentinel tail b"" exactly
+    once."""
 
     def __init__(self, erasure: Erasure, src, batch_blocks: int):
         self.src = src
@@ -205,71 +229,51 @@ class _StripFiller:
         self.k = erasure.data_blocks
         self.shard = erasure.shard_size()
         self.block_size = erasure.block_size
-        self.pad = self.k * self.shard - self.block_size  # last-row zero pad
+        self.row = self.k * self.shard  # block_size + zero pad
         self.can_readinto = hasattr(src, "readinto")
         self.eof = False
-        self.produced = False  # anything (strips or tail) handed out yet
+        self.produced = False  # anything (blocks or tail) handed out yet
 
-    def _fill_block(self, buf: np.ndarray, col: int) -> int:
-        """Read one block directly into buf[:, col:col+shard]; returns
-        bytes read (0 on EOF, < block_size on a short tail read that the
-        caller must re-handle via the bytes path)."""
-        got = 0
-        k, shard, pad = self.k, self.shard, self.pad
-        for j in range(k):
-            want = shard if j < k - 1 else shard - pad
-            view = memoryview(buf[j, col: col + want])
-            while want:
-                n = self.src.readinto(view[len(view) - want:])
+    def _fill_row(self, row: np.ndarray) -> int:
+        """Read one block directly into row[:block_size]; returns bytes
+        read (0 on EOF, < block_size on a short tail read)."""
+        block_size = self.block_size
+        if self.can_readinto:
+            view = memoryview(row)[:block_size]
+            got = 0
+            while got < block_size:
+                n = self.src.readinto(view[got:])
                 if not n:
-                    return got
+                    break
                 got += n
-                want -= n
-        if pad:
-            buf[k - 1, col + shard - pad: col + shard] = 0
-        return got
+            return got
+        b = _read_full(self.src, block_size)
+        if b:
+            row[: len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return len(b)
 
     def fill(self, buf: np.ndarray) -> tuple[int, bytes | None]:
-        """Fill up to batch_blocks blocks into `buf`; returns (nb, tail).
-        Sets self.eof when the source is exhausted."""
+        """Fill up to batch_blocks block rows of `buf`; returns
+        (nb, tail). Sets self.eof when the source is exhausted."""
+        from ..pipeline.buffers import copy_add
+
         nb = 0
         tail: bytes | None = None
-        k, shard, block_size = self.k, self.shard, self.block_size
+        block_size = self.block_size
         while nb < self.batch_blocks:
-            if self.can_readinto:
-                col = nb * shard
-                got = self._fill_block(buf, col)
-                if got < block_size:
-                    self.eof = True
-                    if got or (not nb and not self.produced):
-                        # Reassemble the short tail for the bytes path.
-                        parts = []
-                        left = got
-                        for j in range(k):
-                            take = min(left, shard)
-                            parts.append(buf[j, col: col + take].tobytes())
-                            left -= take
-                            if left == 0:
-                                break
-                        tail = b"".join(parts)
-                    break
-            else:
-                b = _read_full(self.src, block_size)
-                if len(b) < block_size:
-                    self.eof = True
-                    if b or (not nb and not self.produced):
-                        tail = b
-                    break
-                arr = np.frombuffer(b, dtype=np.uint8)
-                col = nb * shard
-                for j in range(k):
-                    row = arr[j * shard: (j + 1) * shard]
-                    buf[j, col: col + len(row)] = row
-                    if len(row) < shard:
-                        buf[j, col + len(row): col + shard] = 0
+            row = buf[nb]
+            got = self._fill_row(row)
+            if got < block_size:
+                self.eof = True
+                if got or (not nb and not self.produced):
+                    tail = row[:got].tobytes() if got else b""
+                break
+            row[block_size:] = 0  # split's zero pad (buffers recycle)
             nb += 1
         if nb or tail is not None:
             self.produced = True
+        copy_add("put.source_read",
+                 nb * block_size + (len(tail) if tail else 0))
         return nb, tail
 
 
@@ -426,6 +430,15 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
     )
     totals = {"bytes": 0}
 
+    # Post-pack items are mutable lists [buf, data, tail, parity_f,
+    # hashes_f] with stable identity, so the executor's drop hook can
+    # return an abandoned item's pooled buffer exactly once (pre-pack
+    # gather tuples carry no buffer and are ignored by drop).
+    def drop(item):
+        if isinstance(item, list) and item and item[0] is not None:
+            pool.release(item[0])
+            item[0] = None
+
     def md5_stage(item):
         full, tail = item
         for b in full:
@@ -435,16 +448,24 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         return item
 
     def pack(item):
+        from ..pipeline.buffers import copy_add
+
         full, tail = item
         if not full:
-            return (None, None, tail)
+            return [None, None, tail, None, None]
         buf = pool.acquire()
-        for bi, b in enumerate(full):
-            row = buf[bi]
-            row[:block_size] = np.frombuffer(b, dtype=np.uint8)
-            row[block_size:] = 0  # split's zero pad (buffers are recycled)
+        try:
+            for bi, b in enumerate(full):
+                row = buf[bi]
+                row[:block_size] = np.frombuffer(b, dtype=np.uint8)
+                row[block_size:] = 0  # split zero pad (buffers recycle)
+        except BaseException:
+            # Not yet wrapped in an item: invisible to the drop hook.
+            pool.release(buf)
+            raise
+        copy_add("put.pack_copy", len(full) * block_size)
         data = buf[: len(full)].reshape(len(full), k, shard)
-        return (buf, data, tail)
+        return [buf, data, tail, None, None]
 
     feed = None
     if engine == "device":
@@ -453,47 +474,46 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         feed = HostFeed()
 
     def h2d(item):
-        buf, data, tail = item
-        if data is None or feed is None:
+        if item[1] is None or feed is None:
             return item
-        return (buf, feed(data), tail)
+        item[1] = feed(item[1])
+        return item
 
     def dispatch(item):
-        buf, data, tail = item
-        if data is None:
-            return (None, None, None, None, tail)
-        parity_f, hashes_f = erasure.encode_batch_async(
-            data, with_hashes=want_digests
+        if item[1] is None:
+            return item
+        item[3], item[4] = erasure.encode_batch_async(
+            item[1], with_hashes=want_digests
         )
-        return (buf, data, parity_f, hashes_f, tail)
+        return item
 
     def flush(item):
-        buf, data, parity_f, hashes_f, tail = item
+        buf, data, tail, parity_f, hashes_f = item
         out = 0
-        try:
-            if data is not None:
-                # D2H only the parity/hashes; the data shards are still
-                # host-resident in the pooled buffer.
-                parity = np.asarray(parity_f)
-                hashes = (np.asarray(hashes_f) if hashes_f is not None
-                          else None)
-                n = parity.shape[0]
-                host = buf[:n].reshape(n, k, shard)
-                for bi in range(n):
-                    blocks = (
-                        [host[bi, j] for j in range(erasure.data_blocks)]
-                        + [parity[bi, j]
-                           for j in range(erasure.parity_blocks)]
-                    )
-                    digests = (
-                        [hashes[bi, j].tobytes()
-                         for j in range(erasure.total_shards)]
-                        if hashes is not None else None
-                    )
-                    writer.write(blocks, digests)
-                    out += block_size
-        finally:
+        if data is not None:
+            # D2H only the parity/hashes; the data shards are still
+            # host-resident in the pooled buffer.
+            parity = np.asarray(parity_f)
+            hashes = (np.asarray(hashes_f) if hashes_f is not None
+                      else None)
+            n = parity.shape[0]
+            host = buf[:n].reshape(n, k, shard)
+            for bi in range(n):
+                blocks = (
+                    [host[bi, j] for j in range(erasure.data_blocks)]
+                    + [parity[bi, j]
+                       for j in range(erasure.parity_blocks)]
+                )
+                digests = (
+                    [hashes[bi, j].tobytes()
+                     for j in range(erasure.total_shards)]
+                    if hashes is not None else None
+                )
+                writer.write(blocks, digests)
+                out += block_size
+        if buf is not None:
             pool.release(buf)
+            item[0] = None
         if tail is not None:
             writer.write(erasure.encode_data(tail))
             out += len(tail)
@@ -501,10 +521,18 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         return out or SKIP
 
     def run_inline(item):
-        if md5_update is not None:
-            md5_stage(item)
-        out = dispatch(h2d(pack(item)))
-        flush(out)
+        out = None
+        try:
+            if md5_update is not None:
+                md5_stage(item)
+            # Bind after each stage so a raise in h2d/dispatch still
+            # leaves `out` holding the pooled buffer for drop().
+            out = pack(item)
+            out = h2d(out)
+            out = dispatch(out)
+            flush(out)
+        finally:
+            drop(out)  # no-op when flush released it
 
     # Single-batch streams gain nothing from a linear pipeline (the one
     # item passes through the stages back-to-back either way): run the
@@ -538,35 +566,32 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         Stage("flush-write", flush, bytes_of=int),
     ]
     Pipeline(telemetry, stages, queue_depth=1,
-             pools=[pool]).run(source_from_peeked())
+             pools=[pool], drop=drop).run(source_from_peeked())
     return totals["bytes"]
 
 
 def _encode_stream_native(erasure: Erasure, src, writer: ParallelWriter,
                           batch_blocks: int) -> int:
-    """Serial strip driver for the host-native engine (single-core
-    hosts): gather B full blocks as [k, B*S] strips (columns of the GF
-    matmul are independent, so B blocks fuse into one 2-D native
-    encode), then one framing+write call per shard. Python per-block
-    work drops to a single scatter copy."""
+    """Serial block-major driver for the host-native engine (single-core
+    hosts): gather B full blocks as [B, k*S] rows (one contiguous
+    readinto per block), encode them as one native [B, k, S] batch, then
+    one strided-hash + vectored writev per shard. Every payload byte is
+    copied exactly once (source read) before the kernel write."""
     from ..ops import gf_native
 
     total = 0
     k = erasure.data_blocks
     m = erasure.parity_blocks
     shard = erasure.shard_size()
-    filler = _StripFiller(erasure, src, batch_blocks)
-    buf = np.empty((k, batch_blocks * shard), dtype=np.uint8)
+    filler = _BlockFiller(erasure, src, batch_blocks)
+    buf = np.empty((batch_blocks, k * shard), dtype=np.uint8)
     while not filler.eof:
         nb, tail = filler.fill(buf)
         if nb:
-            strips = buf[:, : nb * shard]
-            parity = gf_native.apply_matrix(erasure._parity_mat, strips)
-            writer.write_strips(
-                [strips[j] for j in range(k)]
-                + [parity[i] for i in range(m)],
-                shard,
+            parity = gf_native.apply_matrix_batch(
+                erasure._parity_mat, buf[:nb].reshape(nb, k, shard)
             )
+            writer.write_frame_batches(buf, parity, nb, k, m, shard)
             total += nb * erasure.block_size
         if tail is not None:
             writer.write(erasure.encode_data(tail))
@@ -579,14 +604,14 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
                                     batch_blocks: int,
                                     telemetry: str) -> int:
     """Pipelined strip driver for the host-native engine — the PUT hot
-    path on every bench host. Overlapped stages over pooled [k, B*S]
-    strip buffers:
+    path on every bench host. Overlapped stages over pooled block-major
+    [B, k*S] strip buffers:
 
-        source-read (feeder thread)
-          → md5 (delegated from TeeMD5Reader; digests the strip rows)
-            → GF encode (native GFNI/SSSE3, releases the GIL)
-              → bitrot-frame + shard-write (native hh256_frame + fd
-                writes through the IO pool)
+        source-read (feeder thread; one contiguous readinto per block)
+          → md5 (delegated from TeeMD5Reader; one update per block row)
+            → GF encode (native GFNI/SSSE3 [B, k, S] batch, GIL released)
+              → frame-write (strided frame digests + writev scatter-
+                gather straight from the strip buffer, zero data copies)
 
     so the md5/encode/frame/write stages that BENCH_r05 measured
     back-to-back (md5_overlap_speedup 0.978) proceed concurrently;
@@ -596,8 +621,10 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     When `src` is a TeeMD5Reader it delegates hashing to a dedicated
     md5 stage that digests the pooled strip buffers directly (in
     stream order, zero copies) — the tee's own per-read snapshot+queue
-    handoff measures SLOWER than the hash itself under GIL contention,
-    while a whole-batch update releases the GIL for ~8 MiB at a time."""
+    handoff measures SLOWER than the hash itself under GIL contention.
+    The block-major layout gives that stage ONE contiguous block-sized
+    update per block, so hashlib holds the strip for a single GIL-free
+    update instead of k per-row slivers."""
     from ..ops import gf_native
     from ..pipeline import Pipeline, Stage, shared_pool
 
@@ -608,71 +635,83 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     md5_update = None
     if hasattr(src, "delegate_hashing"):
         src, md5_update = src.delegate_hashing()
-    filler = _StripFiller(erasure, src, batch_blocks)
+    filler = _BlockFiller(erasure, src, batch_blocks)
     # Capacity covers the max in-flight window at queue_depth=1 (one
     # buffer per stage + one per queue + the feeder's) so steady state
     # never drops a buffer past the freelist and re-faults it.
     pool = shared_pool(
-        ("strips", k, batch_blocks, shard),
-        lambda: np.empty((k, batch_blocks * shard), dtype=np.uint8),
+        ("blocks-major", k, batch_blocks, shard),
+        lambda: np.empty((batch_blocks, k * shard), dtype=np.uint8),
         capacity=8, name="strips",
     )
     totals = {"bytes": 0}
 
+    # Items are LISTS [buf, ...] and the releasing stage nils item[0]
+    # after returning the buffer, so the executor's drop hook can return
+    # abandoned items' buffers exactly once on error/cancel paths.
+    def drop(item):
+        if isinstance(item, list) and item and item[0] is not None:
+            pool.release(item[0])
+            item[0] = None
+
+    # One mutable item list flows through every stage: [buf, nb, tail,
+    # parity, tail_blocks]. Identity is preserved end to end, so the
+    # buffer is owned by exactly one object and release/drop can nil
+    # item[0] without aliasing.
+    def fill_acquired(buf):
+        """fill() with the acquire undone on a source-read error (client
+        disconnect mid-upload) — a buffer not yet wrapped in an item is
+        invisible to the executor's drop hook."""
+        try:
+            return filler.fill(buf)
+        except BaseException:
+            pool.release(buf)
+            raise
+
     def strips_source():
         while not filler.eof:
             buf = pool.acquire()
-            nb, tail = filler.fill(buf)
+            nb, tail = fill_acquired(buf)
             if nb == 0:
                 pool.release(buf)
                 if tail is None:
                     break
-                yield (None, 0, tail)
+                yield [None, 0, tail, None, None]
             else:
-                yield (buf, nb, tail)
+                yield [buf, nb, tail, None, None]
 
     def md5_stage(item):
-        # Digest the original stream bytes from the strip layout: per
-        # block, rows j hold consecutive byte ranges (the split
-        # semantics), so walking rows in order reproduces the stream.
-        buf, nb, tail = item
-        for b in range(nb):
-            col = b * shard
-            left = block_size
-            for j in range(k):
-                take = min(left, shard)
-                md5_update(buf[j, col: col + take])
-                left -= take
-                if left == 0:
-                    break
+        # Digest the original stream bytes straight from the block-major
+        # strip: row bi's first block_size bytes ARE block bi's stream
+        # bytes, so this is one contiguous GIL-releasing update per
+        # block — no per-row slivers, no reassembly copy.
+        buf, nb, tail = item[0], item[1], item[2]
+        for bi in range(nb):
+            md5_update(buf[bi, :block_size])
         if tail:
             md5_update(tail)
         return item
 
     def encode(item):
-        buf, nb, tail = item
-        parity = None
+        buf, nb, tail = item[0], item[1], item[2]
         if nb:
-            parity = gf_native.apply_matrix(
-                erasure._parity_mat, buf[:, : nb * shard]
+            item[3] = gf_native.apply_matrix_batch(
+                erasure._parity_mat, buf[:nb].reshape(nb, k, shard)
             )
-        tail_blocks = erasure.encode_data(tail) if tail is not None else None
-        return (buf, nb, parity, tail, tail_blocks)
+        item[4] = erasure.encode_data(tail) if tail is not None else None
+        return item
 
     def frame_write(item):
-        buf, nb, parity, tail, tail_blocks = item
+        buf, nb, tail, parity, tail_blocks = item
         out = 0
-        try:
-            if nb:
-                strips = buf[:, : nb * shard]
-                writer.write_strips(
-                    [strips[j] for j in range(k)]
-                    + [parity[i] for i in range(m)],
-                    shard,
-                )
-                out += nb * block_size
-        finally:
+        if nb:
+            writer.write_frame_batches(buf, parity, nb, k, m, shard)
+            out += nb * block_size
+        # Success path release; on an exception above, the executor's
+        # drop hook returns the buffer instead (item[0] still set).
+        if buf is not None:
             pool.release(buf)
+            item[0] = None
         if tail_blocks is not None:
             writer.write(tail_blocks)
             out += len(tail)
@@ -685,15 +724,19 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     # the thread spin-up and run the stages inline (keeps small-object
     # PUT latency at the serial driver's level).
     buf0 = pool.acquire()
-    nb0, tail0 = filler.fill(buf0)
-    first = (buf0, nb0, tail0)
+    nb0, tail0 = fill_acquired(buf0)
+    first = [buf0, nb0, tail0, None, None]
     if filler.eof:
-        if nb0 or tail0 is not None:
-            if md5_update is not None:
-                md5_stage(first)
-            frame_write(encode(first))
-        else:
-            pool.release(buf0)
+        try:
+            if nb0 or tail0 is not None:
+                if md5_update is not None:
+                    md5_stage(first)
+                frame_write(encode(first))
+            else:
+                pool.release(buf0)
+                first[0] = None
+        finally:
+            drop(first)  # no-op when the inline path released it
         return totals["bytes"]
 
     def source_from_first():
@@ -707,7 +750,7 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     stages += [Stage("encode", encode),
                Stage("frame-write", frame_write, bytes_of=int)]
     Pipeline(telemetry, stages, queue_depth=1, pools=[pool],
-             ).run(source_from_first())
+             drop=drop).run(source_from_first())
     return totals["bytes"]
 
 
@@ -1049,6 +1092,14 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     # worth the per-request thread spin-up (the small-object/range-GET
     # fast path stays identical to the serial driver).
     if _SINGLE_CORE or len(geoms) <= 2:
+        # Serial consumption drains every batch's views before the next
+        # reader fan-out, so the bitrot readers may recycle their read
+        # buffers (readinto a private ring, no fresh bytes per fetch).
+        # The pipelined branch below keeps several batches in flight
+        # and must NOT enable this.
+        for r in readers:
+            if hasattr(r, "reuse_buffers"):
+                r.reuse_buffers()
         for block_offset, block_length in geoms:
             bufs = reader.read()
             note_heal()
@@ -1142,6 +1193,11 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
             writers[t].write(np.asarray(shards[t_i]).tobytes())
 
     if _SINGLE_CORE or total_blocks <= 2:
+        # Serial heal consumes (reconstructs + copies) each batch before
+        # the next fan-out: safe to recycle the readers' buffers.
+        for r in readers:
+            if hasattr(r, "reuse_buffers"):
+                r.reuse_buffers()
         for _ in range(total_blocks):
             bufs = reader.read()
             write_targets(erasure.reconstruct_targets(bufs, targets))

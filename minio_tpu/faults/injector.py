@@ -249,6 +249,20 @@ class FaultWriter:
             sched.apply("shard_write")
         return self._inner.write(data)
 
+    def writev(self, buffers):
+        """The vectored shard-write path must hit the same fault gate —
+        __getattr__ delegation would silently bypass injected hangs."""
+        sched = self._disk._sched()
+        if sched is not None:
+            sched.apply("shard_write")
+        wv = getattr(self._inner, "writev", None)
+        if wv is not None:
+            return wv(buffers)
+        total = 0
+        for b in buffers:
+            total += self._inner.write(b)
+        return total
+
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
@@ -275,6 +289,23 @@ class FaultStream:
         if verdict == "bitrot" and out:
             out = bytes([out[0] ^ 0xFF]) + out[1:]
         return out
+
+    def readinto(self, b) -> int:
+        """The recycled-buffer read path must hit the same fault gate
+        (bitrot flips the first byte in place)."""
+        sched = self._disk._sched()
+        verdict = sched.apply("stream_read") if sched is not None else None
+        inner_ri = getattr(self._inner, "readinto", None)
+        view = memoryview(b)
+        if inner_ri is not None:
+            n = inner_ri(view) or 0
+        else:
+            data = self._inner.read(len(view))
+            n = len(data)
+            view[:n] = data
+        if verdict == "bitrot" and n:
+            view[0] = view[0] ^ 0xFF
+        return n
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -355,6 +386,16 @@ class NaughtyWriter:
     def write(self, data):
         self._naughty._maybe_raise()
         return self._inner.write(data)
+
+    def writev(self, buffers):
+        self._naughty._maybe_raise()
+        wv = getattr(self._inner, "writev", None)
+        if wv is not None:
+            return wv(buffers)
+        total = 0
+        for b in buffers:
+            total += self._inner.write(b)
+        return total
 
     def close(self):
         try:

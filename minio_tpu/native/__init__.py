@@ -103,6 +103,10 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_char_p, u8p, ctypes.c_size_t, ctypes.c_size_t,
         ]
         lib.hh256_verify_frames.restype = ctypes.c_int64
+        lib.hh256_hash_strided.argtypes = [
+            ctypes.c_char_p, u8p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_size_t, u8p,
+        ]
         lib.gf_engine_kind.restype = ctypes.c_int
         lib.gf_apply_affine.argtypes = [
             u64p, ctypes.c_int, ctypes.c_int, u8p, u8p,

@@ -228,6 +228,20 @@ void hh256_frame(const uint8_t *key32, const uint8_t *data, size_t len,
     }
 }
 
+/* Hash n equal-length chunks laid out at a fixed stride, digests only —
+ * the zero-copy twin of hh256_frame. The block-major encode pipeline
+ * keeps each erasure block contiguous ([B, k*S] strips), so shard j's
+ * consecutive bitrot chunks live at base + i*stride; this computes all
+ * their frame digests in one call and the caller ships [digest||chunk]
+ * pairs with writev, copying no data byte at all. */
+void hh256_hash_strided(const uint8_t *key32, const uint8_t *base,
+                        size_t stride, size_t n, size_t chunk,
+                        uint8_t *out) {
+    for (size_t i = 0; i < n; i++) {
+        hh256_hash(key32, base + i * stride, chunk, out + i * 32);
+    }
+}
+
 /* Verify a physical [H(chunk)||chunk]* region in one call — the read-side
  * twin of hh256_frame (cmd/bitrot-streaming.go:152-168 verifies chunk by
  * chunk; doing all chunks per file read removes the per-chunk Python

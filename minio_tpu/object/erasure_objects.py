@@ -26,7 +26,8 @@ from ..erasure.bitrot import (
 from ..erasure.codec import Erasure
 from ..erasure.streaming import decode_stream, encode_stream, heal_stream
 from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
-from ..storage.local import SMALL_FILE_THRESHOLD, SYSTEM_META_BUCKET
+from ..storage import local as _local_storage
+from ..storage.local import SYSTEM_META_BUCKET
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
     ErrBadDigest,
@@ -368,7 +369,7 @@ class ErasureObjects(MultipartMixin):
         disks_by_shard = shuffle_disks(self.disks, distribution)
 
         shard_file_size = erasure.shard_file_size(size) if size >= 0 else -1
-        inline = 0 <= shard_file_size <= SMALL_FILE_THRESHOLD
+        inline = 0 <= shard_file_size <= _local_storage.small_file_threshold()
 
         tmp_id = new_uuid()
         data_dir = new_uuid()
@@ -417,11 +418,13 @@ class ErasureObjects(MultipartMixin):
             # (O_DIRECT) sinks hold an fd + staging buffer that GC may
             # not finalize promptly — aborted uploads must not leak them.
             _close_sinks(sinks)
-            self._cleanup_tmp(disks_by_shard, tmp_id)
+            if not inline:  # inline PUTs never stage tmp files
+                self._cleanup_tmp(disks_by_shard, tmp_id)
             raise
         if size >= 0 and total != size:
             _close_sinks(sinks)
-            self._cleanup_tmp(disks_by_shard, tmp_id)
+            if not inline:
+                self._cleanup_tmp(disks_by_shard, tmp_id)
             raise ErrLessData(f"read {total} bytes, expected {size}")
         size = total
 
@@ -440,7 +443,8 @@ class ErasureObjects(MultipartMixin):
             # Digest verified against the encode stream BEFORE the commit
             # rename: a BadDigest must leave nothing behind (ref
             # pkg/hash/reader.go inline verification).
-            self._cleanup_tmp(disks_by_shard, tmp_id)
+            if not inline:
+                self._cleanup_tmp(disks_by_shard, tmp_id)
             raise ErrBadDigest(
                 f"content md5 {etag} != declared {opts.want_md5_hex}"
             )
@@ -475,10 +479,18 @@ class ErasureObjects(MultipartMixin):
             )
             fi.add_part(1, size, size)
             if inline:
+                # Inline commit: the shard bytes ride INSIDE xl.meta, so
+                # the whole commit is ONE metadata journal write — no
+                # staged tmp files, no rename. write_metadata is the
+                # direct journal entry point (rename_data would only add
+                # the no-op data-dir move on top of the same write).
                 fi.data = {1: sinks[i].getvalue()}
-            disk.rename_data(
-                SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi, bucket, object_
-            )
+                disk.write_metadata(bucket, object_, fi)
+            else:
+                disk.rename_data(
+                    SYSTEM_META_BUCKET, self._tmp_path(tmp_id), fi,
+                    bucket, object_,
+                )
 
         # Commit fan-out waits for write quorum + straggler grace, not
         # for every disk: a drive hung in rename_data is detached (its
@@ -509,7 +521,8 @@ class ErasureObjects(MultipartMixin):
                     disks_by_shard[i].delete_version(bucket, object_, undo_fi)
                 except Exception:  # noqa: BLE001 - best effort
                     pass
-            self._cleanup_tmp(disks_by_shard, tmp_id)
+            if not inline:
+                self._cleanup_tmp(disks_by_shard, tmp_id)
             raise err
         # Partial write (quorum met, some disks failed): queue MRF heal
         # (ref cmd/erasure-object.go:798-804 addPartial).

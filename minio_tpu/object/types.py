@@ -248,7 +248,13 @@ class TeeMD5Reader:
                 # _want_pipeline, not _queue: the lazy worker starts
                 # inside _ingest, AFTER this choice.
                 snapshot = self._want_pipeline or self._queue is not None
-                self._ingest(bytes(view[:n]) if snapshot else view[:n])
+                if snapshot:
+                    from ..pipeline.buffers import copy_add
+
+                    copy_add("put.md5_snapshot", n)
+                    self._ingest(bytes(view[:n]))
+                else:
+                    self._ingest(view[:n])
                 self.bytes_read += n
             return n or 0
         buf = self._src.read(len(view))

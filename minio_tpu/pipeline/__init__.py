@@ -15,7 +15,7 @@ fast, pipeline structure, not the codec, dominates throughput
 path.
 """
 
-from .buffers import BufferPool, shared_pool
+from .buffers import COPY, BufferPool, copy_add, shared_pool
 from .executor import Pipeline, PipelineCancelled
 from .metrics import (
     get_registry,
@@ -27,6 +27,8 @@ from .stage import END_OF_STREAM, SKIP, Stage
 
 __all__ = [
     "BufferPool",
+    "COPY",
+    "copy_add",
     "END_OF_STREAM",
     "Pipeline",
     "PipelineCancelled",

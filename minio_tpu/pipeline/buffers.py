@@ -2,9 +2,8 @@
 
 The erasure hot paths move the stream in multi-MiB strip buffers; with
 stages overlapped, several batches are in flight at once, and a fresh
-`np.empty((k, B*S))` per batch costs a page-fault pass over the whole
-allocation (measured in write_frames — the same reuse trick lives
-there). The pool allocates each buffer ONCE and recycles it:
+`np.empty((B, k*S))` per batch costs a page-fault pass over the whole
+allocation. The pool allocates each buffer ONCE and recycles it:
 steady-state throughput does zero allocations, and the `allocated`
 high-water mark is bounded by the pipeline depth, not the stream
 length.
@@ -20,6 +19,55 @@ from __future__ import annotations
 
 import threading
 from typing import Callable
+
+
+class CopyCounters:
+    """Per-site byte counters for every memcpy/alloc the hot paths still
+    perform — the regression guard behind the zero-copy work: bench.py
+    snapshots these around a run and reports bytes-copied per stage, and
+    test_bench_smoke pins the pipelined-PUT floor (exactly one ingest
+    copy per payload byte, zero framing copies on the vectored path).
+
+    Sites are stable dotted labels ("put.source_read", "get.source_read",
+    "put.frame_fallback", ...). Counting is per-batch (one lock + int add
+    per multi-MiB strip), so the accounting itself costs nothing
+    measurable."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._sites: dict[str, int] = {}
+
+    def add(self, site: str, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._mu:
+            self._sites[site] = self._sites.get(site, 0) + nbytes
+
+    def snapshot(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._sites)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._sites.clear()
+
+    def delta(self, before: dict[str, int]) -> dict[str, int]:
+        """Per-site growth since a snapshot (zero-growth sites omitted)."""
+        now = self.snapshot()
+        out = {}
+        for site, n in now.items():
+            d = n - before.get(site, 0)
+            if d:
+                out[site] = d
+        return out
+
+
+COPY = CopyCounters()
+
+
+def copy_add(site: str, nbytes: int) -> None:
+    """Record `nbytes` copied (or freshly materialized) at `site`."""
+    COPY.add(site, nbytes)
 
 
 class BufferPool:

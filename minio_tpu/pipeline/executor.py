@@ -50,19 +50,38 @@ class Pipeline:
                    window; with the buffer pool this is what limits
                    memory, not stream length).
     pools       -- BufferPools whose stats to flush with each run.
+    drop        -- optional item -> None cleanup invoked for every
+                   payload item the pipeline abandons on error or
+                   cancellation (stranded in a queue, or produced but
+                   never enqueued). Drivers that thread pooled buffers
+                   through their items use this to return them, so an
+                   aborted stream leaves the pool at its steady-state
+                   high-water mark instead of leaking one buffer per
+                   abort. An item is dropped AT MOST once, and never
+                   after the stage that owns its release consumed it.
     """
 
     def __init__(self, name: str, stages: list[Stage],
-                 queue_depth: int = 2, pools: list | None = None):
+                 queue_depth: int = 2, pools: list | None = None,
+                 drop=None):
         if not stages:
             raise ValueError("pipeline needs at least one stage")
         self.name = name
         self.stages = stages
         self.queue_depth = max(1, queue_depth)
         self.pools = pools or []
+        self._drop = drop
         self._cancel = threading.Event()
         self._err_mu = threading.Lock()
         self._error: BaseException | None = None
+
+    def _drop_item(self, item) -> None:
+        if self._drop is None or item is END_OF_STREAM or item is CANCELLED:
+            return
+        try:
+            self._drop(item)
+        except Exception:  # noqa: BLE001 - cleanup is best effort
+            pass
 
     # ------------------------------------------------------------------
     # cancel-aware queue ops
@@ -104,6 +123,7 @@ class Pipeline:
         try:
             for item in source:
                 if not self._put(out_q, item):
+                    self._drop_item(item)
                     return
         except BaseException as exc:  # noqa: BLE001 - first error wins
             self._fail(exc)
@@ -127,6 +147,10 @@ class Pipeline:
                 out = stage.fn(item)
                 stats.busy_s += time.perf_counter() - t0
             except BaseException as exc:  # noqa: BLE001 - first error wins
+                # Contract with `drop`: a stage releases an item's pooled
+                # buffer only on full success, so the failed item still
+                # carries it — return it here, exactly once.
+                self._drop_item(item)
                 self._fail(exc, stage)
                 return
             if out is SKIP:
@@ -141,6 +165,7 @@ class Pipeline:
             ok = self._put(out_q, out)
             stats.stall_s += time.perf_counter() - t0
             if not ok:
+                self._drop_item(out)
                 return
             # no-ops internally when no registry is installed
             _pmetrics.record_queue_depth(self.name, stage.name,
@@ -196,7 +221,7 @@ class Pipeline:
             self._cancel.set()
             raise
         finally:
-            self._cancel_wait_flush(threads)
+            self._cancel_wait_flush(threads, queues)
         if self._error is not None:
             raise self._error
         if cancelled_mid:
@@ -211,13 +236,23 @@ class Pipeline:
             n += 1
         return n
 
-    def _cancel_wait_flush(self, threads) -> None:
+    def _cancel_wait_flush(self, threads, queues=()) -> None:
         # After the caller saw EOS (or error), everything upstream is
         # done or cancelled; setting cancel lets any straggler blocked
         # on a full queue exit, making the join bounded.
         self._cancel.set()
         for t in threads:
             t.join()
+        # Workers are parked: anything still queued was abandoned by the
+        # cancellation and never reached its releasing stage — return
+        # those items' pooled buffers before reporting pool stats.
+        if self._drop is not None:
+            for q in queues:
+                while True:
+                    try:
+                        self._drop_item(q.get_nowait())
+                    except _queue.Empty:
+                        break
         _pmetrics.record_run(self.name, self.stages,
                              error=self._error is not None)
         for p in self.pools:

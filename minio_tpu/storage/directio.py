@@ -120,6 +120,21 @@ class DirectFileWriter:
         self._fill = rest
         self._offset += n_aligned
 
+    def writev(self, buffers) -> int:
+        """Vectored write API parity with the buffered sink. O_DIRECT
+        demands block-aligned addresses and lengths, so the frames must
+        stage through the aligned bounce buffer anyway — this is the one
+        sink where the vectored path still copies, and the copy counter
+        records it (real-disk deployments trade that memcpy for page-
+        cache bypass; see storage/directio.py module docs)."""
+        from ..pipeline.buffers import copy_add
+
+        total = 0
+        for b in buffers:
+            total += self.write(b)
+        copy_add("put.directio_stage", total)
+        return total
+
     def fileno(self) -> int:
         return self._fd
 

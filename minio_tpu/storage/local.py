@@ -46,8 +46,23 @@ SYSTEM_MULTIPART = SYSTEM_META_BUCKET + "/multipart"
 XL_META_FILE = "xl.meta"
 
 # Shard files at or below this size are inlined into xl.meta
-# (smallFileThreshold, ref cmd/xl-storage.go:66).
+# (smallFileThreshold, ref cmd/xl-storage.go:66): a small PUT becomes
+# ONE metadata write per disk instead of shard-write + rename-commit.
 SMALL_FILE_THRESHOLD = 128 << 10
+
+
+def small_file_threshold() -> int:
+    """Effective inline threshold: MTPU_INLINE_THRESHOLD (bytes; 0
+    disables inlining) read at call time so operators and tests can
+    retune a live process; falls back to the module default (which
+    tests may monkeypatch directly)."""
+    env = os.environ.get("MTPU_INLINE_THRESHOLD", "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return SMALL_FILE_THRESHOLD
 
 
 def _check_path(p: str):
@@ -448,8 +463,8 @@ class LocalStorage(StorageAPI):
                                         fsync_on_close=self._fsync)
             except OSError:
                 pass  # per-file fallback (e.g. fs quirk): buffered path
-        # Unbuffered: shard writers emit one large framed write per batch
-        # (erasure/streaming.py write_strips), so Python's buffered-IO
+        # Unbuffered: shard writers emit one vectored framed write per
+        # batch (write_frame_batches → writev), so Python's buffered-IO
         # layer would only add a full extra memcpy per write — measured
         # 1.4 vs 2.6 GB/s on the tmpfs bench host. The wrapper restores
         # the ONE buffered-IO behavior that matters: raw write() may
@@ -633,6 +648,34 @@ class _FullWriter:
             n += wrote
         return total
 
+    def writev(self, buffers) -> int:
+        """Vectored scatter-gather write: one writev(2) ships the whole
+        [hash||chunk]* frame list straight out of the strip buffers —
+        the zero-copy sink of StreamingBitrotWriter.write_frames_vec.
+        Retries short writes (near-ENOSPC etc.) resuming mid-iovec."""
+        total = sum(len(b) for b in buffers)
+        if total == 0:
+            return 0
+        fd = self._f.fileno()
+        written = 0
+        pending = list(buffers)
+        while True:
+            n = os.writev(fd, pending[:1024])  # IOV_MAX bound
+            written += n
+            if written >= total:
+                return total
+            if n == 0:
+                raise OSError(f"writev stalled at {written}/{total} bytes")
+            # Advance past fully-written buffers, slice the partial one.
+            while n:
+                ln = len(pending[0])
+                if ln <= n:
+                    n -= ln
+                    pending.pop(0)
+                else:
+                    pending[0] = memoryview(pending[0])[n:]
+                    n = 0
+
     def fileno(self):
         return self._f.fileno()
 
@@ -649,6 +692,9 @@ class _FsyncOnClose:
 
     def __init__(self, f):
         self._f = f
+        # Vectored writes pass through when the wrapped sink has them.
+        if hasattr(f, "writev"):
+            self.writev = f.writev
 
     def write(self, b):
         return self._f.write(b)
@@ -677,6 +723,18 @@ class _LimitedReader:
         buf = self._f.read(n)
         self._left -= len(buf)
         return buf
+
+    def readinto(self, b) -> int:
+        """Zero-alloc fill — lets the bitrot readers recycle their read
+        buffers instead of materializing fresh bytes per fetch."""
+        if self._left <= 0:
+            return 0
+        view = memoryview(b)
+        if len(view) > self._left:
+            view = view[: self._left]
+        n = self._f.readinto(view) or 0
+        self._left -= n
+        return n
 
     def close(self):
         self._f.close()

@@ -68,6 +68,48 @@ def test_put_stages_reports_pipelined_path(tmp_path):
         assert any(v["items"] > 0 for v in pstages.values()), pstages
 
 
+def test_pipelined_put_no_copy_invariant(tmp_path):
+    """The zero-copy floor: a pipelined host-fed PUT copies each payload
+    byte exactly ONCE (the source read into the strip buffer). Framing
+    copies must be zero on the vectored write path, and the shared strip
+    pool must not grow while the vectored writers run."""
+    import os
+
+    import bench
+    from minio_tpu.erasure.codec import Erasure
+    from minio_tpu.ops import gf_native
+    from minio_tpu.pipeline.buffers import COPY, _shared
+
+    if not gf_native.available():
+        import pytest
+
+        pytest.skip("native engine unavailable: vectored path inactive")
+    total_mib = 8
+    stages = bench.bench_put_stages(str(tmp_path), total_mib=total_mib)
+    cc = stages.get("copy_counters", {})
+    assert cc, stages
+    moved = 3 * total_mib << 20  # 3 reps over the payload
+    # Floor: exactly one ingest copy per payload byte...
+    assert cc.get("put.source_read", 0) == moved, cc
+    # ...and ZERO framing copies (writev ships views directly).
+    assert cc.get("put.frame_copy", 0) == 0, cc
+    assert stages.get("copies_per_input_byte", 99) <= 1.05, stages
+    # Pool no-growth across the vectored write runs.
+    er = Erasure(12, 4, 1 << 20)
+    key = ("blocks-major", 12, 8, er.shard_size())
+    if (os.cpu_count() or 1) > 1 and key in _shared:
+        stats = _shared[key].stats()
+        assert stats["allocated"] <= stats["capacity"], stats
+        assert stats["in_use"] == 0, stats
+        # A second measured run must be fully recycled.
+        before = stats["allocated"]
+        COPY.reset()
+        bench.bench_put_stages(str(tmp_path), total_mib=total_mib)
+        after = _shared[key].stats()
+        assert after["allocated"] == before, after
+        assert after["reused"] > stats["reused"], after
+
+
 def test_pipeline_executor_smoke():
     """Fast end-to-end of the executor itself (the machinery every
     bench pipeline number rides on): ordering, telemetry, completion."""

@@ -118,3 +118,12 @@ def test_chaos_soak_no_stall_no_loss(tmp_path):
             sink = io.BytesIO()
             es.get_object("soak", name, sink)
             assert sink.getvalue() == body, name
+
+        # No strip-buffer leaks across all the aborted/raced PUTs: every
+        # shared pool settled back to its high-water mark with nothing
+        # in flight (the executor's drop hook returns abandoned buffers).
+        from minio_tpu.pipeline.buffers import _shared
+
+        for key, pool in _shared.items():
+            stats = pool.stats()
+            assert stats["in_use"] == 0, (key, stats)

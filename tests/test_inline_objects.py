@@ -1,0 +1,148 @@
+"""Inline small-object data: shards at or below the inline threshold
+ride INSIDE xl.meta (MinIO smallFileThreshold parity,
+ref cmd/xl-storage.go:66) so a small PUT is one metadata journal write
+per disk — no staged part files, no rename-commit. These tests pin the
+S3 semantics (byte equality, ETag, versioning), the exact inline/shard
+threshold boundary, and heal/listing of inlined objects."""
+
+import hashlib
+import io
+import os
+
+import pytest
+
+from minio_tpu.object.erasure_objects import ErasureObjects
+from minio_tpu.object.types import ObjectOptions
+from minio_tpu.storage import local as local_mod
+from minio_tpu.storage.local import LocalStorage
+
+
+def _mk_set(tmp_path, n=4, parity=2):
+    disks = [
+        LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+        for i in range(n)
+    ]
+    es = ErasureObjects(disks, default_parity=parity)
+    es.make_bucket("b")
+    return es, disks
+
+
+def _get_bytes(es, bucket, obj, **opts):
+    buf = io.BytesIO()
+    es.get_object(bucket, obj, buf,
+                  opts=ObjectOptions(**opts) if opts else None)
+    return buf.getvalue()
+
+
+def _has_part_file(disks, obj) -> bool:
+    """True when any disk holds an on-disk part file for `obj` (i.e. the
+    object was NOT inlined)."""
+    for d in disks:
+        obj_dir = os.path.join(d.root, "b", obj)
+        if not os.path.isdir(obj_dir):
+            continue
+        for name in os.listdir(obj_dir):
+            sub = os.path.join(obj_dir, name)
+            if os.path.isdir(sub) and any(
+                p.startswith("part.") for p in os.listdir(sub)
+            ):
+                return True
+    return False
+
+
+@pytest.mark.parametrize("size", [0, 1, 100, 4096, 64 << 10])
+def test_inline_put_get_roundtrip(tmp_path, size):
+    es, disks = _mk_set(tmp_path)
+    payload = os.urandom(size)
+    oi = es.put_object("b", "o", io.BytesIO(payload), size)
+    assert oi.etag == hashlib.md5(payload).hexdigest()
+    assert _get_bytes(es, "b", "o") == payload
+    assert not _has_part_file(disks, "o")
+    # The shard bytes live in the journal itself.
+    fi = disks[0].read_version("b", "o", read_data=True)
+    if size:
+        assert fi.data.get(1), "expected inline shard data in xl.meta"
+    info = es.get_object_info("b", "o")
+    assert info.size == size
+    assert info.etag == oi.etag
+
+
+def test_inline_threshold_boundary(tmp_path, monkeypatch):
+    """size == threshold*k inlines; one byte more spills to part files
+    (inline iff shard_file_size(size) <= SMALL_FILE_THRESHOLD; with
+    k=2 data shards, shard_file_size = ceil(size/2))."""
+    thresh = 32 << 10
+    monkeypatch.setattr(local_mod, "SMALL_FILE_THRESHOLD", thresh)
+    es, disks = _mk_set(tmp_path)
+    for size, want_inline in (
+        (2 * thresh - 1, True),   # shard = thresh, one byte short
+        (2 * thresh, True),       # shard == threshold: still inline
+        (2 * thresh + 1, False),  # shard = thresh+1: part files
+    ):
+        payload = os.urandom(size)
+        obj = f"edge-{size}"
+        es.put_object("b", obj, io.BytesIO(payload), size)
+        assert _get_bytes(es, "b", obj) == payload
+        assert _has_part_file(disks, obj) == (not want_inline), size
+        fi = disks[0].read_version("b", obj, read_data=True)
+        assert bool(fi.data.get(1)) == want_inline, size
+
+
+def test_inline_versioned_overwrite(tmp_path):
+    """Two versioned PUTs of one inline object keep BOTH versions'
+    bytes addressable; deleting the latest surfaces the older one."""
+    es, disks = _mk_set(tmp_path)
+    a, b = os.urandom(1000), os.urandom(2000)
+    oi_a = es.put_object("b", "v", io.BytesIO(a), len(a),
+                         ObjectOptions(versioned=True))
+    oi_b = es.put_object("b", "v", io.BytesIO(b), len(b),
+                         ObjectOptions(versioned=True))
+    assert oi_a.version_id and oi_b.version_id
+    assert oi_a.version_id != oi_b.version_id
+    assert _get_bytes(es, "b", "v") == b
+    assert _get_bytes(es, "b", "v", version_id=oi_a.version_id) == a
+    assert _get_bytes(es, "b", "v", version_id=oi_b.version_id) == b
+    es.delete_object("b", "v",
+                     ObjectOptions(version_id=oi_b.version_id,
+                                   versioned=True))
+    assert _get_bytes(es, "b", "v") == a
+    info = es.get_object_info("b", "v")
+    assert info.etag == hashlib.md5(a).hexdigest()
+
+
+def test_inline_object_heal(tmp_path):
+    """An inlined object lost from one disk heals back as inline data
+    (write_metadata path, no part files) and reads still verify."""
+    es, disks = _mk_set(tmp_path)
+    payload = os.urandom(50_000)
+    es.put_object("b", "h", io.BytesIO(payload), len(payload))
+    # Kill the object on one disk entirely.
+    disks[1].delete("b", "h", recursive=True)
+    res = es.heal_object("b", "h")
+    assert res["healed"], res
+    fi = disks[1].read_version("b", "h", read_data=True)
+    assert fi.data.get(1), "healed copy must be inline again"
+    assert not _has_part_file(disks, "h")
+    assert _get_bytes(es, "b", "h") == payload
+
+
+def test_inline_objects_in_listing(tmp_path):
+    es, disks = _mk_set(tmp_path)
+    for i in range(3):
+        es.put_object("b", f"ls/o{i}", io.BytesIO(b"x" * 100), 100)
+    names = [n for n, _ in es.list_objects_raw("b", prefix="ls/")]
+    assert names == [f"ls/o{i}" for i in range(3)]
+
+
+def test_inline_threshold_env_knob(tmp_path, monkeypatch):
+    """MTPU_INLINE_THRESHOLD is read at PUT time: 0 disables inlining
+    on a live process, clearing it restores the default."""
+    monkeypatch.setenv("MTPU_INLINE_THRESHOLD", "0")
+    es, disks = _mk_set(tmp_path)
+    payload = os.urandom(1024)
+    es.put_object("b", "no-inline", io.BytesIO(payload), len(payload))
+    assert _has_part_file(disks, "no-inline")
+    assert _get_bytes(es, "b", "no-inline") == payload
+    monkeypatch.delenv("MTPU_INLINE_THRESHOLD")
+    es.put_object("b", "yes-inline", io.BytesIO(payload), len(payload))
+    assert not _has_part_file(disks, "yes-inline")

@@ -292,7 +292,7 @@ def test_shared_strip_pool_flat_across_puts():
                              telemetry="test") == len(payload)
 
     one_put()  # warm the pool to its high-water mark
-    key = ("strips", 6, 8, er.shard_size())
+    key = ("blocks-major", 6, 8, er.shard_size())
     if key not in _shared:  # single-core host: serial driver, no pool
         pytest.skip("pipelined driver not active on this host")
     high_water = _shared[key].stats()["allocated"]

@@ -319,6 +319,21 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     )
 
 
+_HOST_FEED = None
+
+
+def _host_feed():
+    """Process-wide HostFeed stage (it is stateless): PUTs reuse it
+    instead of constructing one per stream — part of the per-PUT setup
+    the pool-batched path no longer pays."""
+    global _HOST_FEED
+    if _HOST_FEED is None:
+        from ..ops.rs_pallas import HostFeed
+
+        _HOST_FEED = HostFeed()
+    return _HOST_FEED
+
+
 def _gather_batches(src, block_size: int, batch_blocks: int):
     """Yield (full_blocks, tail) gathers for the block-list drivers: up
     to batch_blocks full byte blocks per item, plus the short trailing
@@ -467,11 +482,7 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         data = buf[: len(full)].reshape(len(full), k, shard)
         return [buf, data, tail, None, None]
 
-    feed = None
-    if engine == "device":
-        from ..ops.rs_pallas import HostFeed
-
-        feed = HostFeed()
+    feed = _host_feed() if engine == "device" else None
 
     def h2d(item):
         if item[1] is None or feed is None:
@@ -1178,6 +1189,8 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
     reads of block N+1 and GF reconstruction of block N overlap the
     stale-disk writes of block N-1, so heal throughput is bounded by
     the slowest stage rather than their sum."""
+    from .codec import _select_engine
+
     targets = [i for i, w in enumerate(writers) if w is not None]
     if not targets:
         return
@@ -1191,6 +1204,10 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
     def write_targets(shards) -> None:
         for t_i, t in enumerate(targets):
             writers[t].write(np.asarray(shards[t_i]).tobytes())
+
+    if _select_engine(erasure.shard_size()) == "device" and total_blocks:
+        return _heal_stream_device(erasure, writers, reader, targets,
+                                   total_blocks)
 
     if _SINGLE_CORE or total_blocks <= 2:
         # Serial heal consumes (reconstructs + copies) each batch before
@@ -1211,3 +1228,108 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
     ], queue_depth=2)
     for shards in pipe.results(range(total_blocks)):
         write_targets(shards)
+
+
+# Blocks per fused heal-reconstruction dispatch; matches the read-side
+# prefetch (ParallelReader.BATCH_BLOCKS) so one device batch consumes
+# exactly one reader fan-out.
+_DEVICE_HEAL_BATCH = 8
+
+
+def _heal_stream_device(erasure: Erasure, writers: list, reader,
+                        targets: list[int], total_blocks: int) -> None:
+    """Device heal driver: batches of surviving-shard blocks ship as one
+    [B, k, S] fused dispatch that rebuilds the stale shards AND their
+    bitrot digests (device_engine.reconstruct_async, same single-
+    dispatch + donated-buffer + async-D2H treatment as the encode path).
+    The dispatch of batch N overlaps the stale-disk writes of batch N-1;
+    a ragged tail block (short shard) falls back to the host
+    reconstruction, exactly like the encode drivers' tail path."""
+    from .device_engine import for_geometry
+
+    codec = for_geometry(erasure.data_blocks, erasure.parity_blocks)
+    k = erasure.data_blocks
+    shard = erasure.shard_size()
+    # Device digests frame the target writers' chunks only when every
+    # target speaks the fused-digest protocol (HH256S streaming writers).
+    want_digests = all(
+        getattr(writers[t], "device_hashable", False) for t in targets
+    )
+    # Batches are copied out of the reader's buffers at gather time, so
+    # the recycled readinto ring is safe even with dispatches in flight.
+    for r in reader.readers:
+        if hasattr(r, "reuse_buffers"):
+            r.reuse_buffers()
+
+    pending = None  # (rebuilt_future, digests_future)
+
+    def flush(p) -> None:
+        rebuilt = np.asarray(p[0])  # D2H already started at dispatch
+        digs = np.asarray(p[1]) if p[1] is not None else None
+        for bi in range(rebuilt.shape[0]):
+            for t_i, t in enumerate(targets):
+                w = writers[t]
+                chunk = rebuilt[bi, t_i].tobytes()
+                if digs is not None and hasattr(w, "write_with_digest"):
+                    w.write_with_digest(chunk, digs[bi, t_i].tobytes())
+                else:
+                    w.write(chunk)
+
+    batch: list = []
+    batch_present: tuple = ()
+
+    def dispatch_batch() -> None:
+        nonlocal pending, batch
+        if not batch:
+            return
+        src = np.stack(batch)
+        out = codec.reconstruct_async(src, batch_present, tuple(targets),
+                                      with_hashes=want_digests)
+        batch = []
+        if pending is not None:
+            flush(pending)  # overlap: batch N computes while N-1 writes
+        pending = out
+
+    from ..utils.errors import ErrShardSize, ErrTooFewShards
+
+    for _ in range(total_blocks):
+        bufs = reader.read()
+        present = tuple(
+            i for i, b in enumerate(bufs) if b is not None and len(b)
+        )
+        # Same typed validation as the host reconstruct_targets path: a
+        # truncated shard or sub-quorum survivor set must classify as an
+        # erasure error, not a raw numpy shape failure.
+        if len(present) < k:
+            raise ErrTooFewShards(
+                f"{len(present)} shards present, need {k}"
+            )
+        blen = len(bufs[present[0]])
+        for i in present:
+            if len(bufs[i]) != blen:
+                raise ErrShardSize("present shards differ in size")
+        if blen != shard:
+            # Ragged tail: drain the device ring in order, then host-path
+            # the short block.
+            dispatch_batch()
+            if pending is not None:
+                flush(pending)
+                pending = None
+            shards = erasure.reconstruct_targets(list(bufs), targets)
+            for t_i, t in enumerate(targets):
+                writers[t].write(np.asarray(shards[t_i]).tobytes())
+            continue
+        if batch and present[:k] != batch_present:
+            # Survivor set changed mid-stream (a reader died): close the
+            # old pattern's batch; the next one compiles/caches its own.
+            dispatch_batch()
+        batch_present = present[:k]
+        batch.append(np.stack([
+            np.frombuffer(memoryview(bufs[i]), dtype=np.uint8)
+            for i in present[:k]
+        ]))
+        if len(batch) >= _DEVICE_HEAL_BATCH:
+            dispatch_batch()
+    dispatch_batch()
+    if pending is not None:
+        flush(pending)

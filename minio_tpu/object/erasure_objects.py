@@ -253,7 +253,13 @@ class ErasureObjects(MultipartMixin):
             raise ErrOperationTimedOut(f"{bucket}/{object_}") from exc
 
     def _object_erasure(self, k: int, m: int) -> Erasure:
-        return Erasure(k, m, BLOCK_SIZE_V2)
+        # Geometry-keyed shared instance: PUT/GET/heal of one erasure
+        # set reuse the same codec (matrices, device engine caches)
+        # instead of re-deriving them per object — the per-PUT setup
+        # cost the pool-batched path measured.
+        from ..erasure.codec import cached_erasure
+
+        return cached_erasure(k, m, BLOCK_SIZE_V2)
 
     def _tmp_path(self, tmp_id: str) -> str:
         return f"tmp/{tmp_id}"
@@ -454,6 +460,14 @@ class ErasureObjects(MultipartMixin):
         metadata.setdefault("content-type", "application/octet-stream")
 
         # Commit: RenameData tmp -> final (or metadata-only for inline).
+        # One PUT's per-disk journals differ only in the shard index, so
+        # the fan-out shares ONE serialized xl.meta (stamped per disk)
+        # instead of re-packing it 16 times; disks with an existing
+        # journal (overwrites) or inline data decline the pack and merge
+        # normally (storage/xlmeta.FanoutMetaPack).
+        from ..storage.xlmeta import FanoutMetaPack
+
+        meta_pack = FanoutMetaPack()
         errs: list = [None] * n
 
         def commit(i):
@@ -478,6 +492,7 @@ class ErasureObjects(MultipartMixin):
                 ),
             )
             fi.add_part(1, size, size)
+            fi.fanout_pack = meta_pack
             if inline:
                 # Inline commit: the shard bytes ride INSIDE xl.meta, so
                 # the whole commit is ONE metadata journal write — no

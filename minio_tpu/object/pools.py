@@ -12,6 +12,7 @@ from __future__ import annotations
 import heapq
 import io
 import threading
+import time
 
 from ..storage.xlmeta import XLMeta
 from ..utils.errors import (
@@ -47,6 +48,15 @@ class ErasureServerPools:
         # when set, pages route to the listing's owner node and mutations
         # broadcast generation bumps to peers.
         self.listing_coordinator = None
+        # Positive bucket-existence cache: _check_bucket used to stat the
+        # bucket volume on EVERY disk per object op (16 syscalls per PUT
+        # on the batched path). Positives are safe to cache briefly —
+        # delete_bucket invalidates — and negatives are never cached, so
+        # a just-created bucket is visible immediately.
+        self._bucket_seen: dict[str, float] = {}
+        self._bucket_seen_lock = threading.Lock()
+
+    _BUCKET_SEEN_TTL_S = 2.0
 
     def _bump_gen(self, bucket: str):
         with self._gen_lock:
@@ -118,8 +128,13 @@ class ErasureServerPools:
             self.update_tracker.mark(bucket)
 
     def delete_bucket(self, bucket: str, force: bool = False):
+        self._forget_bucket(bucket)
         for pool in self.pools:
             pool.delete_bucket(bucket, force=force)
+        # Forget AGAIN after the volumes are gone: a _check_bucket racing
+        # the deletes above can observe the still-present bucket and
+        # re-cache it; this second invalidation closes that window.
+        self._forget_bucket(bucket)
         self._metacache.invalidate_bucket(bucket)
         self._list_gen.pop(bucket, None)
         if self.update_tracker is not None:
@@ -143,8 +158,19 @@ class ErasureServerPools:
         return [seen[k] for k in sorted(seen)]
 
     def _check_bucket(self, bucket: str):
+        now = time.monotonic()
+        with self._bucket_seen_lock:
+            seen = self._bucket_seen.get(bucket, 0.0)
+        if now - seen < self._BUCKET_SEEN_TTL_S:
+            return
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
+        with self._bucket_seen_lock:
+            self._bucket_seen[bucket] = now
+
+    def _forget_bucket(self, bucket: str):
+        with self._bucket_seen_lock:
+            self._bucket_seen.pop(bucket, None)
 
     # --- object ops ---
 

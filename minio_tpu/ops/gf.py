@@ -159,6 +159,23 @@ def bit_matrix(mat: np.ndarray) -> np.ndarray:
     return out
 
 
+def bit_matrix_for(mat: np.ndarray) -> np.ndarray:
+    """Cached front-end to bit_matrix, keyed by matrix content: the
+    encode/reconstruct hot paths ask for the same few expansions on
+    every block batch, and re-deriving the [8R, 8C] expansion per call
+    showed up in the device-engine dispatch overhead. Returns a
+    read-only array — callers share it."""
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    return _bit_matrix_cached(mat.shape, mat.tobytes())
+
+
+@functools.lru_cache(maxsize=512)
+def _bit_matrix_cached(shape: tuple, buf: bytes) -> np.ndarray:
+    out = bit_matrix(np.frombuffer(buf, dtype=np.uint8).reshape(shape))
+    out.setflags(write=False)
+    return out
+
+
 def reconstruct_matrix(
     data_shards: int,
     parity_shards: int,

@@ -1,6 +1,13 @@
 """Multi-chip SPMD erasure data-plane: device meshes, lane-sharded
 stripes, XLA-collective reconstruction. See `sharded.py`."""
 
-from .sharded import Mesh, ShardedErasure, full_put_get_step, make_mesh
+from .sharded import (
+    Mesh,
+    ShardedErasure,
+    full_put_get_step,
+    make_mesh,
+    sharded_erasure,
+)
 
-__all__ = ["Mesh", "ShardedErasure", "full_put_get_step", "make_mesh"]
+__all__ = ["Mesh", "ShardedErasure", "full_put_get_step", "make_mesh",
+           "sharded_erasure"]

@@ -66,6 +66,28 @@ def make_mesh(n_devices: int | None = None, lanes: int | None = None) -> Mesh:
     return Mesh(arr, ("dp", "lane"))
 
 
+@functools.lru_cache(maxsize=32)
+def sharded_erasure(mesh: Mesh, data_blocks: int, parity_blocks: int,
+                    block_size: int = 1 << 20) -> "ShardedErasure":
+    """Geometry-keyed ShardedErasure cache (Mesh is hashable): callers
+    that build one per request used to re-derive the parity bit-matrix
+    and re-jit encode/decode every time — a guaranteed recompile per
+    call. Steady-state multichip PUT/heal must come through here."""
+    return ShardedErasure(mesh, data_blocks, parity_blocks, block_size)
+
+
+@functools.lru_cache(maxsize=256)
+def _recon_bits_np(k: int, m: int, survivors: tuple,
+                   targets: tuple) -> np.ndarray:
+    """Host-side reconstruction bit-matrix, cached per failure pattern
+    ACROSS ShardedErasure instances — the matrix inversion + GF(2)
+    expansion cost ~1 ms per call and instance-local caches miss
+    whenever the instance is rebuilt."""
+    return gf.bit_matrix_for(
+        gf.reconstruct_matrix(k, m, list(survivors), list(targets))
+    )
+
+
 class ShardedErasure:
     """One erasure geometry (k data + m parity) laid out on a device mesh.
 
@@ -88,7 +110,8 @@ class ShardedErasure:
                 f"k+m={self.n} must be divisible by mesh lane dim {lanes}"
             )
         self._parity_bits = jnp.asarray(
-            gf.bit_matrix(gf.parity_matrix(self.k, self.m)), dtype=jnp.int8
+            gf.bit_matrix_for(gf.parity_matrix(self.k, self.m)),
+            dtype=jnp.int8,
         )
         self._decode_cache: dict = {}
         self.data_spec = NamedSharding(mesh, P("dp", None, None))
@@ -138,10 +161,11 @@ class ShardedErasure:
 
     def _recon_consts(self, survivors: tuple, targets: tuple):
         """(recon bit-matrix, survivor index vector) — the static
-        operands shared by the degraded-read and heal programs."""
-        recon_np = gf.bit_matrix(
-            gf.reconstruct_matrix(self.k, self.m, list(survivors), list(targets))
-        )
+        operands shared by the degraded-read and heal programs. The
+        host-side matrix comes from the module-level per-pattern cache
+        (_recon_bits_np) so even a rebuilt instance skips the GF
+        inversion."""
+        recon_np = _recon_bits_np(self.k, self.m, survivors, targets)
         return (
             jnp.asarray(recon_np, dtype=jnp.int8),
             jnp.asarray(survivors[: self.k], dtype=jnp.int32),

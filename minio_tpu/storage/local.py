@@ -278,19 +278,45 @@ class LocalStorage(StorageAPI):
             return legacy_to_xlmeta(raw, volume, path)
 
     def _write_meta(self, volume: str, path: str, meta: XLMeta):
+        self._write_meta_blob(volume, path, meta.to_bytes())
+
+    def _write_meta_blob(self, volume: str, path: str, blob: bytes):
         obj_dir = self._file_path(volume, path)
         os.makedirs(obj_dir, exist_ok=True)
         tmp = os.path.join(obj_dir, f".xl.meta.tmp.{os.getpid()}.{time.monotonic_ns()}")
         with open(tmp, "wb") as f:
-            f.write(meta.to_bytes())
+            f.write(blob)
             if self._fsync:
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, os.path.join(obj_dir, XL_META_FILE))
 
+    def _fresh_meta_blob(self, volume: str, path: str,
+                         fi: FileInfo) -> bytes | None:
+        """Pre-serialized journal from the PUT's shared fan-out pack
+        (xlmeta.FanoutMetaPack), usable only when this disk holds NO
+        existing journal to merge with (xl.meta or legacy xl.json)."""
+        pack = getattr(fi, "fanout_pack", None)
+        if pack is None:
+            return None
+        if not os.path.isdir(self._vol_path(volume)):
+            return None  # slow path raises ErrVolumeNotFound as before
+        obj_dir = self._file_path(volume, path)
+        if os.path.exists(os.path.join(obj_dir, XL_META_FILE)):
+            return None
+        from .xlmeta_v1 import XL_JSON_FILE
+
+        if os.path.exists(os.path.join(obj_dir, XL_JSON_FILE)):
+            return None
+        return pack.bytes_for(fi)
+
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         self._require_online()
         with self._lock:
+            blob = self._fresh_meta_blob(volume, path, fi)
+            if blob is not None:
+                self._write_meta_blob(volume, path, blob)
+                return
             try:
                 meta = self._read_meta(volume, path)
             except ErrFileNotFound:
@@ -386,6 +412,10 @@ class LocalStorage(StorageAPI):
                 if os.path.isdir(dst_data):
                     shutil.rmtree(dst_data)
                 os.replace(src_data, dst_data)
+            blob = self._fresh_meta_blob(dst_volume, dst_path, fi)
+            if blob is not None:
+                self._write_meta_blob(dst_volume, dst_path, blob)
+                return
             try:
                 meta = self._read_meta(dst_volume, dst_path)
             except ErrFileNotFound:

@@ -11,6 +11,8 @@ not a byte-level port of the Go codegen format.
 
 from __future__ import annotations
 
+import threading
+
 import msgpack
 
 from ..utils.errors import ErrCorruptedFormat, ErrFileVersionNotFound
@@ -119,3 +121,69 @@ class XLMeta:
 
 def read_xl_meta(buf: bytes, volume: str, name: str, version_id: str | None) -> FileInfo:
     return XLMeta.from_bytes(buf).to_file_info(volume, name, version_id)
+
+
+class FanoutMetaPack:
+    """Shared xl.meta serialization for a k+m commit fan-out.
+
+    The per-disk journals of one PUT differ ONLY in the erasure shard
+    index (everything else — mod time, etag, distribution, checksums —
+    is identical), yet the commit used to build and msgpack-serialize a
+    full XLMeta once PER DISK (meta_commit_us_per_put = 324 at 16
+    disks). This packs the single-version journal ONCE and stamps each
+    disk's index into a copy of the buffer.
+
+    Mechanism: the journal is packed twice with two distinct sentinel
+    indexes; the byte positions where the two buffers differ are
+    exactly the index byte (both sentinels and all real indexes 1..127
+    encode as a 1-byte msgpack positive fixint, so widths match). If
+    the diff is not exactly one byte — or the index exceeds 0x7f, or
+    the version carries per-disk inline data — bytes_for returns None
+    and the caller falls back to the per-disk serializer, so the fast
+    path can only ever produce byte-identical output or decline.
+
+    Only valid for FRESH objects (no existing journal to merge with);
+    the storage layer checks that before consuming the pack.
+    """
+
+    _SENT_A, _SENT_B = 0x75, 0x5B
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._template: bytearray | None = None
+        self._pos: int | None = None  # None = unbuilt, -1 = unusable
+
+    def bytes_for(self, fi: FileInfo) -> bytes | None:
+        """Serialized fresh xl.meta holding exactly fi's version, or
+        None when this fi cannot ride the shared template."""
+        if fi.data or not 0 < fi.erasure.index <= 0x7F:
+            return None
+        with self._lock:
+            if self._pos is None:
+                self._build(fi)
+            if self._pos < 0:
+                return None
+            out = bytearray(self._template)
+            out[self._pos] = fi.erasure.index
+            return bytes(out)
+
+    def _build(self, fi: FileInfo) -> None:
+        idx = fi.erasure.index
+        try:
+            a_meta, b_meta = XLMeta(), XLMeta()
+            fi.erasure.index = self._SENT_A
+            a_meta.add_version(fi)
+            a = a_meta.to_bytes()
+            fi.erasure.index = self._SENT_B
+            b_meta.add_version(fi)
+            b = b_meta.to_bytes()
+        finally:
+            fi.erasure.index = idx
+        self._pos = -1
+        if len(a) != len(b):
+            return
+        diffs = [i for i in range(len(a)) if a[i] != b[i]]
+        if len(diffs) != 1 or a[diffs[0]] != self._SENT_A:
+            return
+        self._template = bytearray(a)
+        self._pos = diffs[0]

@@ -110,6 +110,71 @@ def test_pipelined_put_no_copy_invariant(tmp_path):
         assert after["reused"] > stats["reused"], after
 
 
+def test_device_fused_path_is_one_dispatch_per_batch(monkeypatch):
+    """Regression guard for the fused device engine: a device-engine
+    PUT stream must cost exactly ONE device dispatch per [B, k, S]
+    batch (GF parity + bitrot digests fused), and steady-state streams
+    of the same geometry must not retrace/recompile. Runs on CPU — the
+    dispatch accounting is platform-independent."""
+    import io
+    import os
+
+    import numpy as np
+
+    from minio_tpu.erasure import device_engine
+    from minio_tpu.erasure.bitrot import StreamingBitrotWriter
+    from minio_tpu.erasure.codec import Erasure
+    from minio_tpu.erasure.streaming import encode_stream
+
+    monkeypatch.setenv("MTPU_ENCODE_ENGINE", "device")
+    k, m = 2, 2
+    block_size = k * 4096  # shard 4096 >= device threshold
+    er = Erasure(k, m, block_size)
+    payload = np.random.default_rng(2).integers(
+        0, 256, 6 * block_size, np.uint8
+    ).tobytes()  # 6 full blocks -> 3 batches at batch_blocks=2
+
+    def run():
+        writers = [StreamingBitrotWriter(io.BytesIO()) for _ in range(k + m)]
+        n = encode_stream(er, io.BytesIO(payload), writers, quorum=k + 1,
+                          batch_blocks=2)
+        assert n == len(payload)
+
+    run()  # warm: compiles the fused fn for this batch shape
+    device_engine.reset_stats()
+    run()
+    stats = device_engine.stats_snapshot()
+    assert stats["dispatches"] == 3, stats  # ONE dispatch per batch
+    assert stats["traces"] == 0, stats  # steady state: no recompiles
+    assert stats["donated_batches"] == 3, stats
+    # Second steady-state stream: still 1/batch, still no retrace.
+    run()
+    stats = device_engine.stats_snapshot()
+    assert stats["dispatches"] == 6 and stats["traces"] == 0, stats
+    assert os.environ["MTPU_ENCODE_ENGINE"] == "device"
+
+
+def test_device_benches_skip_cleanly_without_tpu():
+    """Satellite guard: the device batch sweep must not emit misleading
+    CPU numbers (or touch jax at all) when no TPU/axon backend is up."""
+    import bench
+
+    out = bench.bench_device_batch_sweep(tpu_ok=False)
+    assert out == {"skipped": "no TPU/axon backend"}
+
+
+def test_meta_commit_reports_shared_serialization(tmp_path):
+    """The metadata-commit stage must exercise the FanoutMetaPack path
+    (serialize once per PUT, stamp per disk) and report the per-disk
+    serialization cost it removed."""
+    import bench
+
+    stages = bench.bench_put_stages(str(tmp_path), total_mib=4)
+    assert stages["meta_commit_us_per_put"] > 0
+    assert "meta_serialize_us_removed" in stages
+    assert "put_setup_us_removed" in stages
+
+
 def test_pipeline_executor_smoke():
     """Fast end-to-end of the executor itself (the machinery every
     bench pipeline number rides on): ordering, telemetry, completion."""

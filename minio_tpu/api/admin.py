@@ -705,11 +705,27 @@ class AdminHandlers:
         return self._json(audit.recent(max(1, min(n, 1024))))
 
     def health_info(self, ctx) -> Response:
-        """OBD-style bundle: host + per-disk facts in one JSON blob."""
+        """OBD-style bundle: host + per-disk facts in one JSON blob.
+
+        With `?perf=true`, each local disk additionally carries a
+        MEASURED `perf` section (size-bounded O_DIRECT read/write
+        probe, GB/s + per-op latency — the madmin.DrivePerfInfo
+        analog) so operators comparing nodes see drive capability, not
+        just the latency of a stat call. The probe is OPT-IN because it
+        does real data-path IO (a few MiB written+read per drive, tmp
+        file churn) — a monitoring system polling the bundle must not
+        inject that load by default; `?perfsize=N` bounds the per-drive
+        probe to N MiB (default 4, max 64). Remote disks report stat
+        latency only — their probe runs in THEIR node's bundle."""
         import os as _os
         import platform
         import sys as _sys
 
+        want_perf = ctx.qdict.get("perf", "false") == "true"
+        try:
+            perf_mib = max(1, min(int(ctx.qdict.get("perfsize", "4")), 64))
+        except ValueError:
+            perf_mib = 4
         mem_total = mem_avail = 0
         try:
             with open("/proc/meminfo") as f:
@@ -729,12 +745,21 @@ class AdminHandlers:
                 t0 = time.monotonic_ns()
                 try:
                     info = d.disk_info()
-                    disks.append({
+                    entry = {
                         "pool": pool_i, "endpoint": info.endpoint,
                         "total": info.total, "free": info.free,
                         "used": info.used, "state": "ok",
                         "latency_us": (time.monotonic_ns() - t0) // 1000,
-                    })
+                    }
+                    probe = getattr(d, "drive_perf", None)
+                    if want_perf and probe is not None and d.is_local():
+                        try:
+                            entry["perf"] = probe(
+                                size_bytes=perf_mib << 20
+                            )
+                        except Exception as exc:  # noqa: BLE001
+                            entry["perf"] = {"error": str(exc)}
+                    disks.append(entry)
                 except Exception as exc:  # noqa: BLE001
                     disks.append({
                         "pool": pool_i, "state": f"error: {exc}",

@@ -34,40 +34,65 @@ from ..utils.errors import (
 _DEVICE_SHARD_THRESHOLD = 4096
 
 
-def _select_engine(shard_len: int) -> str:
-    """Pick the GF engine for one application: 'native' | 'device' | 'numpy'.
+def _select_engine(shard_len: int, total_shards: int | None = None) -> str:
+    """Pick the GF engine for one application:
+    'native' | 'device' | 'mesh' | 'numpy'.
 
-    MTPU_ENCODE_ENGINE forces it (auto|device|native|numpy). The 'auto'
-    policy is measurement-driven (round 3, single-core host + tunneled
-    v5e): the native GFNI/SSSE3 engine sustains 9-13 GB/s host-fed, the
-    MXU kernel 28+ GB/s device-resident but every available TPU
-    attachment moves host bytes at only 0.3-0.6 GB/s, so for HOST-SOURCED
-    streams (the PutObject path — data arrives over HTTP into host
-    memory) the native engine wins by >10x end to end. Deployments with a
-    co-located chip (PCIe H2D >> encode rate) should set
-    MTPU_ENCODE_ENGINE=device; the full async batched pipeline
+    MTPU_ENCODE_ENGINE forces it (auto|device|mesh|native|numpy). The
+    'auto' policy is measurement-driven (round 3, single-core host +
+    tunneled v5e): the native GFNI/SSSE3 engine sustains 9-13 GB/s
+    host-fed, the MXU kernel 28+ GB/s device-resident but every
+    available TPU attachment moves host bytes at only 0.3-0.6 GB/s, so
+    for HOST-SOURCED streams (the PutObject path — data arrives over
+    HTTP into host memory) the native engine wins by >10x end to end.
+    Deployments with a co-located chip (PCIe H2D >> encode rate) should
+    set MTPU_ENCODE_ENGINE=device; the full async batched pipeline
     (erasure/streaming.py) ships unchanged and is benched by bench.py.
 
-    The decision is re-read per call (tests flip the env var) but the
+    The mesh engine (parallel/mesh_engine.py) serves when the caller
+    supplies the geometry (`total_shards` = k+m, which must divide over
+    the mesh's lane axis) AND a multi-device mesh exists:
+    MTPU_ENCODE_ENGINE=mesh forces it (including on virtual CPU meshes
+    — the CI path); 'auto' self-selects it only on an already-up
+    multi-device ACCELERATOR backend with no native SIMD engine, never
+    on CPU virtual devices (collective dispatch there costs latency
+    with no parallel hardware; see parallel/placement.mesh_fit).
+    Callers that cannot name the geometry (the one-shot host helpers)
+    never route to the mesh.
+
+    The decision is re-read per call (tests flip the env vars) but the
     resolution itself is memoized: the object layer asks once per block
-    batch, and the env lookup is the only part that may change.
+    batch, and the env/mesh probes are the only parts that may change.
     """
     import os
 
     from ..ops import gf_native
 
+    eng = os.environ.get("MTPU_ENCODE_ENGINE", "auto")
+    if eng == "mesh" or (eng == "auto" and total_shards):
+        from ..parallel import placement
+
+        mesh_fit = placement.mesh_fit(total_shards, explicit=eng == "mesh")
+    else:
+        mesh_fit = False
     return _select_engine_memo(
-        os.environ.get("MTPU_ENCODE_ENGINE", "auto"),
+        eng,
         shard_len >= _DEVICE_SHARD_THRESHOLD,
         gf_native.available(),
+        mesh_fit,
     )
 
 
-@functools.lru_cache(maxsize=32)
-def _select_engine_memo(eng: str, device_sized: bool, native_ok: bool) -> str:
+@functools.lru_cache(maxsize=64)
+def _select_engine_memo(eng: str, device_sized: bool, native_ok: bool,
+                        mesh_fit: bool = False) -> str:
     if eng == "numpy":
         return "numpy"
     if eng == "native":
+        return "native" if native_ok else "numpy"
+    if eng == "mesh":
+        if mesh_fit and device_sized:
+            return "mesh"
         return "native" if native_ok else "numpy"
     if eng == "device":
         if device_sized:
@@ -75,6 +100,8 @@ def _select_engine_memo(eng: str, device_sized: bool, native_ok: bool) -> str:
         return "native" if native_ok else "numpy"
     if native_ok:
         return "native"
+    if mesh_fit and device_sized:
+        return "mesh"
     if device_sized:
         return "device"
     return "numpy"
@@ -253,8 +280,8 @@ class Erasure:
         )
         if not staged_on_device:
             blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
-        engine = _select_engine(blocks.shape[-1])
-        if staged_on_device and engine != "device":
+        engine = _select_engine(blocks.shape[-1], self.total_shards)
+        if staged_on_device and engine not in ("device", "mesh"):
             blocks = np.asarray(blocks)  # tiny-shard fallback: host engines
         if engine == "native":
             # Synchronous but fast (GFNI/SSSE3); the writers hash each
@@ -266,6 +293,14 @@ class Erasure:
         if engine == "numpy":
             parity = rs.gf_matmul_shards_np(self._parity_bits_np, blocks)
             return parity, None
+        if engine == "mesh":
+            # Lane-sharded mesh dispatch: same fused parity+digest
+            # contract as the device engine, partitioned over the
+            # ('dp', 'lane') mesh instead of one chip.
+            from ..parallel.mesh_engine import for_geometry as mesh_geometry
+
+            codec = mesh_geometry(self.data_blocks, self.parity_blocks)
+            return codec.encode_async(blocks, with_hashes)
         from .device_engine import for_geometry
 
         codec = for_geometry(self.data_blocks, self.parity_blocks)

@@ -34,7 +34,7 @@ This module is the answer, structured so each [B, k, S] batch costs:
 
 The same fused/overlapped treatment covers heal: ``reconstruct_async``
 rebuilds target shards AND their bitrot digests in one dispatch per
-batch of blocks (consumed by erasure/streaming._heal_stream_device).
+batch of blocks (consumed by erasure/streaming._heal_stream_fused).
 
 Everything here runs identically on CPU (JAX_PLATFORMS=cpu), which is
 how tier-1 exercises the fused path bit-exactly against the host

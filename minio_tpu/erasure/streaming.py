@@ -301,7 +301,7 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     want_digests = any(
         getattr(w, "device_hashable", False) for w in writers if w is not None
     )
-    engine = _select_engine(shard)
+    engine = _select_engine(shard, erasure.total_shards)
     if engine == "native":
         # Host-native engine: the batched strip path (one GFNI encode +
         # one framing call per shard per batch).
@@ -482,7 +482,18 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         data = buf[: len(full)].reshape(len(full), k, shard)
         return [buf, data, tail, None, None]
 
-    feed = _host_feed() if engine == "device" else None
+    if engine == "device":
+        feed = _host_feed()
+    elif engine == "mesh":
+        # Mesh staging shards the batch over the dp axis (one buffer
+        # per dp-group); the feed declines ragged batches, which the
+        # codec pads and stages itself.
+        from ..parallel.mesh_engine import for_geometry as _mesh_geometry
+
+        feed = _mesh_geometry(erasure.data_blocks,
+                              erasure.parity_blocks).host_feed()
+    else:
+        feed = None
 
     def h2d(item):
         if item[1] is None or feed is None:
@@ -1099,10 +1110,34 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
         if reader.saw_corrupt and heal_hint is None:
             heal_hint = ErrFileCorrupt("bitrot during read")
 
+    from .codec import _select_engine
+
     # <=2 blocks: read-ahead can overlap at most one handoff — not
     # worth the per-request thread spin-up (the small-object/range-GET
     # fast path stays identical to the serial driver).
-    if _SINGLE_CORE or len(geoms) <= 2:
+    # The mesh engine owns the whole GET stream, not just degraded
+    # blocks: shard loss is only discovered at read time (a destroyed
+    # part file still yields a non-None reader that fails on its first
+    # fetch), so there is no up-front healthy/degraded split to route
+    # on. Healthy blocks still get batched parallel shard IO from
+    # ParallelReader's BATCH_BLOCKS prefetch; what the mesh driver
+    # forgoes vs the Pipeline branch is only decode/client-write
+    # overlap, and on a mesh deployment degraded reconstruction — the
+    # thing the collective dispatch accelerates — is what GET latency
+    # economics turn on.
+    if _select_engine(erasure.shard_size(), erasure.total_shards) == "mesh":
+        # Mesh serving path: degraded blocks reconstruct in fused
+        # collective dispatches batched per failure pattern; healthy
+        # blocks stream straight through on the host — written before
+        # the next fetch, so the recycled readinto ring is safe here
+        # too (batched degraded rows are copied out at append time).
+        for r in readers:
+            if hasattr(r, "reuse_buffers"):
+                r.reuse_buffers()
+        bytes_written = _decode_stream_mesh(
+            erasure, writer, reader, geoms, note_heal
+        )
+    elif _SINGLE_CORE or len(geoms) <= 2:
         # Serial consumption drains every batch's views before the next
         # reader fan-out, so the bitrot readers may recycle their read
         # buffers (readinto a private ring, no fresh bytes per fetch).
@@ -1141,6 +1176,113 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     if bytes_written != length:
         raise ErrLessData(f"wrote {bytes_written}, want {length}")
     return bytes_written, heal_hint
+
+
+def _decode_stream_mesh(erasure: Erasure, writer, reader, geoms: list,
+                        note_heal) -> int:
+    """Mesh decode driver for the GET path: consecutive degraded blocks
+    sharing one failure pattern batch into a single fused mesh
+    reconstruct dispatch (parallel/mesh_engine.reconstruct_async — the
+    all-gather + matmul plane of ShardedErasure, serving disk-sourced
+    shards). The dispatch of batch N overlaps the client writes of
+    batch N-1; healthy blocks and ragged tail blocks take the host path
+    after draining the ring, so client writes stay strictly in stream
+    order."""
+    from ..parallel.mesh_engine import for_geometry as mesh_geometry
+    from ..utils.errors import ErrShardSize, ErrTooFewShards
+
+    codec = mesh_geometry(erasure.data_blocks, erasure.parity_blocks)
+    k = erasure.data_blocks
+    shard = erasure.shard_size()
+    bytes_written = 0
+
+    pending = None  # (bufs_list, geom_list, targets, rebuilt_future)
+
+    def flush(p) -> None:
+        nonlocal bytes_written
+        bufs_list, geom_list, targets, fut = p
+        rebuilt = np.asarray(fut)  # D2H started at dispatch
+        for bi, (bufs, (off, ln)) in enumerate(zip(bufs_list, geom_list)):
+            for t_i, t in enumerate(targets):
+                bufs[t] = rebuilt[bi, t_i]
+            bytes_written += _write_data_blocks(writer, bufs, k, off, ln)
+
+    batch_bufs: list = []
+    batch_geoms: list = []
+    batch_key: tuple = ()
+
+    def dispatch_batch() -> None:
+        nonlocal pending, batch_bufs, batch_geoms
+        if not batch_bufs:
+            return
+        present, targets = batch_key
+        src = np.stack([
+            np.stack([np.frombuffer(memoryview(bufs[i]), dtype=np.uint8)
+                      for i in present])
+            for bufs in batch_bufs
+        ])
+        fut, _ = codec.reconstruct_async(src, present, targets,
+                                         with_hashes=False)
+        done, batch_bufs, batch_geoms = (batch_bufs, batch_geoms), [], []
+        if pending is not None:
+            flush(pending)  # overlap: batch N computes while N-1 writes
+        pending = (done[0], done[1], targets, fut)
+
+    def drain() -> None:
+        nonlocal pending
+        dispatch_batch()
+        if pending is not None:
+            flush(pending)
+            pending = None
+
+    for off, ln in geoms:
+        bufs = reader.read()
+        note_heal()
+        present = tuple(
+            i for i, b in enumerate(bufs) if b is not None and len(b)
+        )
+        missing_data = tuple(i for i in range(k) if i not in set(present))
+        if not missing_data:
+            # Healthy block: no reconstruction, plain ordered write.
+            drain()
+            bytes_written += _write_data_blocks(writer, bufs, k, off, ln)
+            continue
+        if len(present) < k:
+            raise ErrTooFewShards(
+                f"{len(present)} shards present, need {k}"
+            )
+        blen = len(bufs[present[0]])
+        for i in present:
+            if len(bufs[i]) != blen:
+                raise ErrShardSize("present shards differ in size")
+        if blen != shard:
+            # Ragged tail block: host reconstruction, in order.
+            drain()
+            erasure.decode_data_blocks(bufs)
+            bytes_written += _write_data_blocks(writer, bufs, k, off, ln)
+            continue
+        key = (present[:k], missing_data)
+        if batch_bufs and key != batch_key:
+            dispatch_batch()  # failure pattern changed mid-stream
+        batch_key = key
+        # Copy out of the reader's recycled ring at append time: this
+        # batch (and the overlapped pending one) outlives further
+        # fetches, which reuse the ring's buffers. Healthy/tail blocks
+        # need no copy — they are written before the next fetch. Only
+        # present[:k] is ever read again (reconstruct sources, and the
+        # client write's data rows all sort within it); surviving
+        # parity beyond that would be copied for nothing.
+        held: list = [None] * len(bufs)
+        for i in present[:k]:
+            held[i] = np.frombuffer(
+                memoryview(bufs[i]), dtype=np.uint8
+            ).copy()
+        batch_bufs.append(held)
+        batch_geoms.append((off, ln))
+        if len(batch_bufs) >= ParallelReader.BATCH_BLOCKS:
+            dispatch_batch()
+    drain()
+    return bytes_written
 
 
 def _write_data_blocks(dst, blocks: list, data_blocks: int,
@@ -1205,9 +1347,18 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
         for t_i, t in enumerate(targets):
             writers[t].write(np.asarray(shards[t_i]).tobytes())
 
-    if _select_engine(erasure.shard_size()) == "device" and total_blocks:
-        return _heal_stream_device(erasure, writers, reader, targets,
-                                   total_blocks)
+    engine = _select_engine(erasure.shard_size(), erasure.total_shards)
+    if engine in ("device", "mesh") and total_blocks:
+        # Same fused reconstruct+digest driver for both accelerator
+        # engines; only the codec differs (one chip vs the mesh).
+        if engine == "mesh":
+            from ..parallel.mesh_engine import for_geometry
+        else:
+            from .device_engine import for_geometry
+
+        codec = for_geometry(erasure.data_blocks, erasure.parity_blocks)
+        return _heal_stream_fused(erasure, writers, reader, targets,
+                                  total_blocks, codec)
 
     if _SINGLE_CORE or total_blocks <= 2:
         # Serial heal consumes (reconstructs + copies) each batch before
@@ -1236,18 +1387,18 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
 _DEVICE_HEAL_BATCH = 8
 
 
-def _heal_stream_device(erasure: Erasure, writers: list, reader,
-                        targets: list[int], total_blocks: int) -> None:
-    """Device heal driver: batches of surviving-shard blocks ship as one
+def _heal_stream_fused(erasure: Erasure, writers: list, reader,
+                       targets: list[int], total_blocks: int,
+                       codec) -> None:
+    """Fused heal driver: batches of surviving-shard blocks ship as one
     [B, k, S] fused dispatch that rebuilds the stale shards AND their
-    bitrot digests (device_engine.reconstruct_async, same single-
-    dispatch + donated-buffer + async-D2H treatment as the encode path).
+    bitrot digests (same single-dispatch + donated-buffer + async-D2H
+    treatment as the encode path). `codec` is either the single-chip
+    device engine (device_engine.DeviceCodec) or the mesh engine
+    (parallel/mesh_engine.MeshCodec) — both speak reconstruct_async.
     The dispatch of batch N overlaps the stale-disk writes of batch N-1;
     a ragged tail block (short shard) falls back to the host
     reconstruction, exactly like the encode drivers' tail path."""
-    from .device_engine import for_geometry
-
-    codec = for_geometry(erasure.data_blocks, erasure.parity_blocks)
     k = erasure.data_blocks
     shard = erasure.shard_size()
     # Device digests frame the target writers' chunks only when every

@@ -4,11 +4,18 @@ go-sql-driver/mysql; the notification target needs only handshake +
 COM_QUERY/COM_PING, so no driver is required — same approach as
 resp.py / pgwire.py).
 
-Implements the v10 handshake with mysql_native_password (including the
-auth-switch path servers send when the account uses it non-default) and
-the text protocol for statements that return OK packets. Literals are
-inlined with backslash-aware escaping (MySQL's default sql_mode keeps
-backslash escapes on, unlike Postgres)."""
+Implements the v10 handshake with mysql_native_password AND
+caching_sha2_password (the MySQL 8.0+ account default): fast auth via
+the SHA-256 scramble, and when the server demands full authentication,
+the cleartext-password exchange over TLS (SSLRequest upgrade,
+`?tls=true|skip-verify` in the DSN) or the RSA public-key exchange
+where the `cryptography` module exists — with a loud MyAuthError
+fallback when neither transport is available, so notify_mysql never
+silently degrades to queue-only (ADVICE r5 #1). Auth-switch in either
+direction is honored. The text protocol covers statements that return
+OK packets. Literals are inlined with backslash-aware escaping
+(MySQL's default sql_mode keeps backslash escapes on, unlike
+Postgres)."""
 
 from __future__ import annotations
 
@@ -18,6 +25,7 @@ import struct
 import threading
 
 CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_SSL = 0x800
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
@@ -36,21 +44,22 @@ class MyError(RuntimeError):
 
 
 class MyAuthError(MyError):
-    """The server demands an auth plugin this client does not speak
-    (e.g. caching_sha2_password, the MySQL 8.0+ account default).
+    """The server demands an auth exchange this client cannot complete
+    (an unknown plugin, or caching_sha2_password FULL auth with neither
+    TLS nor an RSA key exchange available).
 
     A PERMANENT configuration error, not an outage: retrying can never
     succeed, so ping() re-raises it instead of reporting the target as
     merely inactive — otherwise notify_mysql silently degrades to
     queue-only forever while docs advertise live delivery."""
 
-    def __init__(self, plugin: str):
+    def __init__(self, plugin: str, reason: str | None = None):
         # 2059 = CR_AUTH_PLUGIN_CANNOT_LOAD, the client-side code the
         # real libmysql reports for an unusable plugin.
-        super().__init__(2059, (
+        super().__init__(2059, reason or (
             f"server requires unsupported auth plugin {plugin!r}; "
-            "create the notify_mysql account WITH "
-            "mysql_native_password (see docs/DEPLOYMENT.md)"
+            "use mysql_native_password or caching_sha2_password for "
+            "the notify_mysql account (see docs/DEPLOYMENT.md)"
         ))
         self.plugin = plugin
 
@@ -105,19 +114,76 @@ def _native_password_token(password: str, scramble: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(h1, h3))
 
 
+def _sha2_token(password: str, scramble: bytes) -> bytes:
+    """caching_sha2_password fast-auth scramble:
+    SHA256(password) XOR SHA256(SHA256(SHA256(password)) + nonce)."""
+    if not password:
+        return b""
+    h1 = hashlib.sha256(password.encode()).digest()
+    h2 = hashlib.sha256(hashlib.sha256(h1).digest() + scramble).digest()
+    return bytes(a ^ b for a, b in zip(h1, h2))
+
+
+def _rsa_encrypt_password(password: str, scramble: bytes,
+                          pem: bytes) -> bytes | None:
+    """Full-auth RSA leg (plain-socket caching_sha2): the NUL-terminated
+    password XOR the repeating nonce, OAEP-SHA1-encrypted with the
+    server's public key. Returns None when the `cryptography` module is
+    absent — the caller surfaces MyAuthError with guidance instead of a
+    hang or a silent queue-only degrade."""
+    try:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding
+    except ImportError:
+        return None
+    key = serialization.load_pem_public_key(pem)
+    pwd = password.encode() + b"\x00"
+    xored = bytes(b ^ scramble[i % len(scramble)]
+                  for i, b in enumerate(pwd))
+    return key.encrypt(
+        xored,
+        padding.OAEP(mgf=padding.MGF1(hashes.SHA1()),
+                     algorithm=hashes.SHA1(), label=None),
+    )
+
+
+def _rsa_available() -> bool:
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 class MyClient:
     """One pooled connection; a lock serializes command round trips."""
 
     def __init__(self, host: str, port: int, user: str, password: str,
-                 database: str, timeout: float = 5.0):
+                 database: str, timeout: float = 5.0, tls=None):
         self.host, self.port = host, port
         self.user, self.password, self.database = user, password, database
         self.timeout = timeout
+        # tls: None (plain), True / "true" (verified), "skip-verify",
+        # or a ready ssl.SSLContext — the go-sql-driver ?tls= values.
+        self.tls = tls if tls not in ("", "false", False) else None
+        self._tls_active = False
         self._sock: socket.socket | None = None
         self._rfile = None
         self._seq = 0
         self.status = 0  # server status flags (handshake + each OK)
         self._mu = threading.Lock()
+
+    def _tls_context(self):
+        import ssl
+
+        if isinstance(self.tls, ssl.SSLContext):
+            return self.tls
+        ctx = ssl.create_default_context()
+        if self.tls == "skip-verify":
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
 
     @property
     def no_backslash_escapes(self) -> bool:
@@ -147,9 +213,9 @@ class MyClient:
     # --- handshake ---
 
     @staticmethod
-    def _parse_handshake(pkt: bytes) -> tuple[bytes, str, int]:
-        """Return (scramble, auth_plugin, status) from the v10
-        greeting."""
+    def _parse_handshake(pkt: bytes) -> tuple[bytes, str, int, int]:
+        """Return (scramble, auth_plugin, status, server_caps) from the
+        v10 greeting."""
         if pkt[0] == 0xFF:
             code = struct.unpack("<H", pkt[1:3])[0]
             raise MyError(code, pkt[3:].decode("utf-8", "replace"))
@@ -185,7 +251,7 @@ class MyClient:
         # byte is 0x00 must keep it or auth fails ~1/256 of connects.
         total = (auth_len - 1) if auth_len > 0 else 20
         scramble = (part1 + part2)[:max(total, 8)]
-        return scramble, plugin, status
+        return scramble, plugin, status, cap
 
     def _connect(self):
         s = socket.create_connection((self.host, self.port),
@@ -193,43 +259,141 @@ class MyClient:
         self._sock = s
         self._rfile = s.makefile("rb")
         self._seq = 0
+        self._tls_active = False
         try:
-            scramble, plugin, self.status = self._parse_handshake(
-                self._read_packet()
+            scramble, plugin, self.status, server_caps = (
+                self._parse_handshake(self._read_packet())
             )
-            if plugin not in ("mysql_native_password", ""):
-                # Ask for native password via auth-switch below; most
-                # servers honor the client's requested plugin.
-                plugin = "mysql_native_password"
             caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION |
                     CLIENT_PLUGIN_AUTH)
             if self.database:
                 caps |= CLIENT_CONNECT_WITH_DB
-            token = _native_password_token(self.password, scramble)
+            if self.tls:
+                if not server_caps & CLIENT_SSL:
+                    # Sending SSLRequest anyway would make wrap_socket
+                    # read the server's ERR/next packet as a TLS record
+                    # and die with an opaque 'wrong version number' —
+                    # name the real, permanent misconfiguration instead
+                    # (go-sql-driver's ErrNoTLS analog).
+                    raise MyAuthError(
+                        "tls",
+                        "DSN requests ?tls= but the MySQL server does "
+                        "not advertise SSL support (CLIENT_SSL missing "
+                        "from its capability flags); enable SSL on the "
+                        "server or drop ?tls= from the notify_mysql DSN",
+                    )
+                # SSLRequest: the abbreviated 32-byte prelude, then the
+                # whole rest of the handshake rides inside TLS
+                # (go-sql-driver does the identical upgrade).
+                caps |= CLIENT_SSL
+                self._send_packet(struct.pack("<IIB23x", caps,
+                                              1 << 24, 45))
+                self._sock = self._tls_context().wrap_socket(
+                    s, server_hostname=self.host
+                )
+                self._rfile = self._sock.makefile("rb")
+                self._tls_active = True
+            if plugin not in ("mysql_native_password",
+                              "caching_sha2_password", ""):
+                # Ask for native password via auth-switch below; most
+                # servers honor the client's requested plugin.
+                plugin = "mysql_native_password"
+            if plugin == "caching_sha2_password":
+                token = _sha2_token(self.password, scramble)
+            else:
+                plugin = "mysql_native_password"
+                token = _native_password_token(self.password, scramble)
             resp = struct.pack("<IIB23x", caps, 1 << 24, 45)  # utf8mb4
             resp += self.user.encode() + b"\x00"
             resp += bytes((len(token),)) + token
             if self.database:
                 resp += self.database.encode() + b"\x00"
-            resp += b"mysql_native_password\x00"
+            resp += plugin.encode() + b"\x00"
             self._send_packet(resp)
-            pkt = self._read_packet()
-            if pkt and pkt[0] == 0xFE:  # AuthSwitchRequest
-                end = pkt.index(b"\x00", 1)
-                want = pkt[1:end].decode()
-                if want != "mysql_native_password":
-                    raise MyAuthError(want)
-                # Exactly 20 scramble bytes + trailing NUL — sliced, not
-                # rstripped (see _parse_handshake).
-                new_scramble = pkt[end + 1:end + 21]
-                self._send_packet(
-                    _native_password_token(self.password, new_scramble)
-                )
-                pkt = self._read_packet()
-            self._check_ok(pkt)
+            self._finish_auth(plugin, scramble)
         except Exception:
             self._teardown()
             raise
+
+    def _finish_auth(self, plugin: str, scramble: bytes) -> None:
+        """Drive the post-response auth exchange to the OK packet:
+        auth-switch (either supported plugin), caching_sha2 fast-auth
+        continuation, and caching_sha2 FULL auth — cleartext password
+        over TLS, RSA key exchange on plain sockets where the
+        cryptography module exists, MyAuthError otherwise."""
+        switched = False
+        while True:
+            pkt = self._read_packet()
+            if pkt and pkt[0] == 0xFE and len(pkt) > 1:
+                # AuthSwitchRequest: 20 scramble bytes + trailing NUL —
+                # sliced, not rstripped (see _parse_handshake). The
+                # protocol allows at most ONE switch per handshake
+                # (go-sql-driver errors on a second); without the bound
+                # a misbehaving server alternating switch requests
+                # would hold this loop open forever.
+                if switched:
+                    raise ConnectionError(
+                        "server sent a second AuthSwitchRequest"
+                    )
+                switched = True
+                end = pkt.index(b"\x00", 1)
+                want = pkt[1:end].decode()
+                scramble = pkt[end + 1:end + 21]
+                if want == "mysql_native_password":
+                    self._send_packet(
+                        _native_password_token(self.password, scramble)
+                    )
+                elif want == "caching_sha2_password":
+                    self._send_packet(
+                        _sha2_token(self.password, scramble)
+                    )
+                else:
+                    raise MyAuthError(want)
+                plugin = want
+                continue
+            if (pkt and pkt[0] == 0x01
+                    and plugin == "caching_sha2_password"):
+                data = pkt[1:]
+                if data == b"\x03":
+                    continue  # fast auth ok; the OK packet follows
+                if data == b"\x04":
+                    self._sha2_full_auth(scramble)
+                    continue
+                if data[:1] == b"-":  # "-----BEGIN PUBLIC KEY-----"
+                    enc = _rsa_encrypt_password(self.password, scramble,
+                                                bytes(data))
+                    if enc is None:  # raced away; cannot happen after
+                        raise MyAuthError(  # the availability check
+                            "caching_sha2_password",
+                            "RSA exchange lost the cryptography module",
+                        )
+                    self._send_packet(enc)
+                    continue
+                raise ConnectionError(
+                    f"unexpected caching_sha2 state {data[:1]!r}"
+                )
+            self._check_ok(pkt)
+            return
+
+    def _sha2_full_auth(self, scramble: bytes) -> None:
+        """The server's cache missed this account: full authentication.
+        Over TLS the protocol's sanctioned payload is the cleartext
+        password; on a plain socket the password must be RSA-sealed with
+        the server's public key — and when the cryptography module is
+        absent that path cannot exist, so fail LOUDLY with operator
+        guidance instead of degrading to queue-only (ADVICE r5 #1)."""
+        if self._tls_active:
+            self._send_packet(self.password.encode() + b"\x00")
+            return
+        if not _rsa_available():
+            raise MyAuthError(
+                "caching_sha2_password",
+                "caching_sha2_password full authentication needs TLS "
+                "(add ?tls=true or ?tls=skip-verify to the notify_mysql "
+                "DSN) or the python 'cryptography' module for the RSA "
+                "exchange; neither is available (see docs/DEPLOYMENT.md)",
+            )
+        self._send_packet(b"\x02")  # request the server's public key
 
     @staticmethod
     def _lenenc(pkt: bytes, i: int) -> tuple[int, int]:
@@ -360,10 +524,12 @@ class MyClient:
 
 
 def parse_dsn(dsn: str) -> dict:
-    """Parse go-sql-driver DSN `user:pass@tcp(host:port)/dbname` (the
-    format notify_mysql's dsn_string uses, ref mysql.go MySQLArgs)."""
+    """Parse go-sql-driver DSN `user:pass@tcp(host:port)/dbname[?tls=..]`
+    (the format notify_mysql's dsn_string uses, ref mysql.go MySQLArgs).
+    Recognized params: tls=true|skip-verify (anything else in the query
+    string is ignored, like unknown driver params)."""
     out = {"host": "127.0.0.1", "port": 3306, "user": "root",
-           "password": "", "dbname": ""}
+           "password": "", "dbname": "", "tls": None}
     rest = dsn
     if "@" in rest:
         cred, _, rest = rest.rpartition("@")
@@ -373,7 +539,11 @@ def parse_dsn(dsn: str) -> dict:
         out["password"] = pwd
     if "/" in rest:
         addr, _, db = rest.partition("/")
-        out["dbname"] = db.partition("?")[0]
+        out["dbname"], _, params = db.partition("?")
+        for kv in params.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "tls" and v in ("true", "skip-verify"):
+                out["tls"] = v
     else:
         addr = rest
     if addr.startswith("tcp(") and addr.endswith(")"):

@@ -242,7 +242,8 @@ class MySQLTarget(_SQLTargetBase):
         self.dsn = dsn
         cfg = parse_dsn(dsn)
         self._client = MyClient(cfg["host"], cfg["port"], cfg["user"],
-                                cfg["password"], cfg["dbname"])
+                                cfg["password"], cfg["dbname"],
+                                tls=cfg.get("tls"))
 
     def _ping(self) -> bool:
         return self._client.ping()

@@ -122,6 +122,13 @@ from ..pipeline.metrics import PIPELINE_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += PIPELINE_DESCRIPTORS
 
+# Mesh serving-engine telemetry (parallel/metrics.py, jax-free import):
+# collective dispatch counts, dp-group batches, per-lane shard bytes and
+# estimated cross-lane traffic for the multi-chip erasure plane.
+from ..parallel.metrics import MESH_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += MESH_DESCRIPTORS
+
 
 def describe_all(metrics) -> None:
     for name, _type, help_text in DESCRIPTORS:

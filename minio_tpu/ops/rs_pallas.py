@@ -159,15 +159,29 @@ class HostFeed:
     returning a lazy handle would make the dispatch stage pay the wait
     and re-serialize the feed. Per-stage items/bytes/timing telemetry
     comes from the executor's StageStats, not from this class.
+
+    `sharding` stages onto a sharded layout (the mesh engine's
+    dp-groups) instead of the default device; `accept` gates which
+    batches stage at all — a declined batch passes through on the host
+    and the downstream codec stages it itself (the mesh engine declines
+    ragged batches whose row count doesn't divide dp, since those need
+    padding the feed must not own).
     """
 
-    def __init__(self, name: str = "h2d"):
+    def __init__(self, name: str = "h2d", sharding=None, accept=None):
         self.name = name
+        self._sharding = sharding
+        self._accept = accept
 
     def __call__(self, batch):
         import jax
 
-        dev = jax.device_put(batch)
+        if self._accept is not None and not self._accept(batch):
+            return batch
+        if self._sharding is not None:
+            dev = jax.device_put(batch, self._sharding)
+        else:
+            dev = jax.device_put(batch)
         dev.block_until_ready()
         return dev
 

@@ -1,13 +1,31 @@
 """Multi-chip SPMD erasure data-plane: device meshes, lane-sharded
-stripes, XLA-collective reconstruction. See `sharded.py`."""
+stripes, XLA-collective reconstruction (`sharded.py`), and the mesh
+serving engine that puts them on the production PUT/GET/heal path
+(`mesh_engine.py`, shape selection in `placement.py`, telemetry in
+`metrics.py`).
 
-from .sharded import (
-    Mesh,
-    ShardedErasure,
-    full_put_get_step,
-    make_mesh,
-    sharded_erasure,
-)
+Exports resolve lazily: `parallel.metrics` (pulled by metrics_v2 at
+server boot) and `parallel.placement` must be importable without
+touching jax — backend init is the engine's decision, made only when a
+mesh is actually requested.
+"""
 
-__all__ = ["Mesh", "ShardedErasure", "full_put_get_step", "make_mesh",
-           "sharded_erasure"]
+_SHARDED_EXPORTS = {
+    "Mesh", "ShardedErasure", "full_put_get_step", "make_mesh",
+    "sharded_erasure",
+}
+_MESH_EXPORTS = {"MeshCodec", "for_geometry"}
+
+__all__ = sorted(_SHARDED_EXPORTS | _MESH_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _SHARDED_EXPORTS:
+        from . import sharded
+
+        return getattr(sharded, name)
+    if name in _MESH_EXPORTS:
+        from . import mesh_engine
+
+        return getattr(mesh_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -143,6 +143,13 @@ class Server:
 
         _streaming.set_metrics(self.metrics)
         _dsync.set_metrics(self.metrics)
+        # Mesh serving-engine counters (collective dispatches, dp-group
+        # batches, per-lane bytes) mirror onto the same registry; the
+        # module import is jax-free, so wiring it costs nothing on
+        # hosts that never select the mesh engine.
+        from .parallel import metrics as _mesh_metrics
+
+        _mesh_metrics.set_metrics(self.metrics)
         # Hung-drive tolerance knobs (config subsystem `drive`): env
         # overrides apply immediately; persisted operator values re-apply
         # after config_sys.load() below.

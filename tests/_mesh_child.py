@@ -1,0 +1,46 @@
+"""Subprocess child for the `mesh` pytest marker (see conftest's
+mesh_subprocess fixture): prove the ObjectLayer mesh serving path —
+PutObject -> GetObject(degraded) -> HealObject — on one (dp, lane)
+shape of an 8-device virtual CPU mesh, then print the evidence as one
+MESH_EVIDENCE json line for the parent to assert on.
+
+Runs standalone too:  python tests/_mesh_child.py 2x4 8
+"""
+
+import faulthandler
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> None:
+    # Self-diagnosing hang armor: dump every thread's stack (and exit)
+    # just INSIDE the parent's hard timeout, so a wedged collective
+    # reports where it stuck instead of dying as a silent kill.
+    timeout_s = float(os.environ.get("MTPU_MESH_CHILD_TIMEOUT_S", "300"))
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(max(30.0, timeout_s - 20.0),
+                                      exit=True)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from minio_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(8)
+
+    shape = sys.argv[1] if len(sys.argv) > 1 else "1x8"
+    payload_mib = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    dp_s, _, lane_s = shape.partition("x")
+
+    from minio_tpu.parallel import meshcheck
+
+    with tempfile.TemporaryDirectory(prefix="mtpu-meshci-") as d:
+        evidence = meshcheck.drive_shape(d, int(dp_s), int(lane_s),
+                                         payload_mib=payload_mib)
+    print("MESH_EVIDENCE " + json.dumps(evidence, sort_keys=True))
+    faulthandler.cancel_dump_traceback_later()
+
+
+if __name__ == "__main__":
+    main()

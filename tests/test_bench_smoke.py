@@ -163,6 +163,75 @@ def test_device_benches_skip_cleanly_without_tpu():
     assert out == {"skipped": "no TPU/axon backend"}
 
 
+def test_bench_mesh_skips_cleanly_on_single_device():
+    """The mesh sweep must report a clean {"skipped": ...} — not an
+    error, not CPU numbers — when only one device exists (the normal
+    bench-host condition). Needs a subprocess: this test process runs
+    on conftest's forced 8-device mesh."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # no forced 8-device host platform
+    code = (
+        "import json; import bench; print(json.dumps(bench.bench_mesh()))"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "skipped" in out, out
+
+
+def test_bench_mesh_sweep_reports_dispatch_invariants(monkeypatch):
+    """One shape of the mesh sweep on the in-process 8-device mesh: the
+    section must report throughput plus the fused-dispatch guards
+    (1 dispatch per dp-group batch, zero steady-state retraces). The
+    full dp×lane sweep is covered by the mesh-marked serving tests;
+    the smoke pins the reporting contract on a single shape."""
+    import bench
+    from minio_tpu.parallel import meshcheck
+
+    monkeypatch.setattr(meshcheck, "shapes_for",
+                        lambda n, total_shards=16: [(2, 4)])
+    # Small geometry: the reporting contract is identical to the 12+4
+    # default but the pjit compile is seconds, not half a minute.
+    out = bench.bench_mesh(total_mib=4, geometry=(4, 4),
+                           block_size=1 << 16)
+    assert out["devices"] == 8, out
+    entry = out["dp2_lane4"]
+    assert entry["encode_gbps"] > 0, entry
+    assert entry["dispatches_per_batch"] == 1.0, entry
+    assert entry["steady_state_retraces"] == 0, entry
+    assert entry["collective_bytes_per_input_byte"] > 0, entry
+
+
+def test_config_repeatability_protocol(monkeypatch):
+    """BENCH JSON per-config contract (VERDICT r5 #4): min-of-3, runs,
+    dispersion, adjacent host memcpy, value_per_memcpy."""
+    import bench
+
+    monkeypatch.setattr(bench, "_memcpy_gbps", lambda: 4.0)
+    out = bench._config_protocol(lambda i: 10.0 + i, better="max", runs=3)
+    assert out["value"] == 12.0
+    assert out["runs"] == [10.0, 11.0, 12.0]
+    assert out["host_memcpy_gbps"] == 4.0
+    assert 0 <= out["dispersion"] < 1
+    # Normalization direction: throughput divides by host speed,
+    # latency MULTIPLIES (latency/memcpy would scale as 1/H^2 — more
+    # host-dependent than the raw number, not less).
+    assert out["value_per_memcpy"] == 3.0  # 12 / 4
+    lat = bench._config_protocol(lambda i: 5.0 - i, better="min", runs=3)
+    assert lat["value"] == 3.0
+    assert lat["value_per_memcpy"] == 12.0  # 3 * 4
+
+
 def test_meta_commit_reports_shared_serialization(tmp_path):
     """The metadata-commit stage must exercise the FanoutMetaPack path
     (serialize once per PUT, stamp per disk) and report the per-disk

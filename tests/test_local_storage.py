@@ -129,3 +129,57 @@ def test_offline_disk_raises(disk):
     with pytest.raises(ErrDiskNotFound):
         disk.read_all("b", "x")
     disk.set_online(True)
+
+
+def test_drive_perf_probe(disk):
+    """The OBD drive-perf probe (madmin.DrivePerfInfo analog): measured
+    sequential write+read GB/s and per-op latency from a size-bounded
+    tmp-file pass, O_DIRECT when the filesystem accepts it (reported
+    either way via `direct`), probe file cleaned up."""
+    perf = disk.drive_perf(size_bytes=1 << 20, io_bytes=256 << 10)
+    assert perf["write_gbps"] > 0
+    assert perf["read_gbps"] > 0
+    assert perf["write_lat_us"] >= 0 and perf["read_lat_us"] >= 0
+    assert perf["probe_bytes"] == 1 << 20
+    assert perf["io_bytes"] == 256 << 10
+    assert isinstance(perf["direct"], bool)
+    tmp_dir = os.path.join(disk.root, *SYSTEM_TMP.split("/"))
+    assert not [f for f in os.listdir(tmp_dir) if f.startswith("drive-perf")]
+    # Size bound: an oversized request clamps instead of hammering IO.
+    perf = disk.drive_perf(size_bytes=1 << 40, io_bytes=1 << 20)
+    assert perf["probe_bytes"] == 64 << 20
+
+
+def test_drive_perf_in_health_bundle(tmp_path):
+    """admin.health_info embeds the measured per-drive probe when the
+    caller opts in with ?perf=true (?perfsize bounds it); the default
+    bundle stays read-only — no injected drive IO on a plain poll."""
+    import json as _json
+
+    from minio_tpu.api.admin import AdminHandlers
+
+    class _Pool:
+        def __init__(self, disks):
+            self.disks = disks
+
+    class _OL:
+        def __init__(self, disks):
+            self.pools = [_Pool(disks)]
+
+    class _Ctx:
+        def __init__(self, qdict):
+            self.qdict = qdict
+
+    disks = [LocalStorage(str(tmp_path / f"hd{i}"), endpoint=f"hd{i}")
+             for i in range(2)]
+    admin = AdminHandlers(_OL(disks), iam=None)
+    resp = admin.health_info(_Ctx({"perf": "true", "perfsize": "1"}))
+    info = _json.loads(resp.body)
+    assert len(info["disks"]) == 2
+    for d in info["disks"]:
+        assert d["perf"]["write_gbps"] > 0, d
+        assert d["perf"]["read_gbps"] > 0, d
+        assert d["perf"]["probe_bytes"] == 1 << 20
+    resp = admin.health_info(_Ctx({}))
+    info = _json.loads(resp.body)
+    assert all("perf" not in d for d in info["disks"])

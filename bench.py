@@ -701,8 +701,10 @@ def bench_device_batch_sweep(tpu_ok: bool) -> dict:
         def full():
             dev = jax.device_put(data_np)
             pf, hf = codec.encode_async(dev, True)
-            np.asarray(pf)
-            np.asarray(hf)
+            # The sweep measures SERIALIZED per-batch latency on
+            # purpose (amortization denominator, not throughput).
+            np.asarray(pf)  # jax-ok: serialized on purpose
+            np.asarray(hf)  # jax-ok: serialized on purpose
 
         full()  # warm/compile this batch shape
         t_best = float("inf")
@@ -913,6 +915,23 @@ def bench_device(tpu_ok: bool) -> dict:
     return out
 
 
+def bench_analysis_gate() -> dict:
+    """Wall-time of the tier-1 static-analysis gate (tools/analysis).
+    The scan runs on every CI pass, so its cost rides along with the
+    throughput numbers it protects — a rule whose walk goes quadratic
+    shows up here before it shows up as CI latency."""
+    from tools.analysis import engine as _analysis
+
+    report = _analysis.run()
+    return {
+        "wall_time_s": round(report.wall_time_s, 3),
+        "files_scanned": report.files_scanned,
+        "findings_new": len(report.new),
+        "findings_waived": len(report.waived),
+        "baseline_size": report.baseline_size,
+    }
+
+
 def _memcpy_gbps(size_mib: int = 128) -> float:
     """One host memcpy sample — the bandwidth bound every host-fed
     pipeline lives under (~5 passes per stream). Sampled ADJACENT to
@@ -1047,6 +1066,12 @@ def main() -> None:
         result["mesh"] = bench_mesh()
     except Exception as exc:  # noqa: BLE001 - diagnostics
         result["mesh"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Static-analysis gate cost (tools/analysis): tracked so the tier-1
+    # scan stays visibly cheap.
+    try:
+        result["analysis_gate"] = bench_analysis_gate()
+    except Exception as exc:  # noqa: BLE001 - diagnostics
+        result["analysis_gate"] = {"error": f"{type(exc).__name__}: {exc}"}
     if not tpu_ok:
         result["tpu_unreachable"] = True
         result["note"] = (

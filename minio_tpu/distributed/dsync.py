@@ -317,12 +317,20 @@ class DRWMutex:
                 self._writer = writer
                 self._start_refresh()
                 return True
-            # roll back partial grants (ref releaseAll :504)
+            # roll back partial grants (ref releaseAll :504). A
+            # rollback whose RPC fails at the transport leaks its grant
+            # server-side until expiry exactly like a failed unlock —
+            # count it the same way instead of dropping the error.
+            rollback_failed = 0
             for i, ok in enumerate(granted):
                 if ok:
-                    self.lockers[i].call(
+                    _ok, err = self.lockers[i].call2(
                         "unlock", self.resource, uid, self.owner
                     )
+                    if err is not None:
+                        rollback_failed += 1
+            if rollback_failed:
+                _note_unlock_failures(rollback_failed, self.resource)
             if time.time() >= deadline:
                 return False
             time.sleep(0.01 + 0.04 * (time.time() % 1))  # jittered retry
@@ -355,8 +363,16 @@ class DRWMutex:
 
     def force_unlock(self):
         self._stop_refresh_loop()
+        failed = 0
         for loc in self.lockers:
-            loc.call("force_unlock", self.resource, "", self.owner)
+            _ok, err = loc.call2("force_unlock", self.resource, "",
+                                 self.owner)
+            if err is not None:
+                failed += 1
+        if failed:
+            # Same leak semantics as a failed unlock: the peer's entry
+            # survives until server-side expiry.
+            _note_unlock_failures(failed, self.resource)
 
     # --- refresh (ref drwmutex.go:214-345; executed by the shared
     # --- module ticker, never a per-acquisition thread) ---

@@ -57,6 +57,7 @@ def verify_token(secret: str, token: str) -> bool:
         return False
     try:
         payload = json.loads(base64.urlsafe_b64decode(b64))
+    # except-ok: malformed credential classifies as invalid token; the False IS the outcome
     except Exception:
         return False
     return payload.get("exp", 0) > time.time()
@@ -287,6 +288,8 @@ class RPCClient:
         # single-flight: one thread probes, the others return the
         # current state instead of stacking probes and clobbering the
         # backoff stamp.
+        # lock-ok: non-blocking single-flight probe gate; released in
+        # the finally below, never held across a wait
         if not self._probe_lock.acquire(blocking=False):
             return self._online
         try:

@@ -195,7 +195,12 @@ class DeviceCodec:
             return blocks
         import jax
 
-        return jax.device_put(np.ascontiguousarray(blocks, dtype=np.uint8))
+        # Identity for the pooled strip buffers (contiguous uint8); a
+        # real host-side fixup copy is counted before the H2D.
+        from ..pipeline.buffers import ascontig_counted
+
+        return jax.device_put(ascontig_counted(blocks,
+                                               "put.device_stage"))
 
     # --- encode ---
 

@@ -266,7 +266,9 @@ class _BlockFiller:
             if got < block_size:
                 self.eof = True
                 if got or (not nb and not self.produced):
+                    # copy-ok: put.tail_copy
                     tail = row[:got].tobytes() if got else b""
+                    copy_add("put.tail_copy", got)
                 break
             row[block_size:] = 0  # split's zero pad (buffers recycle)
             nb += 1
@@ -385,6 +387,7 @@ def _encode_stream_batched(erasure: Erasure, src, writer: ParallelWriter,
                 parity[bi, j] for j in range(erasure.parity_blocks)
             ]
             digests = (
+                # copy-ok: meta (32-byte digests, not payload)
                 [hashes[bi, j].tobytes() for j in range(erasure.total_shards)]
                 if hashes is not None else None
             )
@@ -527,6 +530,7 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
                        for j in range(erasure.parity_blocks)]
                 )
                 digests = (
+                    # copy-ok: meta (32-byte digests, not payload)
                     [hashes[bi, j].tobytes()
                      for j in range(erasure.total_shards)]
                     if hashes is not None else None
@@ -692,6 +696,9 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
 
     def strips_source():
         while not filler.eof:
+            # pool-ok: fill_acquired releases on raise; afterwards the
+            # buffer is wrapped in an item owned by the executor's drop
+            # hook (released exactly once on stage-raise/cancel/drain)
             buf = pool.acquire()
             nb, tail = fill_acquired(buf)
             if nb == 0:
@@ -745,6 +752,9 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     # its stages back-to-back anyway — zero overlap to win — so skip
     # the thread spin-up and run the stages inline (keeps small-object
     # PUT latency at the serial driver's level).
+    # pool-ok: fill_acquired releases on raise; then the buffer lives in
+    # `first`, released by the inline path's finally drop() or handed to
+    # the pipeline whose drop hook owns it
     buf0 = pool.acquire()
     nb0, tail0 = fill_acquired(buf0)
     first = [buf0, nb0, tail0, None, None]
@@ -777,6 +787,8 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
 
 
 def _read_full(src, n: int) -> bytes:
+    from ..pipeline.buffers import copy_add
+
     first = src.read(n)
     if len(first) == n or not first:
         return first  # common case (BytesIO, files): zero extra copies
@@ -786,7 +798,10 @@ def _read_full(src, n: int) -> bytes:
         if not chunk:
             break
         out += chunk
-    return bytes(out)
+    # Chunked-source fallback (sockets, wrapped readers): the join is
+    # a real extra pass over these bytes — counted, never silent.
+    copy_add("put.read_join", len(out))
+    return bytes(out)  # copy-ok: put.read_join
 
 
 class ParallelReader:
@@ -1189,6 +1204,7 @@ def _decode_stream_mesh(erasure: Erasure, writer, reader, geoms: list,
     after draining the ring, so client writes stay strictly in stream
     order."""
     from ..parallel.mesh_engine import for_geometry as mesh_geometry
+    from ..pipeline.buffers import copy_add
     from ..utils.errors import ErrShardSize, ErrTooFewShards
 
     codec = mesh_geometry(erasure.data_blocks, erasure.parity_blocks)
@@ -1274,9 +1290,11 @@ def _decode_stream_mesh(erasure: Erasure, writer, reader, geoms: list,
         # parity beyond that would be copied for nothing.
         held: list = [None] * len(bufs)
         for i in present[:k]:
+            # copy-ok: get.mesh_hold
             held[i] = np.frombuffer(
                 memoryview(bufs[i]), dtype=np.uint8
             ).copy()
+            copy_add("get.mesh_hold", len(held[i]))
         batch_bufs.append(held)
         batch_geoms.append((off, ln))
         if len(batch_bufs) >= ParallelReader.BATCH_BLOCKS:
@@ -1302,7 +1320,14 @@ def _write_data_blocks(dst, blocks: list, data_blocks: int,
             offset -= len(b)
             continue
         if not isinstance(b, (bytes, bytearray, memoryview)):
-            b = np.ascontiguousarray(b)
+            # copy-ok: get.reassemble — no-op view for the contiguous
+            # decode outputs; a real copy (non-contiguous row) counts.
+            fixed = np.ascontiguousarray(b)
+            if fixed is not b:
+                from ..pipeline.buffers import copy_add
+
+                copy_add("get.reassemble", fixed.nbytes)
+            b = fixed
         chunk = memoryview(b)[offset:]
         offset = 0
         if write < len(chunk):
@@ -1344,8 +1369,13 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
     reader.set_blocks_wanted(total_blocks)
 
     def write_targets(shards) -> None:
+        from ..pipeline.buffers import copy_add
+
         for t_i, t in enumerate(targets):
-            writers[t].write(np.asarray(shards[t_i]).tobytes())
+            # copy-ok: heal.shard_copy
+            chunk = np.asarray(shards[t_i]).tobytes()
+            copy_add("heal.shard_copy", len(chunk))
+            writers[t].write(chunk)
 
     engine = _select_engine(erasure.shard_size(), erasure.total_shards)
     if engine in ("device", "mesh") and total_blocks:
@@ -1399,6 +1429,8 @@ def _heal_stream_fused(erasure: Erasure, writers: list, reader,
     The dispatch of batch N overlaps the stale-disk writes of batch N-1;
     a ragged tail block (short shard) falls back to the host
     reconstruction, exactly like the encode drivers' tail path."""
+    from ..pipeline.buffers import copy_add
+
     k = erasure.data_blocks
     shard = erasure.shard_size()
     # Device digests frame the target writers' chunks only when every
@@ -1415,13 +1447,18 @@ def _heal_stream_fused(erasure: Erasure, writers: list, reader,
     pending = None  # (rebuilt_future, digests_future)
 
     def flush(p) -> None:
+        from ..pipeline.buffers import copy_add
+
         rebuilt = np.asarray(p[0])  # D2H already started at dispatch
         digs = np.asarray(p[1]) if p[1] is not None else None
         for bi in range(rebuilt.shape[0]):
             for t_i, t in enumerate(targets):
                 w = writers[t]
+                # copy-ok: heal.shard_copy
                 chunk = rebuilt[bi, t_i].tobytes()
+                copy_add("heal.shard_copy", len(chunk))
                 if digs is not None and hasattr(w, "write_with_digest"):
+                    # copy-ok: meta (32-byte digest)
                     w.write_with_digest(chunk, digs[bi, t_i].tobytes())
                 else:
                     w.write(chunk)
@@ -1468,7 +1505,10 @@ def _heal_stream_fused(erasure: Erasure, writers: list, reader,
                 pending = None
             shards = erasure.reconstruct_targets(list(bufs), targets)
             for t_i, t in enumerate(targets):
-                writers[t].write(np.asarray(shards[t_i]).tobytes())
+                # copy-ok: heal.shard_copy
+                chunk = np.asarray(shards[t_i]).tobytes()
+                copy_add("heal.shard_copy", len(chunk))
+                writers[t].write(chunk)
             continue
         if batch and present[:k] != batch_present:
             # Survivor set changed mid-stream (a reader died): close the

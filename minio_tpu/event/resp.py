@@ -128,6 +128,8 @@ class RespClient:
         (redigo, the reference's client, does not auto-retry either).
         RespError (server rejected the command) does NOT tear down the
         connection; socket errors do."""
+        # lock-ok: connection serialization lock — one socket, one
+        # in-flight command; guards only this target's wire state
         with self._mu:
             for attempt in (0, 1):
                 fresh = self._sock is None

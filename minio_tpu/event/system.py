@@ -246,5 +246,7 @@ class EventNotifier:
             if closer is not None:
                 try:
                     closer()
+                # except-ok: best-effort shutdown — the process is
+                # exiting and the target's socket dies either way
                 except Exception:  # noqa: BLE001 - best-effort shutdown
                     pass

@@ -32,6 +32,8 @@ class QueueStore:
         self._mu = threading.Lock()
 
     def put(self, event: dict) -> str:
+        # lock-ok: dedicated queue-dir serialization lock; guards only
+        # this directory's name allocation, never hot-path state
         with self._mu:
             names = sorted(os.listdir(self.dir))
             if len(names) >= self.limit:
@@ -44,6 +46,7 @@ class QueueStore:
             return key
 
     def list(self) -> list[str]:
+        # lock-ok: queue-dir serialization lock (see put)
         with self._mu:
             return sorted(
                 n for n in os.listdir(self.dir) if not n.startswith(".")
@@ -70,10 +73,14 @@ class Target:
         self.arn = arn
         self.store = store
         self._drain_mu = threading.Lock()
-        # Last wire failure (drain swallows it to keep events queued);
+        # Last wire failure (drain latches it to keep events queued);
         # the notifier's retry loop surfaces it to metrics/logs so an
-        # outage with a growing backlog is never invisible.
+        # outage with a growing backlog is never invisible. The FAILURE
+        # COUNT is latched separately: last_error alone overwrites, so
+        # a target failing every retry tick for an hour would be
+        # indistinguishable from one that failed once.
         self.last_error: Exception | None = None
+        self.drain_failures = 0
 
     def is_active(self) -> bool:
         return True
@@ -95,6 +102,9 @@ class Target:
         head-of-queue file and deliver it twice."""
         if self.store is None:
             return 0
+        # lock-ok: drain serialization lock — two concurrent drains
+        # would double-deliver the head-of-queue event; the lock guards
+        # only this target's queue cursor, never shared state
         with self._drain_mu:
             sent = 0
             for key in self.store.list():
@@ -102,6 +112,7 @@ class Target:
                     self.send_now(self.store.get(key))
                 except Exception as exc:  # noqa: BLE001 - stays queued
                     self.last_error = exc
+                    self.drain_failures += 1
                     break
                 self.store.delete(key)
                 sent += 1

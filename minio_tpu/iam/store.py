@@ -167,6 +167,9 @@ class IAMSys:
     # --- load/persist ---
 
     def load(self):
+        # lock-ok: boot/reload-path lock — serving partially loaded IAM
+        # state would auth against a half-built policy map; backend
+        # reads are cold-path by design
         with self._lock:
             for path in self.store.list("users/"):
                 raw = self.store.load(path)
@@ -191,6 +194,7 @@ class IAMSys:
         drive (ref iam-etcd-store.go watch loop -> reload). STS
         credentials and their session policies are memory-only and
         survive the reload."""
+        # lock-ok: same boot/reload-path lock as load()
         with self._lock:
             sts_mappings = {
                 k: v for k, v in self.user_policy.items() if k in self.sts

@@ -67,6 +67,9 @@ class ListingCache:
     def page(self, marker: str, count: int) -> tuple[list[tuple[str, bytes]], bool]:
         """Entries strictly after `marker`, up to `count` (+1 lookahead is
         the caller's concern). Returns (entries, exhausted_after)."""
+        # lock-ok: per-listing cache lock serializing this listing's
+        # spool-file handle (seek+read must be atomic); guards no
+        # cross-request state
         with self._lock:
             if self._closed:
                 raise StaleListingCache()

@@ -82,6 +82,8 @@ class Logger:
             "message": message,
         }
         entry.update(fields)
+        # lock-ok: log-stream serialization lock (interleaved writes
+        # would tear JSON lines); fast buffered write, no hot state
         with self._mu:
             self._ring.append(entry)
             try:

@@ -89,6 +89,7 @@ def gf_mat_inv(mat: np.ndarray) -> np.ndarray:
     n = mat.shape[0]
     if mat.shape != (n, n):
         raise ValueError("matrix must be square")
+    # copy-ok: meta (k x k coding matrix, not payload)
     work = np.concatenate([mat.copy(), np.eye(n, dtype=np.uint8)], axis=1)
     for col in range(n):
         pivot = None
@@ -105,7 +106,7 @@ def gf_mat_inv(mat: np.ndarray) -> np.ndarray:
         for r in range(n):
             if r != col and work[r, col] != 0:
                 work[r] ^= gf_mul(work[r, col], work[col])
-    return work[:, n:].copy()
+    return work[:, n:].copy()  # copy-ok: meta (coding matrix)
 
 
 def vandermonde(rows: int, cols: int) -> np.ndarray:
@@ -134,6 +135,7 @@ def rs_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
 @functools.lru_cache(maxsize=None)
 def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
     """The (m, k) parity rows of the systematic coding matrix."""
+    # copy-ok: meta (m x k coding matrix, built once per lru key)
     out = rs_matrix(data_shards, parity_shards)[data_shards:].copy()
     out.setflags(write=False)
     return out
@@ -165,8 +167,9 @@ def bit_matrix_for(mat: np.ndarray) -> np.ndarray:
     every block batch, and re-deriving the [8R, 8C] expansion per call
     showed up in the device-engine dispatch overhead. Returns a
     read-only array — callers share it."""
+    # copy-ok: meta (coding-matrix bytes form the cache key)
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    return _bit_matrix_cached(mat.shape, mat.tobytes())
+    return _bit_matrix_cached(mat.shape, mat.tobytes())  # copy-ok: meta
 
 
 @functools.lru_cache(maxsize=512)

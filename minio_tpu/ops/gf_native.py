@@ -55,6 +55,7 @@ def _nibble_tables(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
     nib = np.arange(16, dtype=np.uint8)
     tables[:, :, 0, :] = gf.gf_mul(mat[:, :, None], nib[None, None, :])
     tables[:, :, 1, :] = gf.gf_mul(mat[:, :, None], (nib << 4)[None, None, :])
+    # copy-ok: meta (per-coefficient nibble tables, lru-cached)
     return np.ascontiguousarray(tables)
 
 
@@ -81,6 +82,7 @@ def _affine_qwords(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
         for q in range(8):
             row |= ((prods[q] >> np.uint64(p)) & np.uint64(1)) << np.uint64(q)
         out |= row << np.uint64(8 * (7 - p))
+    # copy-ok: meta (8x8 affine qwords per matrix, lru-cached)
     return np.ascontiguousarray(out)
 
 
@@ -104,18 +106,22 @@ def apply_matrix(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
     lib = _lib()
     if lib is None:
         raise RuntimeError("native GF engine unavailable")
-    mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    from ..pipeline.buffers import ascontig_counted
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)  # copy-ok: meta
+    # Identity for the strip-buffer hot path; a non-contiguous caller
+    # pays (and counts) one fixup copy.
+    shards = ascontig_counted(shards, "ops.contig_fixup")
     r, k = mat.shape
     s = shards.shape[-1]
     assert shards.shape == (k, s), (mat.shape, shards.shape)
     out = np.empty((r, s), dtype=np.uint8)
     if engine_kind() == 2:
-        qw = _affine_qwords(mat.tobytes(), r, k)
+        qw = _affine_qwords(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply_affine(qw.ctypes.data_as(_U64P), r, k, _u8(shards),
                             _u8(out), s, _threads())
     else:
-        tables = _nibble_tables(mat.tobytes(), r, k)
+        tables = _nibble_tables(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply(_u8(tables), r, k, _u8(shards), _u8(out), s, _threads())
     return out
 
@@ -125,18 +131,21 @@ def apply_matrix_batch(mat: np.ndarray, blocks: np.ndarray) -> np.ndarray:
     lib = _lib()
     if lib is None:
         raise RuntimeError("native GF engine unavailable")
-    mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    from ..pipeline.buffers import ascontig_counted
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)  # copy-ok: meta
+    # Identity for the strip-buffer hot path (see apply_matrix).
+    blocks = ascontig_counted(blocks, "ops.contig_fixup")
     r, k = mat.shape
     b, kk, s = blocks.shape
     assert kk == k, (mat.shape, blocks.shape)
     out = np.empty((b, r, s), dtype=np.uint8)
     if engine_kind() == 2:
-        qw = _affine_qwords(mat.tobytes(), r, k)
+        qw = _affine_qwords(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply_affine_batch(qw.ctypes.data_as(_U64P), r, k,
                                   _u8(blocks), _u8(out), b, s, _threads())
     else:
-        tables = _nibble_tables(mat.tobytes(), r, k)
+        tables = _nibble_tables(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply_batch(_u8(tables), r, k, _u8(blocks), _u8(out), b, s,
                            _threads())
     return out

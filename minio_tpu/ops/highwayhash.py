@@ -19,6 +19,7 @@ import numpy as np
 
 # Magic HH-256 key: HH-256 hash of the first 100 decimals of pi as utf-8
 # with a zero key (cmd/bitrot.go:34).
+# copy-ok: meta (32-byte module constant)
 MAGIC_KEY = bytes(
     b"\x4b\xe7\x34\xfa\x8e\x23\x8a\xcd\x26\x3e\x83\xe6\xbb\x96\x85\x52"
     b"\x04\x0f\x93\x5d\xa3\x9f\x44\x14\x97\xe0\x9d\x13\x22\xde\x36\xa0"
@@ -44,7 +45,7 @@ def _rot64_by_32(x):
 def _key_lanes(key: bytes) -> np.ndarray:
     if len(key) != 32:
         raise ValueError("HighwayHash key must be 32 bytes")
-    return np.frombuffer(key, dtype="<u8").copy()
+    return np.frombuffer(key, dtype="<u8").copy()  # copy-ok: meta
 
 
 class State:
@@ -55,15 +56,17 @@ class State:
     def __init__(self, key: bytes, batch_shape: tuple = ()):
         k = _key_lanes(key)
         shape = batch_shape + (4,)
+        # copy-ok: meta (32-byte-per-stream hash state)
         self.mul0 = np.broadcast_to(_INIT0, shape).copy()
-        self.mul1 = np.broadcast_to(_INIT1, shape).copy()
+        self.mul1 = np.broadcast_to(_INIT1, shape).copy()  # copy-ok: meta
         self.v0 = self.mul0 ^ np.broadcast_to(k, shape)
         self.v1 = self.mul1 ^ np.broadcast_to(_rot64_by_32(k), shape)
 
     def copy(self) -> "State":
         s = State.__new__(State)
+        # copy-ok: meta (hash state lanes)
         s.v0, s.v1 = self.v0.copy(), self.v1.copy()
-        s.mul0, s.mul1 = self.mul0.copy(), self.mul1.copy()
+        s.mul0, s.mul1 = self.mul0.copy(), self.mul1.copy()  # copy-ok: meta
         return s
 
 
@@ -180,6 +183,7 @@ def _finalize256(state: State) -> np.ndarray:
         v0[..., 3] + mul0[..., 3], v0[..., 2] + mul0[..., 2],
     )
     out = np.stack([h0, h1, h2, h3], axis=-1)
+    # copy-ok: meta (32-byte digests)
     return np.ascontiguousarray(out).view(np.uint8).reshape(out.shape[:-1] + (32,))
 
 
@@ -189,7 +193,11 @@ def hash256_batch(data: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
     The batch axis is vectorized (all streams advance one packet per numpy
     op); the packet chain within a chunk is sequential per the algorithm.
     """
-    data = np.ascontiguousarray(data, dtype=np.uint8)
+    from ..pipeline.buffers import ascontig_counted
+
+    # Identity for contiguous input; a real fixup copy is counted
+    # (same label as the GF engines).
+    data = ascontig_counted(data, "ops.contig_fixup")
     batch_shape = data.shape[:-1]
     length = data.shape[-1]
     state = State(key, batch_shape)
@@ -208,7 +216,7 @@ def hash256_batch(data: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
 def hash256(data, key: bytes = MAGIC_KEY) -> bytes:
     """One-shot HighwayHash-256 of a bytes-like object."""
     arr = np.frombuffer(memoryview(data), dtype=np.uint8)
-    return hash256_batch(arr, key).tobytes()
+    return hash256_batch(arr, key).tobytes()  # copy-ok: meta (digest)
 
 
 class HighwayHash256:
@@ -224,7 +232,19 @@ class HighwayHash256:
         self._buf = bytearray()
 
     def update(self, data):
-        self._buf += bytes(data)
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            # ndarray and friends: += would dispatch to numpy's
+            # broadcasting add — go through the buffer protocol.
+            data = memoryview(data)
+        if isinstance(data, memoryview) and not data.c_contiguous:
+            # bytearray += rejects non-C-contiguous views (a strided
+            # strip-buffer row): one counted fixup copy, like the GF
+            # engines' staging seam.
+            from ..pipeline.buffers import copy_add
+
+            copy_add("ops.contig_fixup", data.nbytes)
+            data = data.tobytes()  # copy-ok: ops.contig_fixup
+        self._buf += data  # bytearray += a contiguous buffer: no copy
         n = (len(self._buf) // 32) * 32
         if n:
             packets = np.frombuffer(self._buf[:n], dtype="<u8").reshape(-1, 4)
@@ -234,10 +254,12 @@ class HighwayHash256:
         return self
 
     def digest(self) -> bytes:
-        s = self._state.copy()
+        s = self._state.copy()  # copy-ok: meta (hash state)
         if self._buf:
-            _update_remainder(s, np.frombuffer(bytes(self._buf), dtype=np.uint8))
-        return _finalize256(s).tobytes()
+            # frombuffer on the bytearray itself: the view is
+            # consumed before any later resize, zero copies.
+            _update_remainder(s, np.frombuffer(self._buf, dtype=np.uint8))
+        return _finalize256(s).tobytes()  # copy-ok: meta (digest)
 
     def hexdigest(self) -> str:
         return self.digest().hex()

@@ -267,6 +267,7 @@ def _build_hash_fn(length: int, key: bytes):
             state = _update((v0, v1, mul0, mul1), _bytes_to_lanes(packet))
         return _finalize256(state)
 
+    # jax-ok: sole caller _hash_fn_cache is lru_cached per (length, key)
     return jax.jit(fn)
 
 

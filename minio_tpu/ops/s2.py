@@ -53,10 +53,32 @@ def _crc32c_py(data: bytes) -> int:
     return c ^ 0xFFFFFFFF
 
 
+def _as_bytes(data) -> bytes:
+    """The native codec needs a real bytes object: identity for bytes
+    input (CPython returns the same object), a counted copy for
+    bytearray/memoryview callers."""
+    if isinstance(data, bytes):
+        return data
+    from ..pipeline.buffers import copy_add
+
+    b = bytes(data)  # copy-ok: s2.ctypes_stage
+    copy_add("s2.ctypes_stage", len(b))
+    return b
+
+
+def _out_bytes(buf, n: int) -> bytes:
+    """Materialize n output bytes from a ctypes/bytearray buffer —
+    the one unavoidable copy per codec call, counted."""
+    from ..pipeline.buffers import copy_add
+
+    copy_add("s2.out_copy", n)
+    return bytes(memoryview(buf)[:n])  # copy-ok: s2.out_copy
+
+
 def crc32c(data: bytes) -> int:
     lib = _native()
     if lib is not None:
-        return lib.mtpu_crc32c(bytes(data), len(data))
+        return lib.mtpu_crc32c(_as_bytes(data), len(data))
     return _crc32c_py(data)
 
 
@@ -75,7 +97,7 @@ def _varint(n: int) -> bytes:
         out.append((n & 0x7F) | 0x80)
         n >>= 7
     out.append(n)
-    return bytes(out)
+    return bytes(out)  # copy-ok: meta (<=5-byte varint)
 
 
 def _read_varint(data: bytes) -> tuple[int, int]:
@@ -98,9 +120,9 @@ def compress_block(data: bytes) -> bytes:
 
         cap = lib.mtpu_snappy_max_compressed(len(data))
         dst = (ctypes.c_uint8 * cap)()
-        n = lib.mtpu_snappy_compress(bytes(data), len(data), dst)
-        return bytes(dst[:n])
-    return _compress_block_py(bytes(data))
+        n = lib.mtpu_snappy_compress(_as_bytes(data), len(data), dst)
+        return _out_bytes(dst, n)
+    return _compress_block_py(_as_bytes(data))
 
 
 def _compress_block_py(data: bytes) -> bytes:
@@ -137,7 +159,7 @@ def _compress_block_py(data: bytes) -> bytes:
         if blen > lit:
             _emit_literal(out, block[lit:blen])
         base = end
-    return bytes(out)
+    return _out_bytes(out, len(out))
 
 
 def _emit_literal(out: bytearray, data: bytes):
@@ -179,15 +201,15 @@ def decompress_block(data: bytes) -> bytes:
     if lib is not None:
         import ctypes
 
-        want = lib.mtpu_snappy_uncompressed_length(bytes(data), len(data))
+        want = lib.mtpu_snappy_uncompressed_length(_as_bytes(data), len(data))
         if want < 0:
             raise ValueError("corrupt snappy block")
         dst = (ctypes.c_uint8 * max(want, 1))()
-        n = lib.mtpu_snappy_decompress(bytes(data), len(data), dst, want)
+        n = lib.mtpu_snappy_decompress(_as_bytes(data), len(data), dst, want)
         if n < 0:
             raise ValueError("corrupt snappy block")
-        return bytes(dst[:n])
-    return _decompress_block_py(bytes(data))
+        return _out_bytes(dst, n)
+    return _decompress_block_py(_as_bytes(data))
 
 
 def _decompress_block_py(data: bytes) -> bytes:
@@ -225,7 +247,7 @@ def _decompress_block_py(data: bytes) -> bytes:
                 out.append(out[-offset])
     if len(out) != want:
         raise ValueError("snappy length mismatch")
-    return bytes(out)
+    return _out_bytes(out, len(out))
 
 
 # ---------------------------------------------------------------------------
@@ -239,8 +261,10 @@ def frame_chunk(raw: bytes) -> bytes:
     comp = compress_block(raw)
     if len(comp) < len(raw):
         body = crc + comp
+        # copy-ok: meta (1-byte chunk-type tag)
         return bytes([0x00]) + struct.pack("<I", len(body))[:3] + body
     body = crc + raw
+    # copy-ok: meta (1-byte chunk-type tag)
     return bytes([0x01]) + struct.pack("<I", len(body))[:3] + body
 
 
@@ -262,30 +286,43 @@ class FrameDecoder:
             clen = int.from_bytes(self._buf[1:4], "little")
             if len(self._buf) < 4 + clen:
                 return
-            body = bytes(self._buf[4:4 + clen])
-            del self._buf[:4 + clen]
             if ctype == 0xFF:
                 self._seen_header = True
+                del self._buf[:4 + clen]
                 continue
-            if ctype in (0x00, 0x01):
-                if clen < 4:
-                    raise ValueError("short snappy frame")
-                want_crc = struct.unpack("<I", body[:4])[0]
-                payload = body[4:]
-                raw = (decompress_block(payload) if ctype == 0x00
-                       else payload)
-                if _masked_crc(raw) != want_crc:
-                    raise ValueError("snappy frame CRC mismatch")
-                self._out += raw
-            elif 0x80 <= ctype <= 0xFE:
-                # skippable chunks INCLUDING 0xFE padding (the framing
-                # spec requires decoders to skip padding, not reject it)
+            if 0x80 <= ctype <= 0xFE:
+                # Skippable chunks INCLUDING 0xFE padding (the framing
+                # spec requires decoders to skip padding, not reject
+                # it) — discarded without materializing the body.
+                del self._buf[:4 + clen]
                 continue
-            else:
+            if ctype not in (0x00, 0x01):
                 raise ValueError(f"unknown snappy frame type {ctype:#x}")
+            from ..pipeline.buffers import copy_add
+
+            # One counted copy out of the mutable feed buffer (the
+            # view must not outlive the del below). Previously this
+            # was TWO copies: bytearray slice, then bytes() of it.
+            with memoryview(self._buf) as mv:
+                body = bytes(mv[4:4 + clen])  # copy-ok: s2.frame_copy
+            copy_add("s2.frame_copy", clen)
+            del self._buf[:4 + clen]
+            if clen < 4:
+                raise ValueError("short snappy frame")
+            # copy-ok: meta (4-byte CRC slice)
+            want_crc = struct.unpack("<I", body[:4])[0]
+            payload = memoryview(body)[4:]  # zero-copy view
+            raw = (decompress_block(payload) if ctype == 0x00
+                   else payload)
+            if _masked_crc(raw) != want_crc:
+                raise ValueError("snappy frame CRC mismatch")
+            self._out += raw
 
     def decoded(self) -> bytes:
-        out = bytes(self._out)
+        from ..pipeline.buffers import copy_add
+
+        copy_add("s2.out_copy", len(self._out))
+        out = bytes(self._out)  # copy-ok: s2.out_copy
         self._out.clear()
         return out
 
@@ -300,7 +337,7 @@ def compress_stream(data: bytes) -> bytes:
     out = bytearray(STREAM_ID)
     for off in range(0, len(data), CHUNK):
         out += frame_chunk(data[off:off + CHUNK])
-    return bytes(out)
+    return _out_bytes(out, len(out))
 
 
 def decompress_stream(data: bytes) -> bytes:

@@ -152,7 +152,11 @@ class MeshCodec:
             return blocks, blocks.shape[0]
         import jax
 
-        b = np.ascontiguousarray(blocks, dtype=np.uint8)
+        # Identity for contiguous uint8 input; a real host-side fixup
+        # copy is counted before the H2D.
+        from ..pipeline.buffers import ascontig_counted
+
+        b = ascontig_counted(blocks, "put.device_stage")
         n = b.shape[0]
         pad = (-n) % self._pad_rows
         if pad:

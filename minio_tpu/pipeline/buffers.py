@@ -70,6 +70,20 @@ def copy_add(site: str, nbytes: int) -> None:
     COPY.add(site, nbytes)
 
 
+def ascontig_counted(arr, site: str):
+    """np.ascontiguousarray(uint8) that COUNTS when it actually copies:
+    identity (zero cost) for the contiguous strip-buffer hot path, one
+    counted fixup copy for non-contiguous or non-uint8 callers. The one
+    shared implementation for every engine's staging seam — copy-lint
+    treats the `site` argument as a CopyCounters routing label."""
+    import numpy as np
+
+    contig = np.ascontiguousarray(arr, dtype=np.uint8)
+    if contig is not arr:
+        COPY.add(site, contig.nbytes)
+    return contig
+
+
 class BufferPool:
     """Thread-safe freelist of interchangeable buffers.
 

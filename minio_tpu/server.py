@@ -8,6 +8,7 @@ subsystem init, HTTP start, background services).
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 from .api import S3Server
@@ -140,9 +141,29 @@ class Server:
         # dsync unlock-failure counts flow through the same hooks.
         from .distributed import dsync as _dsync
         from .erasure import streaming as _streaming
+        from .utils import fanout as _fanout
 
         _streaming.set_metrics(self.metrics)
         _dsync.set_metrics(self.metrics)
+        _fanout.set_metrics(self.metrics)
+        # Runtime lock-order checker (tools/analysis/lockgraph): armed
+        # only when the operator sets MTPU_LOCK_CHECK=1 — instruments
+        # every lock created from here on and exposes cycle/hold-time
+        # reports (docs/ANALYSIS.md). The tools package lives at the
+        # repo root, so a pip-installed deployment without it skips
+        # silently.
+        if os.environ.get("MTPU_LOCK_CHECK") == "1":
+            try:
+                from tools.analysis import lockgraph as _lockgraph
+
+                _lockgraph.enable_from_env()
+            except ImportError as exc:
+                # An explicit operator opt-in must never no-op
+                # silently — say why the checker stayed off.
+                sys.stderr.write(
+                    f"minio-tpu: MTPU_LOCK_CHECK=1 ignored: "
+                    f"tools.analysis.lockgraph not importable ({exc})\n"
+                )
         # Mesh serving-engine counters (collective dispatches, dp-group
         # batches, per-lane bytes) mirror onto the same registry; the
         # module import is jax-free, so wiring it costs nothing on

@@ -504,6 +504,9 @@ class LocalStorage(StorageAPI):
         """Atomic commit: move staged data dir into place and journal the
         version (ref cmd/xl-storage.go:1825 RenameData)."""
         self._require_online()
+        # lock-ok: per-disk metadata transaction lock — the
+        # rename+journal-merge must be atomic per disk (the reference
+        # holds xl-storage's lock across RenameData the same way)
         with self._lock:
             dst_dir = self._file_path(dst_volume, dst_path)
             if fi.data_dir:

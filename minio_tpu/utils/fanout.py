@@ -17,6 +17,31 @@ from contextlib import contextmanager
 
 SINGLE_CORE = (os.cpu_count() or 1) == 1
 
+# Late straggler outcomes discarded after detach: the slot already
+# carries its timeout and MRF repairs the shard, but the DROP itself
+# must be countable — a drive that persistently finishes-then-fails
+# just past the grace window looks healthy in the error columns unless
+# its discarded failures are tallied somewhere. Module counters for
+# tests; mirrored onto the metrics endpoint when a registry is
+# installed (server boot calls set_metrics, same pattern as
+# erasure/streaming.py).
+LATE_DROPS = {"errors": 0, "results": 0}
+_late_mu = threading.Lock()
+_metrics = None
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    _metrics = registry
+
+
+def _note_late_drop(err) -> None:
+    key = "errors" if err is not None else "results"
+    with _late_mu:
+        LATE_DROPS[key] += 1
+    if _metrics is not None:
+        _metrics.inc(f"fanout_late_dropped_{key}_total")
+
 # Admission control for the CPU-bound encode+hash+write section of PUT
 # and multipart part uploads: at most cpu_count streams run it
 # concurrently; excess uploads queue, and a queue wait past the deadline
@@ -188,7 +213,10 @@ class QuorumFanout:
                 if i in detached:
                     # Straggler finished after detach: result discarded
                     # (its slot already carries the timeout; MRF/heal
-                    # repairs whatever it missed); worker freed.
+                    # repairs whatever it missed); worker freed. The
+                    # discard is counted — a drive that keeps failing
+                    # just past the grace window must not be invisible.
+                    _note_late_drop(err)
                     self._release(i)
                     cv.notify_all()
                     return

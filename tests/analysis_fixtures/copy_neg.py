@@ -1,0 +1,17 @@
+"""copy-lint NEGATIVE fixture: views, routed copies, meta annotations
+— none of this may produce a finding."""
+import numpy as np
+
+from minio_tpu.pipeline.buffers import copy_add
+
+
+def accounted(src, arr):
+    raw = src.read(4096)
+    view = memoryview(raw)[:128]          # view, not a copy
+    # copy-ok: fixture.stage — routed through CopyCounters below
+    staged = arr.tobytes()
+    copy_add("fixture.stage", len(staged))
+    small = arr[:1].tobytes()  # copy-ok: meta (bounded header bytes)
+    strips = np.empty((4, 64), dtype=np.uint8)
+    row = strips[0]                       # ndarray slice = view
+    return view, staged, small, row

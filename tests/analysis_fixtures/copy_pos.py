@@ -1,0 +1,22 @@
+"""copy-lint POSITIVE fixture: unannotated hot-path copies.
+
+Parsed by tests/test_static_analysis.py, never imported — this is what
+a regression looks like, preserved as the rule's falsifiability proof.
+"""
+import numpy as np
+
+
+def leak_copies(src, arr):
+    raw = src.read(4096)
+    head = raw[:128]                      # bytes slice -> copy
+    as_b = bytes(arr)                     # bytes() materialization
+    flat = arr.tobytes()                  # tobytes copy
+    dup = np.copy(arr)                    # np.copy
+    contig = np.ascontiguousarray(arr)    # contiguity copy
+    clone = arr.copy()                    # method copy
+    return head, as_b, flat, dup, contig, clone
+
+
+def bad_label(arr):
+    # copy-ok: no.such.counter — label feeds no copy_add in this module
+    return arr.tobytes()

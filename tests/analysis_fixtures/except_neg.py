@@ -1,0 +1,43 @@
+"""except-lint NEGATIVE fixture: logged, counted, narrow, re-raised,
+or explicitly waived — no findings."""
+import logging
+
+log = logging.getLogger(__name__)
+FAILS = {"n": 0}
+
+
+def records(store):
+    try:
+        store.flush()
+    except Exception as exc:
+        log.warning("flush failed: %s", exc)
+
+
+def counts(store):
+    try:
+        store.flush()
+    except Exception:
+        FAILS["n"] += 1  # counted: retry next tick
+
+
+def reraises(store):
+    try:
+        store.flush()
+    except Exception:
+        store.teardown()
+        raise
+
+
+def narrow(path):
+    try:
+        open(path).close()
+    except FileNotFoundError:
+        pass  # narrow type: not in scope for this rule
+
+
+def waived(sock):
+    try:
+        sock.close()
+    # except-ok: best-effort teardown, the process is exiting
+    except Exception:
+        pass

@@ -1,0 +1,15 @@
+"""except-lint POSITIVE fixture: broad handlers that drop the error."""
+
+
+def drops(store):
+    try:
+        store.flush()
+    except Exception:
+        pass
+
+
+def drops_bare(x):
+    try:
+        return 1 / x
+    except:  # noqa: E722
+        return None

@@ -1,0 +1,46 @@
+"""jax-lint NEGATIVE fixture: cached compiles, overlapped D2H,
+hashable statics — no findings."""
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=8)
+def cached(shape):
+    return jax.jit(lambda x: x + 1)
+
+
+class Codec:
+    def __init__(self):
+        self._fns = {}
+
+    def get(self, key, impl):
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(impl)
+            self._fns.setdefault(key, fn)
+        return fn
+
+
+_top = jax.jit(lambda x: x)  # module level: compiled exactly once
+
+_h = jax.jit(lambda a, b: b, static_argnums=(0,))
+
+
+def good_static(x):
+    return _h((1, 2), x)  # tuple static arg hashes fine
+
+
+def overlapped(codec, batches):
+    """The 2-deep ring: sync the PREVIOUS batch while this one runs."""
+    pending = None
+    outs = []
+    for b in batches:
+        fut = codec.encode_async(b)
+        if pending is not None:
+            outs.append(np.asarray(pending))
+        pending = fut
+    if pending is not None:
+        outs.append(np.asarray(pending))
+    return outs

@@ -1,0 +1,36 @@
+"""jax-lint POSITIVE fixture: every dispatch-hygiene violation class.
+Parsed only — jax is never actually imported at test time."""
+import jax
+import numpy as np
+
+
+def per_call_compile(f, x):
+    return jax.jit(f)(x)              # jit-then-call
+
+
+def loop_compile(f, xs):
+    outs = []
+    for x in xs:
+        g = jax.jit(f)                # jit constructed inside a loop
+        outs.append(g(x))
+    return outs
+
+
+def uncached(f):
+    g = jax.jit(f)                    # no cache idiom in scope
+    return g
+
+
+_g = jax.jit(lambda a, b: b, static_argnums=(0,))
+
+
+def bad_static(x):
+    return _g([1, 2], x)              # non-hashable static arg
+
+
+def serial_sync(codec, batches):
+    outs = []
+    for b in batches:
+        fut = codec.encode_async(b)
+        outs.append(np.asarray(fut))  # same-iteration D2H sync
+    return outs

@@ -1,0 +1,26 @@
+"""lock-lint NEGATIVE fixture: fast critical sections, Condition
+waits, and an annotated deliberate site — no findings."""
+import threading
+import time
+
+_mu = threading.Lock()
+_cv = threading.Condition()
+
+
+def ok_fast():
+    with _mu:
+        x = 1 + 1
+    time.sleep(0)  # outside the lock
+    return x
+
+
+def ok_condition_wait():
+    # Conditions are excluded: waiting under one is their purpose.
+    with _cv:
+        _cv.wait(0.01)
+
+
+def ok_waived(sock):
+    # lock-ok: connection serialization lock; guards only this socket
+    with _mu:
+        sock.sendall(b"x")

@@ -1,0 +1,27 @@
+"""lock-lint POSITIVE fixture: blocking work under a threading.Lock
+and a manual acquire outside `with`."""
+import threading
+import time
+
+_mu = threading.Lock()
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)               # sleep under lock
+
+    def bad_rpc(self, client, fut):
+        with _mu:
+            client.call("ping", {})       # RPC under lock
+            fut.result()                  # future wait under lock
+
+    def bad_manual(self):
+        self._lock.acquire()              # acquire outside with
+        try:
+            return 1
+        finally:
+            self._lock.release()

@@ -1,0 +1,34 @@
+"""pool-lint NEGATIVE fixture: every accepted protection shape —
+try/finally, acquire-then-try, ownership transfer, annotation."""
+from minio_tpu.pipeline.buffers import BufferPool
+
+pool = BufferPool(lambda: bytearray(16))
+
+
+def safe_finally(n):
+    buf = pool.acquire()
+    try:
+        if n > 3:
+            raise ValueError("boom")
+        return buf[0]
+    finally:
+        pool.release(buf)
+
+
+def safe_handler(n):
+    buf = pool.acquire()
+    try:
+        return buf[n]
+    except IndexError:
+        pool.release(buf)
+        raise
+
+
+def transfer():
+    return pool.acquire()  # ownership moves to the caller
+
+
+def waived():
+    # pool-ok: ownership moves into the caller-managed item list
+    buf = pool.acquire()
+    return [buf, None]

@@ -1,0 +1,13 @@
+"""pool-lint POSITIVE fixture: a checkout with no release on the
+exception edge."""
+from minio_tpu.pipeline.buffers import BufferPool
+
+pool = BufferPool(lambda: bytearray(16))
+
+
+def leaky(n):
+    buf = pool.acquire()
+    if n > 3:
+        raise ValueError("boom")  # buffer leaked on this edge
+    pool.release(buf)
+    return n

@@ -257,3 +257,17 @@ def test_pipeline_executor_smoke():
     stats = pipe.stage_stats()
     assert stats["a"]["items"] == 16
     assert stats["b"]["bytes"] == 16 * 8
+
+
+def test_bench_records_analysis_gate_cost():
+    """The tier-1 static-analysis gate's wall-time rides in every bench
+    record (ISSUE 6 satellite): a rule whose AST walk goes quadratic
+    must show up as a number, not as mystery CI latency."""
+    import bench
+
+    gate = bench.bench_analysis_gate()
+    assert gate["files_scanned"] > 100, gate
+    assert 0 < gate["wall_time_s"] < 60, gate
+    # The repo itself must be clean — same invariant the tier-1 gate
+    # (test_static_analysis) enforces, visible here as a zero.
+    assert gate["findings_new"] == 0, gate

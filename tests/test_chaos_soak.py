@@ -35,6 +35,27 @@ GRACE_S = 0.2
 STALL_BOUND_S = OP_DEADLINE_S + GRACE_S + 6.0
 
 
+@pytest.fixture(autouse=True)
+def _lockgraph_armed():
+    """Arm the runtime lock-order checker for the soak: the chaos
+    schedule drives every fan-out/breaker/heal lock path; the teardown
+    asserts the acquisition graph stayed cycle-free and surfaces
+    hold-time outliers in the failure message if it did not."""
+    from tools.analysis import lockgraph
+
+    lockgraph.reset()
+    lockgraph.enable()
+    try:
+        yield lockgraph
+    finally:
+        lockgraph.disable()
+        report = lockgraph.report()
+        lockgraph.reset()
+        assert report["cycles"] == [], (
+            f"lock acquisition-order cycles under chaos soak: {report}"
+        )
+
+
 @pytest.mark.slow
 def test_chaos_soak_no_stall_no_loss(tmp_path):
     with robust_overrides(op_deadline_s=OP_DEADLINE_S,

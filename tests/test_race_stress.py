@@ -27,8 +27,38 @@ from minio_tpu.utils.errors import ErrObjectNotFound, StorageError
 DEP = "aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee"
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lockgraph_armed():
+    """Arm the runtime lock-order checker (tools/analysis/lockgraph)
+    for the whole stress module: every lock created by the object
+    layer under test feeds the acquisition graph, and any A->B / B->A
+    ordering observed across these deliberately racy interleavings
+    fails the module even if no run actually deadlocked."""
+    from tools.analysis import lockgraph
+
+    lockgraph.reset()
+    lockgraph.enable()
+    try:
+        yield lockgraph
+    finally:
+        lockgraph.disable()
+        cycles = lockgraph.GRAPH.cycles()
+        lockgraph.reset()
+        assert not cycles, (
+            f"lock acquisition-order cycles under race stress: {cycles}"
+        )
+
+
+@pytest.fixture(autouse=True)
+def _no_cycles_after_each(_lockgraph_armed):
+    """Per-test cycle check so a failure names the test that first
+    produced the bad ordering, not just the module."""
+    yield
+    _lockgraph_armed.assert_no_cycles()
+
+
 @pytest.fixture()
-def ol(tmp_path):
+def ol(tmp_path, _lockgraph_armed):
     disks = [
         LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
         for i in range(4)

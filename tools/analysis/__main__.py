@@ -1,0 +1,53 @@
+"""``python -m tools.analysis`` — run the full scan, print the JSON
+report to stdout, exit non-zero when any finding is not pinned in
+baseline.json.
+
+Options:
+  --write-baseline   accept every current finding into baseline.json
+                     (prints the report for the PRE-acceptance state)
+  --no-baseline      raw scan: report everything as new, exit by it
+  --all-rules        apply every rule to every file (ignore scopes)
+  --quiet            print only the summary counts line
+  [paths...]         restrict the scan to these repo-relative files
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import load_baseline, run, write_baseline
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analysis")
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--all-rules", action="store_true")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    baseline = {} if args.no_baseline else load_baseline()
+    report = run(
+        paths=args.paths or None,
+        force_all_rules=args.all_rules,
+        baseline=baseline,
+    )
+    if args.write_baseline:
+        n = write_baseline(report)
+        print(f"baseline: pinned {n} finding(s)", file=sys.stderr)
+    if args.quiet:
+        d = report.to_dict()
+        print(json.dumps({"counts": d["counts"],
+                          "wall_time_s": d["wall_time_s"]}))
+    else:
+        print(json.dumps(report.to_dict(), indent=2))
+    if report.parse_errors:
+        return 2
+    return 0 if (args.write_baseline or not report.new) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Shared AST plumbing for the lint rules: parsed-module context with
+parent links, comment/annotation maps, and small node helpers.
+
+Waiver annotations are per-rule comments on the flagged line (or the
+line above it)::
+
+    x = buf.tobytes()  # copy-ok: put.tail_copy
+    # lock-ok: drain serialization lock, guards no hot state
+    with self._drain_mu:
+
+The annotation silences the rule at that site; copy-lint additionally
+validates that the label names a real CopyCounters site (see
+copy_lint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_ANN_RE = re.compile(
+    r"#\s*(copy|lock|pool|jax|except)-ok:\s*(\S[^#]*)"
+)
+
+
+@dataclass
+class ModuleContext:
+    """One parsed source file, shared by every rule that scans it."""
+
+    relpath: str
+    source: str
+    tree: ast.AST
+    lines: list[str]
+    # lineno -> {rule_key: reason} parsed from `# <rule>-ok:` comments.
+    annotations: dict[int, dict[str, str]] = field(default_factory=dict)
+
+    def annotation(self, rule_key: str, lineno: int) -> str | None:
+        """Waiver reason for `rule_key` at `lineno`: the marker may sit
+        on the flagged line itself or anywhere in the contiguous
+        comment block directly above it."""
+        ann = self.annotations.get(lineno)
+        if ann and rule_key in ann:
+            return ann[rule_key]
+        ln = lineno - 1
+        while ln >= 1 and self.line_text(ln).startswith("#"):
+            ann = self.annotations.get(ln)
+            if ann and rule_key in ann:
+                return ann[rule_key]
+            ln -= 1
+        return None
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the enclosing function/class chain —
+        the stable half of a finding's fingerprint (line numbers
+        shift; scopes rarely do)."""
+        parts: list[str] = []
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = getattr(cur, "_parent", None)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def enclosing_function(self, node: ast.AST):
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_parent", None)
+        return None
+
+    def ancestors(self, node: ast.AST):
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_parent", None)
+
+
+def parse_module(relpath: str, source: str) -> ModuleContext:
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._parent = node  # type: ignore[attr-defined]
+    annotations: dict[int, dict[str, str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ANN_RE.search(tok.string)
+            if m:
+                annotations.setdefault(tok.start[0], {})[m.group(1)] = (
+                    m.group(2).strip()
+                )
+    except tokenize.TokenError:
+        pass
+    return ModuleContext(
+        relpath=relpath,
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+        annotations=annotations,
+    )
+
+
+# --- small node predicates shared across rules ---
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called thing: `np.copy(...)` -> "copy",
+    `bytes(...)` -> "bytes"."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering: `np.copy` -> "np.copy",
+    `self._mu` -> "self._mu"; "" for non-name expressions."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def receiver_of(node: ast.Call) -> ast.AST | None:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.value
+    return None
+
+
+def stmt_of(ctx: ModuleContext, node: ast.AST) -> ast.stmt | None:
+    """Nearest enclosing statement node."""
+    cur: ast.AST | None = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = getattr(cur, "_parent", None)
+    return cur
+
+
+def body_and_index(stmt: ast.stmt) -> tuple[list | None, int]:
+    """(containing body list, index of stmt in it) — for next-sibling
+    lookups in the pool-pairing rule."""
+    parent = getattr(stmt, "_parent", None)
+    if parent is None:
+        return None, -1
+    for fieldname in ("body", "orelse", "finalbody"):
+        body = getattr(parent, fieldname, None)
+        if isinstance(body, list) and stmt in body:
+            return body, body.index(stmt)
+    return None, -1

@@ -1,0 +1,173 @@
+"""copy-lint: unaccounted data copies in the erasure hot path.
+
+PR 3 stripped the PUT path to exactly one copy per payload byte and
+pinned it with CopyCounters; this rule keeps the next change honest.
+In the hot-path modules it flags the copy-producing constructs —
+``bytes(x)``, ``.tobytes()``, ``np.copy`` / ``.copy()``,
+``ascontiguousarray``, and slices of bytes-typed locals (bytes slicing
+copies; ndarray slicing does not) — unless the site carries a
+``# copy-ok: <site>`` annotation.
+
+The annotation label is validated: it must either name a CopyCounters
+site that a ``copy_add("<site>", ...)`` call in the same module
+actually feeds, or be the literal ``meta`` (bounded non-payload bytes:
+digests, error paths, metadata packs — document the judgment in
+docs/ANALYSIS.md). An annotation whose label is neither is itself a
+finding, so a stale label cannot silently un-count a copy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding
+
+KEY = "copy"
+
+HOT_PATHS = {
+    "minio_tpu/erasure/streaming.py",
+    "minio_tpu/erasure/device_engine.py",
+    "minio_tpu/parallel/mesh_engine.py",
+    "minio_tpu/storage/local.py",
+}
+HOT_PREFIXES = ("minio_tpu/ops/",)
+
+# Labels exempt from copy_add routing: bounded, non-payload bytes.
+META_LABEL = "meta"
+
+_COPY_CALLS = {"tobytes", "ascontiguousarray"}
+
+
+class CopyLint:
+    name = "copy-lint"
+
+    def applies(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return rel in HOT_PATHS or rel.startswith(HOT_PREFIXES)
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        routed = _copy_add_labels(ctx)
+        # Validate annotations first: every copy-ok label must be
+        # routed through CopyCounters (or be the documented 'meta').
+        for lineno, anns in sorted(ctx.annotations.items()):
+            reason = anns.get(KEY)
+            if reason is None:
+                continue
+            # The label is the first token; anything after it is
+            # free-form commentary ("# copy-ok: put.tail_copy — why").
+            label = reason.split()[0]
+            if label != META_LABEL and label not in routed:
+                yield Finding(
+                    rule=self.name, path=ctx.relpath, line=lineno, col=0,
+                    scope="<annotation>",
+                    message=(
+                        f"copy-ok label '{label}' is not fed by any "
+                        f"copy_add() in this module — route the copy "
+                        f"through pipeline/buffers.CopyCounters or use "
+                        f"'meta' for bounded non-payload bytes"
+                    ),
+                    snippet=ctx.line_text(lineno),
+                )
+        bytes_locals = _bytes_typed_locals(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                msg = self._copy_call(node)
+                if msg and ctx.annotation(KEY, node.lineno) is None:
+                    yield self._finding(ctx, node, msg)
+            elif isinstance(node, ast.Subscript):
+                msg = self._bytes_slice(ctx, node, bytes_locals)
+                if msg and ctx.annotation(KEY, node.lineno) is None:
+                    yield self._finding(ctx, node, msg)
+
+    def _copy_call(self, node: ast.Call) -> str | None:
+        name = astutil.call_name(node)
+        dotted = astutil.dotted_name(node.func)
+        if name == "bytes" and isinstance(node.func, ast.Name) \
+                and node.args:
+            return "bytes(...) materializes a full copy"
+        if name in _COPY_CALLS:
+            return f"{name}() materializes a full copy"
+        if name == "copy" and dotted.startswith(("np.", "numpy.")):
+            return "np.copy() materializes a full copy"
+        if name == "copy" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            return ".copy() materializes a full copy"
+        return None
+
+    def _bytes_slice(self, ctx, node: ast.Subscript,
+                     bytes_locals: dict) -> str | None:
+        if not isinstance(node.slice, ast.Slice):
+            return None
+        if not isinstance(node.ctx, ast.Load):
+            return None
+        if not isinstance(node.value, ast.Name):
+            return None
+        fn = ctx.enclosing_function(node)
+        names = bytes_locals.get(id(fn), set())
+        if node.value.id in names:
+            return (
+                f"slicing bytes local '{node.value.id}' copies the "
+                f"slice (use a memoryview)"
+            )
+        return None
+
+    def _finding(self, ctx, node, msg) -> Finding:
+        return Finding(
+            rule=self.name, path=ctx.relpath, line=node.lineno,
+            col=node.col_offset, scope=ctx.scope_of(node),
+            message=msg, snippet=ctx.line_text(node.lineno),
+        )
+
+
+def _copy_add_labels(ctx: astutil.ModuleContext) -> set[str]:
+    """String labels fed to copy_add(...) / COPY.add(...) /
+    ascontig_counted(_, label) anywhere in the module — the set a
+    copy-ok annotation may legitimately name."""
+    labels: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name not in ("copy_add", "add", "ascontig_counted"):
+            continue
+        if name == "add":
+            dotted = astutil.dotted_name(node.func)
+            if not dotted.endswith("COPY.add"):
+                continue
+        label_arg = 1 if name == "ascontig_counted" else 0
+        if len(node.args) > label_arg \
+                and isinstance(node.args[label_arg], ast.Constant) \
+                and isinstance(node.args[label_arg].value, str):
+            labels.add(node.args[label_arg].value)
+    return labels
+
+
+def _bytes_typed_locals(ctx: astutil.ModuleContext) -> dict:
+    """Per-function names provably bound to bytes: assigned from
+    ``.read(...)``, ``.tobytes()``, ``bytes(...)`` or a bytes literal.
+    Intra-function, flow-insensitive — deliberately narrow so the slice
+    sub-rule has no false positives on ndarray views."""
+    out: dict[int, set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            continue
+        val = node.value
+        is_bytes = False
+        if isinstance(val, ast.Constant) and isinstance(val.value, bytes):
+            is_bytes = True
+        elif isinstance(val, ast.Call):
+            cname = astutil.call_name(val)
+            if cname in ("read", "tobytes", "bytes"):
+                is_bytes = True
+        if is_bytes:
+            fn = ctx.enclosing_function(node)
+            out.setdefault(id(fn), set()).add(node.targets[0].id)
+    return out
+
+
+RULE = CopyLint()

@@ -1,0 +1,243 @@
+"""Analysis engine: file discovery, rule dispatch, baseline matching,
+JSON report.
+
+Findings are identified by a content fingerprint — (rule, path,
+enclosing scope, normalized source line) — NOT by line number, so an
+unrelated edit above a waived site does not resurrect it. The baseline
+(tools/analysis/baseline.json) pins accepted pre-existing findings;
+anything not in it is NEW and fails the tier-1 gate
+(tests/test_static_analysis.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from . import astutil
+
+# Scanned tree: the package, the analysis tooling itself (self-check),
+# and the top-level drivers. tests/ stays out — fixture files contain
+# deliberate violations.
+SCAN_ROOTS = ("minio_tpu", "tools")
+SCAN_FILES = ("bench.py", "__graft_entry__.py")
+
+BASELINE_NAME = "baseline.json"
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    scope: str
+    message: str
+    snippet: str
+    # "baseline" or "" — annotation-waived sites never become findings.
+    waived_by: str = ""
+    # Ordinal among same-(rule,scope,snippet) findings in this file,
+    # assigned by run(): a copy-pasted second occurrence of a waived
+    # line fingerprints differently and stays NEW.
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        raw = (f"{self.rule}|{self.path}|{self.scope}|{self.snippet}"
+               f"|{self.occurrence}")
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "scope": self.scope,
+            "message": self.message,
+            "snippet": self.snippet,
+            "occurrence": self.occurrence,
+            "fingerprint": self.fingerprint,
+            "waived_by": self.waived_by,
+        }
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: list[dict] = field(default_factory=list)
+    wall_time_s: float = 0.0
+    baseline_size: int = 0
+
+    @property
+    def new(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived_by]
+
+    @property
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived_by]
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "wall_time_s": round(self.wall_time_s, 3),
+            "baseline_size": self.baseline_size,
+            "counts": {
+                "total": len(self.findings),
+                "new": len(self.new),
+                "waived": len(self.waived),
+            },
+            "by_rule": self._by_rule(),
+            "new_findings": [f.to_dict() for f in self.new],
+            "waived_findings": [f.to_dict() for f in self.waived],
+            "parse_errors": self.parse_errors,
+        }
+
+    def _by_rule(self) -> dict:
+        out: dict[str, dict] = {}
+        for f in self.findings:
+            d = out.setdefault(f.rule, {"new": 0, "waived": 0})
+            d["waived" if f.waived_by else "new"] += 1
+        return out
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        BASELINE_NAME)
+
+
+def all_rules() -> list:
+    from . import (
+        copy_lint,
+        except_lint,
+        jax_lint,
+        lock_lint,
+        pool_lint,
+    )
+
+    return [
+        copy_lint.RULE,
+        lock_lint.RULE,
+        pool_lint.RULE,
+        jax_lint.RULE,
+        except_lint.RULE,
+    ]
+
+
+def discover(root: str) -> list[str]:
+    """Repo-relative paths of every scanned source file, sorted for
+    stable report ordering."""
+    out: list[str] = []
+    for top in SCAN_ROOTS:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root
+                    ))
+    for fn in SCAN_FILES:
+        if os.path.exists(os.path.join(root, fn)):
+            out.append(fn)
+    return sorted(out)
+
+
+def load_baseline(path: str | None = None) -> dict[str, dict]:
+    """fingerprint -> waiver entry. Missing file = empty baseline."""
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {w["fingerprint"]: w for w in data.get("waivers", [])}
+
+
+def write_baseline(report: Report, path: str | None = None) -> int:
+    """Pin every current finding (new and already-waived) as accepted.
+    The waiver entry carries the human-readable site info so a reviewer
+    can audit baseline.json without re-running the scan."""
+    path = path or baseline_path()
+    waivers = [
+        {
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "scope": f.scope,
+            "snippet": f.snippet,
+            "message": f.message,
+        }
+        for f in sorted(report.findings,
+                        key=lambda f: (f.rule, f.path, f.line))
+    ]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "waivers": waivers}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+    return len(waivers)
+
+
+def run(root: str | None = None, paths: list[str] | None = None,
+        force_all_rules: bool = False,
+        baseline: dict | None = None,
+        use_baseline: bool = True) -> Report:
+    """Scan and return the Report.
+
+    root            repo root (auto-detected by default)
+    paths           explicit repo-relative (or absolute) file list;
+                    default = full repo scan
+    force_all_rules apply every rule to every file regardless of its
+                    scope filter (the fixture harness uses this)
+    baseline        fingerprint->entry map; None loads baseline.json
+                    (pass use_baseline=False for a raw scan)
+    """
+    t0 = time.perf_counter()
+    root = root or repo_root()
+    rules = all_rules()
+    if baseline is None and use_baseline:
+        baseline = load_baseline()
+    baseline = baseline or {}
+    rel_paths = paths if paths is not None else discover(root)
+
+    report = Report(baseline_size=len(baseline))
+    for rel in rel_paths:
+        full = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as f:
+                source = f.read()
+            ctx = astutil.parse_module(rel, source)
+        except (OSError, SyntaxError, ValueError) as exc:
+            report.parse_errors.append({"path": rel, "error": str(exc)})
+            continue
+        report.files_scanned += 1
+        file_findings: list[Finding] = []
+        for rule in rules:
+            if not force_all_rules and not rule.applies(rel):
+                continue
+            file_findings.extend(rule.check(ctx))
+        # Disambiguate identical (rule, scope, snippet) findings by
+        # source order before baseline matching, so one waiver covers
+        # exactly one site.
+        seen: dict[tuple, int] = {}
+        for finding in sorted(file_findings,
+                              key=lambda f: (f.line, f.col, f.rule)):
+            key = (finding.rule, finding.scope, finding.snippet)
+            finding.occurrence = seen.get(key, 0)
+            seen[key] = finding.occurrence + 1
+            if finding.fingerprint in baseline:
+                finding.waived_by = "baseline"
+            report.findings.append(finding)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.wall_time_s = time.perf_counter() - t0
+    return report

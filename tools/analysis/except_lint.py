@@ -1,0 +1,100 @@
+"""except-lint: swallowed errors on quorum and delivery paths.
+
+Scope: ``utils/fanout.py``, ``distributed/``, ``event/`` (the fan-out
+and notification delivery planes, where a silently dropped error is a
+quorum miscount or an invisible outage) plus ``tools/analysis/`` so
+the analyzer holds itself to the rule.
+
+Flags a bare ``except:`` or broad ``except Exception/BaseException:``
+whose handler *drops* the error — no re-raise, no use of the bound
+exception, and no recording call (logging, metrics ``inc``, counter
+``record``/``note``/``add``). ``pass``-bodies on a quorum-relevant
+failure are exactly the bug class this exists for. Waive deliberate
+best-effort sites with ``# except-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding
+
+KEY = "except"
+
+SCOPES = (
+    "minio_tpu/utils/fanout.py",
+    "minio_tpu/distributed/",
+    "minio_tpu/event/",
+    "tools/analysis/",
+)
+
+_BROAD = {"Exception", "BaseException"}
+_RECORD_HINTS = (
+    "log", "warn", "error", "exception", "inc", "record", "note",
+    "metric", "count", "add", "print", "append",
+)
+
+
+class ExceptLint:
+    name = "except-lint"
+
+    def applies(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return rel.startswith(SCOPES) or rel in SCOPES
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node):
+                continue
+            if ctx.annotation(KEY, node.lineno) is not None:
+                continue
+            if self._handler_records(node):
+                continue
+            yield Finding(
+                rule=self.name, path=ctx.relpath, line=node.lineno,
+                col=node.col_offset, scope=ctx.scope_of(node),
+                message=(
+                    "broad except drops the error — count it, log it, "
+                    "or re-raise (never 'pass' a quorum-relevant "
+                    "failure); waive with '# except-ok: <reason>'"
+                ),
+                snippet=ctx.line_text(node.lineno),
+            )
+
+    def _is_broad(self, node: ast.ExceptHandler) -> bool:
+        if node.type is None:
+            return True  # bare except
+        types = (node.type.elts if isinstance(node.type, ast.Tuple)
+                 else [node.type])
+        for t in types:
+            if astutil.dotted_name(t).rsplit(".", 1)[-1] in _BROAD:
+                return True
+        return False
+
+    def _handler_records(self, node: ast.ExceptHandler) -> bool:
+        # Re-raise anywhere in the handler keeps the error alive.
+        for sub in ast.walk(ast.Module(body=list(node.body),
+                                       type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                return True
+            # A counter latch (`FAILS["n"] += 1`, `self.drops += 1`)
+            # records the failure even without touching the exception.
+            if isinstance(sub, ast.AugAssign):
+                return True
+            # The bound exception being USED (assigned somewhere,
+            # appended, passed along) means it is not dropped.
+            if node.name and isinstance(sub, ast.Name) \
+                    and sub.id == node.name:
+                return True
+            if isinstance(sub, ast.Call):
+                leaf = astutil.call_name(sub).lower()
+                if any(h in leaf for h in _RECORD_HINTS):
+                    return True
+        return False
+
+
+RULE = ExceptLint()

@@ -1,0 +1,255 @@
+"""jax-lint: jit dispatch hygiene on the device/mesh hot path.
+
+The device engine's whole throughput story (PR 4/5) is one dispatch per
+batch, zero steady-state retraces, donated staged buffers, and async
+D2H. Each sub-rule guards one of the ways a refactor silently regresses
+that:
+
+- **jit-in-loop / jit-then-call**: ``jax.jit(...)`` constructed inside
+  a loop, or immediately invoked (``jax.jit(f)(x)``), compiles at call
+  frequency instead of once.
+- **uncached jit**: a jit built inside a function with no caching idiom
+  in sight (no ``lru_cache``-style decorator, no ``setdefault``/dict
+  store of the compiled fn) recompiles per call.
+- **non-hashable static arg**: calling a same-module jitted binding
+  with a list/dict/set literal in a ``static_argnums`` position raises
+  at runtime (or retraces forever with unhashable-workarounds).
+- **missing donate_argnums**: jits in the staged-buffer modules
+  (device_engine, mesh_engine, parallel/sharded) must donate their
+  input batch or the device arena grows per batch.
+- **sync D2H in batch loop**: ``np.asarray`` / ``np.array`` /
+  ``.block_until_ready()`` on a value dispatched *in the same loop
+  body* serializes H2D -> compute -> D2H and kills the overlap ring
+  (the correct shape syncs the PREVIOUS iteration's future).
+
+Only modules that textually import jax are checked. Waive deliberate
+sites with ``# jax-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding
+
+KEY = "jax"
+
+# Only the SERVING engines: their contract is a host-staged batch the
+# caller never reads back, so the device copy must be donated.
+# parallel/sharded.py (the SPMD proving ground) keeps device-resident
+# stripes the caller reuses — donation is inapplicable there.
+DONATE_REQUIRED = {
+    "minio_tpu/erasure/device_engine.py",
+    "minio_tpu/parallel/mesh_engine.py",
+}
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+_DISPATCHY_SUFFIXES = ("_async",)
+_DISPATCHY_NAMES = {"device_put"}
+_SYNC_CALLS = {"asarray", "array", "block_until_ready"}
+
+
+class JaxLint:
+    name = "jax-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return True  # gated on the module actually importing jax
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        if not _imports_jax(ctx):
+            return
+        jit_bindings: dict[str, tuple] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node):
+                yield from self._check_jit_site(ctx, node)
+                _record_binding(node, jit_bindings)
+            elif isinstance(node, (ast.For, ast.While)):
+                yield from self._check_loop_sync(ctx, node)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_static_args(ctx, node,
+                                                   jit_bindings)
+
+    # --- jit construction sites ---
+
+    def _check_jit_site(self, ctx, node: ast.Call) -> Iterator[Finding]:
+        if ctx.annotation(KEY, node.lineno) is not None:
+            return
+        parent = getattr(node, "_parent", None)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            yield self._finding(
+                ctx, node,
+                "jit-then-call compiles a fresh function per "
+                "invocation — bind the jitted fn once and reuse it",
+            )
+            return
+        in_loop = any(isinstance(a, (ast.For, ast.While))
+                      for a in ctx.ancestors(node))
+        if in_loop:
+            yield self._finding(
+                ctx, node,
+                "jax.jit constructed inside a loop — retrace risk; "
+                "hoist the compile out of the loop",
+            )
+            return
+        fn = ctx.enclosing_function(node)
+        if fn is not None and not _has_cache_idiom(fn):
+            yield self._finding(
+                ctx, node,
+                f"jax.jit inside {fn.name}() with no compiled-function "
+                f"cache (lru_cache / setdefault / keyed dict store) — "
+                f"recompiles at call frequency",
+            )
+            return
+        if ctx.relpath.replace("\\", "/") in DONATE_REQUIRED \
+                and not _has_kw(node, "donate_argnums"):
+            yield self._finding(
+                ctx, node,
+                "staged-buffer jit without donate_argnums — the device "
+                "arena grows by one input batch per dispatch",
+            )
+
+    # --- non-hashable static args at same-module call sites ---
+
+    def _check_static_args(self, ctx, node: ast.Call,
+                           bindings: dict) -> Iterator[Finding]:
+        if not isinstance(node.func, ast.Name):
+            return
+        info = bindings.get(node.func.id)
+        if info is None:
+            return
+        static_positions = info
+        for pos in static_positions:
+            if pos < len(node.args) and isinstance(
+                    node.args[pos],
+                    (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)):
+                if ctx.annotation(KEY, node.lineno) is not None:
+                    continue
+                yield self._finding(
+                    ctx, node,
+                    f"non-hashable literal passed in static_argnums "
+                    f"position {pos} of jitted '{node.func.id}' — "
+                    f"static args must hash (use a tuple)",
+                )
+
+    # --- sync inside the dispatch loop ---
+
+    def _check_loop_sync(self, ctx, loop) -> Iterator[Finding]:
+        dispatched: dict[str, int] = {}
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call):
+                    cname = astutil.call_name(sub.value)
+                    if cname.endswith(_DISPATCHY_SUFFIXES) \
+                            or cname in _DISPATCHY_NAMES:
+                        for tgt in sub.targets:
+                            for n in ast.walk(tgt):
+                                if isinstance(n, ast.Name):
+                                    dispatched[n.id] = sub.lineno
+        if not dispatched:
+            return
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = astutil.call_name(sub)
+                if name not in _SYNC_CALLS:
+                    continue
+                target = None
+                if name == "block_until_ready":
+                    target = astutil.receiver_of(sub)
+                elif sub.args:
+                    target = sub.args[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                disp_line = dispatched.get(target.id)
+                if disp_line is None or sub.lineno <= disp_line:
+                    continue  # syncing a PREVIOUS iteration's future
+                if ctx.annotation(KEY, sub.lineno) is not None:
+                    continue
+                yield self._finding(
+                    ctx, sub,
+                    f"synchronous D2H of '{target.id}' in the same "
+                    f"loop iteration that dispatched it — serializes "
+                    f"transfer/compute; sync the previous batch "
+                    f"instead",
+                )
+
+    def _finding(self, ctx, node, msg) -> Finding:
+        return Finding(
+            rule=self.name, path=ctx.relpath, line=node.lineno,
+            col=node.col_offset, scope=ctx.scope_of(node),
+            message=msg, snippet=ctx.line_text(node.lineno),
+        )
+
+
+def _imports_jax(ctx) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax"
+                                or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = astutil.call_name(node)
+    if name not in ("jit", "pjit"):
+        return False
+    # `jax.jit(...)` / `jit(...)` / `pjit.pjit(...)` all count; plain
+    # method calls named .jit on arbitrary objects do not exist in
+    # this codebase.
+    return True
+
+
+def _has_kw(node: ast.Call, kw: str) -> bool:
+    return any(k.arg == kw for k in node.keywords)
+
+
+def _has_cache_idiom(fn) -> bool:
+    for dec in fn.decorator_list:
+        d = astutil.dotted_name(dec if not isinstance(dec, ast.Call)
+                                else dec.func)
+        if d.rsplit(".", 1)[-1] in _CACHE_DECORATORS:
+            return True
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) \
+                and astutil.call_name(sub) == "setdefault":
+            return True
+        if isinstance(sub, ast.Assign):
+            if any(isinstance(t, ast.Subscript) for t in sub.targets):
+                return True
+    return False
+
+
+def _record_binding(node: ast.Call, bindings: dict) -> None:
+    """`g = jax.jit(f, static_argnums=(0, 2))` -> bindings["g"] =
+    (0, 2), so later same-module calls of g can be checked."""
+    parent = getattr(node, "_parent", None)
+    if not isinstance(parent, ast.Assign):
+        return
+    if len(parent.targets) != 1 \
+            or not isinstance(parent.targets[0], ast.Name):
+        return
+    positions: list[int] = []
+    for k in node.keywords:
+        if k.arg != "static_argnums":
+            continue
+        vals = (k.value.elts if isinstance(k.value, ast.Tuple)
+                else [k.value])
+        for v in vals:
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                positions.append(v.value)
+    if positions:
+        bindings[parent.targets[0].id] = tuple(positions)
+
+
+RULE = JaxLint()

@@ -1,0 +1,198 @@
+"""lock-lint: blocking work while holding a threading.Lock/RLock, and
+locks acquired outside ``with``.
+
+A blocking call under a lock turns one slow peer/disk into process-wide
+convoy — the bug class PR 2's hung-drive work existed to kill. The rule
+identifies lock objects structurally (names/attributes assigned from
+``threading.Lock()`` / ``threading.RLock()`` anywhere in the module;
+Conditions are excluded — waiting under one is their purpose) and flags,
+inside ``with <lock>:`` bodies:
+
+- ``time.sleep`` / bare ``sleep``
+- ``Future.result()`` and ``.wait()`` on anything other than the held
+  object
+- RPC calls (the project's ``.call()`` / ``.call2()`` idiom,
+  ``urlopen``, ``getresponse``, ``request``, socket ``connect`` /
+  ``recv`` / ``sendall``)
+- blocking filesystem work (``open``, ``os.replace`` / ``rename`` /
+  ``fsync`` / ``listdir``, file ``.read`` / ``.write`` / ``.flush``,
+  ``json.dump`` / ``json.load`` on streams)
+- ``subprocess`` invocations
+
+and any ``<lock>.acquire()`` call outside a ``with`` header (manual
+acquire/release pairing is what the runtime lockgraph exists to audit;
+static code should use ``with``). Waive a deliberate site with
+``# lock-ok: <reason>`` — e.g. a dedicated serialization lock that
+guards no hot state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding
+
+KEY = "lock"
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_EXCLUDE_CTORS = {"Condition", "Semaphore", "BoundedSemaphore", "Event",
+                  "Barrier"}
+
+_BLOCKING_ATTRS = {
+    "sleep", "result", "wait", "call", "call2", "urlopen", "getresponse",
+    "request", "connect", "recv", "sendall", "read", "readinto",
+    "write", "flush", "fsync", "replace", "rename", "listdir",
+    "dump", "load", "run", "check_call", "check_output", "communicate",
+    "read_chunks", "send_now",
+}
+_BLOCKING_NAMES = {"sleep", "open"}
+
+
+class LockLint:
+    name = "lock-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return True  # lock discipline is repo-wide
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        lock_vars, lock_attrs, excluded = _collect_lock_names(ctx)
+        if not lock_vars and not lock_attrs:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.With):
+                yield from self._check_with(ctx, node, lock_vars,
+                                            lock_attrs, excluded)
+            elif isinstance(node, ast.Call):
+                yield from self._check_bare_acquire(
+                    ctx, node, lock_vars, lock_attrs
+                )
+
+    def _is_lock_expr(self, expr, lock_vars, lock_attrs, excluded):
+        if isinstance(expr, ast.Name):
+            return expr.id in lock_vars and expr.id not in excluded
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in lock_attrs and expr.attr not in excluded
+        return False
+
+    def _check_with(self, ctx, node: ast.With, lock_vars, lock_attrs,
+                    excluded) -> Iterator[Finding]:
+        held = [item.context_expr for item in node.items
+                if self._is_lock_expr(item.context_expr, lock_vars,
+                                      lock_attrs, excluded)]
+        if not held:
+            return
+        held_names = {astutil.dotted_name(h) for h in held}
+        lock_desc = ", ".join(sorted(held_names))
+        for sub in _walk_no_defs(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            blocked = self._blocking_reason(sub, held_names)
+            if blocked is None:
+                continue
+            if ctx.annotation(KEY, sub.lineno) is not None:
+                continue
+            if ctx.annotation(KEY, node.lineno) is not None:
+                continue  # whole-with waiver on the `with` line
+            yield Finding(
+                rule=self.name, path=ctx.relpath, line=sub.lineno,
+                col=sub.col_offset, scope=ctx.scope_of(sub),
+                message=(
+                    f"{blocked} while holding lock {lock_desc} — "
+                    f"move the blocking work outside the critical "
+                    f"section or waive with '# lock-ok: <reason>'"
+                ),
+                snippet=ctx.line_text(sub.lineno),
+            )
+
+    def _blocking_reason(self, call: ast.Call,
+                         held_names: set[str]) -> str | None:
+        name = astutil.call_name(call)
+        if isinstance(call.func, ast.Name):
+            if name in _BLOCKING_NAMES:
+                return f"blocking call {name}()"
+            return None
+        if name not in _BLOCKING_ATTRS:
+            return None
+        recv = astutil.receiver_of(call)
+        recv_name = astutil.dotted_name(recv) if recv is not None else ""
+        # .wait() on the held object itself would be a with-Condition
+        # pattern; Conditions are excluded from the lock set anyway,
+        # but keep the guard for odd aliasing.
+        if name == "wait" and recv_name in held_names:
+            return None
+        # str.join-style false positives: literal receivers are never
+        # blocking handles.
+        if isinstance(recv, ast.Constant):
+            return None
+        return f"blocking call .{name}()"
+
+    def _check_bare_acquire(self, ctx, node: ast.Call, lock_vars,
+                            lock_attrs) -> Iterator[Finding]:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "acquire":
+            return
+        recv = node.func.value
+        is_lock = (
+            (isinstance(recv, ast.Name) and recv.id in lock_vars)
+            or (isinstance(recv, ast.Attribute)
+                and recv.attr in lock_attrs)
+        )
+        if not is_lock:
+            return
+        if ctx.annotation(KEY, node.lineno) is not None:
+            return
+        yield Finding(
+            rule=self.name, path=ctx.relpath, line=node.lineno,
+            col=node.col_offset, scope=ctx.scope_of(node),
+            message=(
+                f"lock {astutil.dotted_name(recv)} acquired outside "
+                f"'with' — exception paths can leak the hold; use a "
+                f"with-block or waive with '# lock-ok: <reason>'"
+            ),
+            snippet=ctx.line_text(node.lineno),
+        )
+
+
+def _collect_lock_names(ctx):
+    """Names/attrs assigned threading.Lock()/RLock() anywhere in the
+    module, minus anything ALSO assigned an excluded sync primitive
+    (a name reused for a Condition must not drag Condition waits in)."""
+    lock_vars: set[str] = set()
+    lock_attrs: set[str] = set()
+    excluded: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        ctor = astutil.call_name(node.value)
+        if ctor not in _LOCK_CTORS | _EXCLUDE_CTORS:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                (lock_vars if ctor in _LOCK_CTORS else excluded).add(
+                    tgt.id
+                )
+            elif isinstance(tgt, ast.Attribute):
+                (lock_attrs if ctor in _LOCK_CTORS else excluded).add(
+                    tgt.attr
+                )
+    return lock_vars, lock_attrs, excluded
+
+
+def _walk_no_defs(body: list):
+    """Walk statements without descending into nested function/class
+    defs — code in a nested def does not run under the with."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+RULE = LockLint()

@@ -1,0 +1,334 @@
+"""Runtime lock-order checker: instrumented lock wrappers that record
+the per-thread acquisition graph, detect cycles (potential deadlocks),
+and report hold-time outliers.
+
+Locks are keyed by ALLOCATION SITE (the ``file:line`` that called
+``threading.Lock()``), not by instance — the classic lockdep
+abstraction: two locks born at one site form a lock *class*, and an
+A->B plus B->A ordering between two classes is a deadlock waiting for
+the right interleaving even if this run never deadlocked. Reentrant
+holds of the same *instance* (RLock) add no edge; nesting two distinct
+instances of the same class is recorded as a self-edge and reported
+separately (``self_nesting``) rather than as a cycle, since ordered
+same-class nesting (e.g. parent->child) is legitimate.
+
+Enable by monkeypatching the factories::
+
+    from tools.analysis import lockgraph
+    lockgraph.enable()            # or enable_from_env(): MTPU_LOCK_CHECK=1
+    ...
+    report = lockgraph.report()   # {"cycles": [...], "hold_outliers": ...}
+    lockgraph.disable()
+
+Only locks CREATED while enabled are tracked (module-level locks born
+at import time are not — arm early). ``threading.Condition()`` default
+locks are created through the patched ``RLock`` and tracked under the
+threading.py call site. The wrapper passes through ``_release_save`` /
+``_acquire_restore`` / ``_is_owned`` semantics so Condition.wait keeps
+working and the held-stack stays truthful across waits.
+
+Armed in tests/test_race_stress.py and tests/test_chaos_soak.py; the
+suites assert zero acquisition-graph cycles after driving the risky
+interleavings hard.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+HOLD_OUTLIER_S = 0.1  # report holds longer than this
+
+
+class LockGraph:
+    """Global acquisition graph over lock classes (allocation sites)."""
+
+    def __init__(self):
+        # The graph's own mutex uses the REAL lock type: instrumenting
+        # it would recurse.
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        self.edges: dict[tuple[str, str], int] = {}
+        self.self_nesting: dict[str, int] = {}
+        self.holds: dict[str, dict] = {}  # site -> count/total/max
+        self.acquisitions = 0
+
+    # --- per-thread held stack: list of (site, lock_id, t0) ---
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def note_acquired(self, site: str, lock_id: int) -> None:
+        stack = self._stack()
+        held_ids = [lid for (_s, lid, _t) in stack]
+        if lock_id in held_ids:
+            # Reentrant hold of the same instance (RLock): no new
+            # ordering information; push for release pairing only.
+            stack.append((site, lock_id, time.monotonic()))
+            return
+        new_edges = []
+        self_nest = False
+        for held_site, _lid, _t in stack:
+            if held_site == site:
+                self_nest = True
+            else:
+                new_edges.append((held_site, site))
+        stack.append((site, lock_id, time.monotonic()))
+        if not new_edges and not self_nest:
+            with self._mu:
+                self.acquisitions += 1
+            return
+        with self._mu:
+            self.acquisitions += 1
+            for e in new_edges:
+                self.edges[e] = self.edges.get(e, 0) + 1
+            if self_nest:
+                self.self_nesting[site] = (
+                    self.self_nesting.get(site, 0) + 1
+                )
+
+    def note_released(self, site: str, lock_id: int) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == lock_id:
+                _s, _lid, t0 = stack.pop(i)
+                held = time.monotonic() - t0
+                with self._mu:
+                    h = self.holds.setdefault(
+                        site, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+                    )
+                    h["count"] += 1
+                    h["total_s"] += held
+                    if held > h["max_s"]:
+                        h["max_s"] = held
+                return
+
+    # --- analysis ---
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles in the site graph (self-edges excluded —
+        reported via self_nesting). DFS with a path stack; graphs here
+        are tiny (dozens of sites)."""
+        with self._mu:
+            adj: dict[str, set[str]] = {}
+            for (a, b) in self.edges:
+                if a != b:
+                    adj.setdefault(a, set()).add(b)
+        found: list[list[str]] = []
+        seen_keys: set[tuple] = set()
+
+        def dfs(start: str, node: str, path: list[str],
+                on_path: set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    cyc = path[:]
+                    key = tuple(sorted(cyc))
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        found.append(cyc + [start])
+                elif nxt not in on_path and nxt > start:
+                    # Only explore nodes ordered after start so each
+                    # cycle is found from its smallest node exactly once.
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    dfs(start, nxt, path, on_path)
+                    on_path.discard(nxt)
+                    path.pop()
+
+        for start in sorted(adj):
+            dfs(start, start, [start], {start})
+        return found
+
+    def hold_outliers(self, threshold_s: float = HOLD_OUTLIER_S) -> list:
+        with self._mu:
+            out = [
+                {"site": site, "max_hold_s": round(h["max_s"], 4),
+                 "mean_hold_s": round(h["total_s"] / h["count"], 6),
+                 "count": h["count"]}
+                for site, h in self.holds.items()
+                if h["max_s"] >= threshold_s
+            ]
+        out.sort(key=lambda d: -d["max_hold_s"])
+        return out
+
+    def report(self, outlier_threshold_s: float = HOLD_OUTLIER_S) -> dict:
+        cycles = self.cycles()
+        with self._mu:
+            n_edges = len(self.edges)
+            n_acq = self.acquisitions
+            self_nest = dict(self.self_nesting)
+        return {
+            "acquisitions": n_acq,
+            "edges": n_edges,
+            "cycles": cycles,
+            "self_nesting": self_nest,
+            "hold_outliers": self.hold_outliers(outlier_threshold_s),
+        }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self.self_nesting.clear()
+            self.holds.clear()
+            self.acquisitions = 0
+
+
+GRAPH = LockGraph()
+
+
+class CheckedLock:
+    """Duck-typed Lock/RLock wrapper feeding the global graph. Supports
+    the Condition protocol (_release_save/_acquire_restore/_is_owned)
+    so patched factories keep threading.Condition working."""
+
+    __slots__ = ("_lock", "_site", "_reentrant")
+
+    def __init__(self, site: str, reentrant: bool):
+        self._lock = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._site = site
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            GRAPH.note_acquired(self._site, id(self))
+        return ok
+
+    def release(self):
+        # Pop our accounting BEFORE the real release: after release,
+        # another thread may acquire and we'd race the stack.
+        GRAPH.note_released(self._site, id(self))
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    # --- Condition protocol passthroughs ---
+
+    def _release_save(self):
+        GRAPH.note_released(self._site, id(self))
+        if hasattr(self._lock, "_release_save"):
+            return self._lock._release_save()
+        self._lock.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._lock, "_acquire_restore"):
+            self._lock._acquire_restore(state)
+        else:
+            self._lock.acquire()
+        GRAPH.note_acquired(self._site, id(self))
+
+    def _is_owned(self):
+        if hasattr(self._lock, "_is_owned"):
+            return self._lock._is_owned()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self):
+        # stdlib registers lock._at_fork_reinit as an os fork handler
+        # (concurrent.futures.thread does at import) — must exist.
+        self._lock._at_fork_reinit()
+
+    def __getattr__(self, name):
+        # Fallback for any other stdlib-internal lock attribute; plain
+        # lookups (slots above) never reach here.
+        return getattr(object.__getattribute__(self, "_lock"), name)
+
+    def __repr__(self):
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<CheckedLock {kind} site={self._site}>"
+
+
+def _caller_site() -> str:
+    """file:line of the first frame outside this module — the lock's
+    allocation site / class key."""
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    # Compress to the repo-relative tail for stable, readable keys.
+    parts = fn.replace("\\", "/").rsplit("/", 3)
+    return f"{'/'.join(parts[-2:])}:{f.f_lineno}"
+
+
+def _checked_lock():
+    return CheckedLock(_caller_site(), reentrant=False)
+
+
+def _checked_rlock():
+    return CheckedLock(_caller_site(), reentrant=True)
+
+
+_enabled = False
+
+
+def enable() -> None:
+    """Patch threading.Lock/RLock so every lock created from now on is
+    tracked. Idempotent."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    threading.Lock = _checked_lock
+    threading.RLock = _checked_rlock
+
+
+def disable() -> None:
+    """Restore the real factories. Tracked locks already created keep
+    working (and keep reporting) — only new creations stop."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable_from_env() -> bool:
+    """Arm iff MTPU_LOCK_CHECK=1 — the production/ops knob documented
+    in docs/ANALYSIS.md."""
+    if os.environ.get("MTPU_LOCK_CHECK") == "1":
+        enable()
+        return True
+    return False
+
+
+def report(outlier_threshold_s: float = HOLD_OUTLIER_S) -> dict:
+    return GRAPH.report(outlier_threshold_s)
+
+
+def reset() -> None:
+    GRAPH.reset()
+
+
+def assert_no_cycles() -> None:
+    cyc = GRAPH.cycles()
+    if cyc:
+        raise AssertionError(
+            f"lock acquisition-order cycles detected: {cyc}"
+        )

@@ -1,0 +1,130 @@
+"""pool-lint: every buffer-pool checkout must release on all paths.
+
+The invariant PR 3 enforced by hand (and the chaos soak asserts at
+runtime via ``in_use == 0``): a ``pool.acquire()`` whose buffer can be
+abandoned on an exception edge leaks pool accounting and re-faults a
+fresh multi-MiB buffer on the next batch.
+
+A checkout is a call ``<pool>.acquire()`` where the receiver is
+pool-ish: its name contains "pool", or it was assigned from
+``BufferPool(...)`` / ``shared_pool(...)`` in the same module.
+(ThreadPoolExecutors expose ``submit``, not ``acquire``, so they never
+match; threading locks match ``acquire`` but not the pool-ish filter.)
+
+Accepted protection shapes:
+
+- the acquire is inside a ``try`` whose ``finally`` or exception
+  handler calls ``.release(...)`` / ``drop(...)``;
+- the statement immediately after the acquire-assign is such a
+  ``try`` (the ``buf = pool.acquire(); try: ... except: release; raise``
+  idiom);
+- the acquire feeds a ``return`` / ``yield`` directly (ownership
+  transfers to the caller).
+
+Anything else — including ownership handoffs the analyzer cannot see,
+like wrapping the buffer into a pipeline item covered by a drop hook —
+needs a ``# pool-ok: <reason>`` annotation naming who releases.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding
+
+KEY = "pool"
+
+_RELEASE_NAMES = {"release", "drop", "_release"}
+
+
+class PoolLint:
+    name = "pool-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return True  # pools are used across erasure/pipeline/ops
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        pool_names = _pool_assigned_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr != "acquire":
+                continue
+            recv_name = astutil.dotted_name(node.func.value)
+            leaf = recv_name.rsplit(".", 1)[-1]
+            if "pool" not in leaf.lower() and leaf not in pool_names:
+                continue
+            if ctx.annotation(KEY, node.lineno) is not None:
+                continue
+            if self._protected(ctx, node):
+                continue
+            yield Finding(
+                rule=self.name, path=ctx.relpath, line=node.lineno,
+                col=node.col_offset, scope=ctx.scope_of(node),
+                message=(
+                    f"{recv_name}.acquire() has no release on the "
+                    f"exception path — wrap in try/finally (or "
+                    f"try/except+release+raise), or waive with "
+                    f"'# pool-ok: <who releases>'"
+                ),
+                snippet=ctx.line_text(node.lineno),
+            )
+
+    def _protected(self, ctx, node: ast.Call) -> bool:
+        stmt = astutil.stmt_of(ctx, node)
+        if stmt is None:
+            return False
+        # Ownership transfer: `return pool.acquire()` / yield.
+        if isinstance(stmt, ast.Return):
+            return True
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, (ast.Yield, ast.YieldFrom)):
+            return True
+        # Enclosing try with a releasing finally/handler.
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try) and _try_releases(anc):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        # Next-sibling try: buf = pool.acquire(); try: ... except: ...
+        body, idx = astutil.body_and_index(stmt)
+        if body is not None and idx + 1 < len(body):
+            nxt = body[idx + 1]
+            if isinstance(nxt, ast.Try) and _try_releases(nxt):
+                return True
+        return False
+
+
+def _try_releases(try_node: ast.Try) -> bool:
+    for blob in [try_node.finalbody] + [h.body for h in
+                                        try_node.handlers]:
+        for sub in ast.walk(ast.Module(body=list(blob),
+                                       type_ignores=[])):
+            if isinstance(sub, ast.Call):
+                if astutil.call_name(sub) in _RELEASE_NAMES:
+                    return True
+    return False
+
+
+def _pool_assigned_names(ctx) -> set[str]:
+    """Names/attrs assigned from BufferPool(...) or shared_pool(...)."""
+    out: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        if astutil.call_name(node.value) not in ("BufferPool",
+                                                 "shared_pool"):
+            continue
+        for tgt in node.targets:
+            name = astutil.dotted_name(tgt)
+            if name:
+                out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+RULE = PoolLint()

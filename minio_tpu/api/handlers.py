@@ -2029,7 +2029,14 @@ class S3ApiHandlers:
             io.BytesIO(file_data), len(file_data),
         )
         sub.access_key = cred.access_key
-        resp = self.put_object(sub)
+        # POST-policy uploads branch BEFORE the SigV4 dispatch's
+        # admission tagging: attribute their encode slots to the
+        # signing identity here, or a hot POST-policy tenant pools
+        # into the anonymous client and bypasses per-tenant caps.
+        from ..pipeline.admission import client_context
+
+        with client_context(cred.access_key or "anonymous"):
+            resp = self.put_object(sub)
         status = fields.get("success_action_status", "204")
         if status == "201":
             root = ET.Element("PostResponse")

@@ -965,7 +965,15 @@ class S3Server:
                     "XAmzContentSHA256Mismatch", "empty body, non-empty hash"
                 )
         handler = getattr(self.handlers, name)
-        resp = handler(ctx)
+        # Admission fairness identity: every encode slot this request
+        # takes (PUT, multipart part) is attributed to the caller's
+        # access key, so the governor's per-client caps and round-robin
+        # grant order see TENANTS, not sockets. Anonymous requests
+        # share one bucket by design.
+        from ..pipeline.admission import client_context
+
+        with client_context(auth_result.access_key or "anonymous"):
+            resp = handler(ctx)
         if self.metrics is not None:
             self.metrics.inc(
                 "s3_responses_total", api=name, status=str(resp.status)

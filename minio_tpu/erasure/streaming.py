@@ -171,14 +171,16 @@ class ParallelWriter:
             raise err
 
     def write_frame_batches(self, data_buf, parity, nb: int, k: int,
-                            m: int, shard: int):
+                            m: int, shard: int, digests=None):
         """Zero-copy batched fan-out over the block-major strip buffer:
         block bi's shard j lives at data_buf[bi, j*S:(j+1)*S] (parity at
         parity[bi, j-k]), so shard j's consecutive bitrot chunks sit at
         a fixed stride. Each writer's frame digests come from ONE native
         strided-hash call and the [digest||chunk] pairs ship via the
         sink's vectored writev — no data byte is copied between the
-        strip buffer and the kernel."""
+        strip buffer and the kernel. `digests` ([k+m, nb, 32], from the
+        worker pool's shm segment) skips the in-process hash entirely —
+        the worker already computed the identical strided digests."""
         from .bitrot import hash_strided_digests
 
         row = data_buf.shape[1]  # k * shard bytes per block row
@@ -188,17 +190,17 @@ class ParallelWriter:
             if i < k:
                 chunks = [data_buf[bi, i * shard: (i + 1) * shard]
                           for bi in range(nb)]
-                digests = hash_strided_digests(
-                    data_buf, i * shard, row, nb, shard
-                )
+                digs = (digests[i, :nb] if digests is not None
+                        else hash_strided_digests(
+                            data_buf, i * shard, row, nb, shard))
             else:
                 pi = i - k
                 chunks = [parity[bi, pi] for bi in range(nb)]
-                digests = hash_strided_digests(
-                    parity, pi * shard, m * shard, nb, shard
-                )
+                digs = (digests[i, :nb] if digests is not None
+                        else hash_strided_digests(
+                            parity, pi * shard, m * shard, nb, shard))
             if hasattr(w, "write_frames_vec"):
-                w.write_frames_vec(chunks, digests)
+                w.write_frames_vec(chunks, digs)
             else:
                 for c in chunks:
                     w.write(c)
@@ -309,6 +311,17 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
         # one framing call per shard per batch).
         if _SINGLE_CORE:
             return _encode_stream_native(erasure, src, writer, batch_blocks)
+        from ..pipeline import workers as _workers
+
+        wpool = _workers.armed()
+        if wpool is not None:
+            # Worker-pool path: the per-batch GF encode + strided
+            # digests run in a child process over a shared-memory strip
+            # — the main interpreter's GIL stays free for fill/writev/
+            # commit, which is what lets N concurrent clients scale.
+            return _encode_stream_native_workers(
+                erasure, src, writer, batch_blocks, telemetry, wpool
+            )
         return _encode_stream_native_pipelined(
             erasure, src, writer, batch_blocks, telemetry
         )
@@ -780,6 +793,170 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
         stages.append(Stage("md5", md5_stage,
                             bytes_of=lambda it: it[1] * block_size))
     stages += [Stage("encode", encode),
+               Stage("frame-write", frame_write, bytes_of=int)]
+    Pipeline(telemetry, stages, queue_depth=1, pools=[pool],
+             drop=drop).run(source_from_first())
+    return totals["bytes"]
+
+
+def _encode_stream_native_workers(erasure: Erasure, src,
+                                  writer: ParallelWriter,
+                                  batch_blocks: int, telemetry: str,
+                                  wpool) -> int:
+    """Worker-pool strip driver: the shape of
+    _encode_stream_native_pipelined, but the strip buffers are
+    SHARED-MEMORY segments (pipeline/workers.ShmStrip) and the encode
+    stage ships each batch to a child process that computes GF parity
+    AND all k+m shards' frame digests into the same segment
+    (gf_native.apply_matrix_batch(out=) + hash_strided_digests(out=)):
+
+        source-read (one contiguous readinto per block, into shm)
+          → md5 (delegated; host thread — hashlib releases the GIL)
+            → worker encode+digest (child process; parent blocks on
+              the pipe reply, GIL released)
+              → frame-write (writev straight from the shm segment,
+                digests precomputed — zero hashing on the parent)
+
+    Copy accounting is IDENTICAL to the in-process driver (one
+    source-read copy per input byte, nothing else): no payload byte
+    crosses the pipe, and the parent never re-touches the batch
+    beyond the writev scatter list. A worker failure mid-batch
+    (WorkerCrashed/WorkerUnavailable) recomputes THAT batch in-process
+    from the still-intact shm data — byte-identical output — and
+    counts a fallback; the stream never notices."""
+    from ..ops import gf_native
+    from ..pipeline import Pipeline, Stage
+    from ..pipeline import workers as _workers
+
+    k = erasure.data_blocks
+    m = erasure.parity_blocks
+    shard = erasure.shard_size()
+    block_size = erasure.block_size
+    md5_update = None
+    if hasattr(src, "delegate_hashing"):
+        src, md5_update = src.delegate_hashing()
+    filler = _BlockFiller(erasure, src, batch_blocks)
+    pool = _workers.strip_pool(batch_blocks, k, m, shard)
+    totals = {"bytes": 0}
+
+    # Items are LISTS [strip, nb, tail, parity, tail_blocks, digests];
+    # the executor's drop hook returns abandoned strips exactly once.
+    def drop(item):
+        if isinstance(item, list) and item and item[0] is not None:
+            pool.release(item[0])
+            item[0] = None
+
+    def fill_acquired(strip):
+        try:
+            return filler.fill(strip.data)
+        except BaseException:
+            pool.release(strip)
+            raise
+
+    def strips_source():
+        while not filler.eof:
+            # pool-ok: fill_acquired releases on raise; afterwards the
+            # strip is wrapped in an item owned by the executor's drop
+            # hook (released exactly once on stage-raise/cancel/drain)
+            strip = pool.acquire()
+            nb, tail = fill_acquired(strip)
+            if nb == 0:
+                pool.release(strip)
+                if tail is None:
+                    break
+                yield [None, 0, tail, None, None, None]
+            else:
+                yield [strip, nb, tail, None, None, None]
+
+    def md5_stage(item):
+        strip, nb, tail = item[0], item[1], item[2]
+        for bi in range(nb):
+            md5_update(strip.data[bi, :block_size])
+        if tail:
+            md5_update(tail)
+        return item
+
+    def encode_inprocess(item):
+        strip, nb = item[0], item[1]
+        item[3] = gf_native.apply_matrix_batch(
+            erasure._parity_mat, strip.data[:nb].reshape(nb, k, shard)
+        )
+        item[5] = None  # frame-write hashes in-process
+
+    # Below this, the pipe round-trip costs more than the batch's own
+    # encode+hash: 1-block objects stay in-process.
+    min_worker_blocks = max(1, 2 * (1 << 20) // max(1, erasure.block_size))
+
+    def encode(item):
+        strip, nb, tail = item[0], item[1], item[2]
+        if nb:
+            if nb < min_worker_blocks:
+                encode_inprocess(item)
+            else:
+                try:
+                    wpool.encode_batch(strip, nb)
+                    item[3] = strip.parity
+                    item[5] = strip.digests
+                except (_workers.WorkerCrashed,
+                        _workers.WorkerUnavailable):
+                    # The shm data region is untouched by a dead
+                    # worker: recompute this batch in-process,
+                    # byte-identically.
+                    wpool.note_fallback()
+                    encode_inprocess(item)
+        item[4] = erasure.encode_data(tail) if tail is not None else None
+        return item
+
+    def frame_write(item):
+        strip, nb, tail, parity, tail_blocks, digests = item
+        out = 0
+        if nb:
+            writer.write_frame_batches(strip.data, parity, nb, k, m,
+                                       shard, digests=digests)
+            out += nb * block_size
+        if strip is not None:
+            pool.release(strip)
+            item[0] = None
+        if tail_blocks is not None:
+            writer.write(tail_blocks)
+            out += len(tail)
+        totals["bytes"] += out
+        return out
+
+    # Single-batch streams skip the stage-thread spin-up (nothing to
+    # overlap) but STILL ship multi-block batches to a worker — the
+    # c5-shaped workload (many concurrent few-MiB PUTs) is exactly N
+    # single-batch streams, and keeping their encode+hash on the main
+    # interpreter is what kept the aggregate flat. encode() owns the
+    # worker-vs-inprocess choice either way.
+    # pool-ok: fill_acquired releases on raise; then the strip lives in
+    # `first`, released by the inline path's finally drop() or handed
+    # to the pipeline whose drop hook owns it
+    strip0 = pool.acquire()
+    nb0, tail0 = fill_acquired(strip0)
+    first = [strip0, nb0, tail0, None, None, None]
+    if filler.eof:
+        try:
+            if nb0 or tail0 is not None:
+                if md5_update is not None:
+                    md5_stage(first)
+                frame_write(encode(first))
+            else:
+                pool.release(strip0)
+                first[0] = None
+        finally:
+            drop(first)  # no-op when the inline path released it
+        return totals["bytes"]
+
+    def source_from_first():
+        yield first
+        yield from strips_source()
+
+    stages = []
+    if md5_update is not None:
+        stages.append(Stage("md5", md5_stage,
+                            bytes_of=lambda it: it[1] * block_size))
+    stages += [Stage("worker-encode", encode),
                Stage("frame-write", frame_write, bytes_of=int)]
     Pipeline(telemetry, stages, queue_depth=1, pools=[pool],
              drop=drop).run(source_from_first())

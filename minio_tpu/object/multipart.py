@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import hashlib
 import io
+import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -47,9 +49,132 @@ from .types import (
 )
 
 _mp_pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="mtpu-mp")
+# The parallel-part driver runs whole put_object_part calls on its OWN
+# executor: those calls fan out journal writes through _mp_pool, so
+# running them on _mp_pool too would deadlock it against itself once
+# enough drivers are in flight.
+_part_pool = ThreadPoolExecutor(max_workers=16,
+                                thread_name_prefix="mtpu-mp-part")
 
 # Part number ceiling (ref cmd/utils.go:161 globalMaxPartID = 10000).
 MAX_PART_ID = 10000
+
+
+class _SliceReader:
+    """Zero-copy reader over one part's slice of a shared buffer:
+    read() hands out memoryview sub-slices, readinto() fills the
+    caller's strip row directly — either way the only copy of a
+    payload byte is the one into the encode strip (the counted
+    put.source_read floor)."""
+
+    def __init__(self, mv: memoryview, offset: int, length: int):
+        self._mv = mv[offset:offset + length]
+        self._pos = 0
+
+    def read(self, n: int = -1):
+        left = len(self._mv) - self._pos
+        if n is None or n < 0 or n > left:
+            n = left
+        out = self._mv[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+    def readinto(self, b) -> int:
+        view = memoryview(b)
+        n = min(len(view), len(self._mv) - self._pos)
+        view[:n] = self._mv[self._pos:self._pos + n]
+        self._pos += n
+        return n
+
+
+class _PreadReader:
+    """Per-part reader over a shared file descriptor: every part reads
+    its own byte range via os.pread (positionless), so N concurrent
+    part streams never fight over one file cursor."""
+
+    def __init__(self, fd: int, offset: int, length: int):
+        self._fd = fd
+        self._off = offset
+        self._left = length
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0 or n > self._left:
+            n = self._left
+        if n <= 0:
+            return b""
+        out = os.pread(self._fd, n, self._off)
+        self._off += len(out)
+        self._left -= len(out)
+        return out
+
+    def readinto(self, b) -> int:
+        view = memoryview(b)
+        n = min(len(view), self._left)
+        if n <= 0:
+            return 0
+        got = os.pread(self._fd, n, self._off)
+        view[:len(got)] = got
+        self._off += len(got)
+        self._left -= len(got)
+        return len(got)
+
+
+def _part_reader_factory(source):
+    """(offset, length) -> reader for one part of `source`, choosing
+    the cheapest access path the source supports (see
+    put_object_multipart). Generic streams are staged: the factory is
+    called IN SUBMISSION ORDER from the driver loop, so sequential
+    reads off the shared cursor land in the right part."""
+    try:
+        # cast("B"): part offsets are BYTE offsets — a uint64 ndarray
+        # source would otherwise be sliced in 8-byte elements. Non-C-
+        # contiguous buffers refuse the cast and take the staged path.
+        mv = memoryview(source).cast("B")
+    except TypeError:
+        mv = None
+    if mv is not None:
+        return lambda off, ln: _SliceReader(mv, off, ln)
+    fileno = getattr(source, "fileno", None)
+    if fileno is not None:
+        try:
+            fd = fileno()
+            # Part offsets are relative to the source's CURRENT
+            # position (a caller that consumed a header expects the
+            # upload to start where the cursor is, like read() would).
+            # The logical tell() — not the raw fd offset, which a
+            # BufferedReader's read-ahead has already moved.
+            tell = getattr(source, "tell", None)
+            base = tell() if tell is not None else os.lseek(
+                fd, 0, os.SEEK_CUR)
+        except (OSError, io.UnsupportedOperation):
+            fd = None
+        if fd is not None:
+            return lambda off, ln: _PreadReader(fd, base + off, ln)
+
+    def staged(off, ln):
+        # One stage copy per byte for cursor-only sources — counted,
+        # never silent (the zero-copy floor applies to buffer/fd
+        # sources; a socket body cannot be sliced in place).
+        from ..pipeline.buffers import copy_add
+
+        buf = bytearray(ln)
+        view = memoryview(buf)
+        got = 0
+        while got < ln:
+            n = source.readinto(view[got:]) if hasattr(source, "readinto") \
+                else None
+            if n is None:
+                chunk = source.read(ln - got)
+                n = len(chunk)
+                if n:
+                    view[got:got + n] = chunk
+            if not n:
+                break
+            got += n
+        copy_add("put.mp_stage", got)
+        return _SliceReader(view, 0, got)
+
+    return staged
 
 
 def _upload_root(bucket: str, object_: str) -> str:
@@ -419,7 +544,9 @@ class MultipartMixin:
             total_size += jp.size
             final_parts.append(jp)
 
-        etag = hashlib.md5(b"".join(md5s)).hexdigest() + f"-{len(parts)}"
+        from .types import compute_parts_etag
+
+        etag = compute_parts_etag(md5s)
         mod_time_ns = time.time_ns()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         data_dir = new_uuid()
@@ -486,6 +613,120 @@ class MultipartMixin:
             erasure=ErasureInfo(data_blocks=k, parity_blocks=m),
         )
         return ObjectInfo.from_file_info(out, bucket, object_, opts.versioned)
+
+    # Default part size for the parallel driver: big enough that the
+    # per-part journal/commit overhead amortizes, small enough that
+    # even a modest object splits into several concurrently-hashed
+    # parts (the whole point: per-part MD5s run in parallel, then
+    # compose into the etag-of-parts — the sanctioned route around the
+    # ~0.66 GB/s single-stream MD5 wall).
+    PARALLEL_PART_SIZE = 16 << 20
+
+    def put_object_multipart(self, bucket: str, object_: str, source,
+                             size: int, part_size: int | None = None,
+                             opts: ObjectOptions | None = None,
+                             parallel: int | None = None) -> ObjectInfo:
+        """Server-side parallel multipart PUT: slice `source` into
+        parts and run their encode + bitrot-hash + MD5 CONCURRENTLY
+        through the ordinary put_object_part path, completing with the
+        standard S3 etag-of-parts. Every part is a full independent
+        stream through the streaming drivers (its own TeeMD5Reader, its
+        own admission slot), so with W admitted parts the content
+        hashing runs W-wide — single-stream PUT can never do that
+        without breaking the plain-md5 etag contract.
+
+        `source` is consumed zero-copy when possible:
+        - buffer-protocol objects (bytes/bytearray/memoryview/ndarray):
+          parts are memoryview slices;
+        - readers with a real file descriptor (`fileno()`): parts read
+          via os.pread at their own offsets, no shared cursor;
+        - anything else: parts are staged into part-sized buffers as
+          the stream arrives (the stage copy is counted), submissions
+          overlapping with the reads.
+
+        On any part failure the upload is aborted — no journal or
+        staged shards survive."""
+        opts = opts or ObjectOptions()
+        part_size = part_size or self.PARALLEL_PART_SIZE
+        if size < 0:
+            raise ErrInvalidPart("parallel multipart needs a sized source")
+        # Never exceed the S3 part-count ceiling: grow the part size
+        # instead (rounded up to 1 MiB so erasure blocks stay aligned).
+        min_part = -(-size // MAX_PART_ID) if size else part_size
+        if min_part > part_size:
+            part_size = -(-min_part // (1 << 20)) * (1 << 20)
+        n_parts = max(1, -(-size // part_size)) if size else 1
+        parts_geom = [
+            (i + 1, i * part_size, min(part_size, size - i * part_size))
+            for i in range(n_parts)
+        ]
+        if size == 0:
+            parts_geom = [(1, 0, 0)]
+
+        upload_id = self.new_multipart_upload(bucket, object_, opts)
+        window = threading.BoundedSemaphore(
+            max(1, parallel if parallel is not None
+                else min(8, os.cpu_count() or 1))
+        )
+        results: dict[int, PartInfo] = {}
+        part_reader = _part_reader_factory(source)
+        # Executor threads carry an EMPTY contextvar context: re-tag
+        # each part with the caller's admission identity, or every
+        # multipart part would pool into the anonymous client and
+        # bypass the per-tenant caps/fairness.
+        from ..pipeline.admission import client_context, current_client
+
+        caller = current_client()
+
+        def upload_part(num: int, reader, ln: int):
+            try:
+                with client_context(caller):
+                    results[num] = self.put_object_part(
+                        bucket, object_, upload_id, num, reader, ln
+                    )
+            finally:
+                window.release()
+
+        futures = []
+        try:
+            for num, off, ln in parts_geom:
+                window.acquire()
+                if any(f.done() and not f.cancelled() and f.exception()
+                       for f in futures):
+                    window.release()
+                    break  # a part already failed: stop feeding
+                # Readers are built HERE, in part order — staged
+                # (cursor-only) sources depend on it; sliced/pread
+                # sources don't care.
+                reader = part_reader(off, ln)
+                futures.append(_part_pool.submit(upload_part, num, reader,
+                                                 ln))
+            errs = [f.exception() for f in futures]
+            err = next((e for e in errs if e is not None), None)
+            if err is not None:
+                raise err
+            if len(results) != len(parts_geom):
+                raise ErrInvalidPart("parallel upload incomplete")
+            return self.complete_multipart_upload(
+                bucket, object_, upload_id,
+                [CompletePart(num, results[num].etag)
+                 for num, _, _ in parts_geom],
+                opts,
+            )
+        except Exception:
+            for f in futures:
+                f.cancel()
+            # Settle the in-flight parts before dropping the upload dir
+            # under them, then abort (best effort — the stale-upload
+            # sweeper catches anything a hung disk strands).
+            for f in futures:
+                if not f.cancelled():
+                    f.exception()
+            try:
+                self.abort_multipart_upload(bucket, object_, upload_id)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+            raise
 
     def cleanup_stale_uploads(self, expiry_ns: int):
         """Drop multipart uploads older than expiry

@@ -397,6 +397,19 @@ class ErasureServerPools:
         idx = self._pool_for_put(bucket, object_, opts)
         return self.pools[idx].new_multipart_upload(bucket, object_, opts)
 
+    def put_object_multipart(self, bucket, object_, source, size,
+                             part_size=None, opts=None, parallel=None):
+        """Parallel multipart PUT (parts encode+hash+MD5 concurrently,
+        S3 etag-of-parts) — the high-throughput ingest path for large
+        objects; see MultipartMixin.put_object_multipart."""
+        self._check_bucket(bucket)
+        idx = self._pool_for_put(bucket, object_, opts)
+        oi = self.pools[idx].put_object_multipart(
+            bucket, object_, source, size, part_size, opts, parallel
+        )
+        self._bump_gen(bucket)
+        return oi
+
     def _pool_for_upload(self, bucket, object_, upload_id):
         from ..utils.errors import ErrInvalidUploadID
 

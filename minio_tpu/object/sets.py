@@ -215,6 +215,12 @@ class ErasureSets:
     def new_multipart_upload(self, bucket, object_, opts=None):
         return self.get_hashed_set(object_).new_multipart_upload(bucket, object_, opts)
 
+    def put_object_multipart(self, bucket, object_, source, size,
+                             part_size=None, opts=None, parallel=None):
+        return self.get_hashed_set(object_).put_object_multipart(
+            bucket, object_, source, size, part_size, opts, parallel
+        )
+
     def put_object_part(self, bucket, object_, upload_id, part_number, reader,
                         size, opts=None):
         return self.get_hashed_set(object_).put_object_part(

@@ -153,6 +153,18 @@ def compute_etag(data_md5: bytes | None, parts: int = 0) -> str:
     return data_md5.hex()
 
 
+def compute_parts_etag(part_md5s: list[bytes]) -> str:
+    """The S3 etag-of-parts contract, pinned in one place:
+    md5 over the CONCATENATED raw 16-byte part digests (not their hex
+    forms), suffixed `-N` where N is the part count — including N=1
+    (a single-part multipart object does NOT get a plain md5 etag).
+    Conformance vectors in tests/test_multipart.py hold this to
+    known-good S3 outputs; complete_multipart_upload and the parallel
+    multipart driver both call here so they cannot drift."""
+    return (hashlib.md5(b"".join(part_md5s)).hexdigest()
+            + f"-{len(part_md5s)}")
+
+
 class TeeMD5Reader:
     """Wrap a reader, computing md5/size as data flows through — the
     stand-in for the reference's pkg/hash.Reader.

@@ -129,6 +129,15 @@ from ..parallel.metrics import MESH_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += MESH_DESCRIPTORS
 
+# Concurrency plane: admission-governor counters/gauges
+# (pipeline/admission.py) and encode worker-pool health
+# (pipeline/workers.py) — both jax-free imports.
+from ..pipeline.admission import ADMISSION_DESCRIPTORS  # noqa: E402
+from ..pipeline.workers import WORKER_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += ADMISSION_DESCRIPTORS
+DESCRIPTORS += WORKER_DESCRIPTORS
+
 
 def describe_all(metrics) -> None:
     for name, _type, help_text in DESCRIPTORS:

@@ -126,8 +126,12 @@ def apply_matrix(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
     return out
 
 
-def apply_matrix_batch(mat: np.ndarray, blocks: np.ndarray) -> np.ndarray:
-    """mat uint8 [R, K], blocks uint8 [B, K, S] -> [B, R, S]."""
+def apply_matrix_batch(mat: np.ndarray, blocks: np.ndarray,
+                       out: np.ndarray | None = None) -> np.ndarray:
+    """mat uint8 [R, K], blocks uint8 [B, K, S] -> [B, R, S]. `out`
+    (contiguous [B, R, S]) lets callers land parity in place — the
+    worker pool writes straight into the shared-memory strip segment
+    so the parent's frame-writers ship it with zero copies."""
     lib = _lib()
     if lib is None:
         raise RuntimeError("native GF engine unavailable")
@@ -139,7 +143,10 @@ def apply_matrix_batch(mat: np.ndarray, blocks: np.ndarray) -> np.ndarray:
     r, k = mat.shape
     b, kk, s = blocks.shape
     assert kk == k, (mat.shape, blocks.shape)
-    out = np.empty((b, r, s), dtype=np.uint8)
+    if out is None:
+        out = np.empty((b, r, s), dtype=np.uint8)
+    else:
+        assert out.shape == (b, r, s) and out.flags.c_contiguous, out.shape
     if engine_kind() == 2:
         qw = _affine_qwords(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply_affine_batch(qw.ctypes.data_as(_U64P), r, k,

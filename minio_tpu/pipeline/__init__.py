@@ -15,6 +15,7 @@ fast, pipeline structure, not the codec, dominates throughput
 path.
 """
 
+from .admission import AdmissionGovernor, client_context, governor
 from .buffers import COPY, BufferPool, copy_add, shared_pool
 from .executor import Pipeline, PipelineCancelled
 from .metrics import (
@@ -26,8 +27,11 @@ from .metrics import (
 from .stage import END_OF_STREAM, SKIP, Stage
 
 __all__ = [
+    "AdmissionGovernor",
     "BufferPool",
     "COPY",
+    "client_context",
+    "governor",
     "copy_add",
     "END_OF_STREAM",
     "Pipeline",

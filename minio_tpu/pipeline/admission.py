@@ -1,0 +1,399 @@
+"""Server-wide encode admission governor: the fan-in control plane.
+
+One process-global governor decides which PUT/multipart-part encode
+streams run NOW and which wait — the generalization of the old
+`utils/fanout._encode_slots` semaphore that made single-object PUTs
+survive a 1-core host. The semaphore's problem at scale: it is FIFO
+over *requests*, so one hot client with 50 queued uploads starves
+every other client for seconds even though each of its uploads is
+cheap. The governor keeps the same bounded-slot model and adds:
+
+- **per-client in-flight caps** — each client's concurrent encodes are
+  bounded by a `storage/diskcheck.DiskHealth` token budget (the same
+  machinery that bounds per-disk in-flight ops), so a single client
+  can occupy the whole pool only when nobody else wants it;
+- **queue-depth-aware admission** — when the wait queue is already
+  `max_queue` deep, new arrivals reject IMMEDIATELY with a retriable
+  503 instead of burning a thread on a wait that cannot succeed
+  (ref the reference's maxClients deadline'd throttle,
+  cmd/handler-api.go:36-78);
+- **straggler-fair scheduling** — freed slots grant round-robin
+  ACROSS clients (FIFO within a client), so the Nth upload of a hot
+  client queues behind the 1st upload of everyone else;
+- **telemetry** — admitted/queued/rejected counters and
+  inflight/queue-depth gauges exported as `mtpu_admission_*` via the
+  metrics registry (server boot wires it), with a jax-free snapshot
+  for tests and bench.
+
+Client identity flows through a contextvar set at the API dispatch
+(access key, falling back to anonymous); internal callers (heal,
+replication, bench harnesses) tag themselves explicitly or share the
+"" client.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+# ---------------------------------------------------------------------------
+# client identity
+
+_client_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "mtpu_admission_client", default=""
+)
+
+
+def current_client() -> str:
+    return _client_var.get()
+
+
+@contextmanager
+def client_context(client: str):
+    """Tag every admission decision in this context with `client`
+    (the API layer wraps handler dispatch; bench wraps each simulated
+    client's loop)."""
+    token = _client_var.set(client or "")
+    try:
+        yield
+    finally:
+        _client_var.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# config
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return max(floor, v)
+
+
+@dataclass
+class AdmissionConfig:
+    """Knobs (env > default; see docs/DEPLOYMENT.md "Concurrency
+    tuning"). `slots` keeps the historical MTPU_MAX_CONCURRENT_ENCODES
+    name; `deadline_s` keeps MTPU_ENCODE_SLOT_DEADLINE_S."""
+
+    slots: int = 1
+    per_client_cap: int = 1
+    max_queue: int = 8
+    deadline_s: float = 30.0
+
+    @classmethod
+    def from_env(cls) -> "AdmissionConfig":
+        # Back-compat with the replaced fanout semaphore: 0 (or junk)
+        # means "the cpu-count default", not one serialized slot.
+        try:
+            slots = int(os.environ.get("MTPU_MAX_CONCURRENT_ENCODES",
+                                       "0") or 0)
+        except ValueError:
+            slots = 0
+        if slots <= 0:
+            slots = max(1, os.cpu_count() or 1)
+        # Work-conserving default: a lone client may use every slot;
+        # fairness bites only when clients actually compete. Operators
+        # cap hot tenants harder with MTPU_ADMISSION_CLIENT_CAP.
+        cap = _env_int("MTPU_ADMISSION_CLIENT_CAP", slots)
+        max_queue = _env_int("MTPU_ADMISSION_MAX_QUEUE", 8 * slots)
+        try:
+            deadline = float(os.environ.get("MTPU_ENCODE_SLOT_DEADLINE_S",
+                                            "30"))
+        except ValueError:
+            deadline = 30.0
+        return cls(slots=slots, per_client_cap=min(cap, slots),
+                   max_queue=max_queue, deadline_s=deadline)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+ADMISSION_DESCRIPTORS: list[tuple[str, str, str]] = [
+    ("admission_admitted_total", "counter",
+     "Encode streams admitted by the concurrency governor"),
+    ("admission_queued_total", "counter",
+     "Encode streams that waited in the admission queue"),
+    ("admission_rejected_total", "counter",
+     "Encode streams rejected by the governor (by reason)"),
+    ("admission_inflight", "gauge",
+     "Encode streams currently admitted"),
+    ("admission_queue_depth", "gauge",
+     "Encode streams waiting for admission"),
+    ("admission_clients_waiting", "gauge",
+     "Distinct clients with queued encode streams"),
+]
+
+_metrics = None
+_metrics_mu = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    with _metrics_mu:
+        _metrics = registry
+
+
+def _reg():
+    with _metrics_mu:
+        return _metrics
+
+
+class _Waiter:
+    __slots__ = ("client", "granted")
+
+    def __init__(self, client: str):
+        self.client = client
+        self.granted = False
+
+
+class AdmissionGovernor:
+    """Bounded-slot admission with per-client caps and round-robin
+    fairness. All state mutates under one Condition; grant decisions
+    happen at release time (and at enqueue when capacity is free), so
+    there is no separate scheduler thread to crash or lag."""
+
+    def __init__(self, config: AdmissionConfig | None = None):
+        self.cfg = config or AdmissionConfig.from_env()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        # Per-client in-flight budgets: the diskcheck token machinery,
+        # reused verbatim — DiskHealth is pure state, and its
+        # acquire(0)/release/state() surface is exactly a token bucket
+        # with rejection accounting.
+        self._budgets: dict[str, object] = {}
+        # client -> FIFO of waiters; OrderedDict order IS the round-
+        # robin rotation (grant pops the first eligible client, then
+        # move_to_end so the next grant starts after it).
+        self._queues: "OrderedDict[str, deque[_Waiter]]" = OrderedDict()
+        self._waiting = 0
+        # Counters (module totals; mirrored onto the registry).
+        self.admitted_total = 0
+        self.queued_total = 0
+        self.rejected_queue_full = 0
+        self.rejected_deadline = 0
+
+    # -- budgets -----------------------------------------------------------
+
+    def _budget(self, client: str):
+        b = self._budgets.get(client)
+        if b is None:
+            from ..storage.diskcheck import DiskHealth, RobustConfig
+
+            b = DiskHealth(endpoint=client or "anonymous",
+                           config=RobustConfig(
+                               max_inflight=self.cfg.per_client_cap))
+            self._budgets[client] = b
+        return b
+
+    # -- grant machinery (all under self._cv) ------------------------------
+
+    def _client_has_room(self, client: str) -> bool:
+        b = self._budgets.get(client)
+        return b is None or b.inflight < self.cfg.per_client_cap
+
+    def _grant_to(self, client: str) -> None:
+        self._inflight += 1
+        # Never blocks: callers grant only after _client_has_room.
+        self._budget(client).acquire(timeout_s=0.0)
+        self.admitted_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("admission_admitted_total")
+
+    def _grant_waiters(self) -> None:
+        """Hand freed capacity to queued waiters: rotate over clients,
+        one grant per eligible client per pass (FIFO within a client),
+        until slots run out or nobody eligible remains. The notify
+        covers grants from EVERY pass — keying it on the last pass
+        alone left early-pass grantees sleeping out their deadline."""
+        granted_total = False
+        progressed = True
+        while self._inflight < self.cfg.slots and progressed:
+            progressed = False
+            for client in list(self._queues.keys()):
+                if self._inflight >= self.cfg.slots:
+                    break
+                if not self._client_has_room(client):
+                    continue
+                q = self._queues[client]
+                w = q.popleft()
+                if not q:
+                    del self._queues[client]
+                else:
+                    self._queues.move_to_end(client)
+                self._waiting -= 1
+                w.granted = True
+                self._grant_to(client)
+                progressed = True
+                granted_total = True
+        if granted_total:
+            self._cv.notify_all()
+
+    # -- public surface ----------------------------------------------------
+
+    def acquire(self, client: str | None = None) -> None:
+        """Admit one encode stream for `client`, waiting fairly up to
+        the deadline. Raises ErrOperationTimedOut (a retriable 503) on
+        queue-full or deadline."""
+        from ..utils.errors import ErrOperationTimedOut
+
+        if client is None:
+            client = current_client()
+        deadline = time.monotonic() + self.cfg.deadline_s
+        with self._cv:
+            if (self._waiting == 0 and self._inflight < self.cfg.slots
+                    and self._client_has_room(client)):
+                self._grant_to(client)
+                self._mirror_gauges()
+                return
+            if self._waiting >= self.cfg.max_queue:
+                # Queue-depth-aware rejection: the wait could not
+                # possibly be served inside any reasonable deadline, so
+                # fail fast and let the client back off.
+                self.rejected_queue_full += 1
+                self._mirror_reject("queue_full")
+                raise ErrOperationTimedOut(
+                    f"server busy: admission queue full "
+                    f"({self._waiting} waiting)"
+                )
+            w = _Waiter(client)
+            self._queues.setdefault(client, deque()).append(w)
+            self._waiting += 1
+            self.queued_total += 1
+            self._mirror_queued()
+            # Capacity may be free right now (fast path declined only
+            # because others were already waiting): run one grant pass
+            # so the head of the rotation — possibly us — proceeds.
+            self._grant_waiters()
+            while not w.granted:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._unqueue(w)
+                    self.rejected_deadline += 1
+                    self._mirror_reject("deadline")
+                    raise ErrOperationTimedOut(
+                        "server busy: PUT admission queue deadline "
+                        "exceeded"
+                    )
+                self._cv.wait(left)
+            self._mirror_gauges()
+
+    def _unqueue(self, w: _Waiter) -> None:
+        q = self._queues.get(w.client)
+        if q is not None:
+            try:
+                q.remove(w)
+                self._waiting -= 1
+            except ValueError:
+                pass  # granted between timeout check and removal
+            if not q:
+                self._queues.pop(w.client, None)
+        if w.granted:
+            # Lost the race: the grant landed while we were timing out.
+            # Hand the slot straight back so it is not leaked.
+            self._release_locked(w.client)
+
+    def release(self, client: str | None = None) -> None:
+        if client is None:
+            client = current_client()
+        with self._cv:
+            self._release_locked(client)
+            self._mirror_gauges()
+
+    def _release_locked(self, client: str) -> None:
+        self._inflight = max(0, self._inflight - 1)
+        b = self._budgets.get(client)
+        if b is not None and b.inflight > 0:
+            b.release()
+        # Idle budgets are evicted: client ids are access keys, and a
+        # deployment minting ephemeral STS keys must not accrete one
+        # token bucket per key forever.
+        if b is not None and b.inflight == 0 and client not in self._queues:
+            self._budgets.pop(client, None)
+        self._grant_waiters()
+
+    @contextmanager
+    def slot(self, client: str | None = None):
+        if client is None:
+            client = current_client()
+        self.acquire(client)
+        try:
+            yield
+        finally:
+            self.release(client)
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "slots": self.cfg.slots,
+                "inflight": self._inflight,
+                "waiting": self._waiting,
+                "clients_waiting": len(self._queues),
+                "admitted_total": self.admitted_total,
+                "queued_total": self.queued_total,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_deadline": self.rejected_deadline,
+                "per_client_inflight": {
+                    c: b.inflight for c, b in self._budgets.items()
+                    if b.inflight
+                },
+            }
+
+    # -- metrics mirroring (no-ops without a registry) ---------------------
+
+    def _mirror_gauges(self) -> None:
+        reg = _reg()
+        if reg is None:
+            return
+        reg.set_gauge("admission_inflight", self._inflight)
+        reg.set_gauge("admission_queue_depth", self._waiting)
+        reg.set_gauge("admission_clients_waiting", len(self._queues))
+
+    def _mirror_queued(self) -> None:
+        reg = _reg()
+        if reg is not None:
+            reg.inc("admission_queued_total")
+            reg.set_gauge("admission_queue_depth", self._waiting)
+
+    def _mirror_reject(self, reason: str) -> None:
+        reg = _reg()
+        if reg is not None:
+            reg.inc("admission_rejected_total", reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# process-global instance
+
+_governor: AdmissionGovernor | None = None
+_governor_mu = threading.Lock()
+
+
+def governor() -> AdmissionGovernor:
+    global _governor
+    g = _governor
+    if g is None:
+        with _governor_mu:
+            if _governor is None:
+                _governor = AdmissionGovernor()
+            g = _governor
+    return g
+
+
+def reconfigure(config: AdmissionConfig | None = None) -> AdmissionGovernor:
+    """Swap the process governor (server boot after config load; tests).
+    Streams admitted under the old instance release against it — their
+    context managers hold the old object — so the swap is safe while
+    traffic is in flight."""
+    global _governor
+    with _governor_mu:
+        _governor = AdmissionGovernor(config)
+        return _governor

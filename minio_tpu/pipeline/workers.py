@@ -1,0 +1,670 @@
+"""GIL-free encode worker pool: native GF batch encode +
+hh256_hash_strided in child PROCESSES, fed through shared-memory strip
+segments — the fan-in half of the concurrency plane.
+
+Why processes: the native encode/hash calls already release the GIL,
+but with N concurrent PUT streams the Python orchestration around them
+(fill loops, writer fan-out, journal commits) contends on the main
+interpreter's GIL and the aggregate flatlines (c5 stuck ~0.23 GB/s for
+three rounds while every single-object number improved). Moving the
+per-batch compute off the main interpreter frees its GIL for
+orchestration and scales encode across cores for real. Subinterpreters
+would be the lighter vehicle, but per-interpreter GILs need 3.12+;
+`multiprocessing` with the spawn context works on the floor we have.
+
+Zero extra copies: the strip buffer a PUT stream fills (ONE readinto
+per block, exactly like the in-process driver) IS a shared-memory
+segment. The worker maps the same segment by name, computes parity
+into the segment's parity region (gf_native.apply_matrix_batch(out=))
+and the frame digests into its digest region (hash_strided_digests
+(out=)), and replies with a 2-tuple — no payload byte ever crosses the
+pipe. The parent then writev's shards straight out of the segment.
+`copy_counters` therefore stays at the PR3/PR6 floor (one source-read
+copy per input byte, nothing else) — asserted in tests.
+
+Fallback ladder (armed() is the single gate):
+- single-core hosts, MTPU_WORKER_POOL=off, no native engine, or spawn
+  failure → the in-process drivers, untouched;
+- a worker crash mid-batch (WorkerCrashed) → the caller recomputes
+  THAT batch in-process from the still-intact shm data — byte-
+  identical output, stream uninterrupted — and the pool respawns the
+  worker in background;
+- too many crashes → the pool disarms itself for the process lifetime.
+
+Shutdown discipline: workers are daemon processes AND an atexit hook
+drains them (quit message, join, terminate stragglers) and unlinks
+every shared-memory segment, so neither orphan processes nor
+/dev/shm litter outlive the parent. The strip pools register in
+pipeline.buffers._shared like every other recycled pool, so the chaos
+soak's `in_use == 0` sweep covers them.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import queue as _queue
+import threading
+import weakref
+
+import numpy as np
+
+DIGEST_SIZE = 32
+
+WORKER_DESCRIPTORS: list[tuple[str, str, str]] = [
+    ("worker_pool_workers", "gauge",
+     "Encode worker processes currently alive"),
+    ("worker_pool_busy", "gauge",
+     "Encode worker processes currently executing a batch"),
+    ("worker_tasks_total", "counter",
+     "Batches encoded+hashed by the worker pool"),
+    ("worker_fallbacks_total", "counter",
+     "Batches recomputed in-process after a worker failure"),
+    ("worker_crashes_total", "counter",
+     "Worker processes lost mid-task"),
+]
+
+_metrics = None
+_metrics_mu = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    with _metrics_mu:
+        _metrics = registry
+
+
+def _reg():
+    with _metrics_mu:
+        return _metrics
+
+
+class WorkerCrashed(RuntimeError):
+    """The worker process died (or wedged past the deadline) mid-task;
+    the task's shm inputs are intact — recompute in-process."""
+
+
+class WorkerUnavailable(RuntimeError):
+    """No worker could take the task (pool disarmed, all busy past the
+    wait bound, or the worker declined it); recompute in-process."""
+
+
+# ---------------------------------------------------------------------------
+# shared-memory strip segments
+
+# Every live segment (for atexit unlink): name -> weakref so pooled
+# segments die with their pool, not with this registry.
+_segments: "weakref.WeakValueDictionary[str, ShmStrip]" = (
+    weakref.WeakValueDictionary()
+)
+_segments_mu = threading.Lock()
+
+
+class ShmStrip:
+    """One shared-memory strip segment, laid out as
+    data [B, k*S] | parity [B, m, S] | digests [k+m, B, 32].
+
+    The data region is the block-major strip buffer the encode drivers
+    fill (same geometry as the in-process pools); parity and digests
+    are the worker's output regions. Views are numpy arrays over the
+    one mapping — nothing here copies."""
+
+    def __init__(self, batch: int, k: int, m: int, shard: int):
+        from multiprocessing import shared_memory
+
+        self.batch, self.k, self.m, self.shard = batch, k, m, shard
+        data_n = batch * k * shard
+        par_n = batch * m * shard
+        dig_n = (k + m) * batch * DIGEST_SIZE
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=data_n + par_n + dig_n
+        )
+        self.name = self._shm.name
+        buf = self._shm.buf
+        self.data = np.frombuffer(buf, dtype=np.uint8, count=data_n)\
+            .reshape(batch, k * shard)
+        self.parity = np.frombuffer(buf, dtype=np.uint8, count=par_n,
+                                    offset=data_n).reshape(batch, m, shard)
+        self.digests = np.frombuffer(
+            buf, dtype=np.uint8, count=dig_n, offset=data_n + par_n
+        ).reshape(k + m, batch, DIGEST_SIZE)
+        with _segments_mu:
+            _segments[self.name] = self
+
+    def close(self) -> None:
+        """Drop the numpy views, unmap, and unlink the segment. Safe to
+        call twice (pool drop + atexit sweep)."""
+        shm, self._shm = self._shm, None
+        if shm is None:
+            return
+        # The views pin the mapping; they must go first or close()
+        # raises BufferError.
+        self.data = self.parity = self.digests = None
+        try:
+            shm.close()
+        except BufferError:  # a stale external view still pins it
+            return
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+
+
+def strip_pool(batch: int, k: int, m: int, shard: int):
+    """Process-shared recycled pool of ShmStrip segments for one
+    geometry — the shm counterpart of the in-process strip pools, and
+    registered in the same `buffers._shared` registry so leak sweeps
+    (chaos soak `in_use == 0`) cover it."""
+    from .buffers import shared_pool
+
+    return shared_pool(
+        ("shm-strips", batch, k, m, shard),
+        lambda: ShmStrip(batch, k, m, shard),
+        capacity=8, name="shm-strips",
+    )
+
+
+def _sweep_segments() -> None:
+    with _segments_mu:
+        strips = list(_segments.values())
+    for s in strips:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+
+
+# ---------------------------------------------------------------------------
+# worker child
+
+def _attach_segment(name: str, batch: int, k: int, m: int, shard: int):
+    """Map the parent's segment by name for ONE task. Deliberately
+    uncached: the attach is microseconds against a multi-ms batch, and
+    a cache keyed by name would (a) pin every churned segment's memory
+    for the worker's lifetime and (b) compute into a STALE mapping if
+    the OS ever reuses a freed psm_ name. The child's resource tracker
+    must NOT adopt the segment — on 3.10 a tracked non-owner unlinks
+    it when the child exits (bpo-38119), yanking it from under the
+    parent."""
+    from multiprocessing import resource_tracker, shared_memory
+
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 - tracker internals moved
+        pass
+    data_n = batch * k * shard
+    par_n = batch * m * shard
+    dig_n = (k + m) * batch * DIGEST_SIZE
+    buf = shm.buf
+    return (
+        shm,
+        np.frombuffer(buf, dtype=np.uint8, count=data_n)
+        .reshape(batch, k * shard),
+        np.frombuffer(buf, dtype=np.uint8, count=par_n, offset=data_n)
+        .reshape(batch, m, shard),
+        np.frombuffer(buf, dtype=np.uint8, count=dig_n,
+                      offset=data_n + par_n)
+        .reshape(k + m, batch, DIGEST_SIZE),
+    )
+
+
+def _child_encode(mats: dict, name: str, batch: int, nb: int,
+                  k: int, m: int, shard: int) -> None:
+    """One batch: GF parity into the segment's parity region, frame
+    digests for all k+m shards into its digest region. Must stay
+    byte-identical to the in-process path: same parity matrix
+    derivation (ops/gf.parity_matrix), same native kernels."""
+    from ..erasure.bitrot import hash_strided_digests
+    from ..ops import gf_native
+
+    shm, data, parity, digests = _attach_segment(name, batch, k, m, shard)
+    try:
+        mat = mats.get((k, m))
+        if mat is None:
+            from ..ops import gf
+
+            mat = gf.parity_matrix(k, m)
+            mats[(k, m)] = mat
+        gf_native.apply_matrix_batch(
+            mat, data[:nb].reshape(nb, k, shard), out=parity[:nb]
+        )
+        row = k * shard
+        for j in range(k):
+            if hash_strided_digests(data, j * shard, row, nb, shard,
+                                    out=digests[j]) is None:
+                raise RuntimeError(
+                    "native strided hash unavailable in worker"
+                )
+        for pj in range(m):
+            hash_strided_digests(parity, pj * shard, m * shard, nb, shard,
+                                 out=digests[k + pj])
+    finally:
+        # Views pin the mapping: drop them before close. A lingering
+        # pin only delays the unmap to process exit — never fail a
+        # task that already computed correctly.
+        data = parity = digests = None  # noqa: F841
+        try:
+            shm.close()
+        except BufferError:  # pragma: no cover - stray external view
+            pass
+
+
+def _worker_cli() -> None:  # pragma: no cover - child process
+    """Child loop: unpickle task from stdin -> compute into shm ->
+    pickle reply to stdout. Plain subprocess transport (not
+    multiprocessing spawn): spawn re-executes the parent's __main__,
+    which breaks under pytest/stdin drivers, while stdin EOF here is a
+    natural orphan guard — the child exits the moment its parent dies.
+    Imports stay jax-free (numpy + the native lib); one native thread
+    per worker so W workers never oversubscribe the cores the parent
+    still needs."""
+    import pickle
+    import sys
+
+    os.environ.setdefault("MTPU_NATIVE_THREADS", "1")
+    inp = sys.stdin.buffer
+    out = sys.stdout.buffer
+    mats: dict = {}
+    try:
+        while True:
+            try:
+                msg = pickle.load(inp)
+            except (EOFError, OSError):
+                return
+            kind = msg[0]
+            if kind == "quit":
+                return
+            if kind == "ping":
+                pickle.dump(("ok", None), out)
+                out.flush()
+                continue
+            if kind == "crash":  # test hook: die mid-task
+                os._exit(42)
+            try:
+                _child_encode(mats, *msg[1:])
+            except Exception as exc:  # noqa: BLE001 - reported to parent
+                reply = ("err", f"{type(exc).__name__}: {exc}")
+            else:
+                reply = ("ok", None)
+            pickle.dump(reply, out)
+            out.flush()
+    except KeyboardInterrupt:
+        return
+
+
+# ---------------------------------------------------------------------------
+# parent-side pool
+
+class _Worker:
+    """One child process + its stdin/stdout pickle channel."""
+
+    __slots__ = ("proc",)
+
+    def __init__(self, proc):
+        self.proc = proc
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def send(self, msg: tuple) -> None:
+        import pickle
+
+        pickle.dump(msg, self.proc.stdin)
+        self.proc.stdin.flush()
+
+    def recv(self, timeout_s: float):
+        """Reply or None on timeout; raises EOFError/OSError when the
+        child died."""
+        import pickle
+        import select
+
+        ready, _, _ = select.select([self.proc.stdout], [], [], timeout_s)
+        if not ready:
+            return None
+        return pickle.load(self.proc.stdout)
+
+    def close(self) -> None:
+        for f in (self.proc.stdin, self.proc.stdout):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+
+def default_workers() -> int:
+    env = os.environ.get("MTPU_WORKER_POOL_SIZE", "")
+    if env.isdigit() and int(env) > 0:
+        return int(env)
+    return max(1, os.cpu_count() or 1)
+
+
+class WorkerPool:
+    """Fixed-size pool of encode worker processes with an idle queue.
+    Dispatch is request/response per batch — the caller's pipeline
+    stage blocks on the reply (the pipe recv releases the GIL), while
+    the stream's fill and writev stages keep running on their own
+    threads. Crashed workers are retired, counted, and respawned in
+    background; past `max_respawns` the pool disarms for good."""
+
+    def __init__(self, n: int | None = None,
+                 deadline_s: float | None = None):
+        self.n = n or default_workers()
+        self.deadline_s = deadline_s if deadline_s is not None else float(
+            os.environ.get("MTPU_WORKER_DEADLINE_S", "30")
+        )
+        self.max_respawns = 3 * self.n
+        self._idle: _queue.Queue = _queue.Queue()
+        self._workers: list[_Worker] = []
+        self._mu = threading.Lock()
+        self._dead = False
+        self._respawns = 0
+        self._busy = 0
+        # Counters (mirrored onto the registry when installed).
+        self.tasks_total = 0
+        self.fallbacks_total = 0
+        self.crashes_total = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        for _ in range(self.n):
+            self._spawn()
+        self._gauge()
+
+    def _spawn(self) -> None:
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        # The child must import THIS package, whatever the parent's
+        # entry point was (pytest, bench, the server binary).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env.setdefault("MTPU_NATIVE_THREADS", "1")
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "from minio_tpu.pipeline.workers import _worker_cli; "
+             "_worker_cli()"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+        )
+        w = _Worker(proc)
+        with self._mu:
+            self._workers.append(w)
+        self._idle.put(w)
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Quit every worker, join, terminate stragglers. Leaves the
+        pool disarmed; shm segments are owned by the strip pools (and
+        the atexit sweep), not by this object."""
+        with self._mu:
+            self._dead = True
+            workers, self._workers = self._workers, []
+        import subprocess
+
+        for w in workers:
+            try:
+                w.send(("quit",))
+            except (BrokenPipeError, OSError):
+                pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                w.proc.terminate()
+                try:
+                    w.proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait()
+            w.close()
+        # Drain idle refs so nothing resurrects a closed pipe.
+        while True:
+            try:
+                self._idle.get_nowait()
+            except _queue.Empty:
+                break
+        self._gauge()
+
+    def alive(self) -> bool:
+        with self._mu:
+            return not self._dead and bool(self._workers)
+
+    def live_pids(self) -> list[int]:
+        with self._mu:
+            return [w.pid for w in self._workers
+                    if w.proc.poll() is None]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def encode_batch(self, strip: ShmStrip, nb: int,
+                     _test_crash: bool = False) -> None:
+        """Run one batch's GF encode + strided digests in a worker.
+        On return, strip.parity[:nb] and strip.digests[:, :nb] hold
+        the results. Raises WorkerCrashed / WorkerUnavailable; the shm
+        data region is untouched either way, so callers recompute
+        in-process from the same bytes."""
+        if not self.alive():
+            raise WorkerUnavailable("worker pool not running")
+        try:
+            # Workers ≈ cores and admission bounds concurrent streams
+            # to the same order, so a short wait means a worker frees
+            # within one batch time; past it, in-process is faster.
+            w = self._idle.get(timeout=self.deadline_s)
+        except _queue.Empty:
+            raise WorkerUnavailable(
+                f"no idle encode worker within {self.deadline_s}s"
+            ) from None
+        with self._mu:
+            self._busy += 1
+        self._gauge()
+        healthy = False
+        try:
+            if _test_crash:
+                w.send(("crash",))
+            else:
+                w.send(("enc", strip.name, strip.batch, nb,
+                        strip.k, strip.m, strip.shard))
+            reply = w.recv(self.deadline_s)
+            if reply is None:
+                raise WorkerCrashed(
+                    f"worker pid {w.pid} silent past {self.deadline_s}s"
+                )
+            status, err = reply
+        except Exception as exc:  # noqa: BLE001 - ANY channel fault
+            # EOF/pipe errors, a reply garbled by stray stdout output,
+            # a truncated pickle from a dying child — every channel
+            # fault classifies as a crash so the caller's in-process
+            # fallback runs and the worker is retired, never leaked.
+            self._retire(w)
+            raise exc if isinstance(exc, WorkerCrashed) else WorkerCrashed(
+                f"worker pid {w.pid} channel fault: "
+                f"{type(exc).__name__}: {exc}"
+            ) from None
+        else:
+            healthy = True
+        finally:
+            with self._mu:
+                self._busy -= 1
+            if healthy:
+                self._idle.put(w)
+            self._gauge()
+        if status != "ok":
+            # The worker itself is fine; THIS task cannot run there
+            # (e.g. native lib failed to build in the child).
+            raise WorkerUnavailable(err or "worker declined the task")
+        self.tasks_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("worker_tasks_total")
+
+    def _retire(self, w: _Worker) -> None:
+        """Drop a crashed worker and respawn a replacement off the
+        caller's critical path; disarm the pool past the respawn cap
+        (something is systematically killing workers)."""
+        self.crashes_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("worker_crashes_total")
+        import subprocess
+
+        try:
+            w.proc.terminate()
+            w.proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            # A child wedged in a native call ignores SIGTERM; it MUST
+            # die before the caller's fallback recomputes and the shm
+            # strip recycles — a surviving child would scribble its
+            # stale task into a segment another stream now owns.
+            try:
+                w.proc.kill()
+                w.proc.wait(timeout=2.0)
+            except Exception:  # noqa: BLE001 - unkillable (D-state)
+                pass
+        except Exception:  # noqa: BLE001 - already dead
+            pass
+        w.close()
+        with self._mu:
+            if w in self._workers:
+                self._workers.remove(w)
+            self._respawns += 1
+            if self._respawns > self.max_respawns:
+                self._dead = True
+                return
+            if self._dead:
+                return
+        threading.Thread(target=self._respawn_safe, daemon=True,
+                         name="mtpu-worker-respawn").start()
+
+    def _respawn_safe(self) -> None:
+        try:
+            self._spawn()
+        except Exception:  # noqa: BLE001 - disarm instead of crashing
+            with self._mu:
+                self._dead = True
+        self._gauge()
+
+    def note_fallback(self) -> None:
+        self.fallbacks_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("worker_fallbacks_total")
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _gauge(self) -> None:
+        reg = _reg()
+        if reg is None:
+            return
+        with self._mu:
+            n, busy = len(self._workers), self._busy
+        reg.set_gauge("worker_pool_workers", n)
+        reg.set_gauge("worker_pool_busy", busy)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "workers": len(self._workers),
+                "busy": self._busy,
+                "dead": self._dead,
+                "respawns": self._respawns,
+                "tasks_total": self.tasks_total,
+                "fallbacks_total": self.fallbacks_total,
+                "crashes_total": self.crashes_total,
+            }
+
+
+# ---------------------------------------------------------------------------
+# process-global arming
+
+_pool: WorkerPool | None = None
+_pool_mu = threading.Lock()
+_atexit_registered = False
+
+
+def _supported() -> bool:
+    if (os.cpu_count() or 1) < 2:
+        return False  # single core: processes only add context switches
+    from ..ops import gf_native
+
+    if not gf_native.available():
+        return False
+    from .. import native
+
+    return native.load() is not None  # hh256_hash_strided needs the lib
+
+
+def ensure_pool(n: int | None = None) -> WorkerPool | None:
+    """Start (or return) the process-wide pool; None when unsupported
+    or permanently disarmed. Safe to call from any thread."""
+    global _pool, _atexit_registered
+    with _pool_mu:
+        if _pool is not None:
+            return _pool if _pool.alive() else None
+        if not _supported():
+            return None
+        pool = WorkerPool(n)
+        try:
+            pool.start()
+        except Exception:  # noqa: BLE001 - no spawn here (e.g. sandbox)
+            pool.shutdown(timeout_s=0.5)
+            return None
+        _pool = pool
+        if not _atexit_registered:
+            atexit.register(shutdown)
+            _atexit_registered = True
+        return pool
+
+
+def get_pool() -> WorkerPool | None:
+    with _pool_mu:
+        return _pool if _pool is not None and _pool.alive() else None
+
+
+def armed() -> WorkerPool | None:
+    """The gate the encode drivers consult per stream: a live pool
+    ONLY while MTPU_WORKER_POOL is explicitly on. The env knob is read
+    per call so tests/operators can flip it without a restart — and an
+    already-running pool does NOT capture streams once the knob is
+    cleared (a bench section arming the pool must not silently change
+    every later stream in the process)."""
+    env = os.environ.get("MTPU_WORKER_POOL", "").lower()
+    if env not in ("1", "on", "auto", "true"):
+        return None
+    pool = get_pool()
+    return pool if pool is not None else ensure_pool()
+
+
+def _purge_strip_pools() -> None:
+    """Drop the shm strip pools from the shared-pool registry: their
+    freelisted segments are about to be unlinked, and handing a dead
+    segment to the next armed stream would crash it. A later arm
+    builds fresh pools."""
+    from . import buffers
+
+    with buffers._shared_mu:
+        for key in [k for k in buffers._shared
+                    if isinstance(k, tuple) and k and k[0] == "shm-strips"]:
+            buffers._shared.pop(key, None)
+
+
+def shutdown() -> None:
+    """Stop the pool, drop the strip pools, and unlink every live shm
+    segment (atexit; also called by tests asserting clean teardown)."""
+    global _pool
+    with _pool_mu:
+        pool, _pool = _pool, None
+    if pool is not None:
+        pool.shutdown()
+    _purge_strip_pools()
+    _sweep_segments()

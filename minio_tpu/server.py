@@ -146,6 +146,16 @@ class Server:
         _streaming.set_metrics(self.metrics)
         _dsync.set_metrics(self.metrics)
         _fanout.set_metrics(self.metrics)
+        # Concurrency plane: the encode admission governor and the
+        # GIL-free worker pool mirror admitted/queued/rejected and
+        # worker-health series onto the same registry (mtpu_admission_*
+        # / mtpu_worker_*). Arming the pool itself stays env-driven
+        # (MTPU_WORKER_POOL) — see docs/DEPLOYMENT.md.
+        from .pipeline import admission as _admission
+        from .pipeline import workers as _workers
+
+        _admission.set_metrics(self.metrics)
+        _workers.set_metrics(self.metrics)
         # Runtime lock-order checker (tools/analysis/lockgraph): armed
         # only when the operator sets MTPU_LOCK_CHECK=1 — instruments
         # every lock created from here on and exposes cycle/hold-time

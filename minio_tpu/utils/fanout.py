@@ -13,7 +13,6 @@ import io
 import os
 import threading
 import time
-from contextlib import contextmanager
 
 SINGLE_CORE = (os.cpu_count() or 1) == 1
 
@@ -44,37 +43,29 @@ def _note_late_drop(err) -> None:
 
 # Admission control for the CPU-bound encode+hash+write section of PUT
 # and multipart part uploads: at most cpu_count streams run it
-# concurrently; excess uploads queue, and a queue wait past the deadline
-# returns 503 like the reference's maxClients throttle
-# (cmd/handler-api.go:36-78) — on a small host, N concurrent encode
-# pipelines thrash caches and aggregate BELOW one serial stream
-# (measured: 8-way 0.229 GB/s vs serial 0.283 on 1 core). Lives here so
-# every encode entry point (PUT, multipart) shares one slot pool.
-_encode_slots = threading.BoundedSemaphore(
-    int(os.environ.get("MTPU_MAX_CONCURRENT_ENCODES", "0"))
-    or max(1, os.cpu_count() or 1)
-)
+# concurrently; excess uploads queue FAIRLY (round-robin across
+# clients, per-client in-flight caps), deep queues reject immediately,
+# and a queue wait past the deadline returns 503 like the reference's
+# maxClients throttle (cmd/handler-api.go:36-78) — on a small host, N
+# concurrent encode pipelines thrash caches and aggregate BELOW one
+# serial stream (measured: 8-way 0.229 GB/s vs serial 0.283 on 1
+# core). The policy lives in pipeline/admission.AdmissionGovernor;
+# this wrapper exists so every encode entry point (PUT, multipart)
+# keeps one call shape.
 ENCODE_SLOT_DEADLINE_S = float(
     os.environ.get("MTPU_ENCODE_SLOT_DEADLINE_S", "30")
 )
 
 
-@contextmanager
 def encode_slot():
-    """Bounded admission: a slow uploader holding a slot must not wedge
-    every other PUT forever — waiters time out to a retriable 503
-    (ErrOperationTimedOut), matching the reference's deadline'd
-    maxClients queue."""
-    from .errors import ErrOperationTimedOut
+    """Bounded fair admission: a slow uploader holding a slot must not
+    wedge every other PUT forever — waiters time out to a retriable
+    503 (ErrOperationTimedOut), a full queue rejects immediately, and
+    one hot client cannot starve the rest (the governor's round-robin
+    grant order)."""
+    from ..pipeline.admission import governor
 
-    if not _encode_slots.acquire(timeout=ENCODE_SLOT_DEADLINE_S):
-        raise ErrOperationTimedOut(
-            "server busy: PUT admission queue deadline exceeded"
-        )
-    try:
-        yield
-    finally:
-        _encode_slots.release()
+    return governor().slot()
 
 
 def is_local_sink(sink) -> bool:

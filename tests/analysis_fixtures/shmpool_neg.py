@@ -1,0 +1,34 @@
+"""pool-lint NEGATIVE fixture (worker plane): shared-memory strip
+checkouts with every accepted protection shape."""
+from minio_tpu.pipeline.workers import strip_pool
+
+strips = strip_pool(8, 12, 4, 87382)
+
+
+def safe_encode(wp, nb):
+    seg = strips.acquire()
+    try:
+        wp.encode_batch(seg, nb)
+        return nb
+    finally:
+        strips.release(seg)
+
+
+def fallback_encode(wp, nb):
+    seg = strips.acquire()
+    try:
+        wp.encode_batch(seg, nb)
+        return nb
+    except RuntimeError:
+        strips.release(seg)
+        raise
+
+
+def transfer():
+    return strips.acquire()  # ownership moves to the caller
+
+
+def waived_handoff():
+    # pool-ok: the pipeline item's drop hook owns the release
+    seg = strips.acquire()
+    return [seg, None]

@@ -1,0 +1,13 @@
+"""pool-lint POSITIVE fixture (worker plane): a shared-memory strip
+checkout with no release on the exception edge. The receiver name
+carries no "pool" — only the strip_pool factory tracking catches it."""
+from minio_tpu.pipeline.workers import strip_pool
+
+strips = strip_pool(8, 12, 4, 87382)
+
+
+def leaky_encode(wp, nb):
+    seg = strips.acquire()
+    wp.encode_batch(seg, nb)  # raises WorkerCrashed: segment leaked
+    strips.release(seg)
+    return nb

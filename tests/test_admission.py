@@ -1,0 +1,230 @@
+"""Admission governor (ISSUE 7): bounded fair fan-in — per-client
+caps, round-robin grant order, queue-depth rejection, deadline 503s,
+and the metrics mirror."""
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.pipeline import admission
+from minio_tpu.pipeline.admission import AdmissionConfig, AdmissionGovernor
+from minio_tpu.utils.errors import ErrOperationTimedOut
+
+
+@pytest.fixture(autouse=True)
+def _fresh_governor():
+    yield
+    # Tests swap the process governor; restore the env-derived default
+    # so later tests (PUT paths) see production admission behavior.
+    admission.reconfigure()
+    admission.set_metrics(None)
+
+
+def test_fast_path_admits_without_queueing():
+    g = AdmissionGovernor(AdmissionConfig(slots=2, per_client_cap=2,
+                                          max_queue=4, deadline_s=1.0))
+    with g.slot("a"):
+        with g.slot("b"):
+            snap = g.snapshot()
+            assert snap["inflight"] == 2
+            assert snap["queued_total"] == 0
+    assert g.snapshot()["inflight"] == 0
+    assert g.admitted_total == 2
+
+
+def test_round_robin_across_clients_fifo_within():
+    """One hot client with 3 queued streams must not starve a second
+    client: grant order is hot1, cold1, hot2, hot3."""
+    g = AdmissionGovernor(AdmissionConfig(slots=1, per_client_cap=1,
+                                          max_queue=8, deadline_s=10.0))
+    g.acquire("holder")
+    order: list[str] = []
+    order_mu = threading.Lock()
+    started = []
+
+    def run(tag, client):
+        ev = threading.Event()
+        started.append(ev)
+
+        def body():
+            ev.set()
+            g.acquire(client)
+            with order_mu:
+                order.append(tag)
+            g.release(client)
+
+        t = threading.Thread(target=body)
+        t.start()
+        ev.wait()
+        time.sleep(0.05)  # deterministic enqueue order
+        return t
+
+    threads = [run("hot1", "hot"), run("hot2", "hot"),
+               run("hot3", "hot"), run("cold1", "cold")]
+    g.release("holder")
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["hot1", "cold1", "hot2", "hot3"], order
+
+
+def test_per_client_cap_binds_only_the_hot_client():
+    g = AdmissionGovernor(AdmissionConfig(slots=4, per_client_cap=2,
+                                          max_queue=8, deadline_s=0.1))
+    g.acquire("hot")
+    g.acquire("hot")
+    with pytest.raises(ErrOperationTimedOut):
+        g.acquire("hot")  # over the per-client cap -> deadline 503
+    assert g.rejected_deadline == 1
+    g.acquire("cold")  # other clients unaffected
+    for c in ("hot", "hot", "cold"):
+        g.release(c)
+
+
+def test_queue_full_rejects_immediately():
+    g = AdmissionGovernor(AdmissionConfig(slots=1, per_client_cap=1,
+                                          max_queue=1, deadline_s=5.0))
+    g.acquire("a")
+    waiter_in = threading.Event()
+
+    def waiter():
+        waiter_in.set()
+        try:
+            g.acquire("b")
+            g.release("b")
+        except ErrOperationTimedOut:
+            pass
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    waiter_in.wait()
+    deadline = time.monotonic() + 2.0
+    while g.snapshot()["waiting"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    with pytest.raises(ErrOperationTimedOut):
+        g.acquire("c")
+    assert time.monotonic() - t0 < 1.0, "queue-full must fail fast"
+    assert g.rejected_queue_full == 1
+    g.release("a")
+    t.join(timeout=5)
+
+
+def test_encode_slot_rides_the_governor(monkeypatch):
+    """utils/fanout.encode_slot is the governor's front door: a held
+    slot plus a tiny deadline turns the next PUT admission into a
+    retriable 503."""
+    from minio_tpu.utils.fanout import encode_slot
+
+    g = admission.reconfigure(AdmissionConfig(
+        slots=1, per_client_cap=1, max_queue=4, deadline_s=0.05))
+    g.acquire("occupant")
+    try:
+        with pytest.raises(ErrOperationTimedOut):
+            with encode_slot():
+                pass
+    finally:
+        g.release("occupant")
+    with encode_slot():
+        assert g.snapshot()["inflight"] == 1
+
+
+def test_client_context_tags_the_caller():
+    g = admission.reconfigure(AdmissionConfig(
+        slots=2, per_client_cap=1, max_queue=4, deadline_s=0.05))
+    with admission.client_context("tenant-a"):
+        g.acquire()
+        assert g.snapshot()["per_client_inflight"] == {"tenant-a": 1}
+        with pytest.raises(ErrOperationTimedOut):
+            g.acquire()  # same client, cap 1
+        g.release()
+    assert g.snapshot()["inflight"] == 0
+
+
+def test_capped_client_grant_wakes_promptly():
+    """Review regression: a waiter granted on an EARLY rotation pass
+    must be notified — with spare global slots but a capped client,
+    the grant loop's last pass grants nothing, and keying the notify
+    on it left the grantee sleeping out its whole deadline."""
+    g = AdmissionGovernor(AdmissionConfig(slots=8, per_client_cap=2,
+                                          max_queue=8, deadline_s=30.0))
+    g.acquire("a")
+    g.acquire("a")  # at cap; 6 global slots still free
+    granted_at = {}
+
+    def waiter():
+        g.acquire("a")
+        granted_at["t"] = time.monotonic()
+        g.release("a")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while g.snapshot()["waiting"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    g.release("a")  # frees cap room -> waiter must wake NOW
+    t.join(timeout=5)
+    assert "t" in granted_at, "waiter never granted"
+    assert granted_at["t"] - t0 < 1.0, "grant notification lost"
+    g.release("a")
+
+
+def test_env_zero_slots_means_cpu_default(monkeypatch):
+    """Review regression: MTPU_MAX_CONCURRENT_ENCODES=0 meant 'use the
+    cpu-count default' under the old semaphore and must keep meaning
+    that — not one serialized slot."""
+    import os
+
+    monkeypatch.setenv("MTPU_MAX_CONCURRENT_ENCODES", "0")
+    cfg = AdmissionConfig.from_env()
+    assert cfg.slots == max(1, os.cpu_count() or 1)
+
+
+def test_idle_client_budgets_evicted():
+    """Review regression: per-client token budgets must not accrete
+    forever (STS deployments mint a new access key per session)."""
+    g = AdmissionGovernor(AdmissionConfig(slots=4, per_client_cap=2,
+                                          max_queue=8, deadline_s=1.0))
+    for i in range(50):
+        with g.slot(f"ephemeral-{i}"):
+            pass
+    assert g._budgets == {}
+
+
+class _FakeRegistry:
+    def __init__(self):
+        self.counts: dict = {}
+        self.gauges: dict = {}
+
+    def inc(self, name, n=1, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def set_gauge(self, name, value, **labels):
+        self.gauges[(name, tuple(sorted(labels.items())))] = value
+
+
+def test_metrics_mirroring():
+    reg = _FakeRegistry()
+    admission.set_metrics(reg)
+    g = AdmissionGovernor(AdmissionConfig(slots=1, per_client_cap=1,
+                                          max_queue=0, deadline_s=0.05))
+    with g.slot("a"):
+        with pytest.raises(ErrOperationTimedOut):
+            g.acquire("b")  # queue depth 0 -> immediate reject
+    assert reg.counts[("admission_admitted_total", ())] == 1
+    assert reg.counts[(
+        "admission_rejected_total", (("reason", "queue_full"),)
+    )] == 1
+    assert reg.gauges[("admission_inflight", ())] == 0
+
+
+def test_descriptors_registered_in_catalog():
+    from minio_tpu.observability.metrics_v2 import DESCRIPTORS
+
+    names = {d[0] for d in DESCRIPTORS}
+    for want in ("admission_admitted_total", "admission_rejected_total",
+                 "admission_inflight", "worker_pool_workers",
+                 "worker_fallbacks_total"):
+        assert want in names

@@ -26,13 +26,19 @@ def test_zero_copy_reader_contract():
 
     payload = bytes(range(256)) * 10
     r = _ZeroCopyReader(payload)
-    assert r.read(100) == payload[:100]
+    first = r.read(100)
+    assert first == payload[:100]
+    # The c5/c6 harness must stay off the copy budget: read() hands
+    # out VIEWS of the shared payload, not per-call bytes copies.
+    assert isinstance(first, memoryview)
+    assert first.obj is payload
     buf = bytearray(50)
     assert r.readinto(buf) == 50
     assert bytes(buf) == payload[100:150]
     rest = r.read()
     assert rest == payload[150:]
     assert r.read(10) == b""
+    assert not r.read(10)  # exhausted view is falsy, like b""
 
 
 def test_heal_bench_survives_reps(tmp_path):
@@ -210,6 +216,103 @@ def test_bench_mesh_sweep_reports_dispatch_invariants(monkeypatch):
     assert entry["dispatches_per_batch"] == 1.0, entry
     assert entry["steady_state_retraces"] == 0, entry
     assert entry["collective_bytes_per_input_byte"] > 0, entry
+
+
+def test_c6_closed_loop_config_shape(tmp_path):
+    """ISSUE 7 satellite: the c6 many-client config must carry the
+    repeatability-protocol fields (runs/dispersion/memcpy) PLUS the
+    closed-loop latency percentiles for every N, and skip cleanly on
+    1-core hosts."""
+    import os
+
+    import bench
+
+    if (os.cpu_count() or 1) < 2:
+        out = bench.bench_config6_closed_loop(str(tmp_path))
+        assert out == {
+            "skipped": "single-core host: no fan-in concurrency"
+        }
+        return
+    out = bench.bench_config6_closed_loop(
+        str(tmp_path), ns=(2,), ops_per_client=1, size=1 << 20, runs=1
+    )
+    entry = out["n2"]
+    for field in ("value", "runs", "dispersion", "host_memcpy_gbps",
+                  "value_per_memcpy", "p50_ms", "p99_ms",
+                  "admission_retries"):
+        assert field in entry, (field, entry)
+    assert entry["value"] > 0
+    assert 0 < entry["p50_ms"] <= entry["p99_ms"]
+    assert "admission" in out and out["admission"]["admitted_total"] > 0
+
+
+def test_c6_skips_on_one_core(tmp_path, monkeypatch):
+    import os
+
+    import bench
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    out = bench.bench_config6_closed_loop(str(tmp_path))
+    assert out == {"skipped": "single-core host: no fan-in concurrency"}
+
+
+def test_worker_pool_path_keeps_copy_floor(tmp_path, monkeypatch):
+    """copies_per_input_byte must be UNCHANGED under the worker-pool
+    path: the shm strip is filled by the same one-readinto-per-block
+    source read, and no payload byte crosses the worker pipe."""
+    import io
+    import os
+
+    import numpy as np
+
+    from minio_tpu.erasure.bitrot import (
+        BitrotAlgorithm,
+        StreamingBitrotWriter,
+    )
+    from minio_tpu.erasure.codec import Erasure
+    from minio_tpu.erasure.streaming import encode_stream
+    from minio_tpu.ops import gf_native
+    from minio_tpu.pipeline import workers
+    from minio_tpu.pipeline.buffers import COPY
+
+    if (os.cpu_count() or 1) < 2 or not gf_native.available():
+        import pytest
+
+        pytest.skip("worker pool inactive on this host")
+    monkeypatch.setenv("MTPU_WORKER_POOL", "1")
+    assert workers.ensure_pool() is not None
+    er = Erasure(4, 2, 1 << 18)
+    size = (1 << 18) * 12
+    payload = np.random.default_rng(8).integers(
+        0, 256, size, np.uint8
+    ).tobytes()
+    COPY.reset()
+    writers = [StreamingBitrotWriter(io.BytesIO(),
+                                     BitrotAlgorithm.HIGHWAYHASH256S)
+               for _ in range(6)]
+    n = encode_stream(er, io.BytesIO(payload), writers, 5)
+    assert n == size
+    cc = COPY.snapshot()
+    moved = sum(cc.values())
+    # Exactly one ingest copy per input byte, nothing else.
+    assert cc.get("put.source_read", 0) == size, cc
+    assert cc.get("put.frame_copy", 0) == 0, cc
+    assert cc.get("put.pack_copy", 0) == 0, cc
+    assert round(moved / size, 3) <= 1.05, cc
+
+
+def test_multipart_parallel_bench_shape(tmp_path):
+    import os
+
+    import bench
+
+    out = bench.bench_multipart_parallel(str(tmp_path), total_mib=8)
+    if (os.cpu_count() or 1) < 2:
+        assert "skipped" in out
+        return
+    assert out["serial_put_gbps"] > 0
+    assert out["parallel_put_gbps"] > 0
+    assert out["etag"].endswith(f"-{out['parts']}")
 
 
 def test_config_repeatability_protocol(monkeypatch):

@@ -56,6 +56,33 @@ def _lockgraph_armed():
         )
 
 
+@pytest.fixture(autouse=True)
+def _worker_pool_armed(monkeypatch):
+    """Soak with the GIL-free worker pool ARMED (when the host can run
+    it): the fault schedule then exercises the worker dispatch path
+    too, and the teardown check extends the pool-leak sweep to the
+    shared-memory strip pools plus asserts no worker process leaked."""
+    import os
+
+    from minio_tpu.ops import gf_native
+    from minio_tpu.pipeline import workers
+
+    if (os.cpu_count() or 1) >= 2 and gf_native.available():
+        monkeypatch.setenv("MTPU_WORKER_POOL", "1")
+        workers.ensure_pool()
+    yield
+    pool = workers.get_pool()
+    if pool is not None:
+        pids = pool.live_pids()
+        workers.shutdown()
+        for pid in pids:
+            if os.path.exists(f"/proc/{pid}"):
+                with open(f"/proc/{pid}/stat") as f:
+                    assert f.read().split()[2] == "Z", (
+                        f"orphan encode worker {pid} after soak"
+                    )
+
+
 @pytest.mark.slow
 def test_chaos_soak_no_stall_no_loss(tmp_path):
     with robust_overrides(op_deadline_s=OP_DEADLINE_S,

@@ -75,6 +75,7 @@ RULE_CASES = [
     ("copy-lint", "copy_pos.py", "copy_neg.py", 6),
     ("lock-lint", "lock_pos.py", "lock_neg.py", 4),
     ("pool-lint", "pool_pos.py", "pool_neg.py", 1),
+    ("pool-lint", "shmpool_pos.py", "shmpool_neg.py", 1),
     ("jax-lint", "jax_pos.py", "jax_neg.py", 5),
     ("except-lint", "except_pos.py", "except_neg.py", 2),
 ]

@@ -1,0 +1,201 @@
+"""Worker-pool failure modes and invariants (ISSUE 7): the GIL-free
+encode pool must be byte-identical to the in-process path, survive
+worker crashes mid-stream via in-process fallback, shut down without
+orphan processes or leaked shared-memory, and keep the zero-copy
+floor."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import streaming
+from minio_tpu.erasure.bitrot import BitrotAlgorithm, StreamingBitrotWriter
+from minio_tpu.erasure.codec import Erasure
+from minio_tpu.ops import gf_native
+from minio_tpu.pipeline import workers
+from minio_tpu.pipeline.buffers import COPY, _shared
+
+pytestmark = pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2 or not gf_native.available(),
+    reason="worker pool needs >=2 cores and the native engine",
+)
+
+BLOCK = 1 << 18
+K, M = 4, 2
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("MTPU_WORKER_POOL", "1")
+    pool = workers.ensure_pool()
+    assert pool is not None, "pool failed to start on a capable host"
+    yield pool
+
+
+def _encode(payload: bytes, erasure: Erasure | None = None):
+    er = erasure or Erasure(K, M, BLOCK)
+    sinks = [io.BytesIO() for _ in range(er.total_shards)]
+    ws = [StreamingBitrotWriter(s, BitrotAlgorithm.HIGHWAYHASH256S)
+          for s in sinks]
+    n = streaming.encode_stream(er, io.BytesIO(payload), ws,
+                                er.data_blocks + 1)
+    assert n == len(payload)
+    return [s.getvalue() for s in sinks]
+
+
+def _payload(n: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, n, np.uint8
+    ).tobytes()
+
+
+def test_worker_path_byte_identical(armed, monkeypatch):
+    """Shard files from the worker path must equal the in-process
+    path bit for bit — multi-batch, ragged tail, and single-batch
+    (the inline worker shortcut) shapes."""
+    for size in (BLOCK * 20 + 777, BLOCK * 3, BLOCK // 2, 0):
+        payload = _payload(size, seed=size or 7)
+        monkeypatch.setenv("MTPU_WORKER_POOL", "1")
+        a = _encode(payload)
+        monkeypatch.setenv("MTPU_WORKER_POOL", "off")
+        b = _encode(payload)
+        assert a == b, f"worker path diverged at size {size}"
+
+
+def test_worker_path_keeps_copy_floor(armed):
+    """No payload byte crosses the pipe: the worker path's only copy
+    sites are the source read (exactly one pass) and the short tail."""
+    size = BLOCK * 12 + 345
+    payload = _payload(size, seed=3)
+    COPY.reset()
+    _encode(payload)
+    cc = COPY.snapshot()
+    assert cc.get("put.source_read", 0) == size, cc
+    allowed = {"put.source_read", "put.tail_copy"}
+    extra = {k: v for k, v in cc.items()
+             if k not in allowed and v > 0}
+    assert not extra, f"worker path grew copy sites: {extra}"
+
+
+def test_crash_midstream_falls_back_byte_identical(armed, monkeypatch):
+    """A worker dying mid-part must not fail (or corrupt) the stream:
+    the driver recomputes the batch in-process from the intact shm
+    data. Injected deterministically: first dispatch raises
+    WorkerCrashed, the rest go through."""
+    calls = {"n": 0}
+    real = workers.WorkerPool.encode_batch
+
+    def flaky(self, strip, nb, _test_crash=False):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise workers.WorkerCrashed("injected mid-part crash")
+        return real(self, strip, nb, _test_crash)
+
+    monkeypatch.setattr(workers.WorkerPool, "encode_batch", flaky)
+    payload = _payload(BLOCK * 20 + 99, seed=11)
+    before = armed.fallbacks_total
+    a = _encode(payload)
+    assert calls["n"] >= 2
+    assert armed.fallbacks_total == before + 1
+    monkeypatch.setenv("MTPU_WORKER_POOL", "off")
+    assert a == _encode(payload)
+
+
+def test_real_crash_retires_and_respawns(armed):
+    """The test-hook crash kills a real worker process mid-task: the
+    pool must classify it, replace the worker, and keep serving."""
+    er = Erasure(K, M, BLOCK)
+    pool = workers.strip_pool(8, K, M, er.shard_size())
+    strip = pool.acquire()
+    try:
+        with pytest.raises(workers.WorkerCrashed):
+            armed.encode_batch(strip, 2, _test_crash=True)
+    finally:
+        pool.release(strip)
+    assert armed.crashes_total >= 1
+    # Respawn happens in background; the next stream must still work
+    # (either on the replacement or via fallback).
+    payload = _payload(BLOCK * 10, seed=5)
+    a = _encode(payload)
+    os.environ["MTPU_WORKER_POOL"] = "off"
+    try:
+        assert a == _encode(payload)
+    finally:
+        os.environ["MTPU_WORKER_POOL"] = "1"
+
+
+def test_shutdown_no_orphans_and_pools_clean(monkeypatch):
+    """Pool shutdown must leave zero worker processes, in_use == 0 on
+    every shared strip pool, and every shm segment closed."""
+    monkeypatch.setenv("MTPU_WORKER_POOL", "1")
+    pool = workers.ensure_pool()
+    assert pool is not None
+    _encode(_payload(BLOCK * 16, seed=9))
+    pids = pool.live_pids()
+    assert pids, "no live workers before shutdown"
+    workers.shutdown()
+    for pid in pids:
+        alive = os.path.exists(f"/proc/{pid}")
+        if alive:
+            # Zombie already reaped by wait(); a live dir with state Z
+            # is not an orphan.
+            with open(f"/proc/{pid}/stat") as f:
+                assert f.read().split()[2] == "Z", f"orphan worker {pid}"
+    for key, p in list(_shared.items()):
+        if key and key[0] == "shm-strips":
+            assert p.stats()["in_use"] == 0, (key, p.stats())
+    # Re-arming after shutdown must build a fresh, working pool.
+    pool2 = workers.ensure_pool()
+    assert pool2 is not None and pool2 is not pool
+    a = _encode(_payload(BLOCK * 10, seed=13))
+    monkeypatch.setenv("MTPU_WORKER_POOL", "off")
+    assert a == _encode(_payload(BLOCK * 10, seed=13))
+
+
+def test_garbled_reply_classifies_as_crash(armed, monkeypatch):
+    """Review regression: a reply corrupted by stray stdout output (or
+    a truncated pickle) must classify as WorkerCrashed — retiring the
+    worker and triggering the in-process fallback — not escape as an
+    opaque error that fails the PUT and leaks the worker slot."""
+    before = armed.crashes_total
+    real_recv = workers._Worker.recv
+    poisoned = {"done": False}
+
+    def garbled(self, timeout_s):
+        if not poisoned["done"]:
+            poisoned["done"] = True
+            raise ValueError("unpickling stream corrupted")
+        return real_recv(self, timeout_s)
+
+    monkeypatch.setattr(workers._Worker, "recv", garbled)
+    er = Erasure(K, M, BLOCK)
+    pool = workers.strip_pool(8, K, M, er.shard_size())
+    strip = pool.acquire()
+    try:
+        with pytest.raises(workers.WorkerCrashed):
+            armed.encode_batch(strip, 2)
+    finally:
+        pool.release(strip)
+    assert armed.crashes_total == before + 1
+    # The stream-level ladder still produces byte-identical output.
+    payload = _payload(BLOCK * 10, seed=31)
+    a = _encode(payload)
+    os.environ["MTPU_WORKER_POOL"] = "off"
+    try:
+        assert a == _encode(payload)
+    finally:
+        os.environ["MTPU_WORKER_POOL"] = "1"
+
+
+def test_single_core_and_off_fall_back_cleanly(monkeypatch):
+    """With the pool off (or unsupported), encode_stream keeps using
+    the in-process drivers — no worker, no shm pools touched."""
+    monkeypatch.setenv("MTPU_WORKER_POOL", "off")
+    before = {k: v.stats()["reused"] + v.stats()["allocated"]
+              for k, v in _shared.items() if k and k[0] == "shm-strips"}
+    _encode(_payload(BLOCK * 10, seed=21))
+    after = {k: v.stats()["reused"] + v.stats()["allocated"]
+             for k, v in _shared.items() if k and k[0] == "shm-strips"}
+    assert before == after

@@ -109,16 +109,23 @@ def _try_releases(try_node: ast.Try) -> bool:
     return False
 
 
+# Pool factories whose results are checkout-tracked even when the
+# variable name carries no "pool": the in-process recycled pools AND
+# the worker plane's shared-memory strip pools (pipeline/workers) —
+# a leaked ShmStrip pins a /dev/shm segment, which is strictly worse
+# than a leaked heap buffer.
+_POOL_FACTORIES = ("BufferPool", "shared_pool", "strip_pool")
+
+
 def _pool_assigned_names(ctx) -> set[str]:
-    """Names/attrs assigned from BufferPool(...) or shared_pool(...)."""
+    """Names/attrs assigned from a known pool factory call."""
     out: set[str] = set()
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Assign):
             continue
         if not isinstance(node.value, ast.Call):
             continue
-        if astutil.call_name(node.value) not in ("BufferPool",
-                                                 "shared_pool"):
+        if astutil.call_name(node.value) not in _POOL_FACTORIES:
             continue
         for tgt in node.targets:
             name = astutil.dotted_name(tgt)

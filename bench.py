@@ -303,7 +303,11 @@ def _admission_env(max_queue: int):
 
     old = os.environ.get("MTPU_ADMISSION_MAX_QUEUE")
     os.environ["MTPU_ADMISSION_MAX_QUEUE"] = str(max_queue)
+    # BOTH governors: the closed loop's GETs ride the read governor
+    # (ISSUE 11), which would otherwise keep its default queue and
+    # hand the harness self-inflicted 503 retries at high N.
     admission.reconfigure()
+    admission.reconfigure_read()
     try:
         yield
     finally:
@@ -312,6 +316,7 @@ def _admission_env(max_queue: int):
         else:
             os.environ["MTPU_ADMISSION_MAX_QUEUE"] = old
         admission.reconfigure()
+        admission.reconfigure_read()
 
 
 def _mk_pool_layout(base: str):
@@ -451,7 +456,23 @@ def bench_config6_closed_loop(root: str, ns=(8, 32, 64),
             out[f"n{n}"] = entry
         pool = _workers.get_pool()
         out["worker_pool"] = pool.snapshot() if pool is not None else None
+        out["worker_armed"] = _workers.arm_reason()
         out["admission"] = admission.governor().snapshot()
+        out["admission_read"] = admission.read_governor().snapshot()
+    # Read-side A/B (ISSUE 11): the same closed PUT+GET loop at N=8
+    # with the pool OFF — the on/off delta is the direct measure of
+    # whether the read side still regresses when GET clients join the
+    # PUT load without the worker plane.
+    with _worker_pool_env("0"), _admission_env(max(ns) * 4):
+        sub = os.path.join(root, "c6-ab-off")
+        try:
+            g, p50, p99, retr = _c6_run(sub, 8, ops_per_client, size)
+        finally:
+            _cleanup(sub)
+        out["n8_pool_off"] = {
+            "value": round(g, 4), "p50_ms": round(p50, 2),
+            "p99_ms": round(p99, 2), "admission_retries": retr,
+        }
     return out
 
 

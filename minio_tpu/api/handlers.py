@@ -1657,6 +1657,33 @@ class S3ApiHandlers:
                 )
                 return Response(206, headers, body_stream=stream)
             return Response(200, headers, body_stream=stream)
+        # Read-plane admission (ISSUE 11). The slot itself is taken
+        # inside the object layer (its lifetime IS the decode+transfer)
+        # — but that runs inside body_stream, AFTER the status line,
+        # where a queue-full rejection could only sever the connection.
+        # So: (a) probe the governor NOW, inside the caller's
+        # client_context, turning the documented fast-fail into a real
+        # 503 SlowDown; (b) capture the admission identity and re-enter
+        # it inside the stream closures, because body_stream executes
+        # after the dispatch's client_context has exited — without this
+        # every GET would pool into the anonymous identity and the
+        # per-client caps/(key,bucket) tenancy would never bind. The
+        # rarer mid-stream deadline expiry keeps the established
+        # mid-stream abort semantics (severed connection), exactly like
+        # the expected_etag guard below.
+        from ..pipeline.admission import (
+            client_context,
+            current_client,
+            read_governor,
+        )
+        from ..utils.errors import ErrOperationTimedOut
+
+        if read_governor().saturated():
+            exc = ErrOperationTimedOut(
+                "server busy: GET admission queue full"
+            )
+            raise from_object_error(exc) from exc
+        caller = current_client()
         # Pin the stream to the ADVERTISED version: headers are on the
         # wire before the body, and a concurrent overwrite between the
         # info fetch and the locked data read must abort with ZERO bytes
@@ -1677,20 +1704,22 @@ class S3ApiHandlers:
             del probe
 
             def stream(dst, _opts=opts):
-                chain, closers, _ = transforms.build_get_chain(
-                    oi.user_defined, ctx.headers, self.sse_config,
-                    ctx.bucket, ctx.object, dst,
-                    offset=offset, length=length,
-                )
-                self.ol.get_object(ctx.bucket, ctx.object, chain,
-                                   opts=_opts)
-                for c in closers:
-                    c.close()
+                with client_context(caller):
+                    chain, closers, _ = transforms.build_get_chain(
+                        oi.user_defined, ctx.headers, self.sse_config,
+                        ctx.bucket, ctx.object, dst,
+                        offset=offset, length=length,
+                    )
+                    self.ol.get_object(ctx.bucket, ctx.object, chain,
+                                       opts=_opts)
+                    for c in closers:
+                        c.close()
         else:
             def stream(dst, _opts=opts):
-                self.ol.get_object(ctx.bucket, ctx.object, dst,
-                                   offset=offset, length=length,
-                                   opts=_opts)
+                with client_context(caller):
+                    self.ol.get_object(ctx.bucket, ctx.object, dst,
+                                       offset=offset, length=length,
+                                       opts=_opts)
         headers = self._object_headers(ctx, oi)
         headers.update(resp_extra)
         headers["Content-Length"] = str(length)
@@ -2035,7 +2064,8 @@ class S3ApiHandlers:
         # into the anonymous client and bypasses per-tenant caps.
         from ..pipeline.admission import client_context
 
-        with client_context(cred.access_key or "anonymous"):
+        with client_context(cred.access_key or "anonymous",
+                            bucket=ctx.bucket or ""):
             resp = self.put_object(sub)
         status = fields.get("success_action_status", "204")
         if status == "201":

@@ -965,14 +965,16 @@ class S3Server:
                     "XAmzContentSHA256Mismatch", "empty body, non-empty hash"
                 )
         handler = getattr(self.handlers, name)
-        # Admission fairness identity: every encode slot this request
-        # takes (PUT, multipart part) is attributed to the caller's
-        # access key, so the governor's per-client caps and round-robin
-        # grant order see TENANTS, not sockets. Anonymous requests
-        # share one bucket by design.
+        # Admission fairness identity: every encode/decode slot this
+        # request takes (PUT, multipart part, GET) is attributed to the
+        # caller's access key — and, under MTPU_ADMISSION_TENANT=bucket,
+        # to the (key, bucket) pair — so the governors' per-client caps
+        # and round-robin grant order see TENANTS, not sockets.
+        # Anonymous requests share one identity by design.
         from ..pipeline.admission import client_context
 
-        with client_context(auth_result.access_key or "anonymous"):
+        with client_context(auth_result.access_key or "anonymous",
+                            bucket=ctx.bucket or ""):
             resp = handler(ctx)
         if self.metrics is not None:
             self.metrics.inc(

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import io
+import threading
 from enum import Enum
 
 import numpy as np
@@ -209,6 +210,10 @@ class StreamingBitrotReader:
     # single-core hosts instead of paying pool-dispatch overhead.
     local = False
 
+    # Below this framed-batch size a worker verify round trip costs
+    # more than the (GIL-releasing) in-process native call it replaces.
+    WORKER_VERIFY_MIN = 512 * 1024
+
     def __init__(self, open_stream, till_offset: int, shard_size: int,
                  algo: BitrotAlgorithm = BitrotAlgorithm.HIGHWAYHASH256S):
         self._open = open_stream
@@ -220,6 +225,15 @@ class StreamingBitrotReader:
         self._curr = 0
         self._ring: list | None = None
         self._ring_i = 0
+        # Worker-verify plumbing (ISSUE 11): shm-backed ring slots, the
+        # slot the last batch landed in, and an in-flight/deferred-
+        # release handshake so a parked fan-out thread's late readinto
+        # can never scribble a recycled segment.
+        self._shm_backed = False
+        self._last_shm = None
+        self._inflight = 0
+        self._release_pending = False
+        self._ring_mu = threading.Lock()
 
     def reuse_buffers(self, depth: int = 2) -> None:
         """Opt into recycling read buffers: read_chunks fills a private
@@ -229,23 +243,117 @@ class StreamingBitrotReader:
         further batches are fetched — true for the serial decode/heal
         drivers, whose sinks consume (or copy) every chunk before the
         next reader fan-out. The pipelined GET path keeps several
-        batches in flight and must NOT enable this."""
+        batches in flight and must NOT enable this.
+
+        When the request-plane worker pool is armed (and the algo is
+        the streaming default), the ring slots come from the pooled
+        shared-memory ring segments instead of private bytearrays, so
+        frame verification can run in a worker with zero payload bytes
+        crossing the pipe. Callers that enable reuse should pair it
+        with release_buffers() when the stream ends."""
         if self._ring is None:
             self._ring = [None] * max(2, depth)
+            if self._algo is BitrotAlgorithm.HIGHWAYHASH256S:
+                from ..pipeline import workers as _workers
+
+                self._shm_backed = _workers.armed() is not None
+
+    def release_buffers(self) -> None:
+        """Return pooled shm ring slots to their pool (the decode/heal
+        drivers call this in their finally). If a read is still in
+        flight — a parked/abandoned fan-out thread — the release is
+        deferred to that thread's exit instead, so a recycled segment
+        is never scribbled by a stale readinto."""
+        with self._ring_mu:
+            self._release_pending = True
+            if self._inflight == 0:
+                self._release_now()
+
+    def _release_now(self) -> None:
+        ring, self._ring = self._ring, None
+        self._ring_i = 0
+        self._last_shm = None
+        self._release_pending = False
+        if not ring or not self._shm_backed:
+            return
+        from ..pipeline import workers as _workers
+
+        for slot in ring:
+            # Rings can mix shm and plain slots (the phys threshold
+            # decides per batch); only LIVE shm slots go back to a
+            # pool. A slot closed under us by workers.shutdown()
+            # (view is None) is dropped — re-freelisting it would
+            # seed the post-purge pool with a dead segment and crash
+            # the next armed stream that acquires it.
+            if (slot is not None and hasattr(slot, "view")
+                    and slot.view is not None):
+                _workers.ring_pool(slot.size).release(slot)
+
+    def _enter_read(self) -> None:
+        with self._ring_mu:
+            self._inflight += 1
+
+    def _exit_read(self) -> None:
+        with self._ring_mu:
+            self._inflight -= 1
+            if (self._release_pending and self._inflight == 0
+                    and self._ring is not None):
+                self._release_now()
 
     def _read_phys(self, phys: int):
         """Read `phys` framed bytes; returns a memoryview over either a
-        recycled ring buffer (readinto) or a fresh bytes object."""
+        recycled ring buffer (readinto, no fresh bytes per fetch) or a
+        fresh bytes object. Shm-backed rings record the slot the batch
+        landed in (self._last_shm) for the worker verify path."""
         from ..pipeline.buffers import copy_add
 
         rc = self._rc
+        self._last_shm = None
         if self._ring is not None and hasattr(rc, "readinto"):
             buf = self._ring[self._ring_i]
-            if buf is None or len(buf) < phys:
-                buf = bytearray(phys)
-                self._ring[self._ring_i] = buf
+            # A live shm slot has a non-None view; a slot whose segment
+            # was closed under us (workers.shutdown() racing an
+            # in-flight stream) is treated as absent and replaced.
+            slot_is_shm = (buf is not None
+                           and getattr(buf, "view", None) is not None)
+            if buf is not None and not slot_is_shm and hasattr(buf,
+                                                              "view"):
+                buf = None  # dead segment: drop, never reuse/release
+                self._ring[self._ring_i] = None
+            # A slot goes shm only when this batch is big enough for
+            # the worker verify to engage (or an earlier batch already
+            # paid for a big-enough segment): a small GET must not
+            # allocate 256 KiB segments it can never use.
+            if self._shm_backed and (
+                    phys >= self.WORKER_VERIFY_MIN
+                    or (slot_is_shm and buf.size >= phys)):
+                from ..pipeline import workers as _workers
+
+                if not slot_is_shm or buf.size < phys:
+                    if slot_is_shm:
+                        _workers.ring_pool(buf.size).release(buf)
+                    # pool-ok: returned by release_buffers (the stream
+                    # drivers' finally) or re-released on growth above
+                    buf = _workers.ring_pool(
+                        _workers.ring_capacity(phys)
+                    ).acquire()
+                    self._ring[self._ring_i] = buf
+                view = memoryview(buf.view)[:phys]
+                self._last_shm = buf
+            else:
+                if slot_is_shm:
+                    # Shrinking stream landed on an undersized shm
+                    # slot: hand it back, fall to a plain buffer.
+                    from ..pipeline import workers as _workers
+
+                    _workers.ring_pool(buf.size).release(buf)
+                    buf = None
+                    self._ring[self._ring_i] = None
+                if buf is None or len(buf) < phys:
+                    buf = bytearray(phys)
+                    self._ring[self._ring_i] = buf
+                view = memoryview(buf)[:phys]
             self._ring_i = (self._ring_i + 1) % len(self._ring)
-            view = memoryview(buf)[:phys]
             got = 0
             while got < phys:
                 n = rc.readinto(view[got:])
@@ -275,17 +383,21 @@ class StreamingBitrotReader:
         if offset != self._curr:
             raise ValueError("non-sequential bitrot read")
         ds = self._algo.digest_size
-        if self._ring is not None and hasattr(self._rc, "readinto"):
-            mv = self._read_phys(ds + length)
-            hash_want = bytes(mv[:ds])
-            buf = mv[ds:]
-        else:
-            hash_want = self._rc.read(ds)
-            if len(hash_want) != ds:
-                raise ErrFileCorrupt("short hash read")
-            buf = self._rc.read(length)
-            if len(buf) != length:
-                raise ErrFileCorrupt("short chunk read")
+        self._enter_read()
+        try:
+            if self._ring is not None and hasattr(self._rc, "readinto"):
+                mv = self._read_phys(ds + length)
+                hash_want = bytes(mv[:ds])
+                buf = mv[ds:]
+            else:
+                hash_want = self._rc.read(ds)
+                if len(hash_want) != ds:
+                    raise ErrFileCorrupt("short hash read")
+                buf = self._rc.read(length)
+                if len(buf) != length:
+                    raise ErrFileCorrupt("short chunk read")
+        finally:
+            self._exit_read()
         h = self._algo.new()
         h.update(buf)
         if h.digest() != hash_want:
@@ -313,46 +425,87 @@ class StreamingBitrotReader:
             raise ValueError("non-sequential bitrot read")
         ds = self._algo.digest_size
         phys = sum(lengths) + ds * len(lengths)
-        mv = self._read_phys(phys)
-        from .. import native as _native
-
-        lib = _native.load()
-        out = []
-        if (lib is not None
-                and self._algo is BitrotAlgorithm.HIGHWAYHASH256S
-                and all(ln == self._shard_size for ln in lengths[:-1])):
-            # One native pass verifies every frame (chunk lengths in the
-            # physical layout are shard_size except a trailing short one —
-            # exactly hh256_verify_frames' framing contract).
-            import ctypes
-
-            import numpy as np
-
-            arr = np.frombuffer(mv, dtype=np.uint8)
-            bad = lib.hh256_verify_frames(
-                highwayhash.MAGIC_KEY,
-                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                phys, self._shard_size,
+        self._enter_read()
+        try:
+            mv = self._read_phys(phys)
+            # Chunk lengths in the physical layout are shard_size except
+            # a trailing short one — exactly the whole-buffer framing
+            # contract of hh256_verify_frames (worker or in-process).
+            aligned = (
+                self._algo is BitrotAlgorithm.HIGHWAYHASH256S
+                and all(ln == self._shard_size for ln in lengths[:-1])
             )
-            if bad >= 0:
-                raise ErrFileCorrupt(f"streaming bitrot mismatch chunk {bad}")
+            verified = False
+            if (aligned and self._last_shm is not None
+                    and phys >= self.WORKER_VERIFY_MIN):
+                # Worker verify: the framed batch already lives in a
+                # pooled shm ring segment, so the whole verification
+                # runs in a child interpreter and the pipe carries one
+                # int back. A busy/dead worker falls back to the
+                # in-process pass below — same bytes, same verdict.
+                # Note: verify time has been part of read_chunks (and
+                # therefore of ParallelReader's stall/hedge window)
+                # since the batched verify landed; under extreme CPU
+                # saturation a slow verify — worker or in-process —
+                # can trip the hedge and escalate to a parity reader,
+                # which is the designed response to a slow source and
+                # stays byte-identical (reconstruction).
+                from ..pipeline import workers as _workers
+
+                wpool = _workers.armed()
+                if wpool is not None:
+                    try:
+                        bad = wpool.verify_frames(
+                            self._last_shm, phys, self._shard_size
+                        )
+                        if bad >= 0:
+                            raise ErrFileCorrupt(
+                                f"streaming bitrot mismatch chunk {bad}"
+                            )
+                        verified = True
+                    except (_workers.WorkerCrashed,
+                            _workers.WorkerUnavailable):
+                        wpool.note_fallback("verify")
+            from .. import native as _native
+
+            lib = _native.load()
+            if not verified and aligned and lib is not None:
+                # One native pass verifies every frame in-process.
+                import ctypes
+
+                import numpy as np
+
+                arr = np.frombuffer(mv, dtype=np.uint8)
+                bad = lib.hh256_verify_frames(
+                    highwayhash.MAGIC_KEY,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    phys, self._shard_size,
+                )
+                if bad >= 0:
+                    raise ErrFileCorrupt(
+                        f"streaming bitrot mismatch chunk {bad}"
+                    )
+                verified = True
+            out = []
             off = 0
-            for ln in lengths:
-                out.append(mv[off + ds: off + ds + ln])
-                off += ds + ln
-        else:
-            off = 0
-            for ln in lengths:
-                hash_want = bytes(mv[off: off + ds])
-                chunk = mv[off + ds: off + ds + ln]
-                h = self._algo.new()
-                h.update(chunk)
-                if h.digest() != hash_want:
-                    raise ErrFileCorrupt("streaming bitrot mismatch")
-                out.append(chunk)
-                off += ds + ln
-        self._curr += sum(lengths)
-        return out
+            if verified:
+                for ln in lengths:
+                    out.append(mv[off + ds: off + ds + ln])
+                    off += ds + ln
+            else:
+                for ln in lengths:
+                    hash_want = bytes(mv[off: off + ds])
+                    chunk = mv[off + ds: off + ds + ln]
+                    h = self._algo.new()
+                    h.update(chunk)
+                    if h.digest() != hash_want:
+                        raise ErrFileCorrupt("streaming bitrot mismatch")
+                    out.append(chunk)
+                    off += ds + ln
+            self._curr += sum(lengths)
+            return out
+        finally:
+            self._exit_read()
 
     def close(self):
         if self._rc is not None and hasattr(self._rc, "close"):

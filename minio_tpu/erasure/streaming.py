@@ -1317,53 +1317,88 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     # overlap, and on a mesh deployment degraded reconstruction — the
     # thing the collective dispatch accelerates — is what GET latency
     # economics turn on.
-    if _select_engine(erasure.shard_size(), erasure.total_shards) == "mesh":
-        # Mesh serving path: degraded blocks reconstruct in fused
-        # collective dispatches batched per failure pattern; healthy
-        # blocks stream straight through on the host — written before
-        # the next fetch, so the recycled readinto ring is safe here
-        # too (batched degraded rows are copied out at append time).
-        for r in readers:
-            if hasattr(r, "reuse_buffers"):
-                r.reuse_buffers()
-        bytes_written = _decode_stream_mesh(
-            erasure, writer, reader, geoms, note_heal
-        )
-    elif _SINGLE_CORE or len(geoms) <= 2:
-        # Serial consumption drains every batch's views before the next
-        # reader fan-out, so the bitrot readers may recycle their read
-        # buffers (readinto a private ring, no fresh bytes per fetch).
-        # The pipelined branch below keeps several batches in flight
-        # and must NOT enable this.
-        for r in readers:
-            if hasattr(r, "reuse_buffers"):
-                r.reuse_buffers()
-        for block_offset, block_length in geoms:
-            bufs = reader.read()
-            note_heal()
-            erasure.decode_data_blocks(bufs)
-            bytes_written += _write_data_blocks(
-                writer, bufs, erasure.data_blocks, block_offset, block_length
-            )
-    else:
-        from ..pipeline import Pipeline, Stage
+    engine = _select_engine(erasure.shard_size(), erasure.total_shards)
+    wpool = None
+    if engine == "native" and not _SINGLE_CORE:
+        from ..pipeline import workers as _workers
 
-        def decode(gb):
-            geom, bufs = gb
-            erasure.decode_data_blocks(bufs)
-            return gb
-
-        pipe = Pipeline(telemetry, [
-            Stage("shard-read", lambda geom: (geom, reader.read())),
-            Stage("decode", decode, bytes_of=lambda gb: gb[0][1]),
-        ], queue_depth=2)
-        # The client write stays on the CALLER's thread — response
-        # framing and socket state must not move across threads.
-        for (block_offset, block_length), bufs in pipe.results(geoms):
-            note_heal()
-            bytes_written += _write_data_blocks(
-                writer, bufs, erasure.data_blocks, block_offset, block_length
+        wpool = _workers.armed()
+    try:
+        if engine == "mesh":
+            # Mesh serving path: degraded blocks reconstruct in fused
+            # collective dispatches batched per failure pattern; healthy
+            # blocks stream straight through on the host — written before
+            # the next fetch, so the recycled readinto ring is safe here
+            # too (batched degraded rows are copied out at append time).
+            for r in readers:
+                if hasattr(r, "reuse_buffers"):
+                    r.reuse_buffers()
+            bytes_written = _decode_stream_mesh(
+                erasure, writer, reader, geoms, note_heal
             )
+        elif (wpool is not None and len(geoms) > 2
+              and _worker_read_profitable(erasure, readers)):
+            # Worker serving path (ISSUE 11): bitrot verification runs
+            # in the pool via the readers' shm rings, and degraded
+            # blocks batch per failure pattern into worker reconstruct
+            # dispatches over pooled shm strips — the main
+            # interpreter's GIL stays free for shard reads and client
+            # writes, which is what lets N concurrent GETs coexist
+            # with the PUT load. Serial batch consumption (write
+            # before next fetch) makes the recycled rings safe. The
+            # profitability gate keeps small-shard streams on the
+            # pipelined branch below: there the verify offload never
+            # engages, so serializing would trade the stage-thread
+            # read/write overlap for nothing.
+            for r in readers:
+                if hasattr(r, "reuse_buffers"):
+                    r.reuse_buffers()
+            bytes_written = _decode_stream_workers(
+                erasure, writer, reader, geoms, note_heal, wpool
+            )
+        elif _SINGLE_CORE or len(geoms) <= 2:
+            # Serial consumption drains every batch's views before the
+            # next reader fan-out, so the bitrot readers may recycle
+            # their read buffers (readinto a private ring, no fresh
+            # bytes per fetch). The pipelined branch below keeps
+            # several batches in flight and must NOT enable this.
+            for r in readers:
+                if hasattr(r, "reuse_buffers"):
+                    r.reuse_buffers()
+            for block_offset, block_length in geoms:
+                bufs = reader.read()
+                note_heal()
+                erasure.decode_data_blocks(bufs)
+                bytes_written += _write_data_blocks(
+                    writer, bufs, erasure.data_blocks, block_offset,
+                    block_length
+                )
+        else:
+            from ..pipeline import Pipeline, Stage
+
+            def decode(gb):
+                geom, bufs = gb
+                erasure.decode_data_blocks(bufs)
+                return gb
+
+            pipe = Pipeline(telemetry, [
+                Stage("shard-read", lambda geom: (geom, reader.read())),
+                Stage("decode", decode, bytes_of=lambda gb: gb[0][1]),
+            ], queue_depth=2)
+            # The client write stays on the CALLER's thread — response
+            # framing and socket state must not move across threads.
+            for (block_offset, block_length), bufs in pipe.results(geoms):
+                note_heal()
+                bytes_written += _write_data_blocks(
+                    writer, bufs, erasure.data_blocks, block_offset,
+                    block_length
+                )
+    finally:
+        # Pooled shm ring slots go back to their pool when the stream
+        # ends (parked fan-out threads defer their own slot's release).
+        for r in readers:
+            if hasattr(r, "release_buffers"):
+                r.release_buffers()
 
     if bytes_written != length:
         raise ErrLessData(f"wrote {bytes_written}, want {length}")
@@ -1480,6 +1515,150 @@ def _decode_stream_mesh(erasure: Erasure, writer, reader, geoms: list,
     return bytes_written
 
 
+def _worker_read_profitable(erasure: Erasure, readers: list) -> bool:
+    """Whether the worker GET driver can beat the pipelined one for
+    this stream: the shards must carry the streaming default algorithm
+    (legacy-algo objects can never verify in a worker) AND a reader's
+    per-batch framed read must clear the verify-offload floor, so
+    healthy blocks (the common case) get GIL-free verification in
+    exchange for the lost stage-thread overlap. Otherwise the offload
+    never engages and the pipelined branch's shard-read ∥ decode ∥
+    client-write overlap wins."""
+    from .bitrot import BitrotAlgorithm, StreamingBitrotReader
+
+    for r in readers:
+        if r is None:
+            continue
+        if getattr(r, "_algo", None) is not BitrotAlgorithm.HIGHWAYHASH256S:
+            return False
+        break  # one object, one algorithm
+    phys = ParallelReader.BATCH_BLOCKS * (erasure.shard_size() + 32)
+    return phys >= StreamingBitrotReader.WORKER_VERIFY_MIN
+
+
+def _decode_stream_workers(erasure: Erasure, writer, reader, geoms: list,
+                           note_heal, wpool) -> int:
+    """Worker decode driver for the GET path: consecutive degraded
+    blocks sharing one failure pattern gather into a pooled shm strip
+    (survivor rows into the data region — the only copy, counted) and
+    reconstruct as ONE worker batch (gf reconstruct matrix + native
+    apply in a child interpreter; zero payload over the pipe). Healthy
+    blocks write straight through in stream order. A worker failure
+    mid-batch recomputes THAT batch in-process from the intact shm
+    survivors via the same erasure.decode_data_blocks math — byte-
+    identical output."""
+    from ..pipeline import workers as _workers
+    from ..pipeline.buffers import copy_add
+    from ..utils.errors import ErrShardSize, ErrTooFewShards
+
+    k = erasure.data_blocks
+    m = erasure.parity_blocks
+    shard = erasure.shard_size()
+    n_shards = erasure.total_shards
+    pool = _workers.strip_pool(ParallelReader.BATCH_BLOCKS, k, m, shard)
+    bytes_written = 0
+    # One in-flight gather batch: [strip, nb, present, targets, geoms].
+    state = {"strip": None, "nb": 0, "present": (), "targets": (),
+             "geoms": []}
+
+    def flush() -> None:
+        nonlocal bytes_written
+        strip, nb = state["strip"], state["nb"]
+        if strip is None:
+            return
+        present, targets = state["present"], state["targets"]
+        src = strip.recon_src(nb)
+        try:
+            try:
+                wpool.recon_batch(strip, nb, present, targets,
+                                  digests=False, op="decode")
+                rebuilt = strip.recon_out(nb, len(targets))
+            except (_workers.WorkerCrashed, _workers.WorkerUnavailable):
+                # The shm survivors are intact: recompute this batch
+                # in-process through the SAME codec path the serial
+                # driver uses — byte-identical by construction.
+                wpool.note_fallback("decode")
+                rebuilt = None
+            for bi, (off, ln) in enumerate(state["geoms"]):
+                bufs: list = [None] * n_shards
+                for row, si in enumerate(present):
+                    bufs[si] = src[bi, row]
+                if rebuilt is None:
+                    erasure.decode_data_blocks(bufs)
+                else:
+                    for t_i, t in enumerate(targets):
+                        bufs[t] = rebuilt[bi, t_i]
+                bytes_written += _write_data_blocks(writer, bufs, k, off,
+                                                    ln)
+        finally:
+            state.update(strip=None, nb=0, geoms=[])
+            pool.release(strip)
+
+    try:
+        for off, ln in geoms:
+            bufs = reader.read()
+            note_heal()
+            present = tuple(
+                i for i, b in enumerate(bufs) if b is not None and len(b)
+            )
+            missing_data = tuple(
+                i for i in range(k) if i not in set(present)
+            )
+            if not missing_data:
+                # Healthy block: no reconstruction; drain so client
+                # writes stay strictly in stream order.
+                flush()
+                bytes_written += _write_data_blocks(writer, bufs, k, off,
+                                                    ln)
+                continue
+            if len(present) < k:
+                raise ErrTooFewShards(
+                    f"{len(present)} shards present, need {k}"
+                )
+            blen = len(bufs[present[0]])
+            for i in present:
+                if len(bufs[i]) != blen:
+                    raise ErrShardSize("present shards differ in size")
+            if blen != shard:
+                # Ragged tail block: host reconstruction, in order.
+                flush()
+                erasure.decode_data_blocks(bufs)
+                bytes_written += _write_data_blocks(writer, bufs, k, off,
+                                                    ln)
+                continue
+            key = (present[:k], missing_data)
+            if state["strip"] is not None and key != (state["present"],
+                                                      state["targets"]):
+                flush()  # failure pattern changed mid-stream
+            if state["strip"] is None:
+                # pool-ok: released by flush()'s finally, or by the
+                # driver-level finally below if the stream errors
+                # mid-gather
+                state["strip"] = pool.acquire()
+                state["present"], state["targets"] = key
+            # Gather the k survivor rows out of the reader's recycled
+            # ring into the shm strip — the batch outlives further
+            # fetches, which reuse the ring's buffers (the worker-plane
+            # dual of get.mesh_hold).
+            src = state["strip"].recon_src(ParallelReader.BATCH_BLOCKS)
+            row = state["nb"]
+            for r_i, si in enumerate(key[0]):
+                src[row, r_i] = np.frombuffer(
+                    memoryview(bufs[si]), dtype=np.uint8
+                )
+                copy_add("get.worker_hold", blen)
+            state["nb"] += 1
+            state["geoms"].append((off, ln))
+            if state["nb"] >= ParallelReader.BATCH_BLOCKS:
+                flush()
+        flush()
+    finally:
+        if state["strip"] is not None:
+            pool.release(state["strip"])
+            state["strip"] = None
+    return bytes_written
+
+
 def _write_data_blocks(dst, blocks: list, data_blocks: int,
                        offset: int, length: int) -> int:
     """Concatenate data shards, honoring offset/length within the block
@@ -1555,37 +1734,58 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
             writers[t].write(chunk)
 
     engine = _select_engine(erasure.shard_size(), erasure.total_shards)
-    if engine in ("device", "mesh") and total_blocks:
-        # Same fused reconstruct+digest driver for both accelerator
-        # engines; only the codec differs (one chip vs the mesh).
-        if engine == "mesh":
-            from ..parallel.mesh_engine import for_geometry
-        else:
-            from .device_engine import for_geometry
+    try:
+        if engine in ("device", "mesh") and total_blocks:
+            # Same fused reconstruct+digest driver for both accelerator
+            # engines; only the codec differs (one chip vs the mesh).
+            if engine == "mesh":
+                from ..parallel.mesh_engine import for_geometry
+            else:
+                from .device_engine import for_geometry
 
-        codec = for_geometry(erasure.data_blocks, erasure.parity_blocks)
-        return _heal_stream_fused(erasure, writers, reader, targets,
-                                  total_blocks, codec)
+            codec = for_geometry(erasure.data_blocks,
+                                 erasure.parity_blocks)
+            return _heal_stream_fused(erasure, writers, reader, targets,
+                                      total_blocks, codec)
 
-    if _SINGLE_CORE or total_blocks <= 2:
-        # Serial heal consumes (reconstructs + copies) each batch before
-        # the next fan-out: safe to recycle the readers' buffers.
+        if (engine == "native" and not _SINGLE_CORE and total_blocks > 2
+                and len(targets) <= erasure.parity_blocks):
+            from ..pipeline import workers as _workers
+
+            wpool = _workers.armed()
+            if wpool is not None:
+                # Worker heal driver (ISSUE 11): per-failure-pattern
+                # batch reconstruct + re-digest in a child interpreter
+                # over pooled shm strips, bitrot verification of the
+                # survivor reads in the pool too — the native-engine
+                # counterpart of the fused device/mesh heal.
+                return _heal_stream_workers(erasure, writers, reader,
+                                            targets, total_blocks, wpool)
+
+        if _SINGLE_CORE or total_blocks <= 2:
+            # Serial heal consumes (reconstructs + copies) each batch
+            # before the next fan-out: safe to recycle the readers'
+            # buffers.
+            for r in readers:
+                if hasattr(r, "reuse_buffers"):
+                    r.reuse_buffers()
+            for _ in range(total_blocks):
+                bufs = reader.read()
+                write_targets(erasure.reconstruct_targets(bufs, targets))
+            return
+        from ..pipeline import Pipeline, Stage
+
+        pipe = Pipeline(telemetry, [
+            Stage("shard-read", lambda _i: reader.read()),
+            Stage("reconstruct",
+                  lambda bufs: erasure.reconstruct_targets(bufs, targets)),
+        ], queue_depth=2)
+        for shards in pipe.results(range(total_blocks)):
+            write_targets(shards)
+    finally:
         for r in readers:
-            if hasattr(r, "reuse_buffers"):
-                r.reuse_buffers()
-        for _ in range(total_blocks):
-            bufs = reader.read()
-            write_targets(erasure.reconstruct_targets(bufs, targets))
-        return
-    from ..pipeline import Pipeline, Stage
-
-    pipe = Pipeline(telemetry, [
-        Stage("shard-read", lambda _i: reader.read()),
-        Stage("reconstruct",
-              lambda bufs: erasure.reconstruct_targets(bufs, targets)),
-    ], queue_depth=2)
-    for shards in pipe.results(range(total_blocks)):
-        write_targets(shards)
+            if hasattr(r, "release_buffers"):
+                r.release_buffers()
 
 
 # Blocks per fused heal-reconstruction dispatch; matches the read-side
@@ -1701,3 +1901,137 @@ def _heal_stream_fused(erasure: Erasure, writers: list, reader,
     dispatch_batch()
     if pending is not None:
         flush(pending)
+
+
+def _heal_stream_workers(erasure: Erasure, writers: list, reader,
+                         targets: list[int], total_blocks: int,
+                         wpool) -> None:
+    """Worker heal driver: per-failure-pattern batches of survivor
+    blocks gather straight into a pooled shm strip and ONE worker task
+    rebuilds the stale shards AND their bitrot frame digests
+    (_child_recon: the same cached reconstruction matrix + native
+    kernels as the in-process path, plus hash_strided_digests over the
+    rebuilt region). The parent then frames [digest||chunk] writes
+    without hashing a byte. A worker failure recomputes the batch
+    in-process via erasure.reconstruct_targets — byte-identical,
+    because the frame digest is a pure function of the chunk."""
+    from ..pipeline import workers as _workers
+    from ..pipeline.buffers import copy_add
+    from ..utils.errors import ErrShardSize, ErrTooFewShards
+
+    k = erasure.data_blocks
+    m = erasure.parity_blocks
+    shard = erasure.shard_size()
+    n_shards = erasure.total_shards
+    targets_t = tuple(targets)
+    # Worker digests frame the target writers' chunks only when every
+    # target speaks the fused-digest protocol (HH256S streaming
+    # writers) — same gate as the device/mesh heal.
+    want_digests = all(
+        getattr(writers[t], "device_hashable", False) for t in targets
+    )
+    # Batches are copied out of the readers' rings at gather time and
+    # written before the next fan-out: the recycled rings are safe.
+    for r in reader.readers:
+        if hasattr(r, "reuse_buffers"):
+            r.reuse_buffers()
+    pool = _workers.strip_pool(_DEVICE_HEAL_BATCH, k, m, shard)
+    state = {"strip": None, "nb": 0, "present": ()}
+
+    def flush() -> None:
+        strip, nb = state["strip"], state["nb"]
+        if strip is None:
+            return
+        present = state["present"]
+        src = strip.recon_src(nb)
+        try:
+            digs = None
+            try:
+                wpool.recon_batch(strip, nb, present, targets_t,
+                                  digests=want_digests, op="heal")
+                rebuilt = strip.recon_out(nb, len(targets_t))
+                if want_digests:
+                    digs = strip.recon_digests(nb, len(targets_t))
+            except (_workers.WorkerCrashed, _workers.WorkerUnavailable):
+                # Survivors intact in shm: recompute in-process through
+                # the same codec path the serial heal uses. write()
+                # re-hashes each chunk, producing the identical
+                # [digest||chunk] framing the worker would have.
+                wpool.note_fallback("heal")
+                rebuilt = None
+            for bi in range(nb):
+                if rebuilt is None:
+                    bufs: list = [None] * n_shards
+                    for row, si in enumerate(present):
+                        bufs[si] = src[bi, row]
+                    shards = erasure.reconstruct_targets(bufs, targets)
+                    for t_i, t in enumerate(targets):
+                        # copy-ok: heal.shard_copy
+                        chunk = np.asarray(shards[t_i]).tobytes()
+                        copy_add("heal.shard_copy", len(chunk))
+                        writers[t].write(chunk)
+                    continue
+                for t_i, t in enumerate(targets):
+                    w = writers[t]
+                    # copy-ok: heal.shard_copy
+                    chunk = rebuilt[bi, t_i].tobytes()
+                    copy_add("heal.shard_copy", len(chunk))
+                    if digs is not None and hasattr(w,
+                                                    "write_with_digest"):
+                        # copy-ok: meta (32-byte digest)
+                        w.write_with_digest(chunk, digs[t_i, bi].tobytes())
+                    else:
+                        w.write(chunk)
+        finally:
+            state.update(strip=None, nb=0)
+            pool.release(strip)
+
+    try:
+        for _ in range(total_blocks):
+            bufs = reader.read()
+            present = tuple(
+                i for i, b in enumerate(bufs) if b is not None and len(b)
+            )
+            # Same typed validation as the host reconstruct_targets path.
+            if len(present) < k:
+                raise ErrTooFewShards(
+                    f"{len(present)} shards present, need {k}"
+                )
+            blen = len(bufs[present[0]])
+            for i in present:
+                if len(bufs[i]) != blen:
+                    raise ErrShardSize("present shards differ in size")
+            if blen != shard:
+                # Ragged tail: drain in order, then host-path the short
+                # block (write() hashes it — identical framing).
+                flush()
+                shards = erasure.reconstruct_targets(list(bufs), targets)
+                for t_i, t in enumerate(targets):
+                    # copy-ok: heal.shard_copy
+                    chunk = np.asarray(shards[t_i]).tobytes()
+                    copy_add("heal.shard_copy", len(chunk))
+                    writers[t].write(chunk)
+                continue
+            if state["strip"] is not None and present[:k] != state[
+                    "present"]:
+                flush()  # survivor set changed mid-stream
+            if state["strip"] is None:
+                # pool-ok: released by flush()'s finally, or by the
+                # driver-level finally below on a mid-gather error
+                state["strip"] = pool.acquire()
+                state["present"] = present[:k]
+            src = state["strip"].recon_src(_DEVICE_HEAL_BATCH)
+            row = state["nb"]
+            for r_i, si in enumerate(state["present"]):
+                src[row, r_i] = np.frombuffer(
+                    memoryview(bufs[si]), dtype=np.uint8
+                )
+                copy_add("heal.worker_hold", blen)
+            state["nb"] += 1
+            if state["nb"] >= _DEVICE_HEAL_BATCH:
+                flush()
+        flush()
+    finally:
+        if state["strip"] is not None:
+            pool.release(state["strip"])
+            state["strip"] = None

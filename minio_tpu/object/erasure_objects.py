@@ -64,6 +64,7 @@ _obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
 
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
 from ..utils.fanout import StragglerCompensator
+from ..utils.fanout import decode_slot as _decode_slot
 from ..utils.fanout import encode_slot as _encode_slot
 
 # Commit/delete stragglers detached by _quorum_fanout keep occupying
@@ -793,35 +794,41 @@ class ErasureObjects(MultipartMixin):
         if length == 0 or not fi.parts:
             return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
 
-        # Part loop (ref getObjectWithFileInfo :277-353).
+        # Part loop (ref getObjectWithFileInfo :277-353). The whole
+        # decode+verify section runs under a READ admission slot
+        # (ISSUE 11): GET clients flow through the same per-client
+        # caps / round-robin fairness / queue-depth 503s as PUT
+        # clients, against a separate slot pool so neither plane can
+        # starve the other.
         part_index, part_offset = fi.to_object_part_index(offset)
         remaining = length
         heal_hint = None
-        for p in range(part_index, len(fi.parts)):
-            if remaining <= 0:
-                break
-            part = fi.parts[p]
-            part_length = min(part.size - part_offset, remaining)
-            till_offset = erasure.shard_file_offset(
-                part_offset, part_length, part.size
-            )
-            readers: list = [None] * len(disks_by_shard)
-            for i, disk in enumerate(disks_by_shard):
-                meta = metas_by_shard[i]
-                if disk is None or meta is None:
-                    continue
-                readers[i] = self._shard_reader(
-                    disk, meta, bucket, object_, fi, part.number,
-                    till_offset, erasure.shard_size(),
+        with _decode_slot():
+            for p in range(part_index, len(fi.parts)):
+                if remaining <= 0:
+                    break
+                part = fi.parts[p]
+                part_length = min(part.size - part_offset, remaining)
+                till_offset = erasure.shard_file_offset(
+                    part_offset, part_length, part.size
                 )
-            _, hint = decode_stream(
-                erasure, writer, readers, part_offset, part_length,
-                part.size, telemetry="get",
-            )
-            if hint is not None and heal_hint is None:
-                heal_hint = hint
-            remaining -= part_length
-            part_offset = 0
+                readers: list = [None] * len(disks_by_shard)
+                for i, disk in enumerate(disks_by_shard):
+                    meta = metas_by_shard[i]
+                    if disk is None or meta is None:
+                        continue
+                    readers[i] = self._shard_reader(
+                        disk, meta, bucket, object_, fi, part.number,
+                        till_offset, erasure.shard_size(),
+                    )
+                _, hint = decode_stream(
+                    erasure, writer, readers, part_offset, part_length,
+                    part.size, telemetry="get",
+                )
+                if hint is not None and heal_hint is None:
+                    heal_hint = hint
+                remaining -= part_length
+                part_offset = 0
 
         if heal_hint is not None:
             # On-read heal trigger (ref cmd/erasure-object.go:319-338).

@@ -673,7 +673,11 @@ class MultipartMixin:
         # Executor threads carry an EMPTY contextvar context: re-tag
         # each part with the caller's admission identity, or every
         # multipart part would pool into the anonymous client and
-        # bypass the per-tenant caps/fairness.
+        # bypass the per-tenant caps/fairness. current_client() returns
+        # the COMPOSED identity (key, or key\x1fbucket under
+        # MTPU_ADMISSION_TENANT=bucket); with no bucket var set in the
+        # executor thread it passes through verbatim, so parts keep the
+        # caller's exact tenant.
         from ..pipeline.admission import client_context, current_client
 
         caller = current_client()

@@ -47,22 +47,45 @@ from dataclasses import dataclass
 _client_var: contextvars.ContextVar[str] = contextvars.ContextVar(
     "mtpu_admission_client", default=""
 )
+_bucket_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "mtpu_admission_bucket", default=""
+)
 
 
 def current_client() -> str:
-    return _client_var.get()
+    """The fairness identity for this context. Default: the access key
+    alone. With MTPU_ADMISSION_TENANT=bucket the identity becomes
+    (key, bucket) — one hot bucket can then no longer starve a quiet
+    bucket under the SAME key, because the round-robin rotation and
+    per-client caps see them as distinct tenants. The knob is read per
+    call so operators can flip it without a restart."""
+    client = _client_var.get()
+    if os.environ.get("MTPU_ADMISSION_TENANT", "") == "bucket":
+        bucket = _bucket_var.get()
+        if bucket:
+            return f"{client}\x1f{bucket}"
+    return client
+
+
+def current_bucket() -> str:
+    return _bucket_var.get()
 
 
 @contextmanager
-def client_context(client: str):
-    """Tag every admission decision in this context with `client`
-    (the API layer wraps handler dispatch; bench wraps each simulated
-    client's loop)."""
+def client_context(client: str, bucket: str | None = None):
+    """Tag every admission decision in this context with `client` (the
+    API layer wraps handler dispatch; bench wraps each simulated
+    client's loop) and, when known, the request's bucket — the second
+    half of the (key, bucket) tenant identity."""
     token = _client_var.set(client or "")
+    btoken = (_bucket_var.set(bucket or "") if bucket is not None
+              else None)
     try:
         yield
     finally:
         _client_var.reset(token)
+        if btoken is not None:
+            _bucket_var.reset(btoken)
 
 
 # ---------------------------------------------------------------------------
@@ -88,24 +111,32 @@ class AdmissionConfig:
     deadline_s: float = 30.0
 
     @classmethod
-    def from_env(cls) -> "AdmissionConfig":
+    def from_env(cls, domain: str = "put") -> "AdmissionConfig":
         # Back-compat with the replaced fanout semaphore: 0 (or junk)
         # means "the cpu-count default", not one serialized slot.
+        cpu = max(1, os.cpu_count() or 1)
+        if domain == "get":
+            # Read side (ISSUE 11): GET decode+verify is lighter than
+            # encode per byte and overlaps shard IO, so the default
+            # admits 2 streams per core before queueing.
+            slots_env, default_slots = "MTPU_MAX_CONCURRENT_DECODES", 2 * cpu
+            deadline_env = "MTPU_DECODE_SLOT_DEADLINE_S"
+        else:
+            slots_env, default_slots = "MTPU_MAX_CONCURRENT_ENCODES", cpu
+            deadline_env = "MTPU_ENCODE_SLOT_DEADLINE_S"
         try:
-            slots = int(os.environ.get("MTPU_MAX_CONCURRENT_ENCODES",
-                                       "0") or 0)
+            slots = int(os.environ.get(slots_env, "0") or 0)
         except ValueError:
             slots = 0
         if slots <= 0:
-            slots = max(1, os.cpu_count() or 1)
+            slots = default_slots
         # Work-conserving default: a lone client may use every slot;
         # fairness bites only when clients actually compete. Operators
         # cap hot tenants harder with MTPU_ADMISSION_CLIENT_CAP.
         cap = _env_int("MTPU_ADMISSION_CLIENT_CAP", slots)
         max_queue = _env_int("MTPU_ADMISSION_MAX_QUEUE", 8 * slots)
         try:
-            deadline = float(os.environ.get("MTPU_ENCODE_SLOT_DEADLINE_S",
-                                            "30"))
+            deadline = float(os.environ.get(deadline_env, "30"))
         except ValueError:
             deadline = 30.0
         return cls(slots=slots, per_client_cap=min(cap, slots),
@@ -159,8 +190,14 @@ class AdmissionGovernor:
     happen at release time (and at enqueue when capacity is free), so
     there is no separate scheduler thread to crash or lag."""
 
-    def __init__(self, config: AdmissionConfig | None = None):
-        self.cfg = config or AdmissionConfig.from_env()
+    def __init__(self, config: AdmissionConfig | None = None,
+                 domain: str = ""):
+        self.cfg = config or AdmissionConfig.from_env(domain or "put")
+        # Metrics domain: "" (the PUT/encode governor — label-free for
+        # back-compat with PR7 dashboards) or "get" (the read governor,
+        # whose series carry a domain label so the two planes separate
+        # on the endpoint).
+        self.domain = domain
         self._cv = threading.Condition()
         self._inflight = 0
         # Per-client in-flight budgets: the diskcheck token machinery,
@@ -205,7 +242,7 @@ class AdmissionGovernor:
         self.admitted_total += 1
         reg = _reg()
         if reg is not None:
-            reg.inc("admission_admitted_total")
+            reg.inc("admission_admitted_total", **self._labels())
 
     def _grant_waiters(self) -> None:
         """Hand freed capacity to queued waiters: rotate over clients,
@@ -319,6 +356,20 @@ class AdmissionGovernor:
             self._budgets.pop(client, None)
         self._grant_waiters()
 
+    def saturated(self) -> bool:
+        """True when a fresh acquire would reject IMMEDIATELY (queue
+        already full). The pre-status probe for streaming responses:
+        once the status line is on the wire a rejection can only sever
+        the connection, so handlers ask this BEFORE committing to a
+        200 and turn the documented fast-fail into a real 503. Must
+        mirror acquire()'s ordering: the fast path admits BEFORE the
+        queue-depth check, so an idle governor is never saturated even
+        under a max_queue=0 (no-queueing) config."""
+        with self._cv:
+            if self._waiting == 0 and self._inflight < self.cfg.slots:
+                return False  # acquire()'s fast path would admit
+            return self._waiting >= self.cfg.max_queue
+
     @contextmanager
     def slot(self, client: str | None = None):
         if client is None:
@@ -350,24 +401,30 @@ class AdmissionGovernor:
 
     # -- metrics mirroring (no-ops without a registry) ---------------------
 
+    def _labels(self) -> dict:
+        return {"domain": self.domain} if self.domain else {}
+
     def _mirror_gauges(self) -> None:
         reg = _reg()
         if reg is None:
             return
-        reg.set_gauge("admission_inflight", self._inflight)
-        reg.set_gauge("admission_queue_depth", self._waiting)
-        reg.set_gauge("admission_clients_waiting", len(self._queues))
+        lb = self._labels()
+        reg.set_gauge("admission_inflight", self._inflight, **lb)
+        reg.set_gauge("admission_queue_depth", self._waiting, **lb)
+        reg.set_gauge("admission_clients_waiting", len(self._queues), **lb)
 
     def _mirror_queued(self) -> None:
         reg = _reg()
         if reg is not None:
-            reg.inc("admission_queued_total")
-            reg.set_gauge("admission_queue_depth", self._waiting)
+            lb = self._labels()
+            reg.inc("admission_queued_total", **lb)
+            reg.set_gauge("admission_queue_depth", self._waiting, **lb)
 
     def _mirror_reject(self, reason: str) -> None:
         reg = _reg()
         if reg is not None:
-            reg.inc("admission_rejected_total", reason=reason)
+            reg.inc("admission_rejected_total", reason=reason,
+                    **self._labels())
 
 
 # ---------------------------------------------------------------------------
@@ -397,3 +454,37 @@ def reconfigure(config: AdmissionConfig | None = None) -> AdmissionGovernor:
     with _governor_mu:
         _governor = AdmissionGovernor(config)
         return _governor
+
+
+# The read-side governor (ISSUE 11): GET decode streams take their
+# slots here, NEVER from the encode governor — the two planes must not
+# be able to 503 each other, and a copy/select request that reads while
+# its write side holds an encode slot can never self-deadlock across
+# two independent slot pools with deadlines.
+
+_read_governor: AdmissionGovernor | None = None
+_read_governor_mu = threading.Lock()
+
+
+def read_governor() -> AdmissionGovernor:
+    global _read_governor
+    g = _read_governor
+    if g is None:
+        with _read_governor_mu:
+            if _read_governor is None:
+                _read_governor = AdmissionGovernor(
+                    AdmissionConfig.from_env("get"), domain="get"
+                )
+            g = _read_governor
+    return g
+
+
+def reconfigure_read(
+    config: AdmissionConfig | None = None,
+) -> AdmissionGovernor:
+    global _read_governor
+    with _read_governor_mu:
+        _read_governor = AdmissionGovernor(
+            config or AdmissionConfig.from_env("get"), domain="get"
+        )
+        return _read_governor

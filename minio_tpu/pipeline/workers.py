@@ -1,6 +1,8 @@
-"""GIL-free encode worker pool: native GF batch encode +
-hh256_hash_strided in child PROCESSES, fed through shared-memory strip
-segments — the fan-in half of the concurrency plane.
+"""GIL-free request-plane worker pool: native GF batch encode,
+survivor-block reconstruct (GET decode / heal), and hh256 frame
+verification in child PROCESSES, fed through shared-memory segments —
+the fan-in half of the concurrency plane, covering BOTH sides of the
+request plane since ISSUE 11 (PR7 covered PUT encode only).
 
 Why processes: the native encode/hash calls already release the GIL,
 but with N concurrent PUT streams the Python orchestration around them
@@ -22,9 +24,19 @@ pipe. The parent then writev's shards straight out of the segment.
 `copy_counters` therefore stays at the PR3/PR6 floor (one source-read
 copy per input byte, nothing else) — asserted in tests.
 
-Fallback ladder (armed() is the single gate):
-- single-core hosts, MTPU_WORKER_POOL=off, no native engine, or spawn
-  failure → the in-process drivers, untouched;
+The read-side ops keep the same invariant: a GET's survivor blocks
+are gathered into the SAME strip segments the encode drivers use (the
+data region holds the k survivor rows, the parity region receives the
+rebuilt shards, the digest region the re-framed bitrot digests for
+heal), and bitrot verification reads happen into pooled flat shm ring
+segments (ShmRing) so the whole framed batch is visible to the child
+— the pipe carries only names, offsets and a bad-chunk index.
+
+Fallback ladder (armed() is the single gate; DEFAULT-ON since
+ISSUE 11 — MTPU_WORKER_POOL=0 opts out):
+- single-core hosts, MTPU_WORKER_POOL=0, no native engine, or spawn
+  failure → the in-process drivers, untouched (the worker_armed gauge
+  records WHY: env/cores/native/spawn/crashes);
 - a worker crash mid-batch (WorkerCrashed) → the caller recomputes
   THAT batch in-process from the still-intact shm data — byte-
   identical output, stream uninterrupted — and the pool respawns the
@@ -53,16 +65,43 @@ DIGEST_SIZE = 32
 
 WORKER_DESCRIPTORS: list[tuple[str, str, str]] = [
     ("worker_pool_workers", "gauge",
-     "Encode worker processes currently alive"),
+     "Request-plane worker processes currently alive"),
     ("worker_pool_busy", "gauge",
-     "Encode worker processes currently executing a batch"),
+     "Request-plane worker processes currently executing a task"),
     ("worker_tasks_total", "counter",
-     "Batches encoded+hashed by the worker pool"),
+     "Tasks (encode/decode/verify/heal batches) run by the worker pool"),
     ("worker_fallbacks_total", "counter",
-     "Batches recomputed in-process after a worker failure"),
+     "Tasks recomputed in-process after a worker failure"),
     ("worker_crashes_total", "counter",
      "Worker processes lost mid-task"),
+    # Read-side op series (ISSUE 11): the encode op stays the aggregate
+    # minus these three, so dashboards keep their PR7 shape.
+    ("worker_decode_tasks_total", "counter",
+     "Degraded-GET reconstruct batches run by the worker pool"),
+    ("worker_decode_fallbacks_total", "counter",
+     "Degraded-GET batches recomputed in-process after a worker failure"),
+    ("worker_verify_tasks_total", "counter",
+     "Bitrot frame-verification calls run by the worker pool"),
+    ("worker_verify_fallbacks_total", "counter",
+     "Bitrot verifications recomputed in-process (worker busy/failed)"),
+    ("worker_heal_tasks_total", "counter",
+     "Heal reconstruct+redigest batches run by the worker pool"),
+    ("worker_heal_fallbacks_total", "counter",
+     "Heal batches recomputed in-process after a worker failure"),
+    ("worker_armed", "gauge",
+     "1 when the worker pool is armed, else 0"),
+    ("worker_armed_reason", "gauge",
+     "One-hot arm-state reason: exactly one of reason=armed|env|cores|"
+     "native|spawn|crashes is 1"),
 ]
+
+# Per-op registry series (the aggregate worker_tasks_total /
+# worker_fallbacks_total always tick as well).
+_OP_SERIES = {
+    "decode": ("worker_decode_tasks_total", "worker_decode_fallbacks_total"),
+    "verify": ("worker_verify_tasks_total", "worker_verify_fallbacks_total"),
+    "heal": ("worker_heal_tasks_total", "worker_heal_fallbacks_total"),
+}
 
 _metrics = None
 _metrics_mu = threading.Lock()
@@ -131,6 +170,30 @@ class ShmStrip:
         with _segments_mu:
             _segments[self.name] = self
 
+    # -- read-plane views (ISSUE 11) ---------------------------------------
+    # A decode/heal batch reuses the SAME segment layout: the data
+    # region holds the k survivor rows per block, the parity region
+    # (viewed flat, so any target count T <= m stays contiguous for
+    # apply_matrix_batch(out=)) receives the rebuilt shards, and the
+    # digest region the re-framed bitrot digests. Parent and child
+    # derive these views identically from the region bases.
+
+    def recon_src(self, nb: int) -> np.ndarray:
+        """Survivor blocks as [nb, k, S] over the data region."""
+        return self.data[:nb].reshape(nb, self.k, self.shard)
+
+    def recon_out(self, nb: int, t: int) -> np.ndarray:
+        """Rebuilt shards as a CONTIGUOUS [nb, t, S] view at the parity
+        region's base (t <= m; a [:nb, :t] slice would be strided)."""
+        flat = self.parity.reshape(-1)
+        return flat[: nb * t * self.shard].reshape(nb, t, self.shard)
+
+    def recon_digests(self, nb: int, t: int) -> np.ndarray:
+        """Per-target frame digests [t, nb, 32] at the digest region's
+        base (heal re-digest output)."""
+        flat = self.digests.reshape(-1)
+        return flat[: t * nb * DIGEST_SIZE].reshape(t, nb, DIGEST_SIZE)
+
     def close(self) -> None:
         """Drop the numpy views, unmap, and unlink the segment. Safe to
         call twice (pool drop + atexit sweep)."""
@@ -140,6 +203,43 @@ class ShmStrip:
         # The views pin the mapping; they must go first or close()
         # raises BufferError.
         self.data = self.parity = self.digests = None
+        try:
+            shm.close()
+        except BufferError:  # a stale external view still pins it
+            return
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+
+
+class ShmRing:
+    """One flat shared-memory read buffer: a StreamingBitrotReader ring
+    slot whose framed [digest||chunk]* batch read lands where a verify
+    worker can see it. `view` is the single numpy mapping — readinto
+    fills it, the child hashes it, nothing copies."""
+
+    def __init__(self, size: int):
+        from multiprocessing import shared_memory
+
+        self.size = size
+        self._shm = shared_memory.SharedMemory(create=True, size=size)
+        self.name = self._shm.name
+        self.view = np.frombuffer(self._shm.buf, dtype=np.uint8, count=size)
+        with _segments_mu:
+            _segments[self.name] = self
+
+    def close(self) -> None:
+        shm, self._shm = self._shm, None
+        if shm is None:
+            return
+        self.view = None
         try:
             shm.close()
         except BufferError:  # a stale external view still pins it
@@ -167,6 +267,29 @@ def strip_pool(batch: int, k: int, m: int, shard: int):
         ("shm-strips", batch, k, m, shard),
         lambda: ShmStrip(batch, k, m, shard),
         capacity=8, name="shm-strips",
+    )
+
+
+def ring_capacity(phys: int) -> int:
+    """Size class for a verify ring request: next power of two >= 256
+    KiB, so the handful of per-geometry batch sizes collapse onto a few
+    shared pools instead of one pool per exact length."""
+    cap = 256 * 1024
+    while cap < phys:
+        cap *= 2
+    return cap
+
+
+def ring_pool(size: int):
+    """Process-shared recycled pool of flat ShmRing read buffers for one
+    size class — registered in `buffers._shared` like the strip pools so
+    the chaos soak's `in_use == 0` sweep covers them too."""
+    from .buffers import shared_pool
+
+    return shared_pool(
+        ("shm-rings", size),
+        lambda: ShmRing(size),
+        capacity=16, name="shm-rings",
     )
 
 
@@ -256,6 +379,82 @@ def _child_encode(mats: dict, name: str, batch: int, nb: int,
             pass
 
 
+def _child_recon(name: str, batch: int, nb: int, k: int, m: int,
+                 shard: int, present: tuple, targets: tuple,
+                 with_digests: bool) -> None:
+    """One decode/heal batch: rebuild `targets` shards from the k
+    survivor rows in the segment's data region into the (flat-viewed)
+    parity region, plus their frame digests for heal. Byte-identical to
+    the in-process path by construction: the SAME cached reconstruction
+    matrix (ops/gf.reconstruct_matrix) applied by the SAME native
+    kernel (gf_native.apply_matrix_batch)."""
+    from ..erasure.bitrot import hash_strided_digests
+    from ..ops import gf, gf_native
+
+    shm, data, parity, digests = _attach_segment(name, batch, k, m, shard)
+    out = dig = None
+    try:
+        t = len(targets)
+        mat = gf.reconstruct_matrix(k, m, list(present), list(targets))
+        out = parity.reshape(-1)[: nb * t * shard].reshape(nb, t, shard)
+        gf_native.apply_matrix_batch(
+            mat, data[:nb].reshape(nb, k, shard), out=out
+        )
+        if with_digests:
+            dig = digests.reshape(-1)[: t * nb * DIGEST_SIZE]\
+                .reshape(t, nb, DIGEST_SIZE)
+            for t_i in range(t):
+                if hash_strided_digests(out, t_i * shard, t * shard, nb,
+                                        shard, out=dig[t_i]) is None:
+                    raise RuntimeError(
+                        "native strided hash unavailable in worker"
+                    )
+    finally:
+        # EVERY view must go before close or the child's mapping leaks
+        # one attach per task (close raises BufferError and __del__
+        # cannot unmap either).
+        data = parity = digests = out = dig = None  # noqa: F841
+        try:
+            shm.close()
+        except BufferError:  # pragma: no cover - stray external view
+            pass
+
+
+def _child_verify(name: str, size: int, phys: int, chunk: int) -> int:
+    """Verify every [digest||chunk] frame of the first `phys` bytes of
+    a flat ring segment; returns the first bad chunk index or -1. The
+    reply is ONE int — no payload crosses the pipe here either."""
+    import ctypes
+
+    from multiprocessing import resource_tracker, shared_memory
+
+    from .. import native
+    from ..ops import highwayhash
+
+    lib = native.load()
+    if lib is None:
+        raise RuntimeError("native hh256 engine unavailable in worker")
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 - tracker internals moved
+        pass
+    try:
+        arr = np.frombuffer(shm.buf, dtype=np.uint8, count=size)
+        bad = lib.hh256_verify_frames(
+            highwayhash.MAGIC_KEY,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            phys, chunk,
+        )
+        return int(bad)
+    finally:
+        arr = None  # noqa: F841 - view pins the mapping
+        try:
+            shm.close()
+        except BufferError:  # pragma: no cover - stray external view
+            pass
+
+
 def _worker_cli() -> None:  # pragma: no cover - child process
     """Child loop: unpickle task from stdin -> compute into shm ->
     pickle reply to stdout. Plain subprocess transport (not
@@ -288,11 +487,20 @@ def _worker_cli() -> None:  # pragma: no cover - child process
             if kind == "crash":  # test hook: die mid-task
                 os._exit(42)
             try:
-                _child_encode(mats, *msg[1:])
+                if kind == "enc":
+                    _child_encode(mats, *msg[1:])
+                    result = None
+                elif kind == "rec":
+                    _child_recon(*msg[1:])
+                    result = None
+                elif kind == "vfy":
+                    result = _child_verify(*msg[1:])
+                else:
+                    raise ValueError(f"unknown worker op {kind!r}")
             except Exception as exc:  # noqa: BLE001 - reported to parent
                 reply = ("err", f"{type(exc).__name__}: {exc}")
             else:
-                reply = ("ok", None)
+                reply = ("ok", result)
             pickle.dump(reply, out)
             out.flush()
     except KeyboardInterrupt:
@@ -340,11 +548,17 @@ class _Worker:
                 pass
 
 
+# Stage threads the parent keeps for itself per active stream (source
+# fill + writev fan-out): the default-on auto-size leaves them their
+# cores instead of oversubscribing every core with a worker.
+_RESERVED_STAGE_THREADS = 2
+
+
 def default_workers() -> int:
     env = os.environ.get("MTPU_WORKER_POOL_SIZE", "")
     if env.isdigit() and int(env) > 0:
         return int(env)
-    return max(1, os.cpu_count() or 1)
+    return max(2, (os.cpu_count() or 2) - _RESERVED_STAGE_THREADS)
 
 
 class WorkerPool:
@@ -369,9 +583,13 @@ class WorkerPool:
         self._respawns = 0
         self._busy = 0
         # Counters (mirrored onto the registry when installed).
+        # Aggregates keep their PR7 names; the per-op dicts split them
+        # by request-plane op (encode/decode/verify/heal).
         self.tasks_total = 0
         self.fallbacks_total = 0
         self.crashes_total = 0
+        self.tasks_by_op: dict[str, int] = {}
+        self.fallbacks_by_op: dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -454,16 +672,63 @@ class WorkerPool:
         the results. Raises WorkerCrashed / WorkerUnavailable; the shm
         data region is untouched either way, so callers recompute
         in-process from the same bytes."""
+        self._dispatch(
+            "encode",
+            ("enc", strip.name, strip.batch, nb,
+             strip.k, strip.m, strip.shard),
+            _test_crash=_test_crash,
+        )
+
+    def recon_batch(self, strip: ShmStrip, nb: int, present: tuple,
+                    targets: tuple, digests: bool, op: str = "decode",
+                    _test_crash: bool = False) -> None:
+        """Rebuild `targets` shards from the k survivor rows in
+        strip.recon_src(nb) (rows in `present` order). On return,
+        strip.recon_out(nb, len(targets)) holds the rebuilt shards and
+        — when `digests` — strip.recon_digests(nb, len(targets)) their
+        frame digests. `op` labels the telemetry: "decode" (degraded
+        GET) or "heal"."""
+        self._dispatch(
+            op,
+            ("rec", strip.name, strip.batch, nb, strip.k, strip.m,
+             strip.shard, tuple(present), tuple(targets), bool(digests)),
+            _test_crash=_test_crash,
+        )
+
+    # A verify task is far cheaper than an encode/reconstruct batch, so
+    # a busy pool should divert it in-process (the native verify call
+    # releases the GIL anyway) rather than stall the read fan-out.
+    VERIFY_WAIT_S = 0.05
+
+    def verify_frames(self, ring: ShmRing, phys: int, chunk: int,
+                      _test_crash: bool = False) -> int:
+        """Verify the [digest||chunk]* frames in ring.view[:phys] in a
+        worker; returns the first bad chunk index or -1 (the caller
+        raises ErrFileCorrupt exactly like the in-process path)."""
+        bad = self._dispatch(
+            "verify", ("vfy", ring.name, ring.size, phys, chunk),
+            wait_s=self.VERIFY_WAIT_S, _test_crash=_test_crash,
+        )
+        return int(bad)
+
+    def _dispatch(self, op: str, msg: tuple, wait_s: float | None = None,
+                  _test_crash: bool = False):
+        """One request/response task on an idle worker. Raises
+        WorkerCrashed / WorkerUnavailable; every shm input region is
+        untouched on failure, so callers recompute in-process from the
+        same bytes."""
         if not self.alive():
             raise WorkerUnavailable("worker pool not running")
         try:
             # Workers ≈ cores and admission bounds concurrent streams
             # to the same order, so a short wait means a worker frees
             # within one batch time; past it, in-process is faster.
-            w = self._idle.get(timeout=self.deadline_s)
+            w = self._idle.get(
+                timeout=self.deadline_s if wait_s is None else wait_s
+            )
         except _queue.Empty:
             raise WorkerUnavailable(
-                f"no idle encode worker within {self.deadline_s}s"
+                f"no idle worker for {op} within the wait bound"
             ) from None
         with self._mu:
             self._busy += 1
@@ -473,14 +738,13 @@ class WorkerPool:
             if _test_crash:
                 w.send(("crash",))
             else:
-                w.send(("enc", strip.name, strip.batch, nb,
-                        strip.k, strip.m, strip.shard))
+                w.send(msg)
             reply = w.recv(self.deadline_s)
             if reply is None:
                 raise WorkerCrashed(
                     f"worker pid {w.pid} silent past {self.deadline_s}s"
                 )
-            status, err = reply
+            status, payload = reply
         except Exception as exc:  # noqa: BLE001 - ANY channel fault
             # EOF/pipe errors, a reply garbled by stray stdout output,
             # a truncated pickle from a dying child — every channel
@@ -502,11 +766,17 @@ class WorkerPool:
         if status != "ok":
             # The worker itself is fine; THIS task cannot run there
             # (e.g. native lib failed to build in the child).
-            raise WorkerUnavailable(err or "worker declined the task")
+            raise WorkerUnavailable(payload or "worker declined the task")
         self.tasks_total += 1
+        with self._mu:
+            self.tasks_by_op[op] = self.tasks_by_op.get(op, 0) + 1
         reg = _reg()
         if reg is not None:
             reg.inc("worker_tasks_total")
+            series = _OP_SERIES.get(op)
+            if series is not None:
+                reg.inc(series[0])
+        return payload
 
     def _retire(self, w: _Worker) -> None:
         """Drop a crashed worker and respawn a replacement off the
@@ -554,11 +824,16 @@ class WorkerPool:
                 self._dead = True
         self._gauge()
 
-    def note_fallback(self) -> None:
+    def note_fallback(self, op: str = "encode") -> None:
         self.fallbacks_total += 1
+        with self._mu:
+            self.fallbacks_by_op[op] = self.fallbacks_by_op.get(op, 0) + 1
         reg = _reg()
         if reg is not None:
             reg.inc("worker_fallbacks_total")
+            series = _OP_SERIES.get(op)
+            if series is not None:
+                reg.inc(series[1])
 
     # -- telemetry ---------------------------------------------------------
 
@@ -581,6 +856,8 @@ class WorkerPool:
                 "tasks_total": self.tasks_total,
                 "fallbacks_total": self.fallbacks_total,
                 "crashes_total": self.crashes_total,
+                "tasks_by_op": dict(self.tasks_by_op),
+                "fallbacks_by_op": dict(self.fallbacks_by_op),
             }
 
 
@@ -590,18 +867,68 @@ class WorkerPool:
 _pool: WorkerPool | None = None
 _pool_mu = threading.Lock()
 _atexit_registered = False
+# Why the pool is (not) armed, for the worker_armed gauge and the
+# bench/admin snapshots: "armed" | "env" | "cores" | "native" |
+# "spawn" | "crashes" | "unarmed" (never consulted yet).
+_arm_reason = "unarmed"
+# Set when a full pool spawn failed: with the plane default-on,
+# re-attempting an n-process spawn on EVERY stream of a host that
+# cannot spawn (sandbox, rlimit) would tax exactly the requests the
+# pool exists to speed up. The latch is a COOLDOWN, not permanent —
+# a transient failure (fd exhaustion during a deploy) self-heals on
+# the next arm attempt after the retry window; shutdown() also clears
+# it so an explicit re-arm always gets a real attempt.
+_spawn_failed_at: float | None = None
+_SPAWN_RETRY_S = 60.0
 
 
-def _supported() -> bool:
+_ARM_REASONS = ("armed", "env", "cores", "native", "spawn", "crashes")
+
+
+def _note_arm(reason: str) -> None:
+    global _arm_reason
+    if reason == _arm_reason:
+        return  # armed() runs per stream/reader: write only transitions
+    _arm_reason = reason
+    reg = _reg()
+    if reg is not None:
+        # One unlabeled 1/0 gauge for alerting plus a ONE-HOT labeled
+        # reason series — writing only the current reason's label would
+        # leave the previous state's series exported at its old value
+        # (the registry keys gauges per label set), so every reason is
+        # written every transition.
+        reg.set_gauge("worker_armed", 1.0 if reason == "armed" else 0.0)
+        for r in _ARM_REASONS:
+            reg.set_gauge("worker_armed_reason",
+                          1.0 if r == reason else 0.0, reason=r)
+
+
+def arm_reason() -> str:
+    return _arm_reason
+
+
+_unsupported: str | None = None  # latched probe result ("" = capable)
+
+
+def _supported() -> str | None:
+    """None when a pool can run here; else the reason it never will.
+    The probe is immutable for the process lifetime (core count and
+    native-lib presence don't change), so it latches — armed() is on
+    every stream's path and must not re-probe per call."""
+    global _unsupported
+    if _unsupported is not None:
+        return _unsupported or None
     if (os.cpu_count() or 1) < 2:
-        return False  # single core: processes only add context switches
-    from ..ops import gf_native
+        why = "cores"  # single core: processes only add context switches
+    else:
+        from .. import native
+        from ..ops import gf_native
 
-    if not gf_native.available():
-        return False
-    from .. import native
-
-    return native.load() is not None  # hh256_hash_strided needs the lib
+        # hh256 strided/verify kernels need the lib too.
+        why = "" if (gf_native.available()
+                     and native.load() is not None) else "native"
+    _unsupported = why
+    return why or None
 
 
 def ensure_pool(n: int | None = None) -> WorkerPool | None:
@@ -610,16 +937,33 @@ def ensure_pool(n: int | None = None) -> WorkerPool | None:
     global _pool, _atexit_registered
     with _pool_mu:
         if _pool is not None:
-            return _pool if _pool.alive() else None
-        if not _supported():
+            if _pool.alive():
+                return _pool
+            _note_arm("crashes")
             return None
+        why_not = _supported()
+        if why_not is not None:
+            _note_arm(why_not)
+            return None
+        global _spawn_failed_at
+        if _spawn_failed_at is not None:
+            import time
+
+            if time.monotonic() - _spawn_failed_at < _SPAWN_RETRY_S:
+                return None
+            _spawn_failed_at = None
         pool = WorkerPool(n)
         try:
             pool.start()
         except Exception:  # noqa: BLE001 - no spawn here (e.g. sandbox)
             pool.shutdown(timeout_s=0.5)
+            import time
+
+            _spawn_failed_at = time.monotonic()
+            _note_arm("spawn")
             return None
         _pool = pool
+        _note_arm("armed")
         if not _atexit_registered:
             atexit.register(shutdown)
             _atexit_registered = True
@@ -632,38 +976,47 @@ def get_pool() -> WorkerPool | None:
 
 
 def armed() -> WorkerPool | None:
-    """The gate the encode drivers consult per stream: a live pool
-    ONLY while MTPU_WORKER_POOL is explicitly on. The env knob is read
-    per call so tests/operators can flip it without a restart — and an
-    already-running pool does NOT capture streams once the knob is
-    cleared (a bench section arming the pool must not silently change
-    every later stream in the process)."""
+    """The gate every request-plane driver consults per stream —
+    DEFAULT-ON since ISSUE 11: a live pool unless MTPU_WORKER_POOL is
+    explicitly off (0/off/false/no). The env knob is read per call so
+    tests/operators can flip it without a restart — and an already-
+    running pool does NOT capture streams once the knob is turned off
+    (a bench section arming the pool must not silently change every
+    later stream in the process). Single-core and no-native hosts
+    never arm regardless of the knob."""
     env = os.environ.get("MTPU_WORKER_POOL", "").lower()
-    if env not in ("1", "on", "auto", "true"):
+    if env in ("0", "off", "false", "no"):
+        _note_arm("env")
         return None
+    if _unsupported:
+        return None  # latched: this host never arms (reason recorded)
     pool = get_pool()
     return pool if pool is not None else ensure_pool()
 
 
 def _purge_strip_pools() -> None:
-    """Drop the shm strip pools from the shared-pool registry: their
-    freelisted segments are about to be unlinked, and handing a dead
-    segment to the next armed stream would crash it. A later arm
+    """Drop the shm strip/ring pools from the shared-pool registry:
+    their freelisted segments are about to be unlinked, and handing a
+    dead segment to the next armed stream would crash it. A later arm
     builds fresh pools."""
     from . import buffers
 
     with buffers._shared_mu:
         for key in [k for k in buffers._shared
-                    if isinstance(k, tuple) and k and k[0] == "shm-strips"]:
+                    if isinstance(k, tuple) and k
+                    and k[0] in ("shm-strips", "shm-rings")]:
             buffers._shared.pop(key, None)
 
 
 def shutdown() -> None:
     """Stop the pool, drop the strip pools, and unlink every live shm
-    segment (atexit; also called by tests asserting clean teardown)."""
-    global _pool
+    segment (atexit; also called by tests asserting clean teardown).
+    Clears the spawn cooldown so an explicit re-arm gets a real
+    attempt."""
+    global _pool, _spawn_failed_at
     with _pool_mu:
         pool, _pool = _pool, None
+        _spawn_failed_at = None
     if pool is not None:
         pool.shutdown()
     _purge_strip_pools()

@@ -146,16 +146,20 @@ class Server:
         _streaming.set_metrics(self.metrics)
         _dsync.set_metrics(self.metrics)
         _fanout.set_metrics(self.metrics)
-        # Concurrency plane: the encode admission governor and the
-        # GIL-free worker pool mirror admitted/queued/rejected and
+        # Concurrency plane: the encode/read admission governors and
+        # the GIL-free worker pool mirror admitted/queued/rejected and
         # worker-health series onto the same registry (mtpu_admission_*
-        # / mtpu_worker_*). Arming the pool itself stays env-driven
-        # (MTPU_WORKER_POOL) — see docs/DEPLOYMENT.md.
+        # / mtpu_worker_*). The pool is DEFAULT-ON (ISSUE 11): arm it
+        # at boot — auto-sized from the core count, inert on 1-core or
+        # no-native hosts, MTPU_WORKER_POOL=0 opts out — so the first
+        # request never pays the spawn and the worker_armed gauge
+        # records the arm decision (and its reason) from the start.
         from .pipeline import admission as _admission
         from .pipeline import workers as _workers
 
         _admission.set_metrics(self.metrics)
         _workers.set_metrics(self.metrics)
+        _workers.armed()
         # Runtime lock-order checker (tools/analysis/lockgraph): armed
         # only when the operator sets MTPU_LOCK_CHECK=1 — instruments
         # every lock created from here on and exposes cycle/hold-time

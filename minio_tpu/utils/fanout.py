@@ -68,6 +68,17 @@ def encode_slot():
     return governor().slot()
 
 
+def decode_slot():
+    """The read-side twin (ISSUE 11): every erasure GET's decode+verify
+    section passes the READ governor — its own slot pool (2 per core by
+    default), so GET clients get the same per-client caps, round-robin
+    fairness, and queue-depth 503s as PUT clients, and neither plane
+    can starve the other."""
+    from ..pipeline.admission import read_governor
+
+    return read_governor().slot()
+
+
 def is_local_sink(sink) -> bool:
     """A sink whose write() is a local syscall/memory op (raw or buffered
     file, fsync wrapper, BytesIO) — safe to run inline on 1 core."""

@@ -1,0 +1,18 @@
+"""jax-lint NEGATIVE fixture (read plane, ISSUE 11): the accepted
+overlap shape — batch N dispatches while batch N-1 materializes."""
+import jax  # noqa: F401 - parsed only
+import numpy as np
+
+
+def overlapped_heal(codec, batches, present, targets):
+    outs = []
+    pending = None
+    for b in batches:
+        fut, _digs = codec.reconstruct_async(b, present, targets,
+                                             with_hashes=True)
+        if pending is not None:
+            outs.append(np.asarray(pending))  # PREVIOUS iteration's fut
+        pending = fut
+    if pending is not None:
+        outs.append(np.asarray(pending))
+    return outs

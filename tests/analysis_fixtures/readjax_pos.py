@@ -1,0 +1,15 @@
+"""jax-lint POSITIVE fixture (read plane, ISSUE 11): the heal/decode
+batch loop syncing the reconstruct dispatch it just issued — the
+serialization bug the fused drivers' pending/flush overlap exists to
+avoid. Parsed only."""
+import jax  # noqa: F401 - parsed only
+import numpy as np
+
+
+def serial_heal(codec, batches, present, targets):
+    outs = []
+    for b in batches:
+        fut, digs = codec.reconstruct_async(b, present, targets,
+                                            with_hashes=True)
+        outs.append(np.asarray(fut))  # same-iteration D2H sync
+    return outs

@@ -1,0 +1,30 @@
+"""pool-lint NEGATIVE fixture (read plane, ISSUE 11): the accepted
+protection shapes for the worker read ops' shm checkouts."""
+from minio_tpu.pipeline.workers import ring_pool, strip_pool
+
+rings = ring_pool(1 << 20)
+strips = strip_pool(8, 12, 4, 87382)
+
+
+def safe_verify(wp, phys, chunk):
+    seg = rings.acquire()
+    try:
+        return wp.verify_frames(seg, phys, chunk)
+    finally:
+        rings.release(seg)
+
+
+def fallback_decode(wp, er, nb, present, targets):
+    seg = strips.acquire()
+    try:
+        wp.recon_batch(seg, nb, present, targets, digests=False)
+        return seg.recon_out(nb, len(targets))
+    except RuntimeError:
+        strips.release(seg)
+        raise
+
+
+def deferred_ring():
+    # pool-ok: release_buffers returns it when the stream drains
+    seg = rings.acquire()
+    return [seg]

@@ -1,0 +1,22 @@
+"""pool-lint POSITIVE fixture (read plane, ISSUE 11): shm checkouts of
+the worker read ops with no release reachable on the exception edge —
+a verify ring leaked past a crashed worker, and a recon strip leaked
+past a failed reconstruct dispatch."""
+from minio_tpu.pipeline.workers import ring_pool, strip_pool
+
+rings = ring_pool(1 << 20)
+strips = strip_pool(8, 12, 4, 87382)
+
+
+def leaky_verify(wp, phys, chunk):
+    seg = rings.acquire()
+    bad = wp.verify_frames(seg, phys, chunk)  # raises: ring leaked
+    rings.release(seg)
+    return bad
+
+
+def leaky_decode(wp, nb, present, targets):
+    seg = strips.acquire()
+    wp.recon_batch(seg, nb, present, targets, digests=False)  # raises
+    strips.release(seg)
+    return nb

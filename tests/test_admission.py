@@ -15,9 +15,11 @@ from minio_tpu.utils.errors import ErrOperationTimedOut
 @pytest.fixture(autouse=True)
 def _fresh_governor():
     yield
-    # Tests swap the process governor; restore the env-derived default
-    # so later tests (PUT paths) see production admission behavior.
+    # Tests swap the process governors; restore the env-derived
+    # defaults so later tests (PUT/GET paths) see production admission
+    # behavior.
     admission.reconfigure()
+    admission.reconfigure_read()
     admission.set_metrics(None)
 
 
@@ -127,6 +129,145 @@ def test_encode_slot_rides_the_governor(monkeypatch):
         g.release("occupant")
     with encode_slot():
         assert g.snapshot()["inflight"] == 1
+
+
+def test_bucket_tenant_identity_unstarves_quiet_bucket(monkeypatch):
+    """ISSUE 11 satellite: with MTPU_ADMISSION_TENANT=bucket one hot
+    bucket can no longer starve a quiet bucket under the SAME access
+    key — the rotation grants hot-1, quiet-1, hot-2, hot-3 instead of
+    draining the hot bucket's FIFO first."""
+    monkeypatch.setenv("MTPU_ADMISSION_TENANT", "bucket")
+    g = AdmissionGovernor(AdmissionConfig(slots=1, per_client_cap=1,
+                                          max_queue=8, deadline_s=10.0))
+    g.acquire("holder")
+    order: list[str] = []
+    order_mu = threading.Lock()
+
+    def run(tag, bucket):
+        ev = threading.Event()
+
+        def body():
+            with admission.client_context("one-key", bucket=bucket):
+                ev.set()
+                client = admission.current_client()
+                g.acquire(client)
+                with order_mu:
+                    order.append(tag)
+                g.release(client)
+
+        t = threading.Thread(target=body)
+        t.start()
+        ev.wait()
+        time.sleep(0.05)  # deterministic enqueue order
+        return t
+
+    threads = [run("hot1", "hot-bucket"), run("hot2", "hot-bucket"),
+               run("hot3", "hot-bucket"), run("quiet1", "quiet-bucket")]
+    g.release("holder")
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["hot1", "quiet1", "hot2", "hot3"], order
+    # Without the knob the same key pools into ONE identity.
+    monkeypatch.delenv("MTPU_ADMISSION_TENANT")
+    with admission.client_context("one-key", bucket="hot-bucket"):
+        assert admission.current_client() == "one-key"
+
+
+def test_read_governor_is_separate_and_labeled():
+    """GET decode slots come from their own governor (ISSUE 11): the
+    read pool's slots/rejections never touch the encode governor, its
+    metrics carry domain=get, and utils/fanout.decode_slot is its
+    front door."""
+    from minio_tpu.utils.fanout import decode_slot
+
+    reg = _FakeRegistry()
+    admission.set_metrics(reg)
+    rg = admission.reconfigure_read(AdmissionConfig(
+        slots=1, per_client_cap=1, max_queue=0, deadline_s=0.05))
+    eg = admission.reconfigure(AdmissionConfig(
+        slots=1, per_client_cap=1, max_queue=4, deadline_s=0.05))
+    eg.acquire("writer")  # encode plane saturated...
+    try:
+        with decode_slot():  # ...but reads still flow
+            assert rg.snapshot()["inflight"] == 1
+            assert eg.snapshot()["inflight"] == 1
+            with pytest.raises(ErrOperationTimedOut):
+                rg.acquire("b")  # read queue depth 0 -> immediate 503
+    finally:
+        eg.release("writer")
+    assert rg.snapshot()["inflight"] == 0
+    assert reg.counts[(
+        "admission_admitted_total", (("domain", "get"),)
+    )] == 1
+    assert reg.counts[(
+        "admission_rejected_total",
+        (("domain", "get"), ("reason", "queue_full")),
+    )] == 1
+    # Encode-side series stay label-free (PR7 dashboard back-compat).
+    assert reg.counts[("admission_admitted_total", ())] == 1
+
+
+def test_saturated_probe_matches_queue_full():
+    """saturated() is the pre-status probe the GET handler uses: it
+    must flip exactly when a fresh acquire would reject immediately,
+    so a queue-full 503 goes out BEFORE the 200 status line."""
+    g = AdmissionGovernor(AdmissionConfig(slots=1, per_client_cap=1,
+                                          max_queue=1, deadline_s=5.0))
+    assert not g.saturated()
+    g.acquire("a")
+    assert not g.saturated()  # queue empty: a waiter would be accepted
+    waiter_in = threading.Event()
+
+    def waiter():
+        waiter_in.set()
+        g.acquire("b")
+        g.release("b")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    waiter_in.wait()
+    deadline = time.monotonic() + 2.0
+    while not g.saturated() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert g.saturated(), "queue at max_queue must read as saturated"
+    g.release("a")
+    t.join(timeout=5)
+    assert not g.saturated()
+
+
+def test_identity_survives_stream_closure_reentry():
+    """Regression for the body_stream seam: the GET handler captures
+    current_client() inside the dispatch's client_context and re-enters
+    it in the stream closure (which runs AFTER the context exited).
+    The captured composed identity must pass through verbatim — with
+    and without the (key, bucket) tenant mode."""
+    import os
+
+    for tenant in (None, "bucket"):
+        if tenant:
+            os.environ["MTPU_ADMISSION_TENANT"] = tenant
+        try:
+            with admission.client_context("ak", bucket="b1"):
+                caller = admission.current_client()
+            assert admission.current_client() == ""  # dispatch exited
+            with admission.client_context(caller):  # the stream closure
+                assert admission.current_client() == caller
+        finally:
+            os.environ.pop("MTPU_ADMISSION_TENANT", None)
+
+
+def test_read_config_defaults(monkeypatch):
+    """Read slots default to 2 per core and honor their own env knobs."""
+    import os
+
+    monkeypatch.delenv("MTPU_MAX_CONCURRENT_DECODES", raising=False)
+    cfg = AdmissionConfig.from_env("get")
+    assert cfg.slots == 2 * max(1, os.cpu_count() or 1)
+    monkeypatch.setenv("MTPU_MAX_CONCURRENT_DECODES", "7")
+    monkeypatch.setenv("MTPU_DECODE_SLOT_DEADLINE_S", "3.5")
+    cfg = AdmissionConfig.from_env("get")
+    assert cfg.slots == 7
+    assert cfg.deadline_s == 3.5
 
 
 def test_client_context_tags_the_caller():

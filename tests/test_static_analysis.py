@@ -76,7 +76,9 @@ RULE_CASES = [
     ("lock-lint", "lock_pos.py", "lock_neg.py", 4),
     ("pool-lint", "pool_pos.py", "pool_neg.py", 1),
     ("pool-lint", "shmpool_pos.py", "shmpool_neg.py", 1),
+    ("pool-lint", "readpool_pos.py", "readpool_neg.py", 2),
     ("jax-lint", "jax_pos.py", "jax_neg.py", 5),
+    ("jax-lint", "readjax_pos.py", "readjax_neg.py", 1),
     ("except-lint", "except_pos.py", "except_neg.py", 2),
 ]
 

@@ -114,7 +114,7 @@ def _try_releases(try_node: ast.Try) -> bool:
 # the worker plane's shared-memory strip pools (pipeline/workers) —
 # a leaked ShmStrip pins a /dev/shm segment, which is strictly worse
 # than a leaked heap buffer.
-_POOL_FACTORIES = ("BufferPool", "shared_pool", "strip_pool")
+_POOL_FACTORIES = ("BufferPool", "shared_pool", "strip_pool", "ring_pool")
 
 
 def _pool_assigned_names(ctx) -> set[str]:

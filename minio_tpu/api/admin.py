@@ -71,6 +71,8 @@ class AdminHandlers:
             ("POST", "heal"): "heal",
             ("GET", "top"): "top_locks",
             ("GET", "trace"): "trace_poll",
+            ("GET", "slow-requests"): "slow_requests",
+            ("DELETE", "slow-requests"): "slow_requests_clear",
             ("POST", "service"): "service_action",
             ("GET", "accountinfo"): "account_info",
             ("PUT", "set-remote-target"): "set_remote_target",
@@ -124,6 +126,8 @@ class AdminHandlers:
         "heal": "admin:Heal",
         "top_locks": "admin:TopLocksInfo",
         "trace_poll": "admin:ServerTrace",
+        "slow_requests": "admin:ServerTrace",
+        "slow_requests_clear": "admin:ServerTrace",
         "service_action": "admin:ServiceRestart",
         "account_info": "admin:AccountInfo",
         "set_remote_target": "admin:SetBucketTarget",
@@ -591,7 +595,8 @@ class AdminHandlers:
             peer_future = pool.submit(self.notification.trace_poll, wait_s)
             pool.shutdown(wait=False)
         q = self.trace.subscribe(
-            verbose=ctx.qdict.get("verbose") == "true"
+            verbose=ctx.qdict.get("verbose") == "true",
+            spans=ctx.qdict.get("spans") == "true",
         )
         out = []
         deadline = time.time() + wait_s
@@ -610,6 +615,29 @@ class AdminHandlers:
             except Exception:  # noqa: BLE001 - peers down: local only
                 pass
         return self._json(out)
+
+    def slow_requests(self, ctx) -> Response:
+        """The slow-request exemplar store (observability/spans.py):
+        full span trees of requests that crossed the capture threshold
+        (MTPU_TRACE_SLOW_MS / running-p99 auto mode) — the drill-down
+        from a p99 alert to the stage that actually stalled."""
+        from ..observability import spans as _spans
+
+        try:
+            n = int(ctx.qdict.get("n", str(_spans.SLOW_STORE_CAP)))
+        except ValueError:
+            n = _spans.SLOW_STORE_CAP
+        return self._json({
+            "threshold_ms": (None if _spans.slow_threshold_ms()
+                             == float("inf")
+                             else _spans.slow_threshold_ms()),
+            "captured": _spans.slow_requests(max(1, n)),
+        })
+
+    def slow_requests_clear(self, ctx) -> Response:
+        from ..observability import spans as _spans
+
+        return self._json({"cleared": _spans.clear_slow_requests()})
 
     def service_action(self, ctx) -> Response:
         action = ctx.qdict.get("action", "")

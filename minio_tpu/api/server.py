@@ -661,6 +661,16 @@ class S3Server:
                 )
             self._finish(h, ctx, resp, t0, err_code)
         finally:
+            # A deferred request trace whose body stream never ran
+            # (client reset pre-stream, HEAD, framing error) still
+            # finishes here — resume() is a no-op once the stream
+            # already finished it (deferred flips False).
+            rt = getattr(ctx, "deferred_trace", None)
+            if rt is not None and rt.deferred:
+                from ..observability import spans as _spans
+
+                with _spans.resume(rt):
+                    pass
             # The throttle slot covers everything from admission through
             # the written response — released here, NEVER lower down, so
             # a metrics/trace/audit failure can't leak a permit and
@@ -971,11 +981,50 @@ class S3Server:
         # to the (key, bucket) pair — so the governors' per-client caps
         # and round-robin grant order see TENANTS, not sockets.
         # Anonymous requests share one identity by design.
+        # The request-span trace context sets alongside it (ISSUE 12):
+        # everything the handler touches — admission waits, pipeline
+        # stages, worker shm ops, fan-out quorum waits, disk ops —
+        # records under this request's trace, and a slow request's
+        # whole span tree lands in the exemplar store.
+        from ..observability import spans as _spans
         from ..pipeline.admission import client_context
 
-        with client_context(auth_result.access_key or "anonymous",
-                            bucket=ctx.bucket or ""):
+        client = auth_result.access_key or "anonymous"
+        rt = _spans.request_trace(name, method=ctx.method,
+                                  path=ctx.path,
+                                  request_id=ctx.request_id)
+        with client_context(client, bucket=ctx.bucket or ""), rt:
             resp = handler(ctx)
+            if resp.body_stream is not None and not getattr(
+                    resp, "unbounded_stream", False):
+                # (Unbounded live feeds — listen_notification — stay
+                # un-deferred: a watch held open for hours is not a
+                # slow request, and its "duration" would poison the
+                # running-p99 auto threshold.)
+                # Streaming responses do their real work (decode,
+                # verify, shard fan-in) INSIDE the response writer,
+                # after this scope exits: defer the trace finish and
+                # re-enter both contexts around the stream so the root
+                # span covers dispatch through last byte — and the
+                # read governor keeps seeing the caller's admission
+                # identity rather than the anonymous default.
+                rt.defer()
+                # The writer may never invoke body_stream (client reset
+                # before the status line, HEAD skipping the body, a
+                # framing error raised pre-stream): park the deferred
+                # trace on the request so _handle's finally finishes it
+                # — disconnect-heavy traffic is exactly what the plane
+                # must not lose.
+                ctx.deferred_trace = rt
+                inner = resp.body_stream
+                bucket = ctx.bucket or ""
+
+                def traced_stream(w, _inner=inner):
+                    with client_context(client, bucket=bucket), \
+                            _spans.resume(rt):
+                        _inner(w)
+
+                resp.body_stream = traced_stream
         if self.metrics is not None:
             self.metrics.inc(
                 "s3_responses_total", api=name, status=str(resp.status)

@@ -224,7 +224,14 @@ class ConfigSys:
         import hashlib
         import os as _os
 
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        except ImportError:
+            # The documented fallback: without `cryptography` the blob
+            # stores plain (the config plane must keep working; the
+            # envelope is obfuscation keyed from the root secret, not
+            # the deployment's security boundary).
+            return b"PLAIN\x00" + raw
 
         key = hashlib.sha256(("mtpu-config:" + self._secret).encode()).digest()
         nonce = _os.urandom(12)

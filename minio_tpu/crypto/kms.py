@@ -16,8 +16,24 @@ import os
 import threading
 import time
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# Gated dependency: the crypto package sits on the import path of the
+# whole S3 data plane (handlers -> transforms -> crypto.sse -> here),
+# so a host without `cryptography` must still serve PLAIN traffic —
+# only the SSE seal/unseal operations themselves may fail, loudly, at
+# use time.
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - environment-dependent
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, *_a, **_k):
+            raise KMSError(
+                "NotImplemented",
+                "SSE requires the 'cryptography' package",
+            )
 
 
 class KMSError(Exception):

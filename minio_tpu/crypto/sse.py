@@ -15,8 +15,22 @@ import base64
 import hashlib
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# Gated dependency (same contract as crypto/kms.py): plain traffic
+# must serve on hosts without `cryptography`; only SSE seal/unseal
+# operations fail, loudly, when actually invoked.
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - environment-dependent
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, *_a, **_k):
+            raise SSEError(
+                "server-side encryption requires the 'cryptography' "
+                "package"
+            )
 
 PACKAGE_SIZE = 64 * 1024
 PACKAGE_OVERHEAD = 12 + 16  # nonce + tag

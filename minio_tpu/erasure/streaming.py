@@ -1165,14 +1165,20 @@ class ParallelReader:
                 if i is not None:
                     run(i)
         else:
+            from ..observability import spans as _spans
+
+            # Reader threads carry the caller's trace so their disk-op
+            # and worker-verify spans attribute to this request.
+            bound_worker = _spans.bound(_spans.capture(), worker)
             with cv:
                 state["active"] = len(first)
             for i in first:
-                _io_pool.submit(worker, i)
+                _io_pool.submit(bound_worker, i)
             hedge_s = ROBUST.hedge_delay_s
             deadline = time.monotonic() + ROBUST.long_op_deadline_s
             last_hedge = 0.0
             state["progress"] = time.monotonic()
+            t_span0 = time.monotonic_ns()
             with cv:
                 while len(results) < self.data_blocks:
                     if (state["active"] == 0
@@ -1196,7 +1202,11 @@ class ParallelReader:
                         if j is not None:
                             state["active"] += 1
                             record_stat("hedged_reads_total")
-                            _io_pool.submit(worker, j)
+                            # Event mark: the hedge decision on this
+                            # request's timeline (span dual of the
+                            # hedged_reads_total aggregate).
+                            _spans.record("fanout", f"hedge #{j}", 0)
+                            _io_pool.submit(bound_worker, j)
                         continue
                     cv.wait(min(fire_at, deadline) - now)
                 # Close the batch: workers that have not started their
@@ -1222,6 +1232,11 @@ class ParallelReader:
                     # with the rotation, the reader rejoins (see run()).
                     parked[j] = self.readers[j]
                     self.readers[j] = None
+                    _spans.record("fanout", f"straggler-detach #{j}", 0)
+            # One span per reader fan-out: results-arrival wait + the
+            # hedge/abandon bookkeeping above.
+            _spans.record("fanout", "shard-read-wait",
+                          time.monotonic_ns() - t_span0)
 
         if len(results) < self.data_blocks:
             err = reduce_read_quorum_errs(

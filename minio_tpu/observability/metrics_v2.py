@@ -32,6 +32,8 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
     ("s3_rx_bytes_total", "counter", "Bytes received in S3 request bodies"),
     ("s3_tx_bytes_total", "counter", "Bytes sent in S3 response bodies"),
     ("s3_auth_failures_total", "counter", "Rejected signatures/policies"),
+    ("s3_requests_rejected_total", "counter",
+     "S3 requests rejected by the api requests_max throttle"),
     # --- per-disk storage ---
     ("disk_ops_total", "counter", "Storage ops by op and disk"),
     ("disk_op_errors_total", "counter", "Failed storage ops by op/disk"),
@@ -55,10 +57,16 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
      "Circuit-breaker latch events (disk marked faulty)"),
     ("disk_readmit_total", "counter",
      "Faulty disks re-admitted by the background probe"),
+    ("disk_fresh_healed_total", "counter",
+     "Replaced disks healed back to full shard sets"),
     ("hedged_reads_total", "counter",
      "GET shard reads hedged onto parity past the hedge delay"),
     ("fanout_stragglers_total", "counter",
      "Erasure fan-out writers detached after write quorum"),
+    ("fanout_late_dropped_errors_total", "counter",
+     "Detached-straggler failures discarded after the grace window"),
+    ("fanout_late_dropped_results_total", "counter",
+     "Detached-straggler successes discarded after the grace window"),
     ("dsync_unlock_failures_total", "counter",
      "dsync unlock RPCs that failed (grant leaks until expiry)"),
     # --- erasure/heal ---
@@ -112,7 +120,16 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
     ("node_rss_bytes", "gauge", "Resident set size"),
     ("node_open_fds", "gauge", "Open file descriptors"),
     ("node_cpu_seconds_total", "gauge", "Process CPU time"),
+    # --- observability plane ---
+    ("pubsub_dropped_total", "counter",
+     "Items dropped for slow pub/sub subscribers, by bus"),
 ]
+
+# Request-span tracing (observability/spans.py): per-kind latency
+# histograms and slow-request capture counts — jax-free import.
+from .spans import SPAN_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += SPAN_DESCRIPTORS
 
 # Per-stage pipeline telemetry (pipeline/metrics.py): the erasure hot
 # paths (put/get/heal/multipart + the device host feed) flush their

@@ -4,7 +4,13 @@ DownloadProfilingData, cmd/admin-handlers.go:466-553, which wraps Go's
 pprof). cProfile only instruments the calling thread, so this samples
 sys._current_frames() across ALL threads (py-spy style): cheap, safe to
 run in production, and the aggregate stacks point at the same hot paths
-a tracing profiler would."""
+a tracing profiler would.
+
+When the span plane (observability/spans.py) is armed, each sample also
+notes WHICH request the sampled thread was serving, so the hottest
+stacks come back annotated with concrete trace ids — a flamegraph line
+that points straight at slow-request exemplars instead of "something
+was busy here"."""
 
 from __future__ import annotations
 
@@ -13,6 +19,10 @@ import threading
 import time
 from collections import Counter
 
+# Trace ids retained per distinct stack: enough to cross-reference the
+# slow store without letting a long profile accrete unbounded sets.
+_TRACES_PER_STACK = 8
+
 
 class SamplingProfiler:
     MAX_DURATION_S = 600.0  # an undownloaded profile must not run forever
@@ -20,6 +30,7 @@ class SamplingProfiler:
     def __init__(self, interval_s: float = 0.005):
         self.interval_s = interval_s
         self._stacks: Counter = Counter()
+        self._stack_traces: dict[tuple, set[str]] = {}
         self._samples = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -28,8 +39,11 @@ class SamplingProfiler:
     def start(self):
         if self._thread is not None:
             raise RuntimeError("profiler already running")
+        from . import spans as _spans
+
         self._stop.clear()
         self._stacks.clear()
+        self._stack_traces = {}
         self._samples = 0
         self.started_ns = time.time_ns()
 
@@ -53,7 +67,13 @@ class SamplingProfiler:
                         )
                         f = f.f_back
                         depth += 1
-                    self._stacks[tuple(reversed(stack))] += 1
+                    key = tuple(reversed(stack))
+                    self._stacks[key] += 1
+                    active = _spans.active_trace(tid)
+                    if active is not None:
+                        ids = self._stack_traces.setdefault(key, set())
+                        if len(ids) < _TRACES_PER_STACK:
+                            ids.add(f"{active[0]:08x}")
                 self._samples += 1
 
         self._thread = threading.Thread(target=loop, daemon=True,
@@ -61,21 +81,54 @@ class SamplingProfiler:
         self._thread.start()
         return self
 
-    def stop_and_report(self, top: int = 50) -> str:
-        """Stop sampling; render the most-sampled stacks (collapsed
-        format: 'frame;frame;... count', flamegraph-compatible)."""
+    def _stop_sampling(self) -> float:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
-        dur_s = (time.time_ns() - self.started_ns) / 1e9
+        return (time.time_ns() - self.started_ns) / 1e9
+
+    def report(self, top: int = 50) -> dict:
+        """Stop sampling; structured report: raw per-stack counters
+        plus the flamegraph-ready collapsed text, hottest stacks
+        annotated with the trace ids active while they were sampled."""
+        dur_s = self._stop_sampling()
+        hottest = [
+            {
+                "stack": list(stack),
+                "count": count,
+                "trace_ids": sorted(self._stack_traces.get(stack, ())),
+            }
+            for stack, count in self._stacks.most_common(top)
+        ]
+        return {
+            "samples": self._samples,
+            "duration_s": round(dur_s, 3),
+            "interval_ms": self.interval_s * 1000,
+            "hottest": hottest,
+            "collapsed": self._collapsed(top, dur_s),
+        }
+
+    def _collapsed(self, top: int, dur_s: float) -> str:
+        """Collapsed-stack (Brendan Gregg flamegraph.pl) format:
+        'frame;frame;... count' per line. Trace annotations ride as
+        '#'-prefixed comment lines flamegraph tooling ignores."""
         lines = [
             f"# sampling profile: {self._samples} samples over "
             f"{dur_s:.1f}s @ {self.interval_s * 1000:.0f}ms",
         ]
         for stack, count in self._stacks.most_common(top):
             lines.append(";".join(stack) + f" {count}")
+            ids = self._stack_traces.get(stack)
+            if ids:
+                lines.append(f"# traces: {','.join(sorted(ids))}")
         return "\n".join(lines) + "\n"
+
+    def stop_and_report(self, top: int = 50) -> str:
+        """Stop sampling; render the collapsed flamegraph text (the
+        admin download endpoint's historical payload)."""
+        dur_s = self._stop_sampling()
+        return self._collapsed(top, dur_s)
 
     @property
     def running(self) -> bool:

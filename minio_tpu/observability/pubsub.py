@@ -1,17 +1,41 @@
 """In-process pub/sub bus (ref pkg/pubsub/pubsub.go): bounded
-subscriber queues, non-blocking publish (slow subscribers drop)."""
+subscriber queues, non-blocking publish (slow subscribers drop).
+
+Drops are COUNTED per bus (`dropped_total`, mirrored as
+`mtpu_pubsub_dropped_total{bus=...}` when a registry is installed):
+trace/audit consumers that fall behind silently lose records, and an
+invisible loss rate makes every downstream investigation lie.
+"""
 
 from __future__ import annotations
 
 import queue
 import threading
 
+_metrics = None
+_metrics_mu = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    """Install the process registry (server boot) so per-bus drop
+    counters surface on the metrics endpoint."""
+    global _metrics
+    with _metrics_mu:
+        _metrics = registry
+
+
+def _reg():
+    with _metrics_mu:
+        return _metrics
+
 
 class PubSub:
-    def __init__(self, max_queue: int = 1000):
+    def __init__(self, max_queue: int = 1000, name: str = "bus"):
         self._mu = threading.Lock()
         self._subs: list[queue.Queue] = []
         self._max_queue = max_queue
+        self.name = name
+        self.dropped_total = 0
 
     def subscribe(self) -> queue.Queue:
         q: queue.Queue = queue.Queue(self._max_queue)
@@ -26,6 +50,13 @@ class PubSub:
             except ValueError:
                 pass
 
+    def _note_drop(self):
+        with self._mu:
+            self.dropped_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("pubsub_dropped_total", bus=self.name)
+
     def publish(self, item):
         with self._mu:
             subs = list(self._subs)
@@ -33,18 +64,25 @@ class PubSub:
             try:
                 q.put_nowait(item)
             except queue.Full:
-                pass  # drop for slow subscribers (ref pubsub.go Publish)
+                # drop for slow subscribers (ref pubsub.go Publish) —
+                # but never silently: the loss is counted per bus.
+                self._note_drop()
 
     def publish_each(self, make_item):
         """Per-subscriber payloads: make_item(q) -> the item for that
-        queue (verbose traces go only to queues that asked)."""
+        queue (verbose traces go only to queues that asked), or None
+        to skip the queue entirely (span trees go ONLY to span
+        subscribers; a skip is not a drop)."""
         with self._mu:
             subs = list(self._subs)
         for q in subs:
+            item = make_item(q)
+            if item is None:
+                continue
             try:
-                q.put_nowait(make_item(q))
+                q.put_nowait(item)
             except queue.Full:
-                pass
+                self._note_drop()
 
     @property
     def num_subscribers(self) -> int:

@@ -17,12 +17,22 @@ class TraceHub:
     """Trace bus. publish() takes a dict with at least api/method/path.
     Subscribers may request VERBOSE traces (body snippets included, ref
     `mc admin trace -v` / traceOpts body capture); producers consult
-    `any_verbose` so body copies cost nothing when nobody asked."""
+    `any_verbose` so body copies cost nothing when nobody asked.
+    Span-tree entries (observability/spans.py finished-request trees)
+    flow through the SAME bus but reach only subscribers that asked
+    with spans=True.
+
+    Subscriber capability sets are keyed on the QUEUE OBJECT, never on
+    id(q): a queue id recycled after unsubscribe+GC would otherwise
+    re-route verbose payloads (with body snippets) to a later,
+    non-verbose subscriber that happened to land on the same address.
+    """
 
     def __init__(self):
-        self.bus = PubSub()
+        self.bus = PubSub(name="trace")
         self._vlock = threading.Lock()
-        self._verbose_qs: set[int] = set()
+        self._verbose_qs: set = set()   # queue objects (identity-hashed)
+        self._span_qs: set = set()
 
     def publish(self, info: dict, verbose_extra: dict | None = None):
         """Publish one call record. `verbose_extra` (headers/body
@@ -37,26 +47,45 @@ class TraceHub:
             return
         merged = {**info, **verbose_extra}
         with self._vlock:
-            verbose_ids = set(self._verbose_qs)
+            verbose_qs = set(self._verbose_qs)
         self.bus.publish_each(
-            lambda q: merged if id(q) in verbose_ids else info
+            lambda q: merged if q in verbose_qs else info
         )
 
-    def subscribe(self, verbose: bool = False):
+    def publish_spans(self, entry: dict):
+        """Deliver one finished span tree to span subscribers only
+        (None from the selector skips a queue without counting a
+        drop)."""
+        with self._vlock:
+            if not self._span_qs:
+                return
+            span_qs = set(self._span_qs)
+        entry.setdefault("time_ns", time.time_ns())
+        self.bus.publish_each(lambda q: entry if q in span_qs else None)
+
+    def subscribe(self, verbose: bool = False, spans: bool = False):
         q = self.bus.subscribe()
-        if verbose:
+        if verbose or spans:
             with self._vlock:
-                self._verbose_qs.add(id(q))
+                if verbose:
+                    self._verbose_qs.add(q)
+                if spans:
+                    self._span_qs.add(q)
         return q
 
     def unsubscribe(self, q):
         with self._vlock:
-            self._verbose_qs.discard(id(q))
+            self._verbose_qs.discard(q)
+            self._span_qs.discard(q)
         self.bus.unsubscribe(q)
 
     @property
     def any_verbose(self) -> bool:
         return bool(self._verbose_qs)
+
+    @property
+    def any_spans(self) -> bool:
+        return bool(self._span_qs)
 
 
 class Logger:

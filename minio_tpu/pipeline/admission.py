@@ -278,7 +278,17 @@ class AdmissionGovernor:
     def acquire(self, client: str | None = None) -> None:
         """Admit one encode stream for `client`, waiting fairly up to
         the deadline. Raises ErrOperationTimedOut (a retriable 503) on
-        queue-full or deadline."""
+        queue-full or deadline. The whole admission — instant grant or
+        queue wait — records as ONE request span (kind "admission",
+        labeled by governor domain, "/queued" suffix when the stream
+        actually waited) so a stalled PUT's queue time is attributable
+        instead of vanishing into handler latency."""
+        from ..observability import spans as _spans
+
+        with _spans.span("admission", self.domain or "put") as sp:
+            self._acquire(client, sp)
+
+    def _acquire(self, client: str | None, sp) -> None:
         from ..utils.errors import ErrOperationTimedOut
 
         if client is None:
@@ -304,6 +314,7 @@ class AdmissionGovernor:
             self._queues.setdefault(client, deque()).append(w)
             self._waiting += 1
             self.queued_total += 1
+            sp.relabel(f"{self.domain or 'put'}/queued")
             self._mirror_queued()
             # Capacity may be free right now (fast path declined only
             # because others were already waiting): run one grant pass

@@ -26,8 +26,14 @@ import queue as _queue
 import threading
 import time
 
+from ..observability import spans as _spans
 from . import metrics as _pmetrics
 from .stage import CANCELLED, END_OF_STREAM, SKIP, Stage, StageStats
+
+# Dequeue waits shorter than this record no span: an idle-poll tick is
+# queue mechanics, not latency attribution, and would bury the real
+# spans in noise. Execute spans always record (they ARE the work).
+_SPAN_WAIT_MIN_NS = 500_000  # 0.5 ms
 
 # Poll interval for cancel-aware queue waits: queue.Queue has no native
 # wait-with-abort, so blocked workers re-check the cancel flag at this
@@ -133,19 +139,33 @@ class Pipeline:
     def _work(self, stage: Stage, in_q: _queue.Queue,
               out_q: _queue.Queue) -> None:
         stats = stage.stats
+        # One label per (pipeline, stage); spans no-op when the run is
+        # not under a request trace (the carrier installed nothing).
+        span_label = f"{self.name}/{stage.name}"
+        traced = _spans.current() is not None
         while True:
             t0 = time.perf_counter()
             item = self._get(in_q)
-            stats.wait_s += time.perf_counter() - t0
+            wait = time.perf_counter() - t0
+            stats.wait_s += wait
             if item is CANCELLED:
                 return
             if item is END_OF_STREAM:
                 self._put(out_q, END_OF_STREAM)
                 return
+            if traced and wait * 1e9 >= _SPAN_WAIT_MIN_NS:
+                # Dequeue starvation: this stage sat waiting for its
+                # upstream — the handoff half of enqueue/dequeue
+                # attribution (the enqueue half is the upstream
+                # stage's stall span below).
+                _spans.record("stage-wait", span_label, int(wait * 1e9))
             try:
                 t0 = time.perf_counter()
                 out = stage.fn(item)
-                stats.busy_s += time.perf_counter() - t0
+                busy = time.perf_counter() - t0
+                stats.busy_s += busy
+                if traced:
+                    _spans.record("stage", span_label, int(busy * 1e9))
             except BaseException as exc:  # noqa: BLE001 - first error wins
                 # Contract with `drop`: a stage releases an item's pooled
                 # buffer only on full success, so the failed item still
@@ -163,7 +183,12 @@ class Pipeline:
                     pass
             t0 = time.perf_counter()
             ok = self._put(out_q, out)
-            stats.stall_s += time.perf_counter() - t0
+            stall = time.perf_counter() - t0
+            stats.stall_s += stall
+            if traced and stall * 1e9 >= _SPAN_WAIT_MIN_NS:
+                # Enqueue backpressure: downstream is the bottleneck.
+                _spans.record("stage-stall", span_label,
+                              int(stall * 1e9))
             if not ok:
                 self._drop_item(out)
                 return
@@ -190,15 +215,22 @@ class Pipeline:
             _queue.Queue(maxsize=self.queue_depth)
             for _ in range(len(self.stages) + 1)
         ]
+        # Contextvars do not cross thread creation: carry the caller's
+        # request-trace context into the stage threads so their spans
+        # (and anything the stage functions call — worker dispatches,
+        # fan-outs, disk ops) attribute to the request being served.
+        carrier = _spans.capture()
         threads = [
             threading.Thread(
-                target=self._feed, args=(source, queues[0]),
+                target=_spans.bound(carrier, self._feed),
+                args=(source, queues[0]),
                 name=f"mtpu-pipe-{self.name}-src", daemon=True,
             )
         ]
         for i, st in enumerate(self.stages):
             threads.append(threading.Thread(
-                target=self._work, args=(st, queues[i], queues[i + 1]),
+                target=_spans.bound(carrier, self._work),
+                args=(st, queues[i], queues[i + 1]),
                 name=f"mtpu-pipe-{self.name}-{st.name}", daemon=True,
             ))
         for t in threads:

@@ -487,6 +487,14 @@ def _worker_cli() -> None:  # pragma: no cover - child process
             if kind == "crash":  # test hook: die mid-task
                 os._exit(42)
             try:
+                # The child measures its own execute-ns and ships it in
+                # the reply tuple (ISSUE 12): the parent stitches a
+                # cross-process child span under its dispatch span, so
+                # queue-wait vs compute separate in slow-request trees.
+                # One int — no payload or pickle shape growth.
+                import time as _time
+
+                t0 = _time.monotonic_ns()
                 if kind == "enc":
                     _child_encode(mats, *msg[1:])
                     result = None
@@ -497,10 +505,11 @@ def _worker_cli() -> None:  # pragma: no cover - child process
                     result = _child_verify(*msg[1:])
                 else:
                     raise ValueError(f"unknown worker op {kind!r}")
+                exec_ns = _time.monotonic_ns() - t0
             except Exception as exc:  # noqa: BLE001 - reported to parent
                 reply = ("err", f"{type(exc).__name__}: {exc}")
             else:
-                reply = ("ok", result)
+                reply = ("ok", result, exec_ns)
             pickle.dump(reply, out)
             out.flush()
     except KeyboardInterrupt:
@@ -716,7 +725,18 @@ class WorkerPool:
         """One request/response task on an idle worker. Raises
         WorkerCrashed / WorkerUnavailable; every shm input region is
         untouched on failure, so callers recompute in-process from the
-        same bytes."""
+        same bytes. Under a request trace the whole dispatch records as
+        a "worker" span (idle-wait + pipe round-trip) with the child's
+        self-measured execute-ns stitched in as a "worker-exec" child
+        span — the cross-process half of the latency tree."""
+        from ..observability import spans as _spans
+
+        with _spans.span("worker", op):
+            return self._dispatch_traced(op, msg, wait_s, _test_crash)
+
+    def _dispatch_traced(self, op: str, msg: tuple,
+                         wait_s: float | None = None,
+                         _test_crash: bool = False):
         if not self.alive():
             raise WorkerUnavailable("worker pool not running")
         try:
@@ -744,7 +764,10 @@ class WorkerPool:
                 raise WorkerCrashed(
                     f"worker pid {w.pid} silent past {self.deadline_s}s"
                 )
-            status, payload = reply
+            status, payload = reply[0], reply[1]
+            # Child execute-ns (absent from err/ping replies and from
+            # older two-tuple shapes a test may fake).
+            exec_ns = reply[2] if len(reply) > 2 else 0
         except Exception as exc:  # noqa: BLE001 - ANY channel fault
             # EOF/pipe errors, a reply garbled by stray stdout output,
             # a truncated pickle from a dying child — every channel
@@ -767,6 +790,11 @@ class WorkerPool:
             # The worker itself is fine; THIS task cannot run there
             # (e.g. native lib failed to build in the child).
             raise WorkerUnavailable(payload or "worker declined the task")
+        if exec_ns:
+            from ..observability import spans as _spans
+
+            # Parented under the enclosing "worker" dispatch span.
+            _spans.record("worker-exec", f"{op} pid {w.pid}", int(exec_ns))
         self.tasks_total += 1
         with self._mu:
             self.tasks_by_op[op] = self.tasks_by_op.get(op, 0) + 1

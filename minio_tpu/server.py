@@ -160,6 +160,15 @@ class Server:
         _admission.set_metrics(self.metrics)
         _workers.set_metrics(self.metrics)
         _workers.armed()
+        # Request-span tracing plane (ISSUE 12): per-kind latency
+        # histograms (mtpu_span_seconds) and slow-request capture
+        # counts flow through the same registry; pub/sub buses count
+        # their slow-subscriber drops (mtpu_pubsub_dropped_total).
+        from .observability import pubsub as _pubsub
+        from .observability import spans as _spans
+
+        _spans.set_metrics(self.metrics)
+        _pubsub.set_metrics(self.metrics)
         # Runtime lock-order checker (tools/analysis/lockgraph): armed
         # only when the operator sets MTPU_LOCK_CHECK=1 — instruments
         # every lock created from here on and exposes cycle/hold-time
@@ -251,6 +260,9 @@ class Server:
 
         # --- subsystems (ref initAllSubsystems) ---
         self.trace = TraceHub()
+        # Finished span trees stream to `mc admin trace ?spans=true`
+        # subscribers through the same hub as call records.
+        _spans.set_trace_hub(self.trace)
         self.logger = Logger()
         # IAM backend: etcd when configured (env MTPU_ETCD_ENDPOINTS /
         # config subsystem `etcd`, ref cmd/etcd.go + iam-etcd-store.go),

@@ -271,12 +271,26 @@ class MetricsDisk:
         return self._health
 
     def _wrap(self, op: str, fn):
+        from ..observability import spans as _spans
+
         def call(*args, **kwargs):
             self._check_id()
             h = self._health
             guarded = h is not None and h.cfg.enabled
             if guarded and not _SINGLE_CORE:
-                return self._call_guarded(op, fn, args, kwargs)
+                if _spans.current() is None:
+                    return self._call_guarded(op, fn, args, kwargs)
+                # Per-disk op latency on the request's span timeline —
+                # the leaf level of the attribution tree (which DISK a
+                # stalled fan-out was actually waiting on).
+                t0s = time.monotonic_ns()
+                try:
+                    return self._call_guarded(op, fn, args, kwargs)
+                finally:
+                    _spans.record(
+                        "disk", f"{op}:{self._disk.endpoint()}",
+                        time.monotonic_ns() - t0s,
+                    )
             if guarded and h.is_faulty():
                 # Single-core hosts skip the executor hop (the thread
                 # handoff per op is the measured cost the inline fan-out
@@ -309,6 +323,11 @@ class MetricsDisk:
                     )
                     self._metrics.observe(
                         "disk_op_seconds", time.perf_counter() - t0, op=op
+                    )
+                if _spans.current() is not None:
+                    _spans.record(
+                        "disk", f"{op}:{self._disk.endpoint()}",
+                        int((time.perf_counter() - t0) * 1e9),
                     )
             if guarded:
                 self._posthoc_breaker(op, time.perf_counter() - t0)

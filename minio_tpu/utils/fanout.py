@@ -136,7 +136,16 @@ def quorum_wait(cv, pending, count_ok, quorum, deadline_s, grace_s):
     tasks ms from settling still report true outcomes for cleanup
     paths like undoRename), every task finished, or deadline_s
     elapses. count_ok runs under cv. Whatever is left in `pending`
-    afterwards is the caller's to detach."""
+    afterwards is the caller's to detach. Records one request span
+    (kind "fanout"/"quorum-wait") so a PUT stalled on a straggling
+    disk attributes the stall to the fan-out, not the handler."""
+    from ..observability import spans as _spans
+
+    with _spans.span("fanout", "quorum-wait"):
+        _quorum_wait(cv, pending, count_ok, quorum, deadline_s, grace_s)
+
+
+def _quorum_wait(cv, pending, count_ok, quorum, deadline_s, grace_s):
     deadline = time.monotonic() + deadline_s
     grace_end = None
     fail_end = None
@@ -192,8 +201,14 @@ class QuorumFanout:
     def dispatch(self, attempt, pending, inline, quorum,
                  deadline_s, grace_s, *, count_ok, record,
                  on_detach, skip=None, on_stragglers=None):
+        from ..observability import spans as _spans
+
         cv = self.cv
         detached = self.detached
+        # Pool workers run attempt(i) on foreign threads: carry the
+        # caller's trace so their disk-op spans attribute to this
+        # request (None carrier -> bound() is the identity).
+        carrier = _spans.capture()
 
         def run(i):
             with cv:
@@ -226,8 +241,9 @@ class QuorumFanout:
                 record(i, err)
                 cv.notify_all()
 
+        bound_run = _spans.bound(carrier, run)
         for i in sorted(pending):
-            self.pool.submit(run, i)
+            self.pool.submit(bound_run, i)
         for i in inline:
             run(i)
 
@@ -241,3 +257,6 @@ class QuorumFanout:
                 self.comp.parked()
                 on_detach(i)
                 pending.discard(i)
+                # Zero-duration event mark: the detach decision itself
+                # is a fact worth seeing on a slow request's timeline.
+                _spans.record("fanout", f"straggler-detach #{i}", 0)

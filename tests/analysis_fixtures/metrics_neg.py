@@ -1,0 +1,21 @@
+"""metrics-lint negative fixture: catalogued series, dynamic names,
+and read-side calls — none may fire."""
+
+
+def catalogued_writes(reg):
+    reg.inc("s3_requests_total", api="put_object")
+    reg.set_gauge("worker_armed", 1.0)
+    reg.observe("span_seconds", 0.002, kind="stage")
+    reg.inc_gauge("s3_requests_inflight")
+    with reg.time("disk_op_seconds", op="read_file"):
+        pass
+
+
+def dynamic_name(reg, key):
+    # Unverifiable statically; the runtime descriptor coverage test
+    # owns dynamic series.
+    reg.inc(f"fanout_late_dropped_{key}_total")
+
+
+def read_side(reg):
+    return reg.counter_value("s3_requests_total")

@@ -1,0 +1,21 @@
+"""metrics-lint positive fixture: registry writes naming series that
+exist in NO *DESCRIPTORS catalog — each must fire."""
+
+
+def typod_counter(reg):
+    # A typo of worker_tasks_total: ships a ghost series and starves
+    # the real one.
+    reg.inc("wroker_tasks_total")
+
+
+def unregistered_gauge(metrics):
+    metrics.set_gauge("totally_undocumented_gauge", 1.0)
+
+
+def unregistered_histogram(m):
+    m.observe("no_such_latency_seconds", 0.25, kind="bogus")
+
+
+def waived_write(reg):
+    # metrics-ok: internal scratch series exercised only by this fixture
+    reg.inc("deliberately_uncatalogued_total")

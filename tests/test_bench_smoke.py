@@ -19,6 +19,12 @@ def test_bench_helpers_produce_sane_numbers(tmp_path):
                 "model_put_gbps"):
         assert stages.get(key, 0) > 0, (key, stages)
     assert stages["meta_commit_us_per_put"] > 0
+    # Span-tracing A/B (ISSUE 12): the always-on plane's contract is
+    # <=2% PUT throughput overhead; the bench interleaves/alternates
+    # best-of reps so CPU weather cannot fake a regression.
+    ab = stages["trace_ab"]
+    assert ab["tracing_on_gbps"] > 0 and ab["tracing_off_gbps"] > 0
+    assert ab["overhead_pct"] <= 2.0, ab
 
 
 def test_zero_copy_reader_contract():

@@ -44,6 +44,11 @@ class _MemLayer:
 
 
 def test_sealed_blob_is_encrypted():
+    pytest.importorskip(
+        "cryptography",
+        reason="the AES-GCM config envelope needs the cryptography "
+               "package (the documented fallback stores PLAIN)",
+    )
     sys_ = ConfigSys(_MemLayer(), secret="root-secret")
     sys_.config.set_kv("region", name="eu-west-1")
     sys_.save()

@@ -185,31 +185,47 @@ def test_degraded_single_node_restart(tmp_path):
     """A one-node restart of a two-node deployment serves reads from
     its k local shards while the other node stays down (format quorum
     forms from reachable disks; ref loadFormatErasureAll tolerance)."""
-    pa, pb = _free_port(), _free_port()
-    while abs(pa - pb) < 3:
-        pb = _free_port()
-    eps = [
-        f"http://127.0.0.1:{pa}{tmp_path}/a1",
-        f"http://127.0.0.1:{pa}{tmp_path}/a2",
-        f"http://127.0.0.1:{pb}{tmp_path}/b1",
-        f"http://127.0.0.1:{pb}{tmp_path}/b2",
-    ]
+    # Same armor as the module `cluster` fixture: the reserved-port
+    # trick can race other tests' ephemeral binds under a loaded
+    # full-suite run, so boot retries on fresh ports/dirs.
     servers = {}
+    eps = []
+    pa = pb = 0
+    for attempt in range(3):
+        pa, pb = _free_port(), _free_port()
+        while abs(pa - pb) < 3:
+            pb = _free_port()
+        base = tmp_path / f"try{attempt}"
+        base.mkdir()
+        eps = [
+            f"http://127.0.0.1:{pa}{base}/a1",
+            f"http://127.0.0.1:{pa}{base}/a2",
+            f"http://127.0.0.1:{pb}{base}/b1",
+            f"http://127.0.0.1:{pb}{base}/b2",
+        ]
+        servers = {}
 
-    def boot(name, addr):
-        servers[name] = Server(
-            list(eps), port=0, root_user=AK, root_password=SK,
-            enable_scanner=False, storage_address=addr,
-        ).start()
+        def boot(name, addr):
+            try:
+                servers[name] = Server(
+                    list(eps), port=0, root_user=AK, root_password=SK,
+                    enable_scanner=False, storage_address=addr,
+                ).start()
+            except OSError:  # bind race lost: retry on fresh ports
+                pass
 
-    ts = [
-        threading.Thread(target=boot, args=("a", f"127.0.0.1:{pa}")),
-        threading.Thread(target=boot, args=("b", f"127.0.0.1:{pb}")),
-    ]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(60)
+        ts = [
+            threading.Thread(target=boot, args=("a", f"127.0.0.1:{pa}")),
+            threading.Thread(target=boot, args=("b", f"127.0.0.1:{pb}")),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        if len(servers) == 2:
+            break
+        for s in servers.values():
+            s.stop()
     a, b = servers["a"], servers["b"]
     body = b"survives-restart" * 100
     assert req(a, "PUT", "/restartbkt")[0] == 200

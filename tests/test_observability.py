@@ -1,0 +1,165 @@
+"""Observability seams (ISSUE 12 satellites): verbose-vs-plain trace
+routing keyed on queue OBJECTS (not recyclable ids), slow-subscriber
+drop accounting on every PubSub bus, sampling-profiler lifecycle +
+collapsed output + trace-id tagging, and audit/bandwidth smoke."""
+
+import io
+import queue
+import threading
+import time
+
+import pytest
+
+from minio_tpu.observability import pubsub as pubsub_mod
+from minio_tpu.observability import spans
+from minio_tpu.observability.audit import AuditLogger
+from minio_tpu.observability.bandwidth import BandwidthMonitor
+from minio_tpu.observability.metrics import Metrics
+from minio_tpu.observability.profiler import SamplingProfiler
+from minio_tpu.observability.pubsub import PubSub
+from minio_tpu.observability.trace import TraceHub
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    spans.reset()
+    pubsub_mod.set_metrics(None)
+    yield
+    spans.reset()
+    pubsub_mod.set_metrics(None)
+
+
+# --- TraceHub verbose identity -----------------------------------------
+
+def test_verbose_routing_is_keyed_on_queue_objects():
+    hub = TraceHub()
+    q_plain = hub.subscribe()
+    q_verbose = hub.subscribe(verbose=True)
+    hub.publish({"api": "put_object"},
+                verbose_extra={"request_body": "secret-bytes"})
+    plain = q_plain.get(timeout=2)
+    verbose = q_verbose.get(timeout=2)
+    assert "request_body" not in plain
+    assert verbose["request_body"] == "secret-bytes"
+    # The capability set holds the queue objects themselves — an id()
+    # recycled by a later allocation can never inherit verbosity.
+    assert all(isinstance(q, queue.Queue) for q in hub._verbose_qs)
+    hub.unsubscribe(q_verbose)
+    assert not hub.any_verbose
+
+
+def test_unsubscribed_verbose_queue_never_leaks_bodies():
+    hub = TraceHub()
+    q1 = hub.subscribe(verbose=True)
+    hub.unsubscribe(q1)
+    q2 = hub.subscribe()  # may even reuse q1's freed id
+    hub.publish({"api": "put_object"},
+                verbose_extra={"request_body": "secret"})
+    got = q2.get(timeout=2)
+    assert "request_body" not in got
+
+
+# --- PubSub drop accounting --------------------------------------------
+
+def test_pubsub_counts_slow_subscriber_drops():
+    reg = Metrics()
+    pubsub_mod.set_metrics(reg)
+    bus = PubSub(max_queue=2, name="trace")
+    bus.subscribe()  # never drained
+    for i in range(5):
+        bus.publish(i)
+    assert bus.dropped_total == 3
+    assert reg.counter_value("pubsub_dropped_total", bus="trace") == 3
+
+
+def test_publish_each_none_skips_without_counting_a_drop():
+    bus = PubSub(max_queue=1, name="spanbus")
+    q1 = bus.subscribe()
+    q2 = bus.subscribe()
+    bus.publish_each(lambda q: {"x": 1} if q is q1 else None)
+    assert q1.get_nowait() == {"x": 1}
+    assert q2.empty()
+    assert bus.dropped_total == 0
+
+
+# --- SamplingProfiler ---------------------------------------------------
+
+def test_profiler_lifecycle_and_collapsed_output():
+    prof = SamplingProfiler(interval_s=0.002).start()
+    with pytest.raises(RuntimeError):
+        prof.start()
+    assert prof.running
+    time.sleep(0.05)
+    text = prof.stop_and_report()
+    assert not prof.running
+    assert text.startswith("# sampling profile:")
+    # Collapsed format: every non-comment line is 'frame;... count'.
+    for line in text.strip().splitlines()[1:]:
+        if line.startswith("#"):
+            continue
+        stack, count = line.rsplit(" ", 1)
+        assert ";" in stack or ":" in stack
+        assert count.isdigit()
+
+
+def test_profiler_max_duration_stops_sampling():
+    prof = SamplingProfiler(interval_s=0.002)
+    prof.MAX_DURATION_S = 0.02
+    prof.start()
+    deadline = time.monotonic() + 2.0
+    while prof.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not prof.running, "sampler must stop at MAX_DURATION_S"
+    prof.stop_and_report()  # still renders after self-stop
+
+
+def test_profiler_tags_hot_stacks_with_trace_ids(monkeypatch):
+    monkeypatch.setenv("MTPU_TRACE_SLOW_MS", "100000")
+    stop = threading.Event()
+    trace_hex = {}
+
+    def busy_request():
+        with spans.request_trace("put_object") as ctx:
+            trace_hex["id"] = ctx.hex_id
+            while not stop.is_set():
+                sum(range(2000))
+
+    worker = threading.Thread(target=busy_request)
+    prof = SamplingProfiler(interval_s=0.002).start()
+    worker.start()
+    time.sleep(0.2)
+    stop.set()
+    worker.join()
+    report = prof.report()
+    assert report["samples"] > 0
+    tagged = [h for h in report["hottest"] if h["trace_ids"]]
+    assert tagged, "armed span plane must tag sampled request stacks"
+    assert any(trace_hex["id"] in h["trace_ids"] for h in tagged)
+    # The collapsed text carries the same ids as comment lines.
+    assert f"# traces:" in report["collapsed"]
+
+
+# --- audit / bandwidth smoke -------------------------------------------
+
+def test_audit_logger_smoke():
+    audit = AuditLogger()
+    audit.log(api="put_object", bucket="b", object_="o",
+              status_code=200, duration_ns=1234,
+              remote_host="127.0.0.1", request_id="RID",
+              user_agent="t", access_key="ak")
+    recent = audit.recent(10)
+    assert recent[-1]["api"]["name"] == "put_object"
+    assert recent[-1]["requestID"] == "RID"
+    assert audit.dropped == 0
+    assert AuditLogger.from_config(None)._q is None
+
+
+def test_bandwidth_monitor_smoke():
+    mon = BandwidthMonitor()
+    mon.set_limit("b", "arn:x", 0)
+    mon.account("b", "arn:x", 1 << 20)
+    rep = mon.report()
+    assert rep["b"]["arn:x"]["totalBytes"] == 1 << 20
+    reader = mon.monitor(io.BytesIO(b"x" * 1024), "b", "arn:x")
+    assert reader.read() == b"x" * 1024
+    assert mon.report()["b"]["arn:x"]["totalBytes"] == (1 << 20) + 1024

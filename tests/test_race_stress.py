@@ -59,6 +59,18 @@ def _no_cycles_after_each(_lockgraph_armed):
 
 @pytest.fixture()
 def ol(tmp_path, _lockgraph_armed):
+    # These tests exist to catch TORN STATE under deliberately racy
+    # interleavings — not to exercise admission overload (that is
+    # test_admission's job). On a 1-core host the default governor
+    # (slots=1, queue=8) legitimately 503s some of 16 simultaneous
+    # writers, which reads as a spurious failure here: give the
+    # governor enough queue for every stress writer, restore after.
+    from minio_tpu.pipeline import admission as _admission
+
+    _admission.reconfigure(_admission.AdmissionConfig(
+        slots=max(1, __import__("os").cpu_count() or 1),
+        per_client_cap=64, max_queue=64, deadline_s=60.0,
+    ))
     disks = [
         LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
         for i in range(4)
@@ -67,7 +79,8 @@ def ol(tmp_path, _lockgraph_armed):
     sets.init_format()
     pools = ErasureServerPools([sets])
     pools.make_bucket("race")
-    return pools
+    yield pools
+    _admission.reconfigure()
 
 
 def _run_all(threads):

@@ -80,6 +80,7 @@ RULE_CASES = [
     ("jax-lint", "jax_pos.py", "jax_neg.py", 5),
     ("jax-lint", "readjax_pos.py", "readjax_neg.py", 1),
     ("except-lint", "except_pos.py", "except_neg.py", 2),
+    ("metrics-lint", "metrics_pos.py", "metrics_neg.py", 3),
 ]
 
 

@@ -175,7 +175,8 @@ def test_profiling_and_healthinfo_and_audit(srv):
     # MEASURED perf probe (GB/s + per-op latency, madmin.DrivePerfInfo
     # analog), size-bounded via ?perfsize so the bundle stays cheap.
     st, _, body = cl.request(
-        "GET", "/minio/admin/v3/healthinfo?perf=true&perfsize=1"
+        "GET", "/minio/admin/v3/healthinfo",
+        query=[("perf", "true"), ("perfsize", "1")],
     )
     assert st == 200
     info = json.loads(body)

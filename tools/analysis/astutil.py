@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 
 _ANN_RE = re.compile(
-    r"#\s*(copy|lock|pool|jax|except)-ok:\s*(\S[^#]*)"
+    r"#\s*(copy|lock|pool|jax|except|metrics)-ok:\s*(\S[^#]*)"
 )
 
 

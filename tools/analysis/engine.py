@@ -123,6 +123,7 @@ def all_rules() -> list:
         except_lint,
         jax_lint,
         lock_lint,
+        metrics_lint,
         pool_lint,
     )
 
@@ -132,6 +133,7 @@ def all_rules() -> list:
         pool_lint.RULE,
         jax_lint.RULE,
         except_lint.RULE,
+        metrics_lint.RULE,
     ]
 
 

@@ -1,0 +1,138 @@
+"""metrics-lint: every mtpu_*/span series written at runtime must have
+a descriptor in the metrics_v2 catalog.
+
+The registry (observability/metrics.py) happily creates a series for
+ANY name it is handed — a typo'd `reg.inc("wroker_tasks_total")` ships
+a new undocumented series and silently starves the real one, and a
+series written without a catalog descriptor renders with no HELP text
+and is invisible to the dashboards built off the descriptor list. This
+rule closes the loop statically: each registry write whose series name
+is a string literal (`.inc("...")`, `.observe("...")`,
+`.set_gauge("...")`, `.inc_gauge("...")`, `.time("...")`) must name a
+series that appears in a `*DESCRIPTORS` catalog list somewhere under
+minio_tpu/.
+
+The catalog is extracted from the SOURCE (AST over every module's
+`*DESCRIPTORS = [...]` assignments), never by importing minio_tpu —
+the lint gate must stay runnable on a tree whose imports are broken,
+which is exactly when you want it most.
+
+Dynamic names (f-strings, variables) cannot be checked and are
+skipped; read-side helpers (`counter_value`, `gauge`) are reads, not
+writes. A deliberate off-catalog write takes `# metrics-ok: <reason>`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding, repo_root
+
+KEY = "metrics"
+
+# Registry write methods whose first positional argument is the series
+# name. `time` is observe's context-manager twin.
+_WRITE_METHODS = {"inc", "observe", "set_gauge", "inc_gauge", "time"}
+
+# The registry implementation itself manipulates series generically
+# (name is a parameter); it can never name a literal series.
+_EXEMPT = {"minio_tpu/observability/metrics.py"}
+
+
+def _catalog_names(root: str) -> frozenset[str]:
+    """Series names from every `*DESCRIPTORS = [...]` list literal
+    under minio_tpu/ (tuple-of-literals entries; first element is the
+    name). Parsed from source so the catalog survives broken imports."""
+    names: set[str] = set()
+    base = os.path.join(root, "minio_tpu")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                targets: list = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                if not any(
+                    isinstance(t, ast.Name)
+                    and t.id.endswith("DESCRIPTORS")
+                    for t in targets
+                ):
+                    continue
+                value = getattr(node, "value", None)
+                if not isinstance(value, ast.List):
+                    continue
+                for el in value.elts:
+                    if (isinstance(el, ast.Tuple) and el.elts
+                            and isinstance(el.elts[0], ast.Constant)
+                            and isinstance(el.elts[0].value, str)):
+                        names.add(el.elts[0].value)
+    return frozenset(names)
+
+
+class MetricsLint:
+    name = "metrics-lint"
+
+    def __init__(self):
+        self._catalog: frozenset[str] | None = None
+
+    def applies(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        if rel in _EXEMPT:
+            return False
+        return rel.startswith("minio_tpu/") or rel == "bench.py"
+
+    def catalog(self) -> frozenset[str]:
+        if self._catalog is None:
+            self._catalog = _catalog_names(repo_root())
+        return self._catalog
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        catalog = self.catalog()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr not in _WRITE_METHODS:
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not isinstance(first, ast.Constant) \
+                    or not isinstance(first.value, str):
+                continue  # dynamic name: unverifiable statically
+            series = first.value
+            if series in catalog:
+                continue
+            if ctx.annotation(KEY, node.lineno) is not None:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=ctx.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                scope=ctx.scope_of(node),
+                message=(
+                    f"series {series!r} written via .{func.attr}() has "
+                    "no descriptor in the metrics_v2 catalog — add a "
+                    "(name, type, help) entry to a *DESCRIPTORS list "
+                    "or annotate `# metrics-ok: <reason>`"
+                ),
+                snippet=ctx.line_text(node.lineno),
+            )
+
+
+RULE = MetricsLint()

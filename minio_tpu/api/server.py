@@ -807,7 +807,7 @@ class S3Server:
         # crypto.SSEC.IsRequested). MTPU_ALLOW_INSECURE_SSEC=1 opts out
         # for deployments whose TLS terminates at a fronting proxy.
         if self.tls is None and not os.environ.get(
-            "MTPU_ALLOW_INSECURE_SSEC"
+            "MTPU_ALLOW_INSECURE_SSEC", ""
         ):
             from ..crypto.sse import HDR_SSEC_COPY_PREFIX, HDR_SSEC_PREFIX
 

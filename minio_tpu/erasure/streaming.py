@@ -781,6 +781,8 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
                 pool.release(buf0)
                 first[0] = None
         finally:
+            # lifetime-ok: drop() releases item[0] exactly once and
+            # no-ops after the inline path nil'd it above
             drop(first)  # no-op when the inline path released it
         return totals["bytes"]
 
@@ -945,6 +947,8 @@ def _encode_stream_native_workers(erasure: Erasure, src,
                 pool.release(strip0)
                 first[0] = None
         finally:
+            # lifetime-ok: drop() releases item[0] exactly once and
+            # no-ops after the inline path nil'd it above
             drop(first)  # no-op when the inline path released it
         return totals["bytes"]
 

@@ -61,7 +61,7 @@ def load() -> ctypes.CDLL | None:
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
-    if os.environ.get("MTPU_NO_NATIVE") == "1":
+    if os.environ.get("MTPU_NO_NATIVE", "0") == "1":
         return None
     with _lock:
         if _lib is not None or _tried:

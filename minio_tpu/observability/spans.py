@@ -64,7 +64,7 @@ P99_WINDOW = 512           # request durations feeding the auto threshold
 P99_RECALC_EVERY = 32      # recompute cadence (finishes per recompute)
 MAX_TREE_SPANS = 2048      # exemplar size bound (ring scan result cap)
 
-_metrics = None
+_metrics = None  # guarded-by: _metrics_mu
 _metrics_mu = threading.Lock()
 _hub = None  # TraceHub for ?spans=true streaming (server boot wires it)
 
@@ -120,7 +120,8 @@ class _Ring:
 
 
 _tls = threading.local()
-_rings: dict[int, _Ring] = {}   # thread ident -> ring (idents recycle)
+# thread ident -> ring (idents recycle)     # guarded-by: _rings_mu
+_rings: dict[int, _Ring] = {}  # guarded-by: _rings_mu
 _rings_mu = threading.Lock()
 
 # thread ident -> (trace_id, label): what each thread is serving RIGHT
@@ -336,10 +337,10 @@ def span(kind: str, label: str = ""):
 # slow-request exemplar store + auto threshold
 
 _slow_mu = threading.Lock()
-_slow_store: deque = deque(maxlen=SLOW_STORE_CAP)
-_durations_ms: deque = deque(maxlen=P99_WINDOW)
-_finish_count = 0
-_auto_threshold_ms = float("inf")
+_slow_store: deque = deque(maxlen=SLOW_STORE_CAP)  # guarded-by: _slow_mu
+_durations_ms: deque = deque(maxlen=P99_WINDOW)    # guarded-by: _slow_mu
+_finish_count = 0                                  # guarded-by: _slow_mu
+_auto_threshold_ms = float("inf")                  # guarded-by: _slow_mu
 MIN_AUTO_SAMPLES = 32
 
 
@@ -353,6 +354,8 @@ def slow_threshold_ms() -> float:
             return float(raw)
         except ValueError:
             pass
+    # guardedby-ok: racy read of an atomically-rebound float — a
+    # one-recalc-stale threshold misclassifies at most one request
     return _auto_threshold_ms
 
 
@@ -504,7 +507,9 @@ class request_trace:
             return False
         try:
             _finish(ctx)
-        except Exception:  # noqa: BLE001 - tracing must never fail a request
+        # except-ok: tracing must never fail a request — a broken
+        # exemplar capture drops one trace, never a response
+        except Exception:  # noqa: BLE001
             pass
         return False
 
@@ -541,7 +546,9 @@ class resume:
         self._rt.deferred = False
         try:
             _finish(ctx)
-        except Exception:  # noqa: BLE001 - tracing must never fail a request
+        # except-ok: tracing must never fail a request — a broken
+        # exemplar capture drops one trace, never a response
+        except Exception:  # noqa: BLE001
             pass
         return False
 
@@ -575,6 +582,6 @@ def reset() -> None:
     with _slow_mu:
         _slow_store.clear()
         _durations_ms.clear()
-    _finish_count = 0
-    _auto_threshold_ms = float("inf")
+        _finish_count = 0
+        _auto_threshold_ms = float("inf")
     _active.clear()

@@ -161,7 +161,7 @@ ADMISSION_DESCRIPTORS: list[tuple[str, str, str]] = [
      "Distinct clients with queued encode streams"),
 ]
 
-_metrics = None
+_metrics = None  # guarded-by: _metrics_mu
 _metrics_mu = threading.Lock()
 
 
@@ -199,26 +199,26 @@ class AdmissionGovernor:
         # on the endpoint).
         self.domain = domain
         self._cv = threading.Condition()
-        self._inflight = 0
+        self._inflight = 0                  # guarded-by: _cv
         # Per-client in-flight budgets: the diskcheck token machinery,
         # reused verbatim — DiskHealth is pure state, and its
         # acquire(0)/release/state() surface is exactly a token bucket
         # with rejection accounting.
-        self._budgets: dict[str, object] = {}
+        self._budgets: dict[str, object] = {}   # guarded-by: _cv
         # client -> FIFO of waiters; OrderedDict order IS the round-
         # robin rotation (grant pops the first eligible client, then
         # move_to_end so the next grant starts after it).
-        self._queues: "OrderedDict[str, deque[_Waiter]]" = OrderedDict()
-        self._waiting = 0
+        self._queues: "OrderedDict[str, deque[_Waiter]]" = OrderedDict()  # guarded-by: _cv
+        self._waiting = 0                   # guarded-by: _cv
         # Counters (module totals; mirrored onto the registry).
-        self.admitted_total = 0
-        self.queued_total = 0
-        self.rejected_queue_full = 0
-        self.rejected_deadline = 0
+        self.admitted_total = 0             # guarded-by: _cv
+        self.queued_total = 0               # guarded-by: _cv
+        self.rejected_queue_full = 0        # guarded-by: _cv
+        self.rejected_deadline = 0          # guarded-by: _cv
 
     # -- budgets -----------------------------------------------------------
 
-    def _budget(self, client: str):
+    def _budget(self, client: str):  # guarded-by: _cv
         b = self._budgets.get(client)
         if b is None:
             from ..storage.diskcheck import DiskHealth, RobustConfig
@@ -231,11 +231,11 @@ class AdmissionGovernor:
 
     # -- grant machinery (all under self._cv) ------------------------------
 
-    def _client_has_room(self, client: str) -> bool:
+    def _client_has_room(self, client: str) -> bool:  # guarded-by: _cv
         b = self._budgets.get(client)
         return b is None or b.inflight < self.cfg.per_client_cap
 
-    def _grant_to(self, client: str) -> None:
+    def _grant_to(self, client: str) -> None:  # guarded-by: _cv
         self._inflight += 1
         # Never blocks: callers grant only after _client_has_room.
         self._budget(client).acquire(timeout_s=0.0)
@@ -244,7 +244,7 @@ class AdmissionGovernor:
         if reg is not None:
             reg.inc("admission_admitted_total", **self._labels())
 
-    def _grant_waiters(self) -> None:
+    def _grant_waiters(self) -> None:  # guarded-by: _cv
         """Hand freed capacity to queued waiters: rotate over clients,
         one grant per eligible client per pass (FIFO within a client),
         until slots run out or nobody eligible remains. The notify
@@ -333,7 +333,7 @@ class AdmissionGovernor:
                 self._cv.wait(left)
             self._mirror_gauges()
 
-    def _unqueue(self, w: _Waiter) -> None:
+    def _unqueue(self, w: _Waiter) -> None:  # guarded-by: _cv
         q = self._queues.get(w.client)
         if q is not None:
             try:
@@ -355,7 +355,7 @@ class AdmissionGovernor:
             self._release_locked(client)
             self._mirror_gauges()
 
-    def _release_locked(self, client: str) -> None:
+    def _release_locked(self, client: str) -> None:  # guarded-by: _cv
         self._inflight = max(0, self._inflight - 1)
         b = self._budgets.get(client)
         if b is not None and b.inflight > 0:
@@ -415,7 +415,7 @@ class AdmissionGovernor:
     def _labels(self) -> dict:
         return {"domain": self.domain} if self.domain else {}
 
-    def _mirror_gauges(self) -> None:
+    def _mirror_gauges(self) -> None:  # guarded-by: _cv
         reg = _reg()
         if reg is None:
             return
@@ -424,7 +424,7 @@ class AdmissionGovernor:
         reg.set_gauge("admission_queue_depth", self._waiting, **lb)
         reg.set_gauge("admission_clients_waiting", len(self._queues), **lb)
 
-    def _mirror_queued(self) -> None:
+    def _mirror_queued(self) -> None:  # guarded-by: _cv
         reg = _reg()
         if reg is not None:
             lb = self._labels()
@@ -441,12 +441,14 @@ class AdmissionGovernor:
 # ---------------------------------------------------------------------------
 # process-global instance
 
-_governor: AdmissionGovernor | None = None
+_governor: AdmissionGovernor | None = None  # guarded-by: _governor_mu
 _governor_mu = threading.Lock()
 
 
 def governor() -> AdmissionGovernor:
     global _governor
+    # guardedby-ok: double-checked fast path — a stale None read just
+    # falls through to the locked check; the reference write is atomic
     g = _governor
     if g is None:
         with _governor_mu:
@@ -473,12 +475,14 @@ def reconfigure(config: AdmissionConfig | None = None) -> AdmissionGovernor:
 # its write side holds an encode slot can never self-deadlock across
 # two independent slot pools with deadlines.
 
-_read_governor: AdmissionGovernor | None = None
+_read_governor: AdmissionGovernor | None = None  # guarded-by: _read_governor_mu
 _read_governor_mu = threading.Lock()
 
 
 def read_governor() -> AdmissionGovernor:
     global _read_governor
+    # guardedby-ok: double-checked fast path — a stale None read just
+    # falls through to the locked check; the reference write is atomic
     g = _read_governor
     if g is None:
         with _read_governor_mu:

@@ -86,7 +86,7 @@ class Pipeline:
             return
         try:
             self._drop(item)
-        except Exception:  # noqa: BLE001 - cleanup is best effort
+        except Exception:  # noqa: BLE001  # except-ok: drop-hook cleanup is best effort; first error already propagating
             pass
 
     # ------------------------------------------------------------------
@@ -179,7 +179,7 @@ class Pipeline:
             if stage.bytes_of is not None:
                 try:
                     stats.bytes += int(stage.bytes_of(out))
-                except Exception:  # noqa: BLE001 - telemetry best effort
+                except Exception:  # noqa: BLE001  # except-ok: telemetry best effort, never fails the stage
                     pass
             t0 = time.perf_counter()
             ok = self._put(out_q, out)

@@ -103,7 +103,7 @@ _OP_SERIES = {
     "heal": ("worker_heal_tasks_total", "worker_heal_fallbacks_total"),
 }
 
-_metrics = None
+_metrics = None  # guarded-by: _metrics_mu
 _metrics_mu = threading.Lock()
 
 
@@ -135,7 +135,7 @@ class WorkerUnavailable(RuntimeError):
 # segments die with their pool, not with this registry.
 _segments: "weakref.WeakValueDictionary[str, ShmStrip]" = (
     weakref.WeakValueDictionary()
-)
+)  # guarded-by: _segments_mu
 _segments_mu = threading.Lock()
 
 
@@ -215,7 +215,7 @@ class ShmStrip:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:  # noqa: BLE001 - teardown best effort
+        except Exception:  # noqa: BLE001  # except-ok: GC-time teardown; close() is idempotent and atexit sweeps
             pass
 
 
@@ -252,7 +252,7 @@ class ShmRing:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:  # noqa: BLE001 - teardown best effort
+        except Exception:  # noqa: BLE001  # except-ok: GC-time teardown; close() is idempotent and atexit sweeps
             pass
 
 
@@ -299,7 +299,7 @@ def _sweep_segments() -> None:
     for s in strips:
         try:
             s.close()
-        except Exception:  # noqa: BLE001 - teardown best effort
+        except Exception:  # noqa: BLE001  # except-ok: atexit sweep; a segment that will not close is the OS's now
             pass
 
 
@@ -320,7 +320,7 @@ def _attach_segment(name: str, batch: int, k: int, m: int, shard: int):
     shm = shared_memory.SharedMemory(name=name)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:  # noqa: BLE001 - tracker internals moved
+    except Exception:  # noqa: BLE001  # except-ok: resource_tracker internals moved; worst case the child tracker unlinks early and the task crash-falls-back
         pass
     data_n = batch * k * shard
     par_n = batch * m * shard
@@ -437,7 +437,7 @@ def _child_verify(name: str, size: int, phys: int, chunk: int) -> int:
     shm = shared_memory.SharedMemory(name=name)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:  # noqa: BLE001 - tracker internals moved
+    except Exception:  # noqa: BLE001  # except-ok: resource_tracker internals moved; worst case the child tracker unlinks early and the task crash-falls-back
         pass
     try:
         arr = np.frombuffer(shm.buf, dtype=np.uint8, count=size)
@@ -586,19 +586,19 @@ class WorkerPool:
         )
         self.max_respawns = 3 * self.n
         self._idle: _queue.Queue = _queue.Queue()
-        self._workers: list[_Worker] = []
+        self._workers: list[_Worker] = []   # guarded-by: _mu
         self._mu = threading.Lock()
-        self._dead = False
-        self._respawns = 0
-        self._busy = 0
+        self._dead = False                  # guarded-by: _mu
+        self._respawns = 0                  # guarded-by: _mu
+        self._busy = 0                      # guarded-by: _mu
         # Counters (mirrored onto the registry when installed).
         # Aggregates keep their PR7 names; the per-op dicts split them
         # by request-plane op (encode/decode/verify/heal).
-        self.tasks_total = 0
-        self.fallbacks_total = 0
-        self.crashes_total = 0
-        self.tasks_by_op: dict[str, int] = {}
-        self.fallbacks_by_op: dict[str, int] = {}
+        self.tasks_total = 0                # guarded-by: _mu
+        self.fallbacks_total = 0            # guarded-by: _mu
+        self.crashes_total = 0              # guarded-by: _mu
+        self.tasks_by_op: dict[str, int] = {}       # guarded-by: _mu
+        self.fallbacks_by_op: dict[str, int] = {}   # guarded-by: _mu
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -795,8 +795,8 @@ class WorkerPool:
 
             # Parented under the enclosing "worker" dispatch span.
             _spans.record("worker-exec", f"{op} pid {w.pid}", int(exec_ns))
-        self.tasks_total += 1
         with self._mu:
+            self.tasks_total += 1
             self.tasks_by_op[op] = self.tasks_by_op.get(op, 0) + 1
         reg = _reg()
         if reg is not None:
@@ -810,7 +810,8 @@ class WorkerPool:
         """Drop a crashed worker and respawn a replacement off the
         caller's critical path; disarm the pool past the respawn cap
         (something is systematically killing workers)."""
-        self.crashes_total += 1
+        with self._mu:
+            self.crashes_total += 1
         reg = _reg()
         if reg is not None:
             reg.inc("worker_crashes_total")
@@ -827,9 +828,9 @@ class WorkerPool:
             try:
                 w.proc.kill()
                 w.proc.wait(timeout=2.0)
-            except Exception:  # noqa: BLE001 - unkillable (D-state)
+            except Exception:  # noqa: BLE001  # except-ok: unkillable (D-state) child; crashes_total already counted this retirement
                 pass
-        except Exception:  # noqa: BLE001 - already dead
+        except Exception:  # noqa: BLE001  # except-ok: child already dead; crashes_total already counted this retirement
             pass
         w.close()
         with self._mu:
@@ -847,14 +848,14 @@ class WorkerPool:
     def _respawn_safe(self) -> None:
         try:
             self._spawn()
-        except Exception:  # noqa: BLE001 - disarm instead of crashing
+        except Exception:  # noqa: BLE001  # except-ok: spawn failed — disarms the pool; armed() reports reason=crashes via the one-hot gauge
             with self._mu:
                 self._dead = True
         self._gauge()
 
     def note_fallback(self, op: str = "encode") -> None:
-        self.fallbacks_total += 1
         with self._mu:
+            self.fallbacks_total += 1
             self.fallbacks_by_op[op] = self.fallbacks_by_op.get(op, 0) + 1
         reg = _reg()
         if reg is not None:
@@ -892,7 +893,7 @@ class WorkerPool:
 # ---------------------------------------------------------------------------
 # process-global arming
 
-_pool: WorkerPool | None = None
+_pool: WorkerPool | None = None  # guarded-by: _pool_mu
 _pool_mu = threading.Lock()
 _atexit_registered = False
 # Why the pool is (not) armed, for the worker_armed gauge and the
@@ -906,7 +907,7 @@ _arm_reason = "unarmed"
 # a transient failure (fd exhaustion during a deploy) self-heals on
 # the next arm attempt after the retry window; shutdown() also clears
 # it so an explicit re-arm always gets a real attempt.
-_spawn_failed_at: float | None = None
+_spawn_failed_at: float | None = None  # guarded-by: _pool_mu
 _SPAWN_RETRY_S = 60.0
 
 

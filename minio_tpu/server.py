@@ -106,7 +106,7 @@ class Server:
         from .utils import certs as certs_mod
 
         self.cert_manager = None
-        certs_dir = certs_dir or os.environ.get("MTPU_CERTS_DIR")
+        certs_dir = certs_dir or os.environ.get("MTPU_CERTS_DIR", "")
         if certs_dir:
             pair = certs_mod.find_certs(certs_dir)
             if pair is None:
@@ -175,7 +175,7 @@ class Server:
         # reports (docs/ANALYSIS.md). The tools package lives at the
         # repo root, so a pip-installed deployment without it skips
         # silently.
-        if os.environ.get("MTPU_LOCK_CHECK") == "1":
+        if os.environ.get("MTPU_LOCK_CHECK", "0") == "1":
             try:
                 from tools.analysis import lockgraph as _lockgraph
 

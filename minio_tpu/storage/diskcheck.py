@@ -144,9 +144,11 @@ class DiskHealth:
         self.cfg = config or ROBUST
         self._lock = threading.Lock()
         self._tokens_cv = threading.Condition(self._lock)
-        self._inflight = 0
-        self._consec_timeouts = 0
-        self._faulty = False
+        # _tokens_cv shares _lock's mutex: either name satisfies the
+        # guard (Condition(lock) aliasing).
+        self._inflight = 0          # guarded-by: _tokens_cv|_lock
+        self._consec_timeouts = 0   # guarded-by: _lock
+        self._faulty = False        # guarded-by: _lock
         # Totals for gauges/admin (monotonic; registry counters are
         # inc'd at event time by the wrapper).
         self.timeouts_total = 0
@@ -181,11 +183,16 @@ class DiskHealth:
 
     @property
     def inflight(self) -> int:
+        # guardedby-ok: racy telemetry read — an int snapshot for
+        # gauges and caps; staleness costs one extra queue round
         return self._inflight
 
     # --- breaker ---
 
     def is_faulty(self) -> bool:
+        # guardedby-ok: racy fast-path read — a stale False does one
+        # guarded op (deadline still bounds it), a stale True fails
+        # fast one op late; both converge next op
         return self._faulty
 
     def record_ok(self) -> None:
@@ -214,12 +221,16 @@ class DiskHealth:
 
     def state(self) -> dict:
         return {
+            # guardedby-ok: racy telemetry snapshot for admin/state
+            # endpoints — consistency across fields is not promised
             "state": "faulty" if self._faulty else "ok",
+            # guardedby-ok: racy telemetry snapshot (see above)
             "inflight": self._inflight,
             "timeouts": self.timeouts_total,
             "latched": self.latched_total,
             "readmitted": self.readmitted_total,
             "rejected": self.rejected_total,
+            # guardedby-ok: racy telemetry snapshot (see above)
             "consecutiveTimeouts": self._consec_timeouts,
         }
 
@@ -243,10 +254,10 @@ class MetricsDisk:
                 health.endpoint = disk.endpoint()
             except Exception:  # noqa: BLE001 - cosmetic only
                 pass
-        self._deadline_pool: ThreadPoolExecutor | None = None
+        self._deadline_pool: ThreadPoolExecutor | None = None  # guarded-by: _probe_lock
         self._probe_lock = threading.Lock()
-        self._probe_running = False
-        self._probe_attempt_live = False
+        self._probe_running = False         # guarded-by: _probe_lock
+        self._probe_attempt_live = False    # guarded-by: _probe_lock
 
     # --- identity passthrough ---
 
@@ -367,6 +378,8 @@ class MetricsDisk:
         # HERE instead of draining the shared erasure IO pool. Creation
         # is double-checked under a lock: two racing first ops must not
         # each build an executor and leak the loser's worker thread.
+        # guardedby-ok: double-checked fast path — a stale None read
+        # falls through to the locked re-check below
         pool = self._deadline_pool
         if pool is None:
             with self._probe_lock:

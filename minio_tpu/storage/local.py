@@ -85,7 +85,7 @@ class LocalStorage(StorageAPI):
         # opt-in (MTPU_ODIRECT=1) and probed per disk root — tmpfs and
         # other cache-only filesystems fall back to buffered writes.
         self._odirect = False
-        if os.environ.get("MTPU_ODIRECT") == "1":
+        if os.environ.get("MTPU_ODIRECT", "0") == "1":
             from .directio import supports_odirect
 
             self._odirect = supports_odirect(self.root)

@@ -24,7 +24,7 @@ SINGLE_CORE = (os.cpu_count() or 1) == 1
 # tests; mirrored onto the metrics endpoint when a registry is
 # installed (server boot calls set_metrics, same pattern as
 # erasure/streaming.py).
-LATE_DROPS = {"errors": 0, "results": 0}
+LATE_DROPS = {"errors": 0, "results": 0}  # guarded-by: _late_mu
 _late_mu = threading.Lock()
 _metrics = None
 
@@ -106,11 +106,11 @@ class StragglerCompensator:
         # future CPython renames it.
         self._pool = pool if hasattr(pool, "_max_workers") else None
         self._max_extra = max_extra
-        self._extra = 0
-        self._applied = 0
+        self._extra = 0     # guarded-by: _mu
+        self._applied = 0   # guarded-by: _mu
         self._mu = threading.Lock()
 
-    def _apply(self):
+    def _apply(self):  # guarded-by: _mu
         want = min(self._extra, self._max_extra)
         delta = want - self._applied
         if delta and self._pool is not None:

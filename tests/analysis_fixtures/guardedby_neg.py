@@ -1,0 +1,46 @@
+"""NEGATIVE guardedby-lint fixture: every accepted access shape must
+stay silent — with-held access, Condition aliasing, local lock
+aliases, satisfied preconditions, __init__ writes, and waived racy
+reads."""
+import threading
+
+_mu = threading.Lock()
+_shared = []  # guarded-by: _mu
+
+
+def locked_module_write(x):
+    with _mu:
+        _shared.append(x)
+
+
+class Pool:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        # _cv wraps _mu's mutex: either name satisfies the guard.
+        self._items = []  # guarded-by: _mu|_cv
+        self._stat = 0    # guarded-by: _mu
+
+    def locked(self, x):
+        with self._mu:
+            self._items.append(x)
+
+    def via_condition(self):
+        with self._cv:
+            return self._items.pop()
+
+    def via_alias(self):
+        cv = self._cv
+        with cv:
+            self._items.append(0)
+
+    def _locked_helper(self):  # guarded-by: _mu
+        self._stat += 1
+
+    def calls_helper(self):
+        with self._mu:
+            self._locked_helper()
+
+    def waived_read(self):
+        # guardedby-ok: racy telemetry read — staleness is acceptable
+        return self._stat

@@ -1,0 +1,41 @@
+"""POSITIVE guardedby-lint fixture: declared fields touched outside
+their lock, wrong lock held, and a precondition method called bare —
+every shape must fire."""
+import threading
+
+_mu = threading.Lock()
+_shared = []  # guarded-by: _mu
+
+
+def unlocked_module_write(x):
+    _shared.append(x)  # FIRE: module var outside _mu
+
+
+class Pool:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition()
+        self._items = []   # guarded-by: _mu
+        self._waiting = 0  # guarded-by: _cv
+
+    def _grant(self):  # guarded-by: _cv
+        self._waiting -= 1
+
+    def unlocked_read(self):
+        return len(self._items)  # FIRE: read outside _mu
+
+    def unlocked_write(self, x):
+        self._items.append(x)  # FIRE: write outside _mu
+
+    def wrong_lock(self):
+        with self._mu:
+            self._waiting += 1  # FIRE: needs _cv, holds _mu
+
+    def precondition_violation(self):
+        self._grant()  # FIRE: caller must hold _cv
+
+    def branch_hold(self, flag):
+        if flag:
+            with self._mu:
+                self._items.append(1)  # held here: clean
+        self._items.append(2)  # FIRE: not held on the joined path

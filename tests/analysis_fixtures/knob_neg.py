@@ -1,0 +1,13 @@
+"""NEGATIVE knob-lint fixture: documented knobs with declared
+defaults, non-MTPU env vars, writes, and a waived internal hook —
+all silent."""
+import os
+
+A = os.environ.get("MTPU_WORKER_POOL", "")
+B = os.getenv("MTPU_TRACE", "1")
+# knob-ok: internal test hook, deliberately undocumented
+C = os.environ.get("MTPU_FIXTURE_WAIVED")
+D = os.environ.get("NOT_A_KNOB")
+os.environ["MTPU_ENCODE_ENGINE"] = "native"
+os.environ.setdefault("MTPU_NATIVE_THREADS", "1")
+os.environ.pop("MTPU_MESH_SHAPE", None)

@@ -1,0 +1,8 @@
+"""POSITIVE knob-lint fixture: undocumented MTPU_* knobs and reads
+with no declared default — each read fires twice (undocumented + no
+default)."""
+import os
+
+A = os.environ.get("MTPU_FIXTURE_UNDOCUMENTED")
+B = os.environ["MTPU_FIXTURE_SUBSCRIPT_READ"]
+C = os.getenv("MTPU_FIXTURE_GETENV")

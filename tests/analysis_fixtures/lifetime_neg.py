@@ -1,0 +1,79 @@
+"""NEGATIVE lifetime-lint fixture: every accepted lifetime shape must
+stay silent — use-then-finally-release, recycle loops, join-before-
+release, the PR8 deferred-release handshake, ownership transfer, and
+the streaming-ring yield idiom."""
+import threading
+
+from minio_tpu.pipeline.buffers import BufferPool
+
+pool = BufferPool(lambda: bytearray(1024), capacity=2)
+
+
+def try_finally_after_use(sink):
+    buf = pool.acquire()
+    try:
+        sink.write(buf)
+    finally:
+        pool.release(buf)
+
+
+def release_then_reacquire(n):
+    total = 0
+    for _ in range(n):
+        buf = pool.acquire()
+        total += len(buf)
+        pool.release(buf)
+    return total
+
+
+def join_then_release(executor):
+    buf = pool.acquire()
+    fut = executor.submit(len, buf)
+    out = fut.result()
+    pool.release(buf)
+    return out
+
+
+def thread_join_then_release():
+    buf = pool.acquire()
+    t = threading.Thread(target=lambda: len(buf))
+    t.start()
+    t.join()
+    pool.release(buf)
+
+
+class _DeferredRing:
+    """The PR8 parked-reader handshake: the release point is gated on
+    an in-flight counter, so a parked thread's late readinto can never
+    scribble a recycled segment — the deferred release happens at that
+    thread's exit instead."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._inflight = 0
+        self._pending = False
+
+    def handoff_with_handshake(self, executor):
+        buf = pool.acquire()
+        with self._mu:
+            self._inflight += 1
+        executor.submit(len, buf)
+        with self._mu:
+            self._pending = True
+            if self._inflight == 0:
+                pool.release(buf)  # handshake-guarded: silent
+
+
+def transfer_ownership():
+    return pool.acquire()  # the caller owns (and releases) it
+
+
+def yield_streaming():
+    buf = pool.acquire()
+    try:
+        for i in range(4):
+            yield memoryview(buf)[: 16 * (i + 1)]
+    finally:
+        # Generator finally runs at close — AFTER the consumer drained
+        # the last yielded view (the documented ring contract).
+        pool.release(buf)

@@ -1,0 +1,49 @@
+"""POSITIVE lifetime-lint fixture: every lifetime hazard shape must
+fire — use-after-release, double-release, return past a finally
+release, and thread handoff released before join."""
+import threading
+
+from minio_tpu.pipeline.buffers import BufferPool
+
+pool = BufferPool(lambda: bytearray(1024), capacity=2)
+
+
+def use_after_release():
+    buf = pool.acquire()
+    pool.release(buf)
+    return len(buf)  # FIRE: read of a recycled buffer
+
+
+def double_release(flag):
+    buf = pool.acquire()
+    if flag:
+        pool.release(buf)
+    pool.release(buf)  # FIRE: may already be released
+
+
+def return_past_finally_release():
+    buf = pool.acquire()
+    try:
+        view = memoryview(buf)[:16]
+        return view  # FIRE: the finally releases before the caller sees it
+    finally:
+        pool.release(buf)
+
+
+def handoff_then_release(executor):
+    buf = pool.acquire()
+    fut = executor.submit(_consume, buf)
+    pool.release(buf)  # FIRE: the worker may still hold the view
+    return fut
+
+
+def closure_handoff_release():
+    buf = pool.acquire()
+    t = threading.Thread(target=lambda: _consume(buf))
+    t.start()
+    pool.release(buf)  # FIRE: released before join
+    t.join()
+
+
+def _consume(b):
+    return len(b)

@@ -1,0 +1,32 @@
+"""NEGATIVE shm-lint fixture: the worker protocol's real shapes —
+names/geometry over the pipe, verdict ints back, payload consumed
+in place — must stay silent."""
+import pickle
+
+
+def clean_task(strip, w):
+    # The real dispatch shape: segment NAME and geometry only.
+    w.send(("enc", strip.name, strip.batch, strip.k, strip.m))
+
+
+def clean_reply(out, arr):
+    bad = _verify(arr)
+    reply = ("ok", int(bad), 123)
+    pickle.dump(reply, out)  # verdict int: clean
+
+
+def _verify(arr):
+    # Consumes the payload view; returns a scalar verdict.
+    view = arr.view
+    return _scan(view)
+
+
+def _scan(v):
+    return -1
+
+
+def compute_in_place(strip, kernel):
+    # Payload flows into compute (out= into the segment), nothing
+    # returns to the pipe.
+    kernel(strip.data, out=strip.parity)
+    return None

@@ -1,0 +1,27 @@
+"""POSITIVE shm-lint fixture: payload views smuggled onto the pipe —
+each serialization of a value aliasing a strip/ring region must
+fire."""
+import pickle
+
+
+def smuggle_reply(strip, out):
+    payload = strip.data[:4]
+    reply = ("ok", payload.tobytes(), 0)
+    pickle.dump(reply, out)  # FIRE: payload bytes in the reply tuple
+
+
+def smuggle_send(w, ring):
+    w.send(("vfy", ring.view))  # FIRE: raw ring view over the channel
+
+
+def smuggle_dumps(strip):
+    return pickle.dumps(strip.recon_out(2, 1))  # FIRE: region view
+
+
+def smuggle_through_helper(strip, out):
+    leaked = _leak(strip)
+    pickle.dump(("ok", leaked), out)  # FIRE: via the return summary
+
+
+def _leak(strip):
+    return strip.parity

@@ -376,7 +376,11 @@ def test_bench_records_analysis_gate_cost():
 
     gate = bench.bench_analysis_gate()
     assert gate["files_scanned"] > 100, gate
-    assert 0 < gate["wall_time_s"] < 60, gate
+    # ISSUE 13: the gate parallelizes across cpu_count files-per-worker
+    # workers, so wall time stays flat as rules grow — 15 s is the
+    # budget even on the 1-core container running all ten rules
+    # serially (measured ~4 s there).
+    assert 0 < gate["wall_time_s"] <= 15, gate
     # The repo itself must be clean — same invariant the tier-1 gate
     # (test_static_analysis) enforces, visible here as a zero.
     assert gate["findings_new"] == 0, gate

@@ -1,18 +1,27 @@
 """Project static-analysis plane: the machine-checked discipline behind
 the erasure hot path (see docs/ANALYSIS.md).
 
-Five AST lint rules encode the invariants PRs 2-4 enforced by hand —
-zero-copy accounting (copy-lint), no blocking work under a
-threading.Lock (lock-lint), buffer-pool checkout/release pairing on
-every path (pool-lint), jit dispatch hygiene (jax-lint), and no
-silently swallowed errors on quorum/delivery paths (except-lint) —
-plus a runtime lock-order checker (lockgraph) armed in the
-concurrency stress suites.
+Six per-statement AST lint rules encode the invariants PRs 2-9
+enforced by hand — zero-copy accounting (copy-lint), no blocking work
+under a threading.Lock (lock-lint), buffer-pool checkout/release
+pairing on every path (pool-lint), jit dispatch hygiene (jax-lint),
+no silently swallowed errors on quorum/delivery paths (except-lint),
+and metrics series named in a descriptor catalog (metrics-lint).
+
+Four dataflow rules (ISSUE 13) interpret whole functions through
+``dataflow.py``'s abstract-interpretation engine — pooled-buffer
+lifetime verification (lifetime-lint), the worker plane's
+zero-payload-over-pipe invariant (shm-lint), ``# guarded-by:`` lock
+annotations verified at every access (guardedby-lint), and MTPU_*
+env-knob documentation/defaults (knob-lint) — plus a runtime
+lock-order checker (lockgraph) armed in the concurrency stress
+suites.
 
 Tier-1 gate: tests/test_static_analysis.py runs the full scan and
 fails on any finding not pinned in tools/analysis/baseline.json.
 CLI: ``python -m tools.analysis`` emits the JSON report and exits
-non-zero on new findings.
+non-zero on new findings; ``--rule``, ``--since``, ``--jobs`` scope
+and parallelize local iteration.
 """
 
 from .engine import Finding, load_baseline, run, write_baseline

@@ -7,6 +7,12 @@ Options:
                      (prints the report for the PRE-acceptance state)
   --no-baseline      raw scan: report everything as new, exit by it
   --all-rules        apply every rule to every file (ignore scopes)
+  --rule NAME        run only this rule (repeatable)
+  --since REV        scan only files changed since the git rev
+                     (plus uncommitted changes) — local iteration mode
+  --jobs N           worker processes (default: auto — cpu_count for
+                     full scans, serial for small file lists)
+  --json             full JSON report (the default; wins over --quiet)
   --quiet            print only the summary counts line
   [paths...]         restrict the scan to these repo-relative files
 """
@@ -17,7 +23,7 @@ import argparse
 import json
 import sys
 
-from .engine import load_baseline, run, write_baseline
+from .engine import changed_since, load_baseline, run, write_baseline
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,19 +32,57 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--all-rules", action="store_true")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME")
+    ap.add_argument("--since", default=None, metavar="REV")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N")
+    ap.add_argument("--json", action="store_true")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
+    paths = args.paths or None
+    # A partial scan (restricted files or rules) must never rewrite
+    # the baseline: write_baseline() pins EXACTLY the report's
+    # findings, so accepting a partial report would silently drop
+    # every waiver the scan did not cover.
+    if args.write_baseline and (args.since or args.rule or paths):
+        print("--write-baseline requires a full scan (no --since, "
+              "--rule, or explicit paths)", file=sys.stderr)
+        return 2
+    if args.since is not None:
+        if paths:
+            print("--since and explicit paths are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        try:
+            paths = changed_since(args.since)
+        except RuntimeError as exc:  # bad rev: usage error, not findings
+            print(str(exc), file=sys.stderr)
+            return 2
+        if not paths:
+            print(json.dumps({"counts": {"total": 0, "new": 0,
+                                         "waived": 0},
+                              "wall_time_s": 0.0,
+                              "since": args.since,
+                              "files_scanned": 0}))
+            return 0
+
     baseline = {} if args.no_baseline else load_baseline()
-    report = run(
-        paths=args.paths or None,
-        force_all_rules=args.all_rules,
-        baseline=baseline,
-    )
+    try:
+        report = run(
+            paths=paths,
+            force_all_rules=args.all_rules,
+            baseline=baseline,
+            rules=args.rule,
+            jobs=args.jobs,
+        )
+    except ValueError as exc:  # unknown --rule name
+        print(str(exc), file=sys.stderr)
+        return 2
     if args.write_baseline:
         n = write_baseline(report)
         print(f"baseline: pinned {n} finding(s)", file=sys.stderr)
-    if args.quiet:
+    if args.quiet and not args.json:
         d = report.to_dict()
         print(json.dumps({"counts": d["counts"],
                           "wall_time_s": d["wall_time_s"]}))

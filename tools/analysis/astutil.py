@@ -22,8 +22,16 @@ import tokenize
 from dataclasses import dataclass, field
 
 _ANN_RE = re.compile(
-    r"#\s*(copy|lock|pool|jax|except|metrics)-ok:\s*(\S[^#]*)"
+    r"#\s*(copy|lock|pool|jax|except|metrics|lifetime|shm|guardedby|knob)"
+    r"-ok:\s*(\S[^#]*)"
 )
+
+# Declaration (not waiver) comments consumed by guardedby-lint:
+#   self._workers = []   # guarded-by: _mu
+#   def _grant_to(...):  # guarded-by: _cv
+# The lock spec is one name, optionally `|`-alternated when two names
+# reach the same underlying lock (Condition(lock) sharing).
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*(?:\|[\w.]+)*)")
 
 
 @dataclass
@@ -36,6 +44,8 @@ class ModuleContext:
     lines: list[str]
     # lineno -> {rule_key: reason} parsed from `# <rule>-ok:` comments.
     annotations: dict[int, dict[str, str]] = field(default_factory=dict)
+    # lineno -> lock spec parsed from `# guarded-by:` declarations.
+    guards: dict[int, str] = field(default_factory=dict)
 
     def annotation(self, rule_key: str, lineno: int) -> str | None:
         """Waiver reason for `rule_key` at `lineno`: the marker may sit
@@ -91,6 +101,7 @@ def parse_module(relpath: str, source: str) -> ModuleContext:
         for child in ast.iter_child_nodes(node):
             child._parent = node  # type: ignore[attr-defined]
     annotations: dict[int, dict[str, str]] = {}
+    guards: dict[int, str] = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
@@ -101,6 +112,9 @@ def parse_module(relpath: str, source: str) -> ModuleContext:
                 annotations.setdefault(tok.start[0], {})[m.group(1)] = (
                     m.group(2).strip()
                 )
+            g = _GUARD_RE.search(tok.string)
+            if g:
+                guards[tok.start[0]] = g.group(1)
     except tokenize.TokenError:
         pass
     return ModuleContext(
@@ -109,6 +123,7 @@ def parse_module(relpath: str, source: str) -> ModuleContext:
         tree=tree,
         lines=source.splitlines(),
         annotations=annotations,
+        guards=guards,
     )
 
 

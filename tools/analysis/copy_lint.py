@@ -31,6 +31,12 @@ HOT_PATHS = {
     "minio_tpu/erasure/device_engine.py",
     "minio_tpu/parallel/mesh_engine.py",
     "minio_tpu/storage/local.py",
+    # Added since PR6 (ISSUE 13): the worker read ops move payload
+    # through shm views, the admission/span planes sit ON the request
+    # path — a stray materialization there taxes every stream.
+    "minio_tpu/pipeline/workers.py",
+    "minio_tpu/pipeline/admission.py",
+    "minio_tpu/observability/spans.py",
 }
 HOT_PREFIXES = ("minio_tpu/ops/",)
 

@@ -1,0 +1,464 @@
+"""Module-level dataflow engine for the flow-sensitive lint rules.
+
+PR6's rules check single statements; the bugs left in the hot path are
+*flow* properties — a pooled buffer used after its release point, a
+shm payload view smuggled into a pipe write three assignments later, a
+guarded field read outside its lock. This module provides the shared
+machinery those rules interpret programs with:
+
+- **FlowWalker** — an abstract-interpretation skeleton over ONE
+  function body: statements execute in order against a mutable
+  ``State``; ``If`` forks and joins (union of may-facts), loops run
+  their body twice so loop-carried facts (release at the bottom, use
+  at the top) surface, ``try`` handlers see a merge of entry and body
+  effects, ``finally`` runs on the joined state, and ``return`` /
+  ``raise`` / ``break`` / ``continue`` kill their path so facts from
+  a bailing branch never pollute the fall-through (``except: release;
+  raise`` must not mark the buffer released for code after the try).
+  Nested function/class defs do NOT execute in the enclosing flow —
+  they surface through :meth:`FlowWalker.on_nested_def` (closures run
+  at an unknown time; rules decide what escape means).
+
+- **def-use / alias helpers** — ``assigned_names`` (flattened binding
+  targets), ``names_in`` (every Name read by an expression),
+  ``origins_of`` (which tracked origins an expression may alias,
+  through attribute/subscript views, view-producing calls like
+  ``memoryview``/``np.frombuffer``/``.reshape``, tuple packing, and
+  conditional expressions).
+
+- **LockState** — the lock lattice for guardedby-lint: dotted lock
+  names held by ``with`` blocks, with local aliases (``cv = self._cv``)
+  canonicalized, merged by intersection (a lock is held only if held
+  on every path).
+
+Everything here is intra-procedural by design; the rules add the
+narrow inter-procedural summaries they need (shm-lint's return/param
+taint, guardedby-lint's method preconditions) on top.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+
+# ---------------------------------------------------------------------------
+# def-use / alias helpers
+
+#: Calls that return a VIEW of (not a copy of) their first argument —
+#: aliasing flows straight through them.
+VIEW_CALLS = {"memoryview", "frombuffer"}
+
+#: Methods that return a view of their receiver (numpy/memoryview
+#: reshaping surface). ``.tobytes()`` & friends COPY — a copy no longer
+#: aliases the pooled storage, which is exactly why copy-lint exists.
+VIEW_METHODS = {"reshape", "view", "cast", "ravel", "transpose",
+                "squeeze", "astype_view", "recon_src", "recon_out",
+                "recon_digests"}
+
+
+def stmt_exprs(stmt) -> list:
+    """Expression positions evaluated AT this statement (compound
+    bodies excluded — FlowWalker descends into those itself)."""
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test]
+    return []
+
+
+def walk_no_defs(expr):
+    """Walk an expression without descending into nested defs/lambdas
+    (their bodies run later, not here)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def is_nested_function(fn) -> bool:
+    """True when `fn` is defined inside another function — its body
+    executes through the enclosing flow's on_nested_def hook, so
+    whole-module rule drivers must not ALSO walk it directly."""
+    cur = getattr(fn, "_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return True
+        cur = getattr(cur, "_parent", None)
+    return False
+
+
+def assigned_names(target: ast.AST) -> list[ast.Name]:
+    """Flattened Name targets of an assignment (tuple/list unpacking
+    included; starred targets unwrap; attribute/subscript stores are
+    heap escapes, not local bindings, and are omitted)."""
+    out: list[ast.Name] = []
+    stack = [target]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, ast.Name):
+            out.append(t)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+    return out
+
+
+def names_in(expr: ast.AST) -> set[str]:
+    """Every Name read anywhere inside `expr` (nested defs excluded —
+    their reads happen at call time, not here)."""
+    out: set[str] = set()
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def free_names_of_def(fn: ast.AST) -> set[str]:
+    """Names a nested def/lambda READS but never binds — the closure
+    captures that can smuggle a buffer view into another thread."""
+    bound: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    reads: set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    reads.add(node.id)
+                else:
+                    bound.add(node.id)
+    return reads - bound
+
+
+def origins_of(expr: ast.AST, env: dict[str, frozenset]) -> frozenset:
+    """Which tracked origins `expr` may alias under the name
+    environment `env` (name -> frozenset of origin keys).
+
+    Aliasing propagates through: bare names, attribute/subscript loads
+    (a view of pooled storage IS the pooled storage), view-producing
+    calls and methods (memoryview/frombuffer/.reshape/...), tuple/list
+    packing, conditional expressions, and named-expression walrus
+    binds. Ordinary calls BREAK the chain — ``len(buf)`` does not
+    alias ``buf`` — which keeps the rules' false-positive rate at the
+    level a tier-1 gate needs.
+    """
+    out: set = set()
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name):
+            out.update(env.get(node.id, ()))
+        elif isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            stack.append(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            stack.extend(node.elts)
+        elif isinstance(node, ast.Dict):
+            stack.extend(v for v in node.values if v is not None)
+        elif isinstance(node, ast.IfExp):
+            stack.extend((node.body, node.orelse))
+        elif isinstance(node, ast.BinOp):
+            stack.extend((node.left, node.right))
+        elif isinstance(node, ast.NamedExpr):
+            stack.append(node.value)
+        elif isinstance(node, ast.Await):
+            stack.append(node.value)
+        elif isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name in VIEW_CALLS or name in VIEW_METHODS:
+                if isinstance(node.func, ast.Attribute):
+                    stack.append(node.func.value)
+                stack.extend(node.args)
+            # other calls: alias chain intentionally broken
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# abstract state + walker
+
+class State:
+    """Base abstract state. Subclasses add fact fields and implement
+    copy()/merge_from(). `dead` marks a terminated path (return/raise/
+    break/continue) whose facts must not join the fall-through."""
+
+    __slots__ = ("dead",)
+
+    def __init__(self):
+        self.dead = False
+
+    def copy(self) -> "State":
+        raise NotImplementedError
+
+    def merge_from(self, other: "State") -> None:
+        raise NotImplementedError
+
+
+def merge_states(states: list) -> "State | None":
+    """Join the LIVE states of a fork; None when every path died."""
+    live = [s for s in states if s is not None and not s.dead]
+    if not live:
+        return None
+    out = live[0]
+    for s in live[1:]:
+        out.merge_from(s)
+    return out
+
+
+class FlowWalker:
+    """Abstract-interpretation skeleton; rules subclass the hooks.
+
+    The walker owns control flow only. It calls:
+
+    - on_stmt(stmt, state)        every statement, including compound
+                                  headers (the If test, the For iter,
+                                  the With items) BEFORE descending;
+    - on_assign(stmt, state)      Assign/AugAssign/AnnAssign, after
+                                  on_stmt;
+    - on_return(stmt, state)      Return, before the path dies;
+    - on_with_enter/exit          around With bodies;
+    - on_nested_def(node, state)  FunctionDef/Lambda/ClassDef seen in
+                                  the flow (not descended into).
+
+    `finally_stack` exposes the finalbody lists of every enclosing
+    try-with-finally at the current point — on_return hooks use it to
+    see releases that WILL run after the return value is computed.
+    """
+
+    def __init__(self, ctx: astutil.ModuleContext):
+        self.ctx = ctx
+        self.finally_stack: list[list] = []
+
+    # -- hooks (default no-ops) --------------------------------------------
+
+    def on_stmt(self, stmt, state) -> None:
+        pass
+
+    def on_assign(self, stmt, state) -> None:
+        pass
+
+    def on_return(self, stmt, state) -> None:
+        pass
+
+    def on_with_enter(self, node, state) -> None:
+        pass
+
+    def on_with_exit(self, node, state) -> None:
+        pass
+
+    def on_nested_def(self, node, state) -> None:
+        pass
+
+    # -- driver -------------------------------------------------------------
+
+    def walk_function(self, fn, state: State) -> State | None:
+        """Interpret one function body; returns the fall-through state
+        (None when every path returned/raised)."""
+        return self._exec_body(fn.body, state)
+
+    def _exec_body(self, body: list, state: State | None):
+        for stmt in body:
+            if state is None or state.dead:
+                return state
+            state = self._exec_stmt(stmt, state)
+        return state
+
+    def _exec_stmt(self, stmt, state: State):
+        self.on_stmt(stmt, state)
+        if isinstance(stmt, ast.If):
+            s_then = state.copy()
+            s_then = self._exec_body(stmt.body, s_then)
+            s_else = state.copy()
+            s_else = self._exec_body(stmt.orelse, s_else)
+            merged = merge_states([s_then, s_else])
+            if merged is None:
+                state.dead = True
+                return state
+            return merged
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._exec_loop(stmt, state)
+        if isinstance(stmt, ast.Try):
+            return self._exec_try(stmt, state)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.on_with_enter(stmt, state)
+            out = self._exec_body(stmt.body, state)
+            if out is not None:
+                self.on_with_exit(stmt, out)
+            return out if out is not None else state
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self.on_nested_def(stmt, state)
+            return state
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self.on_assign(stmt, state)
+            return state
+        if isinstance(stmt, ast.Return):
+            self.on_return(stmt, state)
+            state.dead = True
+            return state
+        if isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
+            state.dead = True
+            return state
+        return state
+
+    def _exec_loop(self, stmt, state: State):
+        # Two passes over the body: pass 1 from the entry state, pass 2
+        # from pass 1's exit so loop-carried facts (released at the
+        # bottom, used at the top) meet. break/continue inside kill
+        # only their pass — the loop as a whole still falls through.
+        skip = state.copy()  # zero-iteration path
+        s1 = state.copy()
+        s1.dead = False
+        s1 = self._exec_body(stmt.body, s1)
+        if s1 is not None and not s1.dead:
+            s2 = s1.copy()
+            s2 = self._exec_body(stmt.body, s2)
+            if s2 is not None and not s2.dead:
+                s1 = s2
+        out = merge_states([skip, s1])
+        if out is None:
+            out = skip
+            out.dead = False
+        if stmt.orelse:
+            out = self._exec_body(stmt.orelse, out) or out
+        return out
+
+    def _exec_try(self, stmt: ast.Try, state: State):
+        if stmt.finalbody:
+            self.finally_stack.append(stmt.finalbody)
+        try:
+            entry = state.copy()
+            body_state = self._exec_body(stmt.body, state)
+            handler_states = []
+            for h in stmt.handlers:
+                # A handler can enter after ANY prefix of the body ran:
+                # approximate its entry as entry ∪ end-of-body facts.
+                hs = entry.copy()
+                if body_state is not None:
+                    hs.merge_from(body_state)
+                hs.dead = False
+                hs = self._exec_body(h.body, hs)
+                handler_states.append(hs)
+            if (body_state is not None and not body_state.dead
+                    and stmt.orelse):
+                body_state = self._exec_body(stmt.orelse, body_state)
+            out = merge_states([body_state] + handler_states)
+        finally:
+            if stmt.finalbody:
+                self.finally_stack.pop()
+        if out is None:
+            # Every path bailed; the finally still runs, but nothing
+            # flows past the try.
+            dead = entry
+            dead.dead = True
+            if stmt.finalbody:
+                dead.dead = False
+                dead = self._exec_body(stmt.finalbody, dead) or dead
+                dead.dead = True
+            return dead
+        if stmt.finalbody:
+            out = self._exec_body(stmt.finalbody, out) or out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# lock lattice (guardedby-lint)
+
+class LockState(State):
+    """Lock names held at the current point with HOLD COUNTS (nested
+    ``with`` on one re-entrant lock must not un-hold it at the inner
+    exit), plus local aliases (``cv = self._cv`` makes ``with cv:``
+    count as holding self._cv). Merge = intersection: a guard only
+    counts when EVERY path holds it."""
+
+    __slots__ = ("held", "aliases")
+
+    def __init__(self, held=None):
+        super().__init__()
+        # dotted lock name -> nesting depth
+        self.held: dict[str, int] = dict(held or {})
+        self.aliases: dict[str, str] = {}
+
+    def copy(self) -> "LockState":
+        s = LockState(self.held)
+        s.aliases = dict(self.aliases)
+        s.dead = self.dead
+        return s
+
+    def merge_from(self, other: "LockState") -> None:
+        self.held = {
+            name: min(depth, other.held[name])
+            for name, depth in self.held.items()
+            if name in other.held
+        }
+        self.aliases = {k: v for k, v in self.aliases.items()
+                        if other.aliases.get(k) == v}
+
+    def hold(self, name: str) -> None:
+        self.held[name] = self.held.get(name, 0) + 1
+
+    def unhold(self, name: str) -> None:
+        depth = self.held.get(name, 0)
+        if depth <= 1:
+            self.held.pop(name, None)
+        else:
+            self.held[name] = depth - 1
+
+    def canonical(self, expr: ast.AST) -> str:
+        name = astutil.dotted_name(expr)
+        return self.aliases.get(name, name)
+
+    def note_alias(self, stmt: ast.Assign) -> None:
+        """Record ``x = self._mu``-shaped lock aliases (and kill stale
+        aliases on any other rebind of x)."""
+        if not isinstance(stmt, ast.Assign):
+            return
+        value_name = astutil.dotted_name(stmt.value)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                if value_name:
+                    self.aliases[tgt.id] = self.aliases.get(
+                        value_name, value_name
+                    )
+                else:
+                    self.aliases.pop(tgt.id, None)
+
+    def holds(self, lockname: str) -> bool:
+        """True when `lockname` (a declaration like ``_mu`` or
+        ``self._mu``) matches any held lock by dotted-leaf equality —
+        declarations name the field, with-blocks name the access
+        path."""
+        leaf = lockname.rsplit(".", 1)[-1]
+        for h in self.held:
+            if h == lockname or h.rsplit(".", 1)[-1] == leaf:
+                return True
+        return False

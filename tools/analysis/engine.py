@@ -117,24 +117,43 @@ def baseline_path() -> str:
                         BASELINE_NAME)
 
 
-def all_rules() -> list:
+def all_rules(names: list[str] | None = None) -> list:
     from . import (
         copy_lint,
         except_lint,
+        guardedby_lint,
         jax_lint,
+        knob_lint,
+        lifetime_lint,
         lock_lint,
         metrics_lint,
         pool_lint,
+        shm_lint,
     )
 
-    return [
+    rules = [
         copy_lint.RULE,
         lock_lint.RULE,
         pool_lint.RULE,
         jax_lint.RULE,
         except_lint.RULE,
         metrics_lint.RULE,
+        lifetime_lint.RULE,
+        shm_lint.RULE,
+        guardedby_lint.RULE,
+        knob_lint.RULE,
     ]
+    if names is None:
+        return rules
+    wanted = set(names)
+    picked = [r for r in rules if r.name in wanted]
+    missing = wanted - {r.name for r in picked}
+    if missing:
+        raise ValueError(
+            f"unknown rule(s) {sorted(missing)}; known: "
+            f"{[r.name for r in rules]}"
+        )
+    return picked
 
 
 def discover(root: str) -> list[str]:
@@ -190,10 +209,197 @@ def write_baseline(report: Report, path: str | None = None) -> int:
     return len(waivers)
 
 
+def _scan_one(root: str, rel: str, rules: list,
+              force_all_rules: bool) -> tuple[bool, list, dict | None]:
+    """Scan one file: (scanned?, findings with per-file occurrence
+    ordinals assigned, parse-error entry or None)."""
+    full = rel if os.path.isabs(rel) else os.path.join(root, rel)
+    try:
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        ctx = astutil.parse_module(rel, source)
+    except (OSError, SyntaxError, ValueError) as exc:
+        return False, [], {"path": rel, "error": str(exc)}
+    file_findings: list[Finding] = []
+    for rule in rules:
+        if not force_all_rules and not rule.applies(rel):
+            continue
+        file_findings.extend(rule.check(ctx))
+    # Disambiguate identical (rule, scope, snippet) findings by
+    # source order before baseline matching, so one waiver covers
+    # exactly one site.
+    out: list[Finding] = []
+    seen: dict[tuple, int] = {}
+    for finding in sorted(file_findings,
+                          key=lambda f: (f.line, f.col, f.rule)):
+        key = (finding.rule, finding.scope, finding.snippet)
+        finding.occurrence = seen.get(key, 0)
+        seen[key] = finding.occurrence + 1
+        out.append(finding)
+    return True, out, None
+
+
+def _auto_jobs(n_files: int) -> int:
+    """Files-per-worker parallelism: one worker interpreter is worth
+    ~0.15 s of startup, so parallelize only when the serial scan
+    clearly dwarfs that (the full-repo scan; not a 3-file --since
+    pass)."""
+    cpus = os.cpu_count() or 1
+    if cpus < 2 or n_files < 32:
+        return 1
+    return min(cpus, max(2, n_files // 16))
+
+
+def _chunk_cli() -> None:
+    """Child-process entry for the parallel scan: JSON task on stdin
+    ({root, paths, force_all_rules, rules}), JSON result on stdout.
+    A plain subprocess (not multiprocessing spawn) so the parent's
+    __main__ — pytest, bench — is never re-executed (same reasoning
+    as pipeline/workers)."""
+    import sys
+
+    task = json.load(sys.stdin)
+    rules = all_rules(task.get("rules"))
+    findings: list[dict] = []
+    errors: list[dict] = []
+    scanned = 0
+    for rel in task["paths"]:
+        ok, file_findings, err = _scan_one(
+            task["root"], rel, rules, task["force_all_rules"]
+        )
+        if ok:
+            scanned += 1
+        if err is not None:
+            errors.append(err)
+        findings.extend(f.to_dict() for f in file_findings)
+    json.dump({"scanned": scanned, "findings": findings,
+               "errors": errors}, sys.stdout)
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(
+        rule=d["rule"], path=d["path"], line=d["line"], col=d["col"],
+        scope=d["scope"], message=d["message"], snippet=d["snippet"],
+        occurrence=d.get("occurrence", 0),
+    )
+
+
+def _scan_parallel(root: str, rel_paths: list[str], jobs: int,
+                   force_all_rules: bool,
+                   rule_names: list[str] | None,
+                   report: Report) -> list[Finding]:
+    """Fan the file list across `jobs` child interpreters; falls back
+    to an in-process scan for any chunk whose child fails, so a
+    sandboxed host degrades to the serial result, never to a partial
+    report."""
+    import subprocess
+    import sys
+
+    chunks = [rel_paths[i::jobs] for i in range(jobs)]
+    chunks = [c for c in chunks if c]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for chunk in chunks:
+        task = json.dumps({
+            "root": root, "paths": chunk,
+            "force_all_rules": force_all_rules, "rules": rule_names,
+        })
+        p = None
+        try:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "from tools.analysis.engine import _chunk_cli; "
+                 "_chunk_cli()"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=env, cwd=root, text=True,
+            )
+            p.stdin.write(task)
+            p.stdin.close()
+        except OSError:
+            # Spawn or handoff failed. A child that DID start must be
+            # reaped here (the fallback path never waits on it) or it
+            # zombies for the parent's lifetime.
+            if p is not None:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                p = None
+        procs.append((chunk, p))
+    rules = None
+    findings: list[Finding] = []
+    for chunk, p in procs:
+        payload = None
+        if p is not None:
+            # Not communicate(): stdin is already closed (the children
+            # all started before this drain loop), and communicate()
+            # insists on flushing it.
+            out = p.stdout.read()
+            rc = p.wait()
+            if rc == 0 and out:
+                try:
+                    payload = json.loads(out)
+                except ValueError:
+                    payload = None
+        if payload is None:
+            # Child failed (sandbox, OOM, crash): scan this chunk
+            # here instead.
+            if rules is None:
+                rules = all_rules(rule_names)
+            for rel in chunk:
+                ok, file_findings, err = _scan_one(
+                    root, rel, rules, force_all_rules
+                )
+                if ok:
+                    report.files_scanned += 1
+                if err is not None:
+                    report.parse_errors.append(err)
+                findings.extend(file_findings)
+            continue
+        report.files_scanned += payload["scanned"]
+        report.parse_errors.extend(payload["errors"])
+        findings.extend(_finding_from_dict(d)
+                        for d in payload["findings"])
+    return findings
+
+
+def changed_since(rev: str, root: str | None = None) -> list[str]:
+    """Repo-relative .py paths changed since `rev` — tracked diffs
+    PLUS untracked files (a brand-new module is exactly what local
+    iteration is editing) — the --since incremental mode's filter."""
+    import subprocess
+
+    root = root or repo_root()
+    out = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--", "*.py"],
+        capture_output=True, text=True, cwd=root, timeout=30,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {rev} failed: {out.stderr.strip()}"
+        )
+    changed = {ln.strip() for ln in out.stdout.splitlines()
+               if ln.strip()}
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--",
+         "*.py"],
+        capture_output=True, text=True, cwd=root, timeout=30,
+    )
+    if untracked.returncode == 0:
+        changed.update(ln.strip() for ln in untracked.stdout.splitlines()
+                       if ln.strip())
+    return [p for p in discover(root) if p in changed]
+
+
 def run(root: str | None = None, paths: list[str] | None = None,
         force_all_rules: bool = False,
         baseline: dict | None = None,
-        use_baseline: bool = True) -> Report:
+        use_baseline: bool = True,
+        rules: list[str] | None = None,
+        jobs: int | None = None) -> Report:
     """Scan and return the Report.
 
     root            repo root (auto-detected by default)
@@ -203,43 +409,41 @@ def run(root: str | None = None, paths: list[str] | None = None,
                     scope filter (the fixture harness uses this)
     baseline        fingerprint->entry map; None loads baseline.json
                     (pass use_baseline=False for a raw scan)
+    rules           restrict to these rule names (None = all)
+    jobs            worker processes for the scan; None auto-sizes to
+                    os.cpu_count() for full-repo scans and stays
+                    serial for small file lists, 1 forces serial
     """
     t0 = time.perf_counter()
     root = root or repo_root()
-    rules = all_rules()
     if baseline is None and use_baseline:
         baseline = load_baseline()
     baseline = baseline or {}
     rel_paths = paths if paths is not None else discover(root)
+    if jobs is None:
+        jobs = _auto_jobs(len(rel_paths))
+    # Validate rule names HERE, not in the workers: an unknown --rule
+    # must be one ValueError, not N crashed child interpreters.
+    rule_objs = all_rules(rules)
 
     report = Report(baseline_size=len(baseline))
-    for rel in rel_paths:
-        full = rel if os.path.isabs(rel) else os.path.join(root, rel)
-        try:
-            with open(full, encoding="utf-8") as f:
-                source = f.read()
-            ctx = astutil.parse_module(rel, source)
-        except (OSError, SyntaxError, ValueError) as exc:
-            report.parse_errors.append({"path": rel, "error": str(exc)})
-            continue
-        report.files_scanned += 1
-        file_findings: list[Finding] = []
-        for rule in rules:
-            if not force_all_rules and not rule.applies(rel):
-                continue
-            file_findings.extend(rule.check(ctx))
-        # Disambiguate identical (rule, scope, snippet) findings by
-        # source order before baseline matching, so one waiver covers
-        # exactly one site.
-        seen: dict[tuple, int] = {}
-        for finding in sorted(file_findings,
-                              key=lambda f: (f.line, f.col, f.rule)):
-            key = (finding.rule, finding.scope, finding.snippet)
-            finding.occurrence = seen.get(key, 0)
-            seen[key] = finding.occurrence + 1
-            if finding.fingerprint in baseline:
-                finding.waived_by = "baseline"
-            report.findings.append(finding)
+    if jobs > 1:
+        findings = _scan_parallel(root, rel_paths, jobs,
+                                  force_all_rules, rules, report)
+    else:
+        findings = []
+        for rel in rel_paths:
+            ok, file_findings, err = _scan_one(root, rel, rule_objs,
+                                               force_all_rules)
+            if ok:
+                report.files_scanned += 1
+            if err is not None:
+                report.parse_errors.append(err)
+            findings.extend(file_findings)
+    for finding in findings:
+        if finding.fingerprint in baseline:
+            finding.waived_by = "baseline"
+        report.findings.append(finding)
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     report.wall_time_s = time.perf_counter() - t0
     return report

@@ -28,6 +28,12 @@ SCOPES = (
     "minio_tpu/distributed/",
     "minio_tpu/event/",
     "tools/analysis/",
+    # Added since PR6 (ISSUE 13): the whole concurrency plane plus the
+    # span/mesh planes — a swallowed error there is a silently-dead
+    # worker, a leaked admission slot, or an invisible trace loss.
+    "minio_tpu/pipeline/",
+    "minio_tpu/observability/spans.py",
+    "minio_tpu/parallel/mesh_engine.py",
 )
 
 _BROAD = {"Exception", "BaseException"}

@@ -1,0 +1,291 @@
+"""guardedby-lint: declared lock invariants, verified at every site.
+
+The static complement of the MTPU_LOCK_CHECK runtime lockgraph: the
+lockgraph convicts orderings it OBSERVES; this rule proves every read
+and write of a declared shared field happens under its lock, on every
+path, without needing the racy interleaving to occur in a test run.
+
+Declaration grammar (the comment sits on the field's initialization
+line, or on a ``def`` line for a method precondition)::
+
+    self._workers = []          # guarded-by: _mu
+    _slow_store = deque(...)    # guarded-by: _slow_mu     (module var)
+    def _grant_to(self, c):     # guarded-by: _cv          (precondition)
+    self._inflight = 0          # guarded-by: _tokens_cv|_lock
+
+- A **field declaration** binds the attribute (``self.<field>`` in the
+  declaring class) or module-level name to a lock. Every load/store of
+  it outside ``__init__`` must execute with the lock held.
+- A **method precondition** (``# guarded-by:`` on the ``def`` line)
+  asserts callers hold the lock: the method body is checked WITH the
+  lock assumed held, and every call site of the method is checked to
+  actually hold it.
+- ``|`` alternation accepts any one of several names for the same
+  underlying lock (``threading.Condition(self._lock)`` makes
+  ``_tokens_cv`` and ``_lock`` the same mutex).
+
+Lock state is tracked intra-procedurally by the dataflow engine's
+LockState lattice: ``with self._mu:`` / ``with cv:`` (through local
+aliases like ``cv = self._cv``) holds the lock for the block; branch
+joins require the lock held on EVERY path. Nested defs are checked
+with an empty lock state — a closure runs at an unknown time.
+
+Benign racy reads (telemetry snapshots, double-checked fast paths)
+are waived in place with ``# guardedby-ok: <reason>`` — the point is
+that every unlocked access is either a bug or carries its reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil, dataflow
+from .engine import Finding
+
+KEY = "guardedby"
+
+#: Methods exempt from field checks: construction and teardown run
+#: before/after the object is shared.
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__post_init__"}
+
+
+class _Decl:
+    __slots__ = ("locks", "line")
+
+    def __init__(self, spec: str, line: int):
+        self.locks = tuple(spec.split("|"))
+        self.line = line
+
+    def satisfied(self, state: dataflow.LockState) -> bool:
+        return any(state.holds(lk) for lk in self.locks)
+
+    @property
+    def spec(self) -> str:
+        return "|".join(self.locks)
+
+
+class GuardedByLint:
+    name = "guardedby-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return True  # only modules carrying declarations produce work
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        if not ctx.guards:
+            return
+        module_fields, class_fields, method_pre = _collect_decls(ctx)
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            # Nested defs execute through the enclosing walker's
+            # on_nested_def; walking them here too would report each
+            # access twice.
+            if dataflow.is_nested_function(node):
+                continue
+            cls = _enclosing_class(node)
+            fields = dict(module_fields)
+            pre: dict[str, _Decl] = {}
+            if cls is not None and cls.name in class_fields:
+                if node.name not in _EXEMPT_METHODS:
+                    fields.update(class_fields[cls.name])
+                pre = method_pre.get(cls.name, {})
+            elif cls is not None:
+                pre = method_pre.get(cls.name, {})
+            if not fields and not pre:
+                continue
+            walker = _GuardWalk(ctx, fields, pre, cls, findings)
+            seed = dataflow.LockState()
+            own_pre = pre.get(node.name)
+            if own_pre is not None:
+                # The precondition holds at entry, by contract.
+                for lk in own_pre.locks:
+                    seed.hold(lk)
+            walker.walk_function(node, seed)
+        yield from findings
+
+
+def _enclosing_class(fn) -> ast.ClassDef | None:
+    cur = getattr(fn, "_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None  # nested def: not a method
+        cur = getattr(cur, "_parent", None)
+    return None
+
+
+def _collect_decls(ctx):
+    """(module_fields, class_fields, method_preconditions) from the
+    `# guarded-by:` declarations: the declaration line's statement
+    decides what is being declared."""
+    module_fields: dict[str, _Decl] = {}
+    class_fields: dict[str, dict[str, _Decl]] = {}
+    method_pre: dict[str, dict[str, _Decl]] = {}
+    for node in ast.walk(ctx.tree):
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            continue
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Assign, ast.AnnAssign)):
+            continue
+        # The declaration comment may sit on any physical line of the
+        # statement (multi-line initializers put it on the closing
+        # paren); defs match only their header line, not their body.
+        end = lineno if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+            else (node.end_lineno or lineno)
+        spec = None
+        decl_line = lineno
+        for ln in range(lineno, end + 1):
+            if ln in ctx.guards:
+                spec = ctx.guards[ln]
+                decl_line = ln
+                break
+        if spec is None:
+            continue
+        lineno = decl_line
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = _enclosing_class(node)
+            if cls is not None:
+                method_pre.setdefault(cls.name, {})[node.name] = _Decl(
+                    spec, lineno
+                )
+            continue
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                # The declaring class is the one whose method (usually
+                # __init__) performs the annotated assignment.
+                cur = getattr(node, "_parent", None)
+                while cur is not None and not isinstance(cur,
+                                                         ast.ClassDef):
+                    cur = getattr(cur, "_parent", None)
+                if cur is not None:
+                    class_fields.setdefault(cur.name, {})[tgt.attr] = (
+                        _Decl(spec, lineno)
+                    )
+            elif isinstance(tgt, ast.Name):
+                # Module-level declaration only (function locals are
+                # thread-private).
+                parent = getattr(node, "_parent", None)
+                if isinstance(parent, ast.Module):
+                    module_fields[tgt.id] = _Decl(spec, lineno)
+    return module_fields, class_fields, method_pre
+
+
+class _GuardWalk(dataflow.FlowWalker):
+    def __init__(self, ctx, fields: dict, pre: dict, cls, findings):
+        super().__init__(ctx)
+        self.fields = fields
+        self.pre = pre
+        self.cls = cls
+        self.findings = findings
+        self._seen: set[tuple] = set()
+
+    # -- lock tracking -------------------------------------------------------
+
+    def on_with_enter(self, node, state: dataflow.LockState) -> None:
+        for item in node.items:
+            expr = item.context_expr
+            # `with self._mu:` and `with lock.acquire_ctx()`-free shapes;
+            # a Call context (e.g. `with open(...)`) is not a lock hold.
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                state.hold(state.canonical(expr))
+
+    def on_with_exit(self, node, state: dataflow.LockState) -> None:
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                state.unhold(state.canonical(expr))
+
+    def on_assign(self, stmt, state: dataflow.LockState) -> None:
+        if isinstance(stmt, ast.Assign):
+            state.note_alias(stmt)
+
+    # -- access checking -----------------------------------------------------
+
+    def on_stmt(self, stmt, state: dataflow.LockState) -> None:
+        for expr in dataflow.stmt_exprs(stmt):
+            for node in dataflow.walk_no_defs(expr):
+                self._check_node(node, state)
+        # Assignment/augassign targets are accesses too.
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                for node in dataflow.walk_no_defs(tgt):
+                    self._check_node(node, state)
+
+    def on_nested_def(self, node, state) -> None:
+        # A closure executes at an unknown time: check its body with
+        # an EMPTY lock state (anything guarded it touches must be
+        # waived or restructured).
+        walker = _GuardWalk(self.ctx, self.fields, self.pre, self.cls,
+                            self.findings)
+        walker.walk_function(node, dataflow.LockState())
+
+    def _check_node(self, node, state: dataflow.LockState) -> None:
+        decl = None
+        what = ""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in self.fields:
+            decl = self.fields[node.attr]
+            what = f"self.{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in self.fields:
+            decl = self.fields[node.id]
+            what = node.id
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self" \
+                and node.func.attr in self.pre:
+            pdecl = self.pre[node.func.attr]
+            if not pdecl.satisfied(state):
+                self._emit(
+                    node, pdecl,
+                    f"call to self.{node.func.attr}() which requires "
+                    f"lock '{pdecl.spec}' (declared at line "
+                    f"{pdecl.line}) without holding it",
+                )
+            return
+        if decl is None:
+            return
+        # The declaration line itself initializes the field.
+        if node.lineno == decl.line:
+            return
+        if not decl.satisfied(state):
+            self._emit(
+                node, decl,
+                f"access to {what} outside its declared lock "
+                f"'{decl.spec}' (guarded-by at line {decl.line}) — "
+                f"hold the lock, or waive a benign racy read with "
+                f"'# guardedby-ok: <reason>'",
+            )
+
+    def _emit(self, node, decl, message: str) -> None:
+        key = (node.lineno, node.col_offset, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if self.ctx.annotation(KEY, node.lineno) is not None:
+            return
+        self.findings.append(Finding(
+            rule="guardedby-lint", path=self.ctx.relpath,
+            line=node.lineno, col=node.col_offset,
+            scope=self.ctx.scope_of(node), message=message,
+            snippet=self.ctx.line_text(node.lineno),
+        ))
+
+
+RULE = GuardedByLint()

@@ -1,0 +1,134 @@
+"""knob-lint: every MTPU_* environment knob is documented and has a
+declared default.
+
+The deployment surface is env knobs; an undocumented one is a feature
+operators cannot find and a default nobody agreed to. Two checks on
+every ``MTPU_*`` read in ``minio_tpu/``:
+
+- **documented** — the knob name appears in ``docs/DEPLOYMENT.md`` or
+  ``docs/OBSERVABILITY.md`` (or ``docs/ANALYSIS.md`` for the analysis
+  plane's own knobs);
+- **default declared** — the read supplies a default at the call site:
+  ``os.environ.get("MTPU_X", <default>)`` / ``os.getenv("MTPU_X",
+  <default>)``. Bare ``os.environ["MTPU_X"]`` or a get() with no
+  second argument fires — a missing knob must mean the documented
+  default, never a KeyError or a None surprise.
+
+Writes (``os.environ["MTPU_X"] = ...``, ``.pop``, ``.setdefault``)
+are not reads and are ignored. Waive a deliberate site with
+``# knob-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from . import astutil
+from .engine import Finding, repo_root
+
+KEY = "knob"
+
+DOC_FILES = ("docs/DEPLOYMENT.md", "docs/OBSERVABILITY.md",
+             "docs/ANALYSIS.md")
+
+_doc_cache: dict[str, str] = {}
+
+
+def _docs_text() -> str:
+    root = repo_root()
+    out = []
+    for rel in DOC_FILES:
+        path = os.path.join(root, rel)
+        cached = _doc_cache.get(path)
+        if cached is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    cached = f.read()
+            except OSError:
+                cached = ""
+            _doc_cache[path] = cached
+        out.append(cached)
+    return "\n".join(out)
+
+
+class KnobLint:
+    name = "knob-lint"
+
+    def applies(self, relpath: str) -> bool:
+        # The analysis plane reads its own knobs (MTPU_LOCK_CHECK) and
+        # docs/ANALYSIS.md is in DOC_FILES exactly for them: hold
+        # tools/ to the same standard as the package.
+        rel = relpath.replace("\\", "/")
+        return rel.startswith(("minio_tpu/", "tools/"))
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        docs = None  # loaded lazily: most modules read no knobs
+        for node in ast.walk(ctx.tree):
+            knob, has_default = _knob_read(node)
+            if knob is None:
+                continue
+            if ctx.annotation(KEY, node.lineno) is not None:
+                continue
+            if docs is None:
+                docs = _docs_text()
+            # Whole-word match: docs naming MTPU_TRACE_SLOW_MS must not
+            # count as documenting MTPU_TRACE (underscore is a word
+            # char, so \b rejects the prefix-of-longer-knob case).
+            if not re.search(rf"\b{re.escape(knob)}\b", docs):
+                yield self._finding(
+                    ctx, node,
+                    f"env knob {knob} is read here but documented "
+                    f"nowhere — add it (name, default, effect) to "
+                    f"docs/DEPLOYMENT.md or docs/OBSERVABILITY.md",
+                )
+            if not has_default:
+                yield self._finding(
+                    ctx, node,
+                    f"env knob {knob} is read without a default — "
+                    f"use os.environ.get({knob!r}, <default>) so a "
+                    f"missing knob means the documented default",
+                )
+
+    def _finding(self, ctx, node, message: str) -> Finding:
+        return Finding(
+            rule=self.name, path=ctx.relpath, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            scope=ctx.scope_of(node), message=message,
+            snippet=ctx.line_text(node.lineno),
+        )
+
+
+def _knob_read(node) -> tuple[str | None, bool]:
+    """(knob name, default declared) for an env READ node, else
+    (None, ...)."""
+    # os.environ["MTPU_X"] — a Load-context subscript only.
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.ctx, ast.Load) \
+            and astutil.dotted_name(node.value).endswith("environ"):
+        name = _const_knob(node.slice)
+        if name:
+            return name, False
+    if isinstance(node, ast.Call):
+        fname = astutil.call_name(node)
+        dotted = astutil.dotted_name(node.func)
+        is_env_get = (fname == "get" and dotted.endswith("environ.get"))
+        is_getenv = (fname == "getenv"
+                     and dotted in ("os.getenv", "getenv"))
+        if (is_env_get or is_getenv) and node.args:
+            name = _const_knob(node.args[0])
+            if name:
+                return name, len(node.args) > 1 or bool(node.keywords)
+    return None, False
+
+
+def _const_knob(expr) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+            and expr.value.startswith("MTPU_"):
+        return expr.value
+    return None
+
+
+RULE = KnobLint()

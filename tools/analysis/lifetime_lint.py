@@ -1,0 +1,400 @@
+"""lifetime-lint: pooled-buffer lifetime verification (dataflow).
+
+The hazard class PR8's deferred-release handshake exists for: a
+recycled strip/ring segment scribbled by someone still holding a view
+of it. pool-lint proves a checkout has *a* release; this rule proves
+the release is at the right POINT in the flow. Four sub-rules, all
+driven by the shared dataflow engine (def-use chains + alias tracking
+through assignments, views, tuple packing and closures):
+
+- **use-after-release** — any read of a name aliasing a pooled buffer
+  after a statement that (may have) released it back to its pool.
+  The next acquirer owns those bytes now.
+- **double-release** — releasing the same checkout twice corrupts the
+  pool's accounting and freelists one buffer under two owners.
+- **return-past-release** — ``return`` of a view derived from a pooled
+  buffer that an enclosing ``finally`` releases: the finally runs
+  before the caller sees the value, so the caller receives a recycled
+  buffer. (``yield`` is exempt — the generator's finally runs at
+  close, after the consumer drained the view; that is the documented
+  streaming-ring idiom.)
+- **handoff-release** — a buffer view handed to another thread
+  (``executor.submit``, ``threading.Thread``, ``Pipeline``/``Stage``
+  closures — directly as an argument or captured free in a closure)
+  and then released while that thread may still be running. Silent
+  when the handoff was joined first (``.join()`` / ``.result()`` /
+  ``.wait()`` on the handle) or when the release is guarded by an
+  in-flight handshake (the release statement sits under an ``if``
+  whose test reads an ``inflight``-named gate — the PR8
+  deferred-release shape in erasure/bitrot.py).
+
+A checkout is ``<pool>.acquire()`` with a pool-ish receiver (same
+structural test as pool-lint). Releases: ``<pool>.release(x)``,
+``x.release_buffers()``, ``x.close()``. Stores into attributes or
+subscripts escape the intra-procedural frame and end tracking (the
+object graph owns the buffer now; the runtime ``in_use == 0`` sweeps
+cover that side). Waive deliberate sites with
+``# lifetime-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil, dataflow
+from .engine import Finding
+from .pool_lint import _pool_assigned_names
+
+KEY = "lifetime"
+
+_RELEASE_POOL_METHODS = {"release", "drop", "_release"}
+_RELEASE_SELF_METHODS = {"release_buffers", "close"}
+_HANDOFF_CALLS = {"submit", "apply_async"}
+_HANDOFF_CTORS = {"Thread", "Stage", "Pipeline"}
+_JOIN_METHODS = {"join", "result", "wait", "shutdown"}
+
+
+class _Handoff:
+    __slots__ = ("origins", "handle", "line", "joined")
+
+    def __init__(self, origins: frozenset, handle: str | None, line: int):
+        self.origins = origins
+        self.handle = handle
+        self.line = line
+        self.joined = False
+
+
+class _LifetimeState(dataflow.State):
+    __slots__ = ("env", "released", "handoffs")
+
+    def __init__(self):
+        super().__init__()
+        # name -> frozenset of origin keys (acquire-site line numbers)
+        self.env: dict[str, frozenset] = {}
+        # origin -> line of the (may-)release
+        self.released: dict[int, int] = {}
+        self.handoffs: list[_Handoff] = []
+
+    def copy(self) -> "_LifetimeState":
+        s = _LifetimeState()
+        s.env = dict(self.env)
+        s.released = dict(self.released)
+        # Handoff records are shared identity on purpose: a join on
+        # one path marks the same record every fork sees.
+        s.handoffs = list(self.handoffs)
+        s.dead = self.dead
+        return s
+
+    def merge_from(self, other: "_LifetimeState") -> None:
+        for name, origins in other.env.items():
+            self.env[name] = self.env.get(name, frozenset()) | origins
+        for origin, line in other.released.items():
+            self.released.setdefault(origin, line)
+        seen = {id(h) for h in self.handoffs}
+        self.handoffs.extend(h for h in other.handoffs
+                             if id(h) not in seen)
+
+
+class _FnScan(dataflow.FlowWalker):
+    """One function's lifetime interpretation."""
+
+    def __init__(self, ctx: astutil.ModuleContext, pool_names: set[str],
+                 findings: list):
+        super().__init__(ctx)
+        self.pool_names = pool_names
+        self.findings = findings
+        self._seen: set[tuple] = set()  # dedupe across two-pass loops
+
+    # -- helpers ------------------------------------------------------------
+
+    def _is_pool_recv(self, recv: ast.AST) -> bool:
+        name = astutil.dotted_name(recv)
+        leaf = name.rsplit(".", 1)[-1]
+        return ("pool" in leaf.lower() or leaf in self.pool_names)
+
+    def _acquire_origin(self, expr: ast.AST) -> int | None:
+        """Origin key when `expr` is `<pool>.acquire(...)`."""
+        if (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "acquire"
+                and self._is_pool_recv(expr.func.value)):
+            return expr.lineno * 1000 + expr.col_offset
+        return None
+
+    def _emit(self, node, kind: str, message: str) -> None:
+        key = (kind, node.lineno, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if self.ctx.annotation(KEY, node.lineno) is not None:
+            return
+        self.findings.append(Finding(
+            rule="lifetime-lint", path=self.ctx.relpath,
+            line=node.lineno, col=getattr(node, "col_offset", 0),
+            scope=self.ctx.scope_of(node), message=message,
+            snippet=self.ctx.line_text(node.lineno),
+        ))
+
+    def _release_targets(self, call: ast.Call,
+                         state: _LifetimeState) -> frozenset:
+        """Origins a call releases, or an empty set."""
+        if not isinstance(call.func, ast.Attribute):
+            return frozenset()
+        attr = call.func.attr
+        if attr in _RELEASE_POOL_METHODS and call.args \
+                and self._is_pool_recv(call.func.value):
+            return dataflow.origins_of(call.args[0], state.env)
+        if attr in _RELEASE_SELF_METHODS and not call.args:
+            return dataflow.origins_of(call.func.value, state.env)
+        return frozenset()
+
+    @staticmethod
+    def _inflight_guarded(ctx, node) -> bool:
+        """True when `node` sits under an ``if`` whose test reads an
+        inflight-style gate — the deferred-release handshake shape
+        (``if self._inflight == 0: self._release_now()``)."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, ast.If):
+                for name in dataflow.names_in(anc.test):
+                    if "inflight" in name.lower() \
+                            or "in_flight" in name.lower():
+                        return True
+                for sub in ast.walk(anc.test):
+                    if isinstance(sub, ast.Attribute) and (
+                            "inflight" in sub.attr.lower()
+                            or "in_flight" in sub.attr.lower()):
+                        return True
+        return False
+
+    # -- transfer hooks ------------------------------------------------------
+
+    def on_stmt(self, stmt, state: _LifetimeState) -> None:
+        # Expression-position work: uses, releases, handoffs, joins.
+        for expr in dataflow.stmt_exprs(stmt):
+            self._scan_expr(expr, stmt, state)
+        # Loop targets bind views of the iterated collection.
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            origins = dataflow.origins_of(stmt.iter, state.env)
+            for name_node in dataflow.assigned_names(stmt.target):
+                if origins:
+                    state.env[name_node.id] = origins
+                else:
+                    state.env.pop(name_node.id, None)
+
+    def _scan_expr(self, expr, stmt, state: _LifetimeState) -> None:
+        # Uses are checked against the state BEFORE this statement's
+        # releases apply — `pool.release(buf)` must not flag its own
+        # argument — so the walk is staged: collect releases, check
+        # uses (excluding names inside release calls), then apply
+        # joins/handoffs/releases.
+        nodes = list(dataflow.walk_no_defs(expr))
+        releases: list[tuple[ast.Call, frozenset]] = []
+        release_calls: set[int] = set()
+        release_names: set[int] = set()
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                released = self._release_targets(node, state)
+                if released:
+                    releases.append((node, released))
+                    release_calls.add(id(node))
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name):
+                            release_names.add(id(sub))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                if id(node) not in release_calls:
+                    self._handle_join(node, state)
+                    self._handle_handoff(node, stmt, state)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and id(node) not in release_names:
+                self._check_use(node, state)
+        for call, released in releases:
+            self._handle_release(call, released, state)
+
+    def _check_use(self, node: ast.Name, state: _LifetimeState) -> None:
+        origins = state.env.get(node.id)
+        if not origins:
+            return
+        for origin in origins:
+            line = state.released.get(origin)
+            if line is not None:
+                self._emit(
+                    node, "uar",
+                    f"'{node.id}' is used after the pooled buffer it "
+                    f"aliases was released (release at line {line}) — "
+                    f"the pool may have recycled it to another stream; "
+                    f"restructure or waive with '# lifetime-ok: "
+                    f"<reason>'",
+                )
+                return
+
+    def _handle_release(self, call: ast.Call, released: frozenset,
+                        state: _LifetimeState) -> None:
+        for origin in released:
+            prior = state.released.get(origin)
+            if prior is not None and prior != call.lineno:
+                self._emit(
+                    call, "double",
+                    f"double release of a pooled buffer (first "
+                    f"released at line {prior}) — the freelist would "
+                    f"hold one buffer under two owners",
+                )
+            # Live thread handoffs of this origin: release-before-join.
+            for h in state.handoffs:
+                if origin in h.origins and not h.joined \
+                        and not self._inflight_guarded(self.ctx, call):
+                    self._emit(
+                        call, "handoff",
+                        f"pooled buffer released while a view handed "
+                        f"to a thread at line {h.line} may still be "
+                        f"live — a parked thread can scribble the "
+                        f"recycled segment; join the handoff first or "
+                        f"gate the release on an in-flight handshake",
+                    )
+                    break
+            state.released[origin] = call.lineno
+
+    def _handle_join(self, call: ast.Call, state: _LifetimeState) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        if call.func.attr not in _JOIN_METHODS:
+            return
+        handle = astutil.dotted_name(call.func.value)
+        if not handle:
+            return
+        for h in state.handoffs:
+            if h.handle == handle:
+                h.joined = True
+
+    def _handle_handoff(self, call: ast.Call, stmt,
+                        state: _LifetimeState) -> None:
+        name = astutil.call_name(call)
+        is_submit = (isinstance(call.func, ast.Attribute)
+                     and name in _HANDOFF_CALLS)
+        is_ctor = (isinstance(call.func, (ast.Name, ast.Attribute))
+                   and name in _HANDOFF_CTORS)
+        if not (is_submit or is_ctor):
+            return
+        origins: set = set()
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            origins.update(dataflow.origins_of(arg, state.env))
+            # Closures: a lambda/def passed (or referenced by name)
+            # captures views by free variable.
+            fns = []
+            if isinstance(arg, ast.Lambda):
+                fns.append(arg)
+            elif isinstance(arg, ast.Name):
+                fn = self._local_defs.get(arg.id)
+                if fn is not None:
+                    fns.append(fn)
+            for fn in fns:
+                for free in dataflow.free_names_of_def(fn):
+                    origins.update(state.env.get(free, ()))
+        if not origins:
+            return
+        handle = None
+        if isinstance(stmt, ast.Assign):
+            names = dataflow.assigned_names(stmt.targets[0])
+            if len(names) == 1:
+                handle = names[0].id
+        state.handoffs.append(
+            _Handoff(frozenset(origins), handle, call.lineno)
+        )
+
+    def on_assign(self, stmt, state: _LifetimeState) -> None:
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value = stmt.value
+            targets = [stmt.target]
+        else:
+            return  # AugAssign never rebinds to a fresh buffer
+        # A subscript/attribute store INTO a tracked name mutates the
+        # container: our knowledge of what it holds is stale, so its
+        # aliasing ends (`item[0] = None` after a release is exactly
+        # the nil-the-entry ownership protocol the executors use).
+        for tgt in targets:
+            if isinstance(tgt, (ast.Subscript, ast.Attribute)) \
+                    and isinstance(tgt.value, ast.Name):
+                state.env.pop(tgt.value.id, None)
+        origin = self._acquire_origin(value)
+        if origin is not None:
+            origins: frozenset = frozenset((origin,))
+            # A fresh checkout from this site starts a NEW lifetime:
+            # the previous iteration's release belongs to the previous
+            # buffer.
+            state.released.pop(origin, None)
+        else:
+            origins = dataflow.origins_of(value, state.env)
+        for name_node in dataflow.assigned_names(
+                targets[0] if len(targets) == 1 else ast.Tuple(
+                    elts=list(targets), ctx=ast.Store())):
+            if origin is not None or origins:
+                state.env[name_node.id] = origins
+            else:
+                state.env.pop(name_node.id, None)
+
+    def on_return(self, stmt: ast.Return, state: _LifetimeState) -> None:
+        if stmt.value is None:
+            return
+        origins = dataflow.origins_of(stmt.value, state.env)
+        if not origins:
+            return
+        # Releases pending in enclosing finally blocks run AFTER the
+        # return value is computed but BEFORE the caller receives it.
+        pending: dict[int, int] = {}
+        for finalbody in self.finally_stack:
+            for node in ast.walk(ast.Module(body=list(finalbody),
+                                            type_ignores=[])):
+                if isinstance(node, ast.Call):
+                    for o in self._release_targets(node, state):
+                        pending.setdefault(o, node.lineno)
+        for origin in origins:
+            line = state.released.get(origin, pending.get(origin))
+            if line is not None:
+                self._emit(
+                    stmt, "ret",
+                    f"returning a view of a pooled buffer that is "
+                    f"released before the caller can use it (release "
+                    f"at line {line}) — the caller receives a "
+                    f"recycled buffer",
+                )
+                return
+
+    def on_nested_def(self, node, state) -> None:
+        pass  # closures surface via _handle_handoff's free-name scan
+
+    # populated by the rule before walking
+    _local_defs: dict[str, ast.AST] = {}
+
+
+class LifetimeLint:
+    name = "lifetime-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return True  # origins only arise from pool-ish acquires
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        pool_names = _pool_assigned_names(ctx)
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scan = _FnScan(ctx, pool_names, findings)
+            scan._local_defs = {
+                sub.name: sub for sub in ast.walk(node)
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                and sub is not node
+            }
+            scan.walk_function(node, _LifetimeState())
+        yield from findings
+
+
+RULE = LifetimeLint()

@@ -312,7 +312,7 @@ def enabled() -> bool:
 def enable_from_env() -> bool:
     """Arm iff MTPU_LOCK_CHECK=1 — the production/ops knob documented
     in docs/ANALYSIS.md."""
-    if os.environ.get("MTPU_LOCK_CHECK") == "1":
+    if os.environ.get("MTPU_LOCK_CHECK", "0") == "1":
         enable()
         return True
     return False

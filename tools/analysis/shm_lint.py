@@ -1,0 +1,251 @@
+"""shm-lint: statically prove the zero-payload-over-pipe invariant.
+
+The worker plane's whole performance story (PR7/PR8) rests on one
+fact: shared-memory segments carry the payload, the pipe carries only
+names, offsets and verdict ints. One careless reply tuple —
+``("ok", strip.data[:n].tobytes())`` — silently reintroduces a full
+payload pickle per batch and the copy floor is gone. This rule proves
+the invariant over ``pipeline/workers.py`` by taint dataflow:
+
+- **sources** — the payload regions of shm segments: attribute loads
+  of ``.data`` / ``.parity`` / ``.digests`` / ``.view`` / ``.buf``,
+  the ``recon_src`` / ``recon_out`` / ``recon_digests`` region views,
+  and ``np.frombuffer(...)`` results;
+- **propagation** — through assignments (def-use chains), tuple/list
+  packing, subscripts/attributes of tainted values, method calls ON a
+  tainted receiver (``.tobytes()``, ``.reshape()`` — a copy of
+  payload bytes is still payload bytes on the pipe), and same-module
+  function calls via two summaries computed to fixpoint: does the
+  callee's return taint, and which callee params receive tainted
+  arguments anywhere in the module;
+- **sinks** — anything that serializes onto the pipe: ``pickle.dump``
+  / ``dumps``, ``marshal.dump(s)``, and ``.send(...)`` (the worker
+  channel). A tainted value reaching a sink fires.
+
+Ordinary calls with tainted arguments return CLEAN
+(``hash_strided_digests(data, ...)`` consumes payload, its return is
+a digest count) — that asymmetry is what lets the rule prove the
+real reply tuples clean instead of drowning in false positives.
+Waive a deliberate site with ``# shm-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import astutil, dataflow
+from .engine import Finding
+
+KEY = "shm"
+
+SCOPE = "minio_tpu/pipeline/workers.py"
+
+_PAYLOAD_ATTRS = {"data", "parity", "digests", "view", "buf"}
+_REGION_METHODS = {"recon_src", "recon_out", "recon_digests"}
+_SOURCE_CALLS = {"frombuffer"}
+_SINK_DUMPS = {"dump", "dumps"}
+_SINK_METHODS = {"send"}
+
+
+class ShmLint:
+    name = "shm-lint"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/") == SCOPE
+
+    def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
+        fns = [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # Module-wide fixpoint over (return-taints, param-taints):
+        # bounded by module size; converges in 2-3 passes here.
+        ret_taint: set[str] = set()
+        param_taint: dict[str, set[str]] = {}
+        for _ in range(4):
+            changed = False
+            for fn in fns:
+                scan = _TaintScan(ctx, ret_taint, param_taint)
+                scan.run(fn, report=False)
+                if scan.returns_tainted and fn.name not in ret_taint:
+                    ret_taint.add(fn.name)
+                    changed = True
+                for callee, idx in scan.tainted_call_params:
+                    names = _param_names(fns, callee, idx)
+                    if names - param_taint.get(callee, set()):
+                        param_taint.setdefault(callee, set()).update(names)
+                        changed = True
+            if not changed:
+                break
+        for fn in fns:
+            scan = _TaintScan(ctx, ret_taint, param_taint)
+            scan.run(fn, report=True)
+            yield from scan.findings
+
+
+def _param_names(fns: list, callee: str, idx: int) -> set[str]:
+    for fn in fns:
+        if fn.name == callee:
+            args = fn.args.posonlyargs + fn.args.args
+            if 0 <= idx < len(args):
+                return {args[idx].arg}
+    return set()
+
+
+class _TaintScan:
+    """One function's taint pass. Statements execute in source order —
+    taint only ever grows, so a simple ordered walk (descending into
+    every compound body) reaches the same fixpoint as a full CFG walk
+    for a may-analysis, with loop bodies walked twice for
+    loop-carried taint."""
+
+    def __init__(self, ctx, ret_taint: set[str],
+                 param_taint: dict[str, set[str]]):
+        self.ctx = ctx
+        self.ret_taint = ret_taint
+        self.param_taint = param_taint
+        self.tainted: set[str] = set()
+        self.returns_tainted = False
+        self.tainted_call_params: list[tuple[str, int]] = []
+        self.findings: list[Finding] = []
+        self._report = False
+        self._seen: set[tuple] = set()
+
+    def run(self, fn, report: bool) -> None:
+        self._report = report
+        self.tainted = set(self.param_taint.get(fn.name, ()))
+        body = fn.body
+        self._walk(body)
+        self._walk(body)  # second pass: loop-carried / late-def taint
+
+    # -- expression taint ----------------------------------------------------
+
+    def _is_tainted(self, expr) -> bool:
+        """Structural VALUE taint: does evaluating `expr` yield payload
+        bytes (or a container holding them)? A call with tainted
+        arguments is CLEAN unless it is a known source, a taint-
+        returning module function, or a method on a tainted receiver —
+        `hash_strided_digests(data, ...)` consumes payload, its return
+        does not carry it."""
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _PAYLOAD_ATTRS \
+                    and isinstance(expr.ctx, ast.Load):
+                return True
+            # Attribute OF a tainted object (arr.ctypes) stays tainted;
+            # scalar metadata attrs (strip.name) on a CLEAN receiver
+            # stay clean.
+            return self._is_tainted(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self._is_tainted(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return any(self._is_tainted(v) for v in expr.values
+                       if v is not None)
+        if isinstance(expr, ast.Call):
+            name = astutil.call_name(expr)
+            if name in _SOURCE_CALLS or name in _REGION_METHODS:
+                return True
+            if isinstance(expr.func, ast.Name) \
+                    and name in self.ret_taint:
+                return True
+            if isinstance(expr.func, ast.Attribute) \
+                    and self._is_tainted(expr.func.value):
+                # .tobytes()/.reshape()/[:] of payload stays payload.
+                return True
+            return False
+        if isinstance(expr, ast.BinOp):
+            return self._is_tainted(expr.left) \
+                or self._is_tainted(expr.right)
+        if isinstance(expr, ast.IfExp):
+            return self._is_tainted(expr.body) \
+                or self._is_tainted(expr.orelse)
+        if isinstance(expr, (ast.Starred, ast.Await, ast.NamedExpr)):
+            return self._is_tainted(expr.value)
+        return False
+
+    # -- statement walk ------------------------------------------------------
+
+    def _walk(self, body: list) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            if self._is_tainted(stmt.value):
+                for name in dataflow.assigned_names(
+                        stmt.targets[0] if len(stmt.targets) == 1
+                        else ast.Tuple(elts=list(stmt.targets),
+                                       ctx=ast.Store())):
+                    self.tainted.add(name.id)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if self._is_tainted(stmt.value):
+                for name in dataflow.assigned_names(stmt.target):
+                    self.tainted.add(name.id)
+        elif isinstance(stmt, ast.AugAssign):
+            if self._is_tainted(stmt.value) \
+                    and isinstance(stmt.target, ast.Name):
+                self.tainted.add(stmt.target.id)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None and self._is_tainted(stmt.value):
+                self.returns_tainted = True
+        # Sinks + inter-procedural arg flow, anywhere in the statement.
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+        # Descend into compound statements (loops twice for carried
+        # taint — cheap, and dedupe keeps findings single).
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                self._walk(sub)
+        for h in getattr(stmt, "handlers", []):
+            self._walk(h.body)
+
+    def _check_call(self, call: ast.Call) -> None:
+        name = astutil.call_name(call)
+        dotted = astutil.dotted_name(call.func)
+        is_sink = (
+            (name in _SINK_DUMPS
+             and dotted.split(".", 1)[0] in ("pickle", "marshal"))
+            or (isinstance(call.func, ast.Attribute)
+                and name in _SINK_METHODS)
+        )
+        if is_sink:
+            for arg in list(call.args) + [kw.value for kw in
+                                          call.keywords]:
+                if self._is_tainted(arg):
+                    self._emit(call, name)
+                    break
+        # Tainted args into same-module functions feed the param-taint
+        # summary (resolved by the module fixpoint loop).
+        if isinstance(call.func, ast.Name):
+            for i, arg in enumerate(call.args):
+                if self._is_tainted(arg):
+                    self.tainted_call_params.append((call.func.id, i))
+
+    def _emit(self, call: ast.Call, sink: str) -> None:
+        key = (call.lineno, call.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if not self._report:
+            return
+        if self.ctx.annotation(KEY, call.lineno) is not None:
+            return
+        self.findings.append(Finding(
+            rule="shm-lint", path=self.ctx.relpath, line=call.lineno,
+            col=call.col_offset, scope=self.ctx.scope_of(call),
+            message=(
+                f"a value aliasing shm payload (ShmStrip/ShmRing "
+                f"region) flows into pipe serialization '.{sink}()' — "
+                f"the zero-payload-over-pipe invariant: the pipe "
+                f"carries names, offsets and verdicts only; waive "
+                f"with '# shm-ok: <reason>'"
+            ),
+            snippet=self.ctx.line_text(call.lineno),
+        ))
+
+
+RULE = ShmLint()

@@ -53,6 +53,8 @@ class AdminHandlers:
             ("GET", "info"): "server_info",
             ("GET", "storageinfo"): "storage_info",
             ("GET", "datausage"): "data_usage_info",
+            ("GET", "usage"): "usage_info",
+            ("GET", "ioflow"): "ioflow_report",
             ("GET", "metrics"): "metrics_snapshot",
             ("GET", "get-config-kv"): "get_config_kv",
             ("PUT", "set-config-kv"): "set_config_kv",
@@ -108,6 +110,8 @@ class AdminHandlers:
         "server_info": "admin:ServerInfo",
         "storage_info": "admin:StorageInfo",
         "data_usage_info": "admin:DataUsageInfo",
+        "usage_info": "admin:DataUsageInfo",
+        "ioflow_report": "admin:ServerInfo",
         "metrics_snapshot": "admin:Prometheus",
         "get_config_kv": "admin:ConfigUpdate",
         "set_config_kv": "admin:ConfigUpdate",
@@ -250,6 +254,83 @@ class AdminHandlers:
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
         return self._json(usage)
+
+    def usage_info(self, ctx) -> Response:
+        """GET /minio/admin/v3/usage[?histogram=true] — the scanner's
+        streaming usage snapshot (ISSUE 14): per-bucket counts/sizes,
+        cycle progress/ETA, and (with histogram=true) the per-bucket
+        log2 object-size / version-count distributions. Unlike
+        `datausage` this never walks the namespace — it serves the
+        scanner's O(buckets) accounting."""
+        scanner = getattr(getattr(self, "collector", None), "scanner",
+                          None)
+        if scanner is None:
+            return self._json({"error": "scanner not running"},
+                              status=503)
+        usage = scanner.usage
+        want_hist = ctx.qdict.get("histogram", "") in ("true", "1")
+        buckets = {}
+        for b, bu in usage.buckets_usage.items():
+            entry = {
+                "objectsCount": bu.objects_count,
+                "objectsSize": bu.objects_size,
+                "versionsCount": bu.versions_count,
+            }
+            if want_hist:
+                entry["sizeHistogram"] = {
+                    f"2^{i}": n for i, n in enumerate(bu.size_hist) if n
+                }
+                entry["versionsHistogram"] = {
+                    f"2^{i}": n
+                    for i, n in enumerate(bu.versions_hist) if n
+                }
+            buckets[b] = entry
+        return self._json({
+            "lastUpdateNs": usage.last_update_ns,
+            "objectsTotalCount": usage.objects_total_count,
+            "objectsTotalSize": usage.objects_total_size,
+            "bucketsCount": usage.buckets_count,
+            "bucketsUsage": buckets,
+            "scanner": scanner.progress(),
+        })
+
+    def ioflow_report(self, ctx) -> Response:
+        """GET /minio/admin/v3/ioflow — the byte-flow ledger: nested
+        per-op/per-drive/per-dir byte totals, the derived efficiency
+        series, the hot-bucket sketch, and the heal/MRF scoreboard."""
+        from ..observability import ioflow
+
+        scanner = getattr(getattr(self, "collector", None), "scanner",
+                          None)
+        scanned = getattr(scanner, "objects_scanned_total", 0) \
+            if scanner is not None else 0
+        out = ioflow.report(scan_objects=scanned)
+        mrf = getattr(getattr(self, "collector", None), "mrf", None)
+        # Same traversal the Prometheus collector uses (metrics_v2.
+        # mrf_scoreboard) so the JSON and exposition scoreboards cannot
+        # drift; keys are always present (zeroed without an MRF healer)
+        # so clients can rely on the documented payload shape.
+        from ..observability.metrics_v2 import mrf_scoreboard
+
+        sb = mrf_scoreboard(self.ol)
+        scoreboard: dict = {
+            "pending": sb["pending"],
+            "oldestAgeSeconds": sb["oldest_age_s"],
+            "drainRatePerSecond": 0.0, "healedTotal": 0,
+            "sets": [{
+                "pool": s["pool"], "set": s["set"],
+                "pending": s["pending"],
+                "oldestAgeSeconds": s["oldest_age_s"],
+                "onlineDisks": s["online"], "disks": s["disks"],
+                "healthy": s["healthy"],
+            } for s in sb["sets"]],
+        }
+        if mrf is not None and hasattr(mrf, "drain_rate_per_s"):
+            scoreboard["drainRatePerSecond"] = round(
+                mrf.drain_rate_per_s(), 4)
+            scoreboard["healedTotal"] = getattr(mrf, "healed_total", 0)
+        out["healScoreboard"] = scoreboard
+        return self._json(out)
 
     def metrics_snapshot(self, ctx) -> Response:
         if self.metrics is None:

@@ -419,6 +419,40 @@ _CROSS_DOMAIN_XML = (
 _MAX_REQUEST_BODY = 5 * 1024 ** 4 + 64 * 1024 ** 2
 
 
+# Byte-flow ledger op-classes (ISSUE 14): the routed API name maps to
+# the op-class every disk byte the request moves is attributed to.
+# get may be promoted to get-degraded mid-stream by the shard readers;
+# anything unlisted is "other" (tagging ops, policy reads, ...).
+_OP_CLASSES = {
+    "put_object": "put", "post_policy_object": "put",
+    "get_object": "get", "head_object": "get",
+    "select_object_content": "get", "restore_object": "get",
+    "list_objects_v1": "list", "list_objects_v2": "list",
+    "list_object_versions": "list", "list_buckets": "list",
+    "list_multipart_uploads": "list",
+    "new_multipart_upload": "multipart", "put_object_part": "multipart",
+    "complete_multipart_upload": "multipart",
+    "abort_multipart_upload": "multipart",
+    "list_object_parts": "multipart",
+}
+
+# rest.py validates the wire op header against ioflow.OP_CLASSES and
+# silently reclassifies unknown values as untagged — a class added here
+# without extending the ledger's set would diverge remote ledgers.
+def _check_op_classes():
+    from ..observability.ioflow import OP_CLASSES
+
+    extra = set(_OP_CLASSES.values()) - set(OP_CLASSES)
+    assert not extra, f"op classes missing from ioflow.OP_CLASSES: {extra}"
+
+
+_check_op_classes()
+
+
+def op_class(api_name: str) -> str:
+    return _OP_CLASSES.get(api_name, "other")
+
+
 def _reserved_metadata_check(ctx: RequestContext):
     """Reject client-supplied internal metadata + oversized headers (ref
     cmd/generic-handlers.go ReservedMetadataPrefix filter and the
@@ -986,14 +1020,23 @@ class S3Server:
         # stages, worker shm ops, fan-out quorum waits, disk ops —
         # records under this request's trace, and a slow request's
         # whole span tree lands in the exemplar store.
+        # The byte-flow op tag sets here too (ISSUE 14): every disk
+        # byte the handler moves — through fan-out threads, pipeline
+        # stages, worker shm ops — lands in the ledger under this
+        # request's op-class (and its bucket feeds the hot-bucket
+        # sketch). GETs that hit a missing/corrupt shard are promoted
+        # to get-degraded by the shard readers mid-stream.
+        from ..observability import ioflow as _ioflow
         from ..observability import spans as _spans
         from ..pipeline.admission import client_context
 
         client = auth_result.access_key or "anonymous"
+        opc = op_class(name)
         rt = _spans.request_trace(name, method=ctx.method,
                                   path=ctx.path,
                                   request_id=ctx.request_id)
-        with client_context(client, bucket=ctx.bucket or ""), rt:
+        with client_context(client, bucket=ctx.bucket or ""), \
+                _ioflow.tag(opc, bucket=ctx.bucket or ""), rt:
             resp = handler(ctx)
             if resp.body_stream is not None and not getattr(
                     resp, "unbounded_stream", False):
@@ -1020,7 +1063,11 @@ class S3Server:
                 bucket = ctx.bucket or ""
 
                 def traced_stream(w, _inner=inner):
+                    # Fresh tag holder for the stream phase: the
+                    # decode/verify reads happen HERE, and a degraded
+                    # promotion must reclassify this phase's bytes.
                     with client_context(client, bucket=bucket), \
+                            _ioflow.tag(opc, bucket=bucket), \
                             _spans.resume(rt):
                         _inner(w)
 

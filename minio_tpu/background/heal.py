@@ -8,8 +8,13 @@ IO gating, rate limits — cmd/admin-heal-ops.go) live in healseq.py.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 
 from ..utils.errors import ErrObjectNotFound, ErrVersionNotFound
+
+# Drain-rate window: (monotonic_ts, healed) samples per drain pass.
+_RATE_WINDOW_S = 300.0
 
 
 class MRFHealer:
@@ -23,12 +28,45 @@ class MRFHealer:
         self.logger = logger
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.healed_total = 0  # guarded-by: _rate_mu
+        # Scoreboard: drain samples over the last _RATE_WINDOW_S feed
+        # the mrf_drain_rate gauge (entries healed per second).
+        self._drained: deque = deque()  # guarded-by: _rate_mu
+        self._rate_mu = threading.Lock()
+        self._interval_s = 5.0  # rate-span floor; start() overwrites
+
+    def drain_rate_per_s(self) -> float:
+        now = time.monotonic()
+        with self._rate_mu:
+            while self._drained and now - self._drained[0][0] > _RATE_WINDOW_S:
+                self._drained.popleft()
+            if not self._drained:
+                return 0.0
+            # Span floored at the drain interval: a single fresh sample
+            # scraped milliseconds after the pass must read as "N per
+            # interval", not N divided by the scrape latency (a 100x
+            # spike that fires rate alerts).
+            span = max(self._interval_s, now - self._drained[0][0])
+            total = sum(n for _, n in self._drained)
+            return total / span
+
+    def _note_drained(self, healed: int) -> None:
+        # drain_once() runs from BOTH the healer loop and the disk
+        # monitor's reconnect hook (background/monitor.py), so the
+        # total shares the rate window's lock.
+        with self._rate_mu:
+            self.healed_total += healed
+            self._drained.append((time.monotonic(), healed))
+            while self._drained and (self._drained[-1][0]
+                                     - self._drained[0][0]) > _RATE_WINDOW_S:
+                self._drained.popleft()
 
     def drain_once(self) -> int:
         healed = 0
         for pool in getattr(self.ol, "pools", []):
             for es in pool.sets:
-                for bucket, object_, version_id in es.drain_mrf():
+                for bucket, object_, version_id, t0 in \
+                        es.drain_mrf(with_times=True):
                     try:
                         # remove_dangling: MRF entries include deletes a
                         # straggler disk missed — the leftover copy is
@@ -40,20 +78,32 @@ class MRFHealer:
                         healed += 1
                         if self.metrics is not None:
                             self.metrics.inc("mrf_healed_total")
+                            self.metrics.inc("heal_objects_total",
+                                             trigger="mrf")
                     except (ErrObjectNotFound, ErrVersionNotFound):
                         # Nothing left to heal anywhere reachable (e.g.
                         # a delete that every live disk applied): drop
                         # the entry — requeueing would spin forever.
                         continue
                     except Exception as exc:  # noqa: BLE001 requeue
-                        es.queue_mrf(bucket, object_, version_id)
+                        # Original timestamp preserved: a repeatedly
+                        # failing repair keeps AGING on the scoreboard
+                        # (mrf_oldest_age_seconds) instead of looking
+                        # ~drain-interval fresh forever.
+                        es.queue_mrf(bucket, object_, version_id,
+                                     enqueued_at=t0)
+                        if self.metrics is not None:
+                            self.metrics.inc("heal_failures_total")
                         if self.logger is not None:
                             self.logger.log_once_if(
                                 exc, f"mrf:{bucket}/{object_}"
                             )
+        self._note_drained(healed)
         return healed
 
     def start(self, interval_s: float = 5.0):
+        self._interval_s = max(1e-3, interval_s)
+
         def loop():
             while not self._stop.wait(interval_s):
                 self.drain_once()
@@ -113,14 +163,19 @@ def heal_erasure_set(object_layer, buckets: list[str] | None = None) -> dict:
             result["failed"] += 1
         return item
 
+    from ..observability import ioflow
     from ..utils.fanout import SINGLE_CORE
 
-    if SINGLE_CORE:
-        # Same fanout policy as the erasure drivers: stage threads on a
-        # single core only add dispatch cost over the serial sweep.
-        for item in listing():
-            heal_one(item)
-    else:
-        Pipeline("heal-sweep", [Stage("heal", heal_one)],
-                 queue_depth=64).run(listing())
+    # The sweep's LISTING IO is heal work too (per-object heal re-tags
+    # at the heal_object choke point, which is a no-op here — same op).
+    with ioflow.tag("heal"):
+        if SINGLE_CORE:
+            # Same fanout policy as the erasure drivers: stage threads
+            # on a single core only add dispatch cost over the serial
+            # sweep.
+            for item in listing():
+                heal_one(item)
+        else:
+            Pipeline("heal-sweep", [Stage("heal", heal_one)],
+                     queue_depth=64).run(listing())
     return result

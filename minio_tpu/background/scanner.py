@@ -15,10 +15,23 @@ import time
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
-from ..utils.errors import StorageError
+from ..utils.errors import (ErrObjectNotFound, ErrVersionNotFound,
+                            StorageError)
 
 # 1 in N scanned objects get a deep heal check (ref :52 healObjectSelectProb).
 HEAL_OBJECT_SELECT_PROB = 512
+
+
+# Streaming per-bucket histograms: fixed log2 bins, O(1) memory per
+# bucket regardless of object count (ISSUE 14 namespace analytics).
+SIZE_HIST_BINS = 40    # 2^0 .. 2^39 (512 GiB); bin 0 also holds size 0
+VERSION_HIST_BINS = 16  # up to 2^15 versions per object
+
+
+def _log2_bin(v: int, bins: int) -> int:
+    if v <= 0:
+        return 0
+    return min(v.bit_length() - 1, bins - 1)
 
 
 @dataclass
@@ -26,6 +39,14 @@ class BucketUsage:
     objects_count: int = 0
     objects_size: int = 0
     versions_count: int = 0
+    size_hist: list[int] = field(
+        default_factory=lambda: [0] * SIZE_HIST_BINS)
+    versions_hist: list[int] = field(
+        default_factory=lambda: [0] * VERSION_HIST_BINS)
+
+    def observe(self, size: int, versions: int) -> None:
+        self.size_hist[_log2_bin(size, SIZE_HIST_BINS)] += 1
+        self.versions_hist[_log2_bin(versions, VERSION_HIST_BINS)] += 1
 
 
 @dataclass
@@ -58,7 +79,19 @@ class DataUsageInfo:
             buckets_count=d.get("bucketsCount", 0),
         )
         for b, u in d.get("bucketsUsage", {}).items():
-            out.buckets_usage[b] = BucketUsage(**u)
+            bu = BucketUsage(
+                objects_count=u.get("objects_count", 0),
+                objects_size=u.get("objects_size", 0),
+                versions_count=u.get("versions_count", 0),
+            )
+            # Snapshots written before the histogram fields existed
+            # load with empty (correctly-sized) histograms.
+            for field_name, bins in (("size_hist", SIZE_HIST_BINS),
+                                     ("versions_hist", VERSION_HIST_BINS)):
+                hist = u.get(field_name)
+                if isinstance(hist, list) and len(hist) == bins:
+                    setattr(bu, field_name, list(hist))
+            out.buckets_usage[b] = bu
         return out
 
 
@@ -127,15 +160,29 @@ class DataScanner:
         self._cycle_uploads = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Cycle progress telemetry (ISSUE 14): live gauges while a
+        # cycle runs + a monotonic objects-visited counter feeding
+        # the ledger's scan_bytes_per_object series.
+        self.objects_scanned_total = 0
+        self.cycle_started_ns = 0
+        self._cycle_ended_ns = 0
+        self.cycle_buckets_total = 0
+        self.cycle_buckets_done = 0
+        self.last_cycle_duration_s = 0.0
+        self._cycle_objects_seen = 0
 
     # --- persistence (ref data-usage-cache persisted in .minio.sys) ---
 
     def load_usage(self):
+        # Restoring the snapshot (with its non-zero last_update_ns) is
+        # what keeps a restarted node from serving zero usage gauges:
+        # MetricsCollector._collect_usage publishes from self.usage at
+        # every scrape once last_update_ns is set (ISSUE 14).
         try:
             raw = self.ol.get_object_bytes(self.META_BUCKET, self.USAGE_PATH)
             self.usage = DataUsageInfo.from_dict(json.loads(raw))
         except (StorageError, ValueError):
-            pass
+            return
 
     def save_usage(self):
         import io
@@ -156,6 +203,8 @@ class DataScanner:
     # --- one cycle ---
 
     def scan_cycle(self) -> DataUsageInfo:
+        from ..observability import ioflow
+
         full_pass = (
             self.tracker is None
             or self.cycles_completed % self.FULL_SCAN_CYCLES == 0
@@ -163,7 +212,12 @@ class DataScanner:
         if self.tracker is not None:
             self.tracker.advance()
         try:
-            return self._scan_cycle(full_pass)
+            # Every disk byte the crawl moves (listings, xl.meta reads,
+            # lifecycle tombstones) lands in the ledger as op=scan; a
+            # sampled heal re-tags itself at the heal_object choke
+            # point, so deep-heal IO stays out of the scan column.
+            with ioflow.tag("scan"):
+                return self._scan_cycle(full_pass)
         except BaseException:
             # A failed cycle must not swallow the change marks it
             # consumed, or the next cycle would skip changed buckets.
@@ -178,9 +232,15 @@ class DataScanner:
         # Multipart tree walked at most once per cycle (lazy; see
         # _abort_stale_uploads).
         self._cycle_uploads = None
-        for b in self.ol.list_buckets():
-            if b.name.startswith("."):
-                continue
+        buckets = [b for b in self.ol.list_buckets()
+                   if not b.name.startswith(".")]
+        self.cycle_started_ns = time.monotonic_ns()
+        self._cycle_ended_ns = 0
+        self.cycle_buckets_total = len(buckets)
+        self.cycle_buckets_done = 0
+        cycle_objects = 0
+        self._publish_progress(cycle_objects)
+        for b in buckets:
             # Bloom-gated skip (ref dataUpdateTracker consultation in
             # scanDataFolder): an unchanged bucket reuses its previous
             # usage entry with zero per-object work, except on the
@@ -193,6 +253,7 @@ class DataScanner:
                 usage.objects_total_count += bu_prev.objects_count
                 usage.objects_total_size += bu_prev.objects_size
                 self.buckets_skipped_last_cycle += 1
+                self.cycle_buckets_done += 1
                 if self.metrics is not None:
                     self.metrics.inc("scanner_buckets_skipped_total")
                 continue
@@ -209,15 +270,19 @@ class DataScanner:
                 done = self.sleeper.timer()
                 for oi in res.objects:
                     self._counter += 1
+                    self.objects_scanned_total += 1
+                    cycle_objects += 1
                     expired = self._apply_lifecycle(b.name, oi, rules, now_ns)
                     if expired:
                         continue
                     bu.objects_count += 1
                     bu.objects_size += oi.size
                     bu.versions_count += max(1, oi.num_versions)
+                    bu.observe(oi.size, max(1, oi.num_versions))
                     if self._counter % self.heal_prob == 0:
                         self._heal_one(b.name, oi.name)
                 done()
+                self._publish_progress(cycle_objects)
                 if not res.is_truncated:
                     break
                 marker = res.next_marker
@@ -231,19 +296,72 @@ class DataScanner:
             usage.buckets_usage[b.name] = bu
             usage.objects_total_count += bu.objects_count
             usage.objects_total_size += bu.objects_size
+            self.cycle_buckets_done += 1
+            self._publish_progress(cycle_objects)
         usage.buckets_count = len(usage.buckets_usage)
         usage.last_update_ns = time.time_ns()
         self.usage = usage
+        self._cycle_ended_ns = time.monotonic_ns()
+        self.last_cycle_duration_s = (
+            (self._cycle_ended_ns - self.cycle_started_ns) / 1e9
+        )
         self.save_usage()
         if self.tracker is not None:
             self.tracker.save()
         self.cycles_completed += 1
+        self._publish_progress(cycle_objects)
         if self.metrics is not None:
             self.metrics.inc("scanner_cycles_total")
             self.metrics.set_gauge(
                 "scanner_objects_total", usage.objects_total_count
             )
+            self.metrics.set_gauge("scanner_cycle_duration_seconds",
+                                   round(self.last_cycle_duration_s, 3))
         return usage
+
+    def progress(self) -> dict:
+        """Live cycle progress: fraction of buckets covered, visit
+        rate, and a naive bucket-rate ETA (admin usage endpoint +
+        gauges). All derived, O(1)."""
+        total = self.cycle_buckets_total
+        done = self.cycle_buckets_done
+        frac = (done / total) if total else 0.0
+        # Between cycles the clock FREEZES at the last cycle's end:
+        # elapsed/objectsPerSecond keep describing that cycle instead
+        # of decaying toward zero while the scanner sleeps.
+        if not self.cycle_started_ns:
+            elapsed = 0.0
+        else:
+            end = (self._cycle_ended_ns
+                   if self._cycle_ended_ns >= self.cycle_started_ns
+                   else time.monotonic_ns())
+            elapsed = (end - self.cycle_started_ns) / 1e9
+        ops = (self._cycle_objects_seen / elapsed
+               if elapsed > 0 else 0.0)
+        eta = (elapsed * (total - done) / done) if done and total else 0.0
+        return {
+            "cycle": self.cycles_completed,
+            "bucketsTotal": total,
+            "bucketsDone": done,
+            "progress": round(frac, 4),
+            "objectsPerSecond": round(ops, 2),
+            "etaSeconds": round(eta, 2),
+            "elapsedSeconds": round(elapsed, 2),
+            "objectsScannedTotal": self.objects_scanned_total,
+            "lastCycleDurationSeconds": round(
+                self.last_cycle_duration_s, 3),
+        }
+
+    def _publish_progress(self, cycle_objects: int) -> None:
+        self._cycle_objects_seen = cycle_objects
+        if self.metrics is None:
+            return
+        p = self.progress()
+        self.metrics.set_gauge("scanner_cycle_progress", p["progress"])
+        self.metrics.set_gauge("scanner_objects_per_second",
+                               p["objectsPerSecond"])
+        self.metrics.set_gauge("scanner_cycle_eta_seconds",
+                               p["etaSeconds"])
 
     def _apply_lifecycle(self, bucket: str, oi, rules, now_ns: int) -> bool:
         from .. import tier as tiermod
@@ -412,10 +530,19 @@ class DataScanner:
 
     def _heal_one(self, bucket: str, object_: str):
         try:
-            self.ol.heal_object(bucket, object_)
+            res = self.ol.heal_object(bucket, object_)
+            # Pools return a list when the object exists in >1 pool.
+            results = res if isinstance(res, list) else [res]
             if self.metrics is not None:
                 self.metrics.inc("scanner_heal_checks_total")
+                if any(r.get("healed") for r in results):
+                    self.metrics.inc("heal_objects_total",
+                                     trigger="scanner")
+        except (ErrObjectNotFound, ErrVersionNotFound):
+            pass  # vanished between listing and heal — not a failure
         except Exception as exc:  # noqa: BLE001 - heal is best-effort
+            if self.metrics is not None:
+                self.metrics.inc("heal_failures_total")
             if self.logger is not None:
                 self.logger.log_once_if(exc, f"scan-heal:{bucket}")
 

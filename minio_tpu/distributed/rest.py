@@ -21,7 +21,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import msgpack
 
+from ..observability import ioflow
+
 TOKEN_VALIDITY_S = 15 * 60
+
+# The byte-flow op tag crosses the wire in these headers so the node
+# that OWNS the disk attributes its own syscall-layer bytes to the
+# originating request's op-class — the proxy never counts remote bytes
+# (each byte lands in exactly one node's ledger, correctly classified).
+_IOFLOW_OP_HDR = "X-Mtpu-Ioflow-Op"
+_IOFLOW_BUCKET_HDR = "X-Mtpu-Ioflow-Bucket"
 
 _log = logging.getLogger("minio_tpu.rpc")
 
@@ -190,8 +199,18 @@ class RPCServer:
         args = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
         clen = int(h.headers.get("Content-Length", "0") or "0")
         body = h.rfile.read(clen) if clen else b""
+        # Dispatch under the caller's byte-flow op tag (token already
+        # verified above, and unknown classes are dropped) so local
+        # disk IO this call triggers is attributed, not "untagged".
+        op = h.headers.get(_IOFLOW_OP_HDR, "")
+        if op not in ioflow.OP_CLASSES:
+            op = ""
         try:
-            out = fn(args, body)
+            if op:
+                with ioflow.tag(op, h.headers.get(_IOFLOW_BUCKET_HDR, "")):
+                    out = fn(args, body)
+            else:
+                out = fn(args, body)
         except Exception as exc:  # noqa: BLE001 - typed error to client
             self._reply_error(h, 500, type(exc).__name__, str(exc))
             return
@@ -338,6 +357,11 @@ class RPCClient:
             "Authorization": f"Bearer {make_token(self.secret)}",
             "Content-Length": str(len(body)),
         }
+        tag = ioflow.capture()
+        if tag is not None:
+            headers[_IOFLOW_OP_HDR] = tag.op
+            if tag.bucket:
+                headers[_IOFLOW_BUCKET_HDR] = tag.bucket
         conn = self._get_conn()
         try:
             conn.request("POST", url, body=body, headers=headers)

@@ -41,6 +41,7 @@ from .codec import Erasure
 # a pool keeps Python thread churn bounded).
 _io_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-io")
 
+from ..observability import ioflow as _ioflow
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
 from ..utils.fanout import QuorumFanout, StragglerCompensator
 from ..utils.fanout import is_local_sink as _is_local_sink
@@ -1114,6 +1115,12 @@ class ParallelReader:
                         self.saw_missing = True
                     elif isinstance(exc, ErrFileCorrupt):
                         self.saw_corrupt = True
+                    if self.saw_missing or self.saw_corrupt:
+                        # Byte-flow ledger: the stream is degraded from
+                        # this instant — the shared op-tag holder flips
+                        # to get-degraded, reclassifying every
+                        # remaining byte in every serving thread.
+                        _ioflow.retag_degraded()
                     self.org_readers[buf_idx] = None
                     self.readers[i] = None
                     self.errs[i] = exc
@@ -1169,11 +1176,13 @@ class ParallelReader:
                 if i is not None:
                     run(i)
         else:
+            from ..observability import carry as _obs_carry
             from ..observability import spans as _spans
 
-            # Reader threads carry the caller's trace so their disk-op
-            # and worker-verify spans attribute to this request.
-            bound_worker = _spans.bound(_spans.capture(), worker)
+            # Reader threads carry the caller's trace (disk-op and
+            # worker-verify spans) and byte-flow op tag (shard-read
+            # bytes) so both attribute to this request.
+            bound_worker = _obs_carry(worker)
             with cv:
                 state["active"] = len(first)
             for i in first:
@@ -1715,6 +1724,9 @@ def _write_data_blocks(dst, blocks: list, data_blocks: int,
         write -= len(chunk)
         if write <= 0:
             break
+    # Logical (payload-level) bytes served to the client — the
+    # denominator of the degraded-GET read-amplification series.
+    _ioflow.logical(written)
     return written
 
 

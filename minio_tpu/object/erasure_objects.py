@@ -62,6 +62,8 @@ BLOCK_SIZE_V2 = 1 << 20  # erasure block size, ref cmd/object-api-common.go:39
 
 _obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
 
+from ..observability import carry as _obs_carry
+from ..observability import ioflow as _ioflow
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
 from ..utils.fanout import StragglerCompensator
 from ..utils.fanout import decode_slot as _decode_slot
@@ -93,7 +95,10 @@ def _fanout(fn, n: int, disks: list):
         for i in range(n):
             fn(i)
     else:
-        list(_obj_pool.map(fn, range(n)))
+        # Pool threads carry the caller's request-scoped observability
+        # context (span trace + byte-flow op tag) so metadata reads/
+        # writes attribute to the request.
+        list(_obj_pool.map(_obs_carry(fn), range(n)))
 
 
 def _quorum_fanout(attempt, n: int, disks: list, errs: list, quorum: int,
@@ -172,8 +177,12 @@ class ErasureObjects(MultipartMixin):
         self.set_index = set_index
         self.pool_index = pool_index
         # MRF-style queue of (bucket, object, version_id) needing heal
-        # (ref mrfOpCh, cmd/erasure.go:75).
+        # (ref mrfOpCh, cmd/erasure.go:75). Enqueue times ride in a
+        # parallel list (same lock, same order) feeding the heal
+        # scoreboard's age-of-oldest gauge without changing the entry
+        # shape drain callers and tests consume.
         self._mrf: list[tuple[str, str, str]] = []
+        self._mrf_times: list[float] = []  # guarded-by: _mrf_lock
         self._mrf_lock = threading.Lock()
         # Namespace locks for this set (ref nsMutex, cmd/erasure.go:60).
         from ..utils.nslock import NamespaceLock
@@ -265,14 +274,38 @@ class ErasureObjects(MultipartMixin):
     def _tmp_path(self, tmp_id: str) -> str:
         return f"tmp/{tmp_id}"
 
-    def queue_mrf(self, bucket: str, object_: str, version_id: str = ""):
+    def queue_mrf(self, bucket: str, object_: str, version_id: str = "",
+                  enqueued_at: float | None = None):
+        """enqueued_at: pass the ORIGINAL drain_mrf timestamp when
+        re-queueing a failed heal, so mrf_oldest_age_seconds keeps
+        aging a stuck repair instead of resetting every drain pass."""
         with self._mrf_lock:
             self._mrf.append((bucket, object_, version_id))
+            self._mrf_times.append(
+                time.monotonic() if enqueued_at is None else enqueued_at
+            )
 
-    def drain_mrf(self) -> list[tuple[str, str, str]]:
+    def drain_mrf(self, with_times: bool = False) -> list[tuple]:
         with self._mrf_lock:
             out, self._mrf = self._mrf, []
+            times, self._mrf_times = self._mrf_times, []
+        if with_times:
+            return [(b, o, v, t) for (b, o, v), t in zip(out, times)]
         return out
+
+    def mrf_stats(self) -> dict:
+        """Heal-scoreboard snapshot: backlog depth + age of the oldest
+        queued entry (seconds). min() scan, not index 0: a failed heal
+        re-queues with its ORIGINAL timestamp, which can land after
+        fresher entries — O(backlog) at scoreboard cadence is cheap."""
+        with self._mrf_lock:
+            depth = len(self._mrf)
+            oldest = min(self._mrf_times) if self._mrf_times else None
+        return {
+            "pending": depth,
+            "oldest_age_s": (round(time.monotonic() - oldest, 3)
+                             if oldest is not None else 0.0),
+        }
 
     # ------------------------------------------------------------------
     # bucket ops (ref cmd/erasure-bucket.go)
@@ -288,7 +321,8 @@ class ErasureObjects(MultipartMixin):
             except Exception as exc:  # noqa: BLE001
                 errs[i] = exc
 
-        list(_obj_pool.map(do, range(len(self.disks))))
+        list(_obj_pool.map(_obs_carry(do),
+                           range(len(self.disks))))
         write_quorum = len(self.disks) // 2 + 1
         from ..utils.errors import ErrVolumeExists
 
@@ -308,7 +342,8 @@ class ErasureObjects(MultipartMixin):
             except Exception as exc:  # noqa: BLE001
                 errs[i] = exc
 
-        list(_obj_pool.map(do, range(len(self.disks))))
+        list(_obj_pool.map(_obs_carry(do),
+                           range(len(self.disks))))
         write_quorum = len(self.disks) // 2 + 1
         real_errs = [None if isinstance(e, ErrVolumeNotFound) else e for e in errs]
         err = reduce_write_quorum_errs(real_errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
@@ -334,13 +369,18 @@ class ErasureObjects(MultipartMixin):
                    opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
         if opts.no_lock:
-            return self._put_object(bucket, object_, reader, size, opts)
-        # Serialize concurrent writers of one object so rename_data /
-        # write_metadata cannot interleave across disks into a
-        # mixed-mod-time quorum state (ref NSLock at
-        # cmd/erasure-object.go:741-749).
-        with self._locked_write(bucket, object_):
-            return self._put_object(bucket, object_, reader, size, opts)
+            oi = self._put_object(bucket, object_, reader, size, opts)
+        else:
+            # Serialize concurrent writers of one object so rename_data /
+            # write_metadata cannot interleave across disks into a
+            # mixed-mod-time quorum state (ref NSLock at
+            # cmd/erasure-object.go:741-749).
+            with self._locked_write(bucket, object_):
+                oi = self._put_object(bucket, object_, reader, size, opts)
+        # Source-payload bytes of a COMMITTED put: the denominator of
+        # the write-amplification series (aborted puts never count).
+        _ioflow.logical(oi.size)
+        return oi
 
     def _put_object(self, bucket: str, object_: str, reader, size: int,
                     opts: ObjectOptions) -> ObjectInfo:
@@ -604,7 +644,8 @@ class ErasureObjects(MultipartMixin):
             except Exception:  # noqa: BLE001 - best effort per disk
                 pass
 
-        list(_obj_pool.map(do, range(len(self.disks))))
+        list(_obj_pool.map(_obs_carry(do),
+                           range(len(self.disks))))
         return new_mod_time
 
     # ------------------------------------------------------------------
@@ -672,8 +713,10 @@ class ErasureObjects(MultipartMixin):
                         except Exception:  # noqa: BLE001 - best effort
                             pass
 
-            list(_obj_pool.map(commit_meta, range(len(self.disks))))
-            list(_obj_pool.map(drop_parts, range(len(self.disks))))
+            list(_obj_pool.map(_obs_carry(commit_meta),
+                               range(len(self.disks))))
+            list(_obj_pool.map(_obs_carry(drop_parts),
+                               range(len(self.disks))))
 
     def restore_object(self, bucket: str, object_: str, version_id: str,
                        reader, size: int, updates: dict) -> None:
@@ -821,6 +864,16 @@ class ErasureObjects(MultipartMixin):
                         disk, meta, bucket, object_, fi, part.number,
                         till_offset, erasure.shard_size(),
                     )
+                if any(r is None
+                       for r in readers[:erasure.data_blocks]):
+                    # A DATA shard is already known missing from the
+                    # metadata phase (offline/wiped disk): this GET
+                    # reconstructs from parity from byte zero, and the
+                    # read-time retag (a present reader failing
+                    # mid-stream) would never fire. A missing parity
+                    # shard alone degrades nothing — the data path
+                    # reads around it.
+                    _ioflow.retag_degraded()
                 _, hint = decode_stream(
                     erasure, writer, readers, part_offset, part_length,
                     part.size, telemetry="get",
@@ -978,7 +1031,12 @@ class ErasureObjects(MultipartMixin):
         # Exclusive lock: healing rewrites shards + metadata, so it must
         # not race a foreground put/delete of the same object
         # (ref healObject takes the write NSLock, cmd/erasure-healing.go).
-        with self._locked_write(bucket, object_):
+        # Byte-flow choke point: EVERY heal — admin sequence, MRF drain,
+        # scanner sampling, fresh-disk sweep — passes here, so the tag
+        # is set once and the ledger's heal read/write ratio (bytes read
+        # per byte healed) is complete by construction.
+        with _ioflow.tag("heal", bucket=bucket), \
+                self._locked_write(bucket, object_):
             return self._heal_object(bucket, object_, version_id,
                                      remove_dangling)
 

@@ -188,11 +188,19 @@ class FSObjects:
             "mod_time_ns": time.time_ns(),
             "meta": dict(opts.user_defined or {}),
         }
+        self._write_meta(bucket, object_, meta)
+        return self._info(bucket, object_, meta)
+
+    def _write_meta(self, bucket: str, object_: str, meta: dict) -> None:
+        """Write-temp-then-rename the sidecar meta json: a crash
+        mid-dump must never leave a torn document behind (the scanner's
+        usage snapshot and every listing read these — ISSUE 14)."""
         mp = self._meta_path(bucket, object_)
         os.makedirs(os.path.dirname(mp), exist_ok=True)
-        with open(mp, "w") as f:
+        tmp = mp + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
+        with open(tmp, "w") as f:
             json.dump(meta, f)
-        return self._info(bucket, object_, meta)
+        os.replace(tmp, mp)
 
     def update_object_metadata(self, bucket, object_, version_id, updates,
                                replace_user_meta=False):
@@ -215,10 +223,7 @@ class FSObjects:
         if replace_user_meta:
             new_mod_time = time.time_ns()
             meta["mod_time_ns"] = new_mod_time
-        mp = self._meta_path(bucket, object_)
-        os.makedirs(os.path.dirname(mp), exist_ok=True)
-        with open(mp, "w") as f:
-            json.dump(meta, f)
+        self._write_meta(bucket, object_, meta)
         return new_mod_time
 
     def _load_meta(self, bucket: str, object_: str) -> dict:
@@ -532,10 +537,7 @@ class FSObjects:
             "etag": etag, "size": total, "mod_time_ns": time.time_ns(),
             "meta": up_info.get("meta", {}),
         }
-        mp = self._meta_path(bucket, object_)
-        os.makedirs(os.path.dirname(mp), exist_ok=True)
-        with open(mp, "w") as f:
-            json.dump(meta, f)
+        self._write_meta(bucket, object_, meta)
         shutil.rmtree(d)
         return self._info(bucket, object_, meta)
 

@@ -12,6 +12,7 @@ import hashlib
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 
+from ..observability import carry as obs_carry
 from ..storage.fileinfo import FileInfo
 from ..utils.errors import (
     OBJECT_OP_IGNORED_ERRS,
@@ -176,7 +177,8 @@ def write_unique_file_info(disks: list, bucket: str, prefix: str,
         except Exception as exc:  # noqa: BLE001 - collected for quorum
             errs[i] = exc
 
-    list(_meta_pool.map(do, range(len(disks))))
+    list(_meta_pool.map(obs_carry(do),
+                        range(len(disks))))
     err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, quorum)
     if err is not None:
         raise err

@@ -15,6 +15,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..erasure.bitrot import BitrotAlgorithm, StreamingBitrotWriter
+from ..observability import carry as obs_carry
+from ..observability import ioflow
 from ..erasure.codec import Erasure
 from ..erasure.streaming import encode_stream
 from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
@@ -233,7 +235,7 @@ class MultipartMixin:
             except Exception as exc:  # noqa: BLE001
                 errs[i] = exc
 
-        list(_mp_pool.map(do, range(n)))
+        list(_mp_pool.map(obs_carry(do), range(n)))
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise err
@@ -263,11 +265,17 @@ class MultipartMixin:
         # 1-core host can sustain (measured 20% aggregate loss).
         if _SINGLE_CORE:
             with _encode_slot():
-                return self._put_object_part_inner(
+                pi = self._put_object_part_inner(
                     bucket, object_, upload_id, part_number, reader, size,
                     opts)
-        return self._put_object_part_inner(
-            bucket, object_, upload_id, part_number, reader, size, opts)
+        else:
+            pi = self._put_object_part_inner(
+                bucket, object_, upload_id, part_number, reader, size,
+                opts)
+        # Source-payload bytes of a committed part (op=multipart): the
+        # write-amplification denominator, like put_object's.
+        ioflow.logical(pi.size)
+        return pi
 
     def _put_object_part_inner(self, bucket: str, object_: str,
                                upload_id: str, part_number: int, reader,
@@ -415,7 +423,8 @@ class MultipartMixin:
                 errs[i] = exc
 
         with self._ns_lock.write(f"{SYSTEM_META_BUCKET}/{upload_path}"):
-            list(_mp_pool.map(journal, range(len(self.disks))))
+            list(_mp_pool.map(obs_carry(journal),
+                              range(len(self.disks))))
         err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise err
@@ -447,7 +456,8 @@ class MultipartMixin:
                 pass
 
         with self._ns_lock.write(f"{SYSTEM_META_BUCKET}/{upload_path}"):
-            list(_mp_pool.map(drop, range(len(self.disks))))
+            list(_mp_pool.map(obs_carry(drop),
+                              range(len(self.disks))))
 
     def list_object_parts(self, bucket: str, object_: str, upload_id: str,
                           part_marker: int = 0, max_parts: int = 1000) -> list[PartInfo]:
@@ -506,7 +516,8 @@ class MultipartMixin:
             except Exception:  # noqa: BLE001
                 pass
 
-        list(_mp_pool.map(do, range(len(self.disks))))
+        list(_mp_pool.map(obs_carry(do),
+                           range(len(self.disks))))
 
     def complete_multipart_upload(self, bucket: str, object_: str, upload_id: str,
                                   parts: list[CompletePart],
@@ -703,8 +714,10 @@ class MultipartMixin:
                 # (cursor-only) sources depend on it; sliced/pread
                 # sources don't care.
                 reader = part_reader(off, ln)
-                futures.append(_part_pool.submit(upload_part, num, reader,
-                                                 ln))
+                futures.append(_part_pool.submit(
+                    obs_carry(upload_part),
+                    num, reader, ln,
+                ))
             errs = [f.exception() for f in futures]
             err = next((e for e in errs if e is not None), None)
             if err is not None:

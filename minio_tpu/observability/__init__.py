@@ -6,4 +6,18 @@ from .metrics import Metrics
 from .pubsub import PubSub
 from .trace import Logger, TraceHub
 
-__all__ = ["Logger", "Metrics", "PubSub", "TraceHub"]
+
+def carry(fn):
+    """Bind `fn` to the calling thread's request-scoped observability
+    context — the span trace AND the byte-flow op tag — for handing to
+    another thread (pool submit, Thread target). Contextvars do not
+    cross thread creation; fan-out sites use this ONE helper so adding
+    the next request-scoped plane means extending it here, not
+    re-touching every fan-out (and no site can forget one half,
+    silently mis-attributing spans or bytes)."""
+    from . import ioflow, spans
+
+    return ioflow.bound(ioflow.capture(), spans.bound(spans.capture(), fn))
+
+
+__all__ = ["Logger", "Metrics", "PubSub", "TraceHub", "carry"]

@@ -40,6 +40,36 @@ class Metrics:
             key = _label_key(labels)
             series[key] = series.get(key, 0.0) + value
 
+    def set_counter(self, name: str, value: float, **labels):
+        """Absolute counter mirror: scrape-time collectors publish a
+        subsystem's own monotonic totals (byte-flow ledger, pool
+        stats) without double-counting across scrapes, and the series
+        still renders with TYPE counter so rate() works."""
+        with self._mu:
+            self._counters.setdefault(name, {})[_label_key(labels)] = value
+
+    def replace_counter_series(self, name: str, entries) -> None:
+        """Atomically replace ALL label-sets of an absolute counter
+        (`entries` = iterable of (labels dict, value)). Scrape-time
+        mirrors of bounded sketches (the hot-bucket top-K) use this so
+        evicted series DISAPPEAR from the exposition — Prometheus
+        staleness handles the gap — instead of exporting frozen values
+        forever and growing label cardinality past the sketch's bound."""
+        with self._mu:
+            self._counters[name] = {
+                _label_key(labels): v for labels, v in entries
+            }
+
+    def replace_gauge_series(self, name: str, entries) -> None:
+        """Gauge twin of replace_counter_series: scrape-time mirrors of
+        rebuilt-from-scratch state (per-bucket histograms) drop series
+        whose label-set vanished (bin emptied, bucket deleted) instead
+        of exporting the last value forever."""
+        with self._mu:
+            self._gauges[name] = {
+                _label_key(labels): v for labels, v in entries
+            }
+
     def set_gauge(self, name: str, value: float, **labels):
         with self._mu:
             self._gauges.setdefault(name, {})[_label_key(labels)] = value

@@ -69,17 +69,39 @@ DESCRIPTORS: list[tuple[str, str, str]] = [
      "Detached-straggler successes discarded after the grace window"),
     ("dsync_unlock_failures_total", "counter",
      "dsync unlock RPCs that failed (grant leaks until expiry)"),
-    # --- erasure/heal ---
+    # --- erasure/heal + the heal/MRF scoreboard (ISSUE 14) ---
     ("heal_objects_total", "counter", "Objects healed by trigger"),
     ("heal_failures_total", "counter", "Object heal failures"),
     ("mrf_healed_total", "counter", "MRF queue entries healed"),
     ("mrf_pending", "gauge", "MRF entries awaiting heal"),
+    ("mrf_oldest_age_seconds", "gauge",
+     "Age of the oldest entry in any MRF queue"),
+    ("mrf_drain_rate", "gauge",
+     "MRF entries healed per second (5-minute window)"),
+    ("erasure_set_online_disks", "gauge",
+     "Online disks per erasure set (pool/set labels)"),
+    ("erasure_set_health", "gauge",
+     "1 when the erasure set holds read quorum, 0 when not"),
+    ("erasure_set_mrf_pending", "gauge",
+     "MRF backlog depth per erasure set"),
     # --- scanner / ILM / usage ---
     ("scanner_cycles_total", "counter", "Completed scanner cycles"),
     ("scanner_objects_total", "counter", "Objects visited by the scanner"),
     ("scanner_heal_checks_total", "counter", "Scanner deep heal checks"),
     ("scanner_buckets_skipped_total", "counter",
      "Buckets skipped via the update tracker"),
+    ("scanner_cycle_progress", "gauge",
+     "Fraction of buckets covered by the running scan cycle (0-1)"),
+    ("scanner_objects_per_second", "gauge",
+     "Objects visited per second by the running scan cycle"),
+    ("scanner_cycle_eta_seconds", "gauge",
+     "Naive bucket-rate ETA for the running scan cycle"),
+    ("scanner_cycle_duration_seconds", "gauge",
+     "Wall time of the last completed scan cycle"),
+    ("bucket_objects_size_distribution", "gauge",
+     "Per-bucket object-size histogram (log2 bins, bin label = 2^i)"),
+    ("bucket_objects_version_distribution", "gauge",
+     "Per-bucket versions-per-object histogram (log2 bins)"),
     ("ilm_expired_total", "counter", "Objects expired by lifecycle"),
     ("ilm_transitioned_total", "counter", "Objects tiered by lifecycle"),
     ("ilm_restored_total", "counter", "Objects restored from tiers"),
@@ -131,6 +153,12 @@ from .spans import SPAN_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += SPAN_DESCRIPTORS
 
+# Byte-flow ledger (observability/ioflow.py): per-drive/op-class IO
+# accounting + repair-efficiency series + hot-bucket sketch (jax-free).
+from .ioflow import IOFLOW_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += IOFLOW_DESCRIPTORS
+
 # Per-stage pipeline telemetry (pipeline/metrics.py): the erasure hot
 # paths (put/get/heal/multipart + the device host feed) flush their
 # stage counters through the same registry, so the descriptors join
@@ -154,6 +182,49 @@ from ..pipeline.workers import WORKER_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += ADMISSION_DESCRIPTORS
 DESCRIPTORS += WORKER_DESCRIPTORS
+
+
+def mrf_scoreboard(ol) -> dict:
+    """One traversal of the heal/MRF scoreboard (ISSUE 14), consumed by
+    BOTH the Prometheus collector (_collect_mrf) and the admin
+    /v3/ioflow payload — a single source so the two surfaces cannot
+    drift. Returns {"pending", "oldest_age_s", "sets": [{pool, set,
+    pending, oldest_age_s, online, disks, healthy}]}."""
+    out: dict = {"pending": 0, "oldest_age_s": 0.0, "sets": []}
+    for pool in getattr(ol, "pools", []):
+        for pi, es in enumerate(getattr(pool, "sets", [])):
+            stats_fn = getattr(es, "mrf_stats", None)
+            if stats_fn is not None:
+                st = stats_fn()
+            else:
+                st = {"pending": len(getattr(es, "_mrf", ())),
+                      "oldest_age_s": 0.0}
+            out["pending"] += st["pending"]
+            oldest = st.get("oldest_age_s", 0.0)
+            out["oldest_age_s"] = max(out["oldest_age_s"], oldest)
+            disks = getattr(es, "disks", [])
+            online = 0
+            for d in disks:
+                try:
+                    online += 1 if d is not None and d.is_online() else 0
+                except Exception:  # noqa: BLE001 - counts offline
+                    pass
+            # READ quorum = data blocks (k): a set that cannot serve
+            # GETs must not report healthy, and majority (n//2)
+            # overstates health for low-parity layouts.
+            parity = getattr(es, "default_parity", None)
+            quorum = (len(disks) - parity if parity is not None
+                      else len(disks) // 2) if disks else 0
+            out["sets"].append({
+                "pool": getattr(es, "pool_index", 0),
+                "set": getattr(es, "set_index", pi),
+                "pending": st["pending"],
+                "oldest_age_s": oldest,
+                "online": online,
+                "disks": len(disks),
+                "healthy": bool(disks) and online >= quorum,
+            })
+    return out
 
 
 def describe_all(metrics) -> None:
@@ -186,6 +257,7 @@ class MetricsCollector:
         self._collect_cache(m)
         self._collect_iam(m)
         self._collect_mrf(m)
+        self._collect_ioflow(m)
         self._collect_node(m)
 
     # Remote-disk stats are RPCs; bound how often a scrape pays them so
@@ -245,11 +317,31 @@ class MetricsCollector:
         m.set_gauge("usage_total_bytes", usage.objects_total_size)
         m.set_gauge("usage_object_total", usage.objects_total_count)
         m.set_gauge("usage_bucket_total", len(usage.buckets_usage))
+        # Streaming log2 histograms (ISSUE 14): only occupied bins
+        # export, so series cardinality tracks real data shape — and
+        # whole-series replace drops bins that EMPTIED (or buckets that
+        # were deleted) since the last cycle rather than freezing them.
+        size_series: list = []
+        ver_series: list = []
+        bytes_series: list = []
+        count_series: list = []
         for bucket, bu in usage.buckets_usage.items():
-            m.set_gauge("bucket_usage_total_bytes", bu.objects_size,
-                        bucket=bucket)
-            m.set_gauge("bucket_usage_object_count", bu.objects_count,
-                        bucket=bucket)
+            bytes_series.append(({"bucket": bucket}, bu.objects_size))
+            count_series.append(({"bucket": bucket}, bu.objects_count))
+            for i, n in enumerate(getattr(bu, "size_hist", ())):
+                if n:
+                    size_series.append(
+                        ({"bucket": bucket, "bin": f"2^{i}"}, n))
+            for i, n in enumerate(getattr(bu, "versions_hist", ())):
+                if n:
+                    ver_series.append(
+                        ({"bucket": bucket, "bin": f"2^{i}"}, n))
+        m.replace_gauge_series("bucket_usage_total_bytes", bytes_series)
+        m.replace_gauge_series("bucket_usage_object_count", count_series)
+        m.replace_gauge_series("bucket_objects_size_distribution",
+                               size_series)
+        m.replace_gauge_series("bucket_objects_version_distribution",
+                               ver_series)
 
     def _collect_replication(self, m):
         if self.repl is None:
@@ -276,6 +368,9 @@ class MetricsCollector:
                 m.set_gauge("replication_bandwidth_current_bytes",
                             f["currentBandwidthInBytesPerSecond"],
                             bucket=bucket, target=arn)
+                m.set_counter("replication_bandwidth_bytes_total",
+                              f["totalBytes"],
+                              bucket=bucket, target=arn)
 
     def _collect_cache(self, m):
         cache_layer = self.cache
@@ -300,14 +395,48 @@ class MetricsCollector:
             pass
 
     def _collect_mrf(self, m):
-        """Heal backlog: entries sitting in per-set MRF queues."""
+        """Heal/MRF scoreboard (ISSUE 14): backlog depth, age of the
+        oldest queued entry, drain rate, per-erasure-set health."""
         if self.ol is None:
             return
-        pending = 0
-        for pool in getattr(self.ol, "pools", []):
-            for es in getattr(pool, "sets", []):
-                pending += len(getattr(es, "_mrf", ()))
-        m.set_gauge("mrf_pending", pending)
+        sb = mrf_scoreboard(self.ol)
+        for s in sb["sets"]:
+            labels = {"pool": str(s["pool"]), "set": str(s["set"])}
+            m.set_gauge("erasure_set_online_disks", s["online"], **labels)
+            m.set_gauge("erasure_set_health",
+                        1.0 if s["healthy"] else 0.0, **labels)
+            m.set_gauge("erasure_set_mrf_pending", s["pending"], **labels)
+        m.set_gauge("mrf_pending", sb["pending"])
+        m.set_gauge("mrf_oldest_age_seconds", round(sb["oldest_age_s"], 3))
+        if self.mrf is not None and hasattr(self.mrf, "drain_rate_per_s"):
+            m.set_gauge("mrf_drain_rate",
+                        round(self.mrf.drain_rate_per_s(), 4))
+
+    def _collect_ioflow(self, m):
+        """Byte-flow ledger mirror: absolute per-(drive, op, dir)
+        totals + derived efficiency series + the hot-bucket sketch."""
+        from . import ioflow
+
+        snap = ioflow.snapshot()
+        for (drive, op, dir_), n in snap["bytes"].items():
+            m.set_counter("ioflow_bytes_total", n,
+                          drive=drive, op=op, dir=dir_)
+        for op, n in snap["logical"].items():
+            m.set_counter("ioflow_logical_bytes_total", n, op=op)
+        scanned = getattr(self.scanner, "objects_scanned_total", 0) \
+            if self.scanner is not None else 0
+        eff = ioflow.efficiency(snap, scan_objects=scanned)
+        for name, v in eff.items():
+            if v is not None:
+                m.set_gauge(name, v)
+        # Whole-series replace: a bucket evicted from the top-K sketch
+        # drops out of the exposition instead of freezing at its last
+        # value (keeps label cardinality at the sketch's O(K) bound).
+        m.replace_counter_series(
+            "hot_bucket_bytes_total",
+            [({"bucket": e["bucket"]}, e["bytes"])
+             for e in ioflow.hot_buckets()],
+        )
 
     def _collect_node(self, m):
         m.set_gauge("node_uptime_seconds", time.time() - self.started)

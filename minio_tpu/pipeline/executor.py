@@ -215,21 +215,22 @@ class Pipeline:
             _queue.Queue(maxsize=self.queue_depth)
             for _ in range(len(self.stages) + 1)
         ]
-        # Contextvars do not cross thread creation: carry the caller's
-        # request-trace context into the stage threads so their spans
-        # (and anything the stage functions call — worker dispatches,
-        # fan-outs, disk ops) attribute to the request being served.
-        carrier = _spans.capture()
+        # Carry the caller's request-scoped observability context (span
+        # trace + byte-flow op tag) into the stage threads so anything
+        # the stage functions call — worker dispatches, fan-outs, disk
+        # ops — attributes to the request being served.
+        from ..observability import carry as _bound
+
         threads = [
             threading.Thread(
-                target=_spans.bound(carrier, self._feed),
+                target=_bound(self._feed),
                 args=(source, queues[0]),
                 name=f"mtpu-pipe-{self.name}-src", daemon=True,
             )
         ]
         for i, st in enumerate(self.stages):
             threads.append(threading.Thread(
-                target=_spans.bound(carrier, self._work),
+                target=_bound(self._work),
                 args=(st, queues[i], queues[i + 1]),
                 name=f"mtpu-pipe-{self.name}-{st.name}", daemon=True,
             ))

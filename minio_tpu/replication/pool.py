@@ -124,7 +124,12 @@ class ReplicationPool:
                 self._inflight += 1
             mark_failed = False
             try:
-                self._replicate(task)
+                # Byte-flow ledger: replication reads (and any tiering
+                # writes) attribute to op=replication.
+                from ..observability import ioflow
+
+                with ioflow.tag("replication", bucket=task.bucket):
+                    self._replicate(task)
             except Exception:  # noqa: BLE001 - re-queue below
                 task.attempts += 1
                 with self._cv:

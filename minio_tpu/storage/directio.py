@@ -27,6 +27,8 @@ from __future__ import annotations
 import mmap
 import os
 
+from ..observability import ioflow
+
 ALIGN = 4096  # covers 512e and 4Kn devices (ref pkg/disk directio block)
 _BUF_SIZE = 1 << 20
 
@@ -50,7 +52,8 @@ class DirectFileWriter:
     """Write-once file sink over an O_DIRECT fd with aligned staging."""
 
     def __init__(self, path: str, expected_size: int = -1,
-                 fsync_on_close: bool = False):
+                 fsync_on_close: bool = False, drive: str = ""):
+        self._drive = drive
         # _closed guards __del__ against a partially-built instance
         # (os.open or mmap failing mid-init must not AttributeError in
         # the finalizer or leak the fd).
@@ -94,6 +97,10 @@ class DirectFileWriter:
             pos += n
             if self._fill == _BUF_SIZE:
                 self._flush_aligned(_BUF_SIZE)
+        # The ledger is fed at the commit points — _flush_aligned and
+        # the close() tail write — so a mid-stream EINVAL/ENOSPC raise
+        # (or a close() that fails before committing the staged tail)
+        # never counts bytes that missed the disk.
         return total
 
     def _flush_aligned(self, n_aligned: int):
@@ -119,6 +126,7 @@ class DirectFileWriter:
             self._buf.move(0, n_aligned, rest)
         self._fill = rest
         self._offset += n_aligned
+        ioflow.account(self._drive, "write", n_aligned)
 
     def writev(self, buffers) -> int:
         """Vectored write API parity with the buffered sink. O_DIRECT
@@ -179,6 +187,7 @@ class DirectFileWriter:
                     while written < self._fill:
                         written += os.write(self._fd, mv[written:self._fill])
                 self._offset += self._fill
+                ioflow.account(self._drive, "write", self._fill)
                 self._fill = 0
             # fallocate may have reserved past the true end.
             os.ftruncate(self._fd, self._offset)
@@ -195,8 +204,9 @@ class DirectReader:
     verify/heal scans that must neither pollute the page cache nor
     materialize multi-GiB parts in memory."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, drive: str = ""):
         self._closed = True  # guards __del__ on partial init
+        self._drive = drive
         self._fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
         self.size = os.fstat(self._fd).st_size
         try:
@@ -235,6 +245,7 @@ class DirectReader:
             out += self._buf[self._pos: self._pos + take]
             self._pos += take
             n -= take
+        ioflow.account(self._drive, "read", len(out))
         return bytes(out)
 
     def close(self):

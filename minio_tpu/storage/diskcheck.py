@@ -71,6 +71,12 @@ _PASSTHROUGH = frozenset({
 
 _ID_CHECK_INTERVAL_S = 5.0
 
+# Byte accounting happens ONLY at the syscall layer of the node that
+# owns the disk (storage/local.py, storage/directio.py); the op tag
+# crosses the storage-REST wire in a header (distributed/rest.py), so
+# remote bytes land once, correctly classified, in the owner's ledger
+# — never double-counted at the proxy boundary.
+
 
 @dataclass
 class RobustConfig:
@@ -343,6 +349,7 @@ class MetricsDisk:
             if guarded:
                 self._posthoc_breaker(op, time.perf_counter() - t0)
             return out
+
         call.__name__ = op
         return call
 

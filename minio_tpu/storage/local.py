@@ -24,6 +24,7 @@ import shutil
 import threading
 import time
 
+from ..observability import ioflow
 from ..utils.errors import (
     ErrDiskNotFound,
     ErrFileAccessDenied,
@@ -326,7 +327,9 @@ class LocalStorage(StorageAPI):
                 return
             if XL_META_FILE in names:
                 with open(os.path.join(p, XL_META_FILE), "rb") as f:
-                    yield rel, f.read()
+                    raw = f.read()
+                ioflow.account(self._endpoint, "rmeta", len(raw))
+                yield rel, raw
                 return
             if "xl.json" in names:
                 # Legacy v1 object: surface it to listings/scanner/heal
@@ -361,7 +364,9 @@ class LocalStorage(StorageAPI):
         meta_path = os.path.join(self._file_path(volume, path), XL_META_FILE)
         try:
             with open(meta_path, "rb") as f:
-                return XLMeta.from_bytes(f.read())
+                raw = f.read()
+            ioflow.account(self._endpoint, "rmeta", len(raw))
+            return XLMeta.from_bytes(raw)
         except FileNotFoundError:
             # Legacy object (pre-2020 reference deployments migrated in
             # place): fall back to the v1 xl.json document
@@ -378,6 +383,7 @@ class LocalStorage(StorageAPI):
                 if not os.path.isdir(self._vol_path(volume)):
                     raise ErrVolumeNotFound(volume) from None
                 raise ErrFileNotFound(f"{volume}/{path}") from None
+            ioflow.account(self._endpoint, "rmeta", len(raw))
             return legacy_to_xlmeta(raw, volume, path)
 
     def _write_meta(self, volume: str, path: str, meta: XLMeta):
@@ -393,6 +399,7 @@ class LocalStorage(StorageAPI):
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, os.path.join(obj_dir, XL_META_FILE))
+        ioflow.account(self._endpoint, "wmeta", len(blob))
 
     def _fresh_meta_blob(self, volume: str, path: str,
                          fi: FileInfo) -> bytes | None:
@@ -543,6 +550,7 @@ class LocalStorage(StorageAPI):
             raise ErrFileAccessDenied(f"{volume}/{path}") from None
         if len(buf) != length:
             raise ErrFileCorrupt(f"short read {volume}/{path}")
+        ioflow.account(self._endpoint, "read", len(buf))
         return buf
 
     def append_file(self, volume: str, path: str, buf: bytes) -> None:
@@ -556,6 +564,7 @@ class LocalStorage(StorageAPI):
             if self._fsync:
                 f.flush()
                 os.fsync(f.fileno())
+        ioflow.account(self._endpoint, "write", len(buf))
 
     def create_file(self, volume: str, path: str, size: int, reader) -> None:
         """Stream-write a file of `size` bytes (-1 = unknown), ref
@@ -596,7 +605,8 @@ class LocalStorage(StorageAPI):
                 # a known size preallocates extents (fallocate) so
                 # commit-time ENOSPC becomes open-time.
                 return DirectFileWriter(p, expected_size=size,
-                                        fsync_on_close=self._fsync)
+                                        fsync_on_close=self._fsync,
+                                        drive=self._endpoint)
             except OSError:
                 pass  # per-file fallback (e.g. fs quirk): buffered path
         # Unbuffered: shard writers emit one vectored framed write per
@@ -606,7 +616,7 @@ class LocalStorage(StorageAPI):
         # the ONE buffered-IO behavior that matters: raw write() may
         # return short (e.g. near-ENOSPC), and a dropped count would
         # silently truncate a shard that still counts toward quorum.
-        f = _FullWriter(open(p, "wb", buffering=0))
+        f = _FullWriter(open(p, "wb", buffering=0), drive=self._endpoint)
         if not self._fsync:
             return f
         return _FsyncOnClose(f)
@@ -620,7 +630,7 @@ class LocalStorage(StorageAPI):
         except IsADirectoryError:
             raise ErrFileAccessDenied(f"{volume}/{path}") from None
         f.seek(offset)
-        return _LimitedReader(f, length)
+        return _LimitedReader(f, length, drive=self._endpoint)
 
     def rename_file(self, src_volume: str, src_path: str,
                     dst_volume: str, dst_path: str) -> None:
@@ -707,18 +717,20 @@ class LocalStorage(StorageAPI):
                         # Streaming: constant memory even for GiB parts.
                         from .directio import DirectReader
 
-                        stream = DirectReader(p)
+                        stream = DirectReader(p, drive=self._endpoint)
                         file_size = stream.size
                     else:
-                        stream = open(p, "rb")
                         file_size = os.stat(p).st_size
+                        stream = _LimitedReader(open(p, "rb"), file_size,
+                                                drive=self._endpoint)
                 except FileNotFoundError:
                     raise ErrFileNotFound(
                         f"{volume}/{path} part.{part.number}"
                     ) from None
                 except OSError:
-                    stream = open(p, "rb")
                     file_size = os.stat(p).st_size
+                    stream = _LimitedReader(open(p, "rb"), file_size,
+                                            drive=self._endpoint)
             try:
                 ci = fi.erasure.get_checksum_info(part.number)
                 bitrot_verify(
@@ -750,16 +762,19 @@ class LocalStorage(StorageAPI):
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, p)
+        ioflow.account(self._endpoint, "wmeta", len(data))
 
     def read_all(self, volume: str, path: str) -> bytes:
         self._require_online()
         try:
             with open(self._file_path(volume, path), "rb") as f:
-                return f.read()
+                raw = f.read()
         except FileNotFoundError:
             if not os.path.isdir(self._vol_path(volume)):
                 raise ErrVolumeNotFound(volume) from None
             raise ErrFileNotFound(f"{volume}/{path}") from None
+        ioflow.account(self._endpoint, "rmeta", len(raw))
+        return raw
 
 
 class _FullWriter:
@@ -767,14 +782,18 @@ class _FullWriter:
     the OS raises — write() on an unbuffered FileIO is a single syscall
     and may legitimately return a short count."""
 
-    def __init__(self, f):
+    def __init__(self, f, drive: str = ""):
         self._f = f
+        self._drive = drive
 
     def write(self, b) -> int:
         mv = memoryview(b).cast("B") if not isinstance(b, bytes) else b
         total = len(mv)
         n = self._f.write(mv)
         if n is None or n >= total:
+            # Ledger AFTER the syscalls succeed: a failed write must not
+            # inflate the heal/put efficiency denominators.
+            ioflow.account(self._drive, "write", total)
             return total
         mv = memoryview(mv)
         while n < total:
@@ -782,6 +801,7 @@ class _FullWriter:
             if not wrote:
                 raise OSError(f"write stalled at {n}/{total} bytes")
             n += wrote
+        ioflow.account(self._drive, "write", total)
         return total
 
     def writev(self, buffers) -> int:
@@ -799,6 +819,7 @@ class _FullWriter:
             n = os.writev(fd, pending[:1024])  # IOV_MAX bound
             written += n
             if written >= total:
+                ioflow.account(self._drive, "write", total)
                 return total
             if n == 0:
                 raise OSError(f"writev stalled at {written}/{total} bytes")
@@ -847,9 +868,10 @@ class _FsyncOnClose:
 class _LimitedReader:
     """Read at most `limit` bytes from an underlying file, then EOF."""
 
-    def __init__(self, f, limit: int):
+    def __init__(self, f, limit: int, drive: str = ""):
         self._f = f
         self._left = limit
+        self._drive = drive
 
     def read(self, n: int = -1) -> bytes:
         if self._left <= 0:
@@ -858,6 +880,7 @@ class _LimitedReader:
             n = self._left
         buf = self._f.read(n)
         self._left -= len(buf)
+        ioflow.account(self._drive, "read", len(buf))
         return buf
 
     def readinto(self, b) -> int:
@@ -870,6 +893,7 @@ class _LimitedReader:
             view = view[: self._left]
         n = self._f.readinto(view) or 0
         self._left -= n
+        ioflow.account(self._drive, "read", n)
         return n
 
     def close(self):

@@ -201,14 +201,11 @@ class QuorumFanout:
     def dispatch(self, attempt, pending, inline, quorum,
                  deadline_s, grace_s, *, count_ok, record,
                  on_detach, skip=None, on_stragglers=None):
+        from ..observability import carry as _obs_carry
         from ..observability import spans as _spans
 
         cv = self.cv
         detached = self.detached
-        # Pool workers run attempt(i) on foreign threads: carry the
-        # caller's trace so their disk-op spans attribute to this
-        # request (None carrier -> bound() is the identity).
-        carrier = _spans.capture()
 
         def run(i):
             with cv:
@@ -241,7 +238,10 @@ class QuorumFanout:
                 record(i, err)
                 cv.notify_all()
 
-        bound_run = _spans.bound(carrier, run)
+        # Pool workers run attempt(i) on foreign threads: carry the
+        # caller's trace and byte-flow op tag so their disk-op spans
+        # and ledger bytes attribute to this request.
+        bound_run = _obs_carry(run)
         for i in sorted(pending):
             self.pool.submit(bound_run, i)
         for i in inline:

@@ -1,0 +1,23 @@
+"""metrics-lint dead-series negative fixture: every catalog entry has
+write evidence — a literal write, an f-string write pattern, or the
+name passing through a table-driven mirror loop — none may fire."""
+
+FIXTURE_DESCRIPTORS = [
+    ("zz_direct_write_total", "counter", "Written via a literal inc"),
+    ("zz_dynamic_errors_total", "counter", "Written via an f-string"),
+    ("zz_dynamic_results_total", "counter", "Written via an f-string"),
+    ("zz_mirrored_queued_total", "counter", "Mirrored from a table"),
+]
+
+
+def direct(reg):
+    reg.inc("zz_direct_write_total")
+
+
+def dynamic(reg, key):
+    reg.inc(f"zz_dynamic_{key}_total")
+
+
+def mirrored(reg, stats):
+    for src, series in (("queued", "zz_mirrored_queued_total"),):
+        reg.set_gauge(series, stats.get(src, 0))

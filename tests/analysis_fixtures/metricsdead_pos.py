@@ -1,0 +1,14 @@
+"""metrics-lint dead-series positive fixture: a *DESCRIPTORS catalog
+entry with NO registry write site anywhere — must fire."""
+
+FIXTURE_DESCRIPTORS = [
+    ("zz_dead_series_total", "counter",
+     "Promised by the catalog, produced by nothing"),
+    ("zz_live_series_total", "counter", "This one is written below"),
+    # metrics-ok: reserved for the next release's exporter
+    ("zz_reserved_series_total", "counter", "Waived on purpose"),
+]
+
+
+def writes(reg):
+    reg.inc("zz_live_series_total")

@@ -81,6 +81,9 @@ RULE_CASES = [
     ("jax-lint", "readjax_pos.py", "readjax_neg.py", 1),
     ("except-lint", "except_pos.py", "except_neg.py", 2),
     ("metrics-lint", "metrics_pos.py", "metrics_neg.py", 3),
+    # Dead-series direction (ISSUE 14): catalog entry with no write
+    # site anywhere fires; literal/f-string/table evidence is silent.
+    ("metrics-lint", "metricsdead_pos.py", "metricsdead_neg.py", 1),
     # Dataflow rules (ISSUE 13).
     ("lifetime-lint", "lifetime_pos.py", "lifetime_neg.py", 5),
     ("shm-lint", "shm_pos.py", "shm_neg.py", 4),

@@ -1,31 +1,41 @@
 """metrics-lint: every mtpu_*/span series written at runtime must have
-a descriptor in the metrics_v2 catalog.
+a descriptor in the metrics_v2 catalog — and every catalog descriptor
+must have a write site somewhere in the tree.
 
 The registry (observability/metrics.py) happily creates a series for
 ANY name it is handed — a typo'd `reg.inc("wroker_tasks_total")` ships
 a new undocumented series and silently starves the real one, and a
 series written without a catalog descriptor renders with no HELP text
 and is invisible to the dashboards built off the descriptor list. This
-rule closes the loop statically: each registry write whose series name
-is a string literal (`.inc("...")`, `.observe("...")`,
-`.set_gauge("...")`, `.inc_gauge("...")`, `.time("...")`) must name a
-series that appears in a `*DESCRIPTORS` catalog list somewhere under
-minio_tpu/.
+rule closes the loop statically in BOTH directions:
 
-The catalog is extracted from the SOURCE (AST over every module's
-`*DESCRIPTORS = [...]` assignments), never by importing minio_tpu —
-the lint gate must stay runnable on a tree whose imports are broken,
-which is exactly when you want it most.
+- **write→catalog** — each registry write whose series name is a
+  string literal (`.inc("...")`, `.observe("...")`, `.set_gauge`,
+  `.inc_gauge`, `.set_counter`, `.time`) must name a series that
+  appears in a `*DESCRIPTORS` catalog list somewhere under minio_tpu/.
+- **catalog→write (dead-series)** — each `*DESCRIPTORS` entry must
+  have SOME write evidence in the tree: a literal write call, an
+  f-string write whose pattern matches the name, or the name appearing
+  as a plain string constant outside any descriptor list (the
+  table-driven mirror loops pass series names through tuples). A
+  descriptor nothing writes is a dashboard lying about coverage.
 
-Dynamic names (f-strings, variables) cannot be checked and are
+The catalog and the write-site index are extracted from the SOURCE
+(AST over every module), never by importing minio_tpu — the lint gate
+must stay runnable on a tree whose imports are broken, which is
+exactly when you want it most.
+
+Dynamic names (f-strings, variables) cannot be write-checked and are
 skipped; read-side helpers (`counter_value`, `gauge`) are reads, not
-writes. A deliberate off-catalog write takes `# metrics-ok: <reason>`.
+writes. A deliberate off-catalog write or an intentionally-reserved
+descriptor takes `# metrics-ok: <reason>`.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Iterator
 
 from . import astutil
@@ -34,52 +44,132 @@ from .engine import Finding, repo_root
 KEY = "metrics"
 
 # Registry write methods whose first positional argument is the series
-# name. `time` is observe's context-manager twin.
-_WRITE_METHODS = {"inc", "observe", "set_gauge", "inc_gauge", "time"}
+# name. `time` is observe's context-manager twin; `set_counter` is the
+# scrape-time absolute mirror.
+_WRITE_METHODS = {"inc", "observe", "set_gauge", "inc_gauge",
+                  "set_counter", "replace_counter_series",
+                  "replace_gauge_series", "time"}
 
 # The registry implementation itself manipulates series generically
 # (name is a parameter); it can never name a literal series.
 _EXEMPT = {"minio_tpu/observability/metrics.py"}
 
+# Files outside minio_tpu/ that legitimately write series (drivers).
+_EXTRA_WRITE_FILES = ("bench.py", "__graft_entry__.py")
 
-def _catalog_names(root: str) -> frozenset[str]:
-    """Series names from every `*DESCRIPTORS = [...]` list literal
-    under minio_tpu/ (tuple-of-literals entries; first element is the
-    name). Parsed from source so the catalog survives broken imports."""
+
+def _descriptor_lists(tree: ast.AST) -> list[ast.List]:
+    """Every list literal assigned to a *DESCRIPTORS name."""
+    out = []
+    for node in ast.walk(tree):
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id.endswith("DESCRIPTORS")
+            for t in targets
+        ):
+            continue
+        value = getattr(node, "value", None)
+        if isinstance(value, ast.List):
+            out.append(value)
+    return out
+
+
+def _entries(desc_list: ast.List):
+    """(name, line) for each (name, type, help) tuple literal."""
+    for el in desc_list.elts:
+        if (isinstance(el, ast.Tuple) and el.elts
+                and isinstance(el.elts[0], ast.Constant)
+                and isinstance(el.elts[0].value, str)):
+            yield el.elts[0].value, el.lineno
+
+
+class _Evidence:
+    """Write-site evidence extracted from one module's AST."""
+
+    __slots__ = ("literals", "patterns", "constants")
+
+    def __init__(self):
+        self.literals: set[str] = set()   # literal write first-args
+        self.patterns: list = []          # compiled f-string regexes
+        self.constants: set[str] = set()  # strings outside catalogs
+
+    def update_from(self, tree: ast.AST) -> None:
+        # Neither a catalog entry's own strings nor docstrings/bare
+        # string statements are write evidence — a dead series whose
+        # name is MENTIONED in module prose must still fire.
+        skip_const_ids = set()
+        for dl in _descriptor_lists(tree):
+            for node in ast.walk(dl):
+                if isinstance(node, ast.Constant):
+                    skip_const_ids.add(id(node))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Constant)):
+                skip_const_ids.add(id(node.value))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant):
+                if (isinstance(node.value, str)
+                        and id(node) not in skip_const_ids):
+                    self.constants.add(node.value)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr not in _WRITE_METHODS or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                self.literals.add(first.value)
+            elif isinstance(first, ast.JoinedStr):
+                parts = []
+                for v in first.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append(re.escape(str(v.value)))
+                    else:
+                        parts.append(".*")
+                try:
+                    self.patterns.append(
+                        re.compile("^" + "".join(parts) + "$")
+                    )
+                except re.error:
+                    pass
+
+    def covers(self, name: str) -> bool:
+        if name in self.literals or name in self.constants:
+            return True
+        return any(p.match(name) for p in self.patterns)
+
+
+def _scan_tree() -> tuple[frozenset[str], _Evidence]:
+    """One pass over the source tree: (catalog names, write evidence).
+    Parsed from source so both survive broken imports."""
+    root = repo_root()
     names: set[str] = set()
+    ev = _Evidence()
+    paths = [os.path.join(root, f) for f in _EXTRA_WRITE_FILES]
     base = os.path.join(root, "minio_tpu")
     for dirpath, dirnames, filenames in os.walk(base):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            try:
-                with open(os.path.join(dirpath, fn),
-                          encoding="utf-8") as f:
-                    tree = ast.parse(f.read())
-            except (OSError, SyntaxError, ValueError):
-                continue
-            for node in ast.walk(tree):
-                targets: list = []
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
-                    targets = [node.target]
-                if not any(
-                    isinstance(t, ast.Name)
-                    and t.id.endswith("DESCRIPTORS")
-                    for t in targets
-                ):
-                    continue
-                value = getattr(node, "value", None)
-                if not isinstance(value, ast.List):
-                    continue
-                for el in value.elts:
-                    if (isinstance(el, ast.Tuple) and el.elts
-                            and isinstance(el.elts[0], ast.Constant)
-                            and isinstance(el.elts[0].value, str)):
-                        names.add(el.elts[0].value)
-    return frozenset(names)
+        paths.extend(os.path.join(dirpath, fn) for fn in filenames
+                     if fn.endswith(".py"))
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError, ValueError):
+            continue
+        if path.startswith(base):
+            for dl in _descriptor_lists(tree):
+                for name, _line in _entries(dl):
+                    names.add(name)
+        ev.update_from(tree)
+    return frozenset(names), ev
 
 
 class MetricsLint:
@@ -87,6 +177,7 @@ class MetricsLint:
 
     def __init__(self):
         self._catalog: frozenset[str] | None = None
+        self._evidence: _Evidence | None = None
 
     def applies(self, relpath: str) -> bool:
         rel = relpath.replace("\\", "/")
@@ -94,13 +185,22 @@ class MetricsLint:
             return False
         return rel.startswith("minio_tpu/") or rel == "bench.py"
 
-    def catalog(self) -> frozenset[str]:
+    def _index(self) -> tuple[frozenset[str], _Evidence]:
         if self._catalog is None:
-            self._catalog = _catalog_names(repo_root())
-        return self._catalog
+            self._catalog, self._evidence = _scan_tree()
+        return self._catalog, self._evidence
+
+    def catalog(self) -> frozenset[str]:
+        return self._index()[0]
 
     def check(self, ctx: astutil.ModuleContext) -> Iterator[Finding]:
-        catalog = self.catalog()
+        catalog, tree_ev = self._index()
+        # A module-local *DESCRIPTORS list catalogs its series too (the
+        # real catalog walk only covers minio_tpu/; fixtures and future
+        # out-of-tree tooling self-contain theirs).
+        desc_lists = _descriptor_lists(ctx.tree)
+        local_names = {name for dl in desc_lists
+                       for name, _line in _entries(dl)}
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -115,7 +215,7 @@ class MetricsLint:
                     or not isinstance(first.value, str):
                 continue  # dynamic name: unverifiable statically
             series = first.value
-            if series in catalog:
+            if series in catalog or series in local_names:
                 continue
             if ctx.annotation(KEY, node.lineno) is not None:
                 continue
@@ -133,6 +233,34 @@ class MetricsLint:
                 ),
                 snippet=ctx.line_text(node.lineno),
             )
+        # --- dead-series: this module's catalog entries need a write
+        # site SOMEWHERE (the tree index covers minio_tpu/, bench.py,
+        # __graft_entry__.py; fixture modules self-contain theirs).
+        if not desc_lists:
+            return
+        local_ev = _Evidence()
+        local_ev.update_from(ctx.tree)
+        for dl in desc_lists:
+            for name, line in _entries(dl):
+                if tree_ev.covers(name) or local_ev.covers(name):
+                    continue
+                if ctx.annotation(KEY, line) is not None:
+                    continue
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=line,
+                    col=dl.col_offset,
+                    scope=ctx.scope_of(dl),
+                    message=(
+                        f"descriptor {name!r} has no registry write "
+                        "site anywhere in the tree (dead series — the "
+                        "catalog promises a metric nothing produces): "
+                        "wire a write, prune the entry, or annotate "
+                        "`# metrics-ok: <reason>`"
+                    ),
+                    snippet=ctx.line_text(line),
+                )
 
 
 RULE = MetricsLint()

@@ -1356,6 +1356,48 @@ def bench_device(tpu_ok: bool) -> dict:
     return out
 
 
+def bench_soak(root: str) -> dict:
+    """Seeded mini-soak through the scenario engine (ISSUE 15): the
+    tier-2 gate's shape at bench scale — mixed op classes, drive
+    faults, a worker kill, an admission squeeze — reported with the
+    memcpy-normalized throughput the gate's floor is written against
+    (MTPU_SOAK_FLOOR; docs/SOAK.md). `passed` carries the full
+    invariant verdict: a round where it is false is measuring a broken
+    build, not a slow one."""
+    from minio_tpu.faults.scenarios import (
+        ScenarioSpec,
+        host_memcpy_gbps,
+        run_scenario,
+    )
+
+    spec = ScenarioSpec(
+        seed=1337, clients=4, ops_per_client=8, disks=8, parity=4,
+        payload_sizes=(256 << 10, 1 << 20), fault_drives=2,
+        worker_kills=1, admission_slots=2, lock_check=False,
+    )
+    res = run_scenario(spec, root)
+    # The SAME normalizer the gate's floor is written against
+    # (scenarios.host_memcpy_gbps, best-of-3) — value_per_memcpy here
+    # must be the number an operator retunes MTPU_SOAK_FLOOR from.
+    memcpy = host_memcpy_gbps()
+    art = res.to_dict()
+    return {
+        "passed": res.passed,
+        "clients": spec.clients,
+        "ops_per_client": spec.ops_per_client,
+        "bytes_moved": res.bytes_moved,
+        "wall_s": round(res.wall_s, 3),
+        "soak_gbps": round(res.throughput_gbps, 5),
+        "value_per_memcpy": round(res.throughput_gbps / memcpy, 7),
+        "floor_value_per_memcpy": 2e-5,
+        "host_memcpy_gbps": round(memcpy, 2),
+        "drive_faults_fired": art["drive_faults_fired"],
+        "verify_requeued": art["verify_requeued"],
+        "counts": res.counts,
+        "violations": {k: v for k, v in res.violations.items() if v},
+    }
+
+
 def bench_analysis_gate() -> dict:
     """Wall-time of the tier-1 static-analysis gate (tools/analysis).
     The scan runs on every CI pass, so its cost rides along with the
@@ -1536,6 +1578,14 @@ def main() -> None:
         _cleanup(flow_root)
     except Exception as exc:  # noqa: BLE001 - diagnostics
         result["ioflow"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Scenario soak (ISSUE 15): the tier-2 gate's throughput-floor
+    # numbers, recorded every round.
+    try:
+        soak_root = os.path.join(root, "soak-bench")
+        result["soak"] = bench_soak(soak_root)
+        _cleanup(soak_root)
+    except Exception as exc:  # noqa: BLE001 - diagnostics
+        result["soak"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis gate cost (tools/analysis): tracked so the tier-1
     # scan stays visibly cheap.
     try:

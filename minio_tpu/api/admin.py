@@ -348,11 +348,16 @@ class AdminHandlers:
     # --- fault injection (chaos drills; minio_tpu/faults) ---
 
     def faults_status(self, ctx) -> Response:
+        """Fault-plane state. `?active=true` filters to currently-armed
+        (not yet disarmed) schedules, whose per-spec entries carry
+        `fired` and `remaining` trigger counts — how a soak or operator
+        verifies mid-run that the chaos plane is still live."""
         from .. import faults
 
+        active_only = ctx.qdict.get("active", "") in ("true", "1")
         return self._json({
             "enabled": faults.enabled(),
-            "armed": faults.status(),
+            "armed": faults.status(active_only=active_only),
         })
 
     def faults_arm(self, ctx) -> Response:

@@ -14,6 +14,7 @@ import hmac
 import http.client
 import json
 import logging
+import random
 import threading
 import time
 import urllib.parse
@@ -24,6 +25,39 @@ import msgpack
 from ..observability import ioflow
 
 TOKEN_VALIDITY_S = 15 * 60
+
+# --- transient-failure retry (idempotent methods only) ---------------------
+# A 1s network blip (peer restart, conntrack flush) must not fail an
+# in-flight GET whose shard read would succeed 100ms later. One
+# jittered-backoff retry, only when the CALLER declared the method
+# idempotent (reads/probes; a write retried after an ambiguous failure
+# could apply twice), and only within the call's original deadline.
+RETRY_MIN_BUDGET_S = 0.05
+RETRY_BACKOFF_S = (0.02, 0.15)
+
+RPC_DESCRIPTORS: list[tuple[str, str, str]] = [
+    ("rpc_retries_total", "counter",
+     "Idempotent RPC calls retried after a transient transport failure"),
+]
+
+_metrics = None  # guarded-by: _metrics_mu
+_metrics_mu = threading.Lock()
+# Process totals, importable by tests/bench without a registry.
+RETRIES = {"total": 0}  # guarded-by: _metrics_mu
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    with _metrics_mu:
+        _metrics = registry
+
+
+def _note_retry() -> None:
+    with _metrics_mu:
+        RETRIES["total"] += 1
+        reg = _metrics
+    if reg is not None:
+        reg.inc("rpc_retries_total")
 
 # The byte-flow op tag crosses the wire in these headers so the node
 # that OWNS the disk attributes its own syscall-layer bytes to the
@@ -272,20 +306,23 @@ class RPCClient:
 
     # --- connection pool ---
 
-    def _get_conn(self) -> http.client.HTTPConnection:
-        with self._lock:
-            if self._pool:
-                return self._pool.pop()
+    def _new_conn(self, timeout_s: float) -> http.client.HTTPConnection:
         from ..utils import certs as _certs
 
         ctx = _certs.client_ssl_context()
         if ctx is not None:
             return http.client.HTTPSConnection(
-                self.endpoint_str, timeout=self.timeout, context=ctx
+                self.endpoint_str, timeout=timeout_s, context=ctx
             )
         return http.client.HTTPConnection(
-            self.endpoint_str, timeout=self.timeout
+            self.endpoint_str, timeout=timeout_s
         )
+
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._new_conn(self.timeout)
 
     def _put_conn(self, conn):
         with self._lock:
@@ -348,9 +385,44 @@ class RPCClient:
     # --- calls ---
 
     def call(self, method: str, args: dict | None = None,
-             body: bytes = b"", want_stream: bool = False):
+             body: bytes = b"", want_stream: bool = False,
+             idempotent: bool = False):
         """POST one method. Returns the msgpack result, or
-        (result, raw_rest_of_body) when want_stream."""
+        (result, raw_rest_of_body) when want_stream.
+
+        `idempotent=True` (reads/probes only — never a write, whose
+        ambiguous first attempt may have applied) grants ONE
+        jittered-backoff retry after a transient transport failure
+        (connect reset/refused/timeout), inside the call's ORIGINAL
+        deadline: the retry's connection timeout is the remaining
+        budget, so a caller that asked for `timeout` seconds never
+        waits longer because a blip happened."""
+        deadline = time.monotonic() + self.timeout
+        try:
+            return self._call_once(method, args, body, want_stream)
+        except RPCError as exc:
+            if not idempotent or exc.kind != "Unreachable":
+                raise
+            remaining = deadline - time.monotonic()
+            if remaining <= RETRY_MIN_BUDGET_S:
+                raise  # no budget left: surface the first failure
+            time.sleep(min(random.uniform(*RETRY_BACKOFF_S),
+                           remaining / 4))
+            remaining = deadline - time.monotonic()
+            if remaining <= RETRY_MIN_BUDGET_S:
+                raise
+            _note_retry()
+            out = self._call_once(method, args, body, want_stream,
+                                  timeout_s=remaining)
+            # The retry round-tripped: the peer is back. Re-admit it
+            # immediately instead of waiting out the probe backoff.
+            self._online = True
+            self.last_probe_error = ""
+            return out
+
+    def _call_once(self, method: str, args: dict | None,
+                   body: bytes, want_stream: bool,
+                   timeout_s: float | None = None):
         qs = urllib.parse.urlencode(args or {})
         url = f"{self.prefix}/{method}" + (f"?{qs}" if qs else "")
         headers = {
@@ -362,12 +434,22 @@ class RPCClient:
             headers[_IOFLOW_OP_HDR] = tag.op
             if tag.bucket:
                 headers[_IOFLOW_BUCKET_HDR] = tag.bucket
-        conn = self._get_conn()
+        # A deadline-propagated retry never draws from the pool: pooled
+        # sockets carry the full default timeout, and a dead keep-alive
+        # from before the blip would burn the remaining budget twice.
+        conn = (self._get_conn() if timeout_s is None
+                else self._new_conn(timeout_s))
         try:
             conn.request("POST", url, body=body, headers=headers)
             resp = conn.getresponse()
             raw = resp.read()
-            self._put_conn(conn)
+            if timeout_s is None:
+                self._put_conn(conn)
+            else:
+                # Never pool the retry's short-timeout socket: a later
+                # unrelated call inheriting the truncated budget would
+                # time out spuriously and latch the peer offline.
+                conn.close()
         except (OSError, http.client.HTTPException) as exc:
             conn.close()
             self.mark_offline()

@@ -38,6 +38,19 @@ def _fi_pack(fi: FileInfo) -> dict:
     return fi.to_dict()
 
 
+# Methods safe to retry after a transient transport failure: pure reads
+# and probes. Every mutating method stays out — an "Unreachable" on a
+# write is AMBIGUOUS (the bytes may have landed before the reset), and
+# replaying e.g. rename_data or delete_version could double-apply
+# against a concurrent writer.
+_IDEMPOTENT = frozenset({
+    "ping", "disk_info", "get_disk_id", "list_vols", "stat_vol",
+    "list_dir", "walk_dir", "read_version", "list_versions",
+    "read_file", "read_file_stream", "read_all", "check_parts",
+    "check_file", "verify_file", "stat_info_file",
+})
+
+
 class StorageRESTServer:
     """Expose a set of local disks at /mtpu/storage/v1/<method>?disk=N."""
 
@@ -337,7 +350,8 @@ class RemoteStorage(StorageAPI):
         a = {"disk": self._disk_ep}
         a.update(args or {})
         try:
-            return self._client.call(method, a, body, want_stream)
+            return self._client.call(method, a, body, want_stream,
+                                     idempotent=method in _IDEMPOTENT)
         except RPCError as exc:
             raise _rehydrate(exc) from exc
 

@@ -66,6 +66,9 @@ class FaultSpec:
         self.probability = float(probability)
         self.latency_s = float(latency_s)
         self.error = error
+        # Times this spec actually fired (schedule-lock guarded by the
+        # owning FaultSchedule's _match).
+        self.fired = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultSpec":
@@ -149,6 +152,7 @@ class FaultSchedule:
             for spec in self.specs:
                 if spec.matches(op, n, self._rng):
                     self.fired += 1
+                    spec.fired += 1
                     return spec
             return None
 
@@ -172,13 +176,30 @@ class FaultSchedule:
             raise ErrDiskNotFound(f"injected hang on {op} hit MAX_HANG_S")
         return "bitrot"
 
+    def remaining(self) -> list[int | None]:
+        """Per-spec remaining-trigger counts: how many of a scripted
+        spec's call numbers are still ahead of the shared counter (0 =
+        spent). Probabilistic / unconditional specs have no finite
+        count and report None — active-until-disarmed."""
+        with self._lock:
+            n = self._calls
+        return [
+            (sum(1 for c in s.calls if c > n) if s.calls is not None
+             else None)
+            for s in self.specs
+        ]
+
     def status(self) -> dict:
+        remaining = self.remaining()
         return {
             "seed": self.seed,
             "calls": self._calls,
             "fired": self.fired,
             "active": self.active,
-            "specs": [s.to_dict() for s in self.specs],
+            "specs": [
+                dict(s.to_dict(), fired=s.fired, remaining=remaining[i])
+                for i, s in enumerate(self.specs)
+            ],
         }
 
 
@@ -220,9 +241,15 @@ def disarm(endpoint: str | None = None) -> list[str]:
     return sorted(dropped)
 
 
-def status() -> dict:
+def status(active_only: bool = False) -> dict:
+    """Armed schedules by endpoint. `active_only` filters to schedules
+    still live (not disarmed), each carrying per-spec fired counts and
+    remaining-trigger counts — the mid-run fault-plane verification a
+    soak (or an operator drill) polls."""
     with _REG_LOCK:
-        return {ep: s.status() for ep, s in _REGISTRY.items()}
+        items = list(_REGISTRY.items())
+    return {ep: s.status() for ep, s in items
+            if not active_only or s.active}
 
 
 def _lookup(endpoint: str) -> FaultSchedule | None:
@@ -269,7 +296,7 @@ class FaultWriter:
     def close(self):
         try:
             self._inner.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # except-ok: best-effort close of a possibly-faulted inner handle on an abort path
             pass
 
 
@@ -313,7 +340,7 @@ class FaultStream:
     def close(self):
         try:
             self._inner.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # except-ok: best-effort close of a possibly-faulted inner handle on an abort path
             pass
 
 
@@ -331,7 +358,7 @@ class FaultDisk:
             return self._schedule
         try:
             return _lookup(self._disk.endpoint())
-        except Exception:  # noqa: BLE001 - endpoint() is metadata-only
+        except Exception:  # noqa: BLE001  # except-ok: endpoint() is identity metadata; an unwrappable disk simply has no armable schedule
             return None
 
     def arm(self, schedule: FaultSchedule | dict) -> FaultSchedule:
@@ -400,7 +427,7 @@ class NaughtyWriter:
     def close(self):
         try:
             self._inner.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # except-ok: best-effort close of a possibly-faulted inner handle on an abort path
             pass
 
 

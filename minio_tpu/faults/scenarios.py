@@ -1,0 +1,1499 @@
+"""Composable mixed-workload chaos scenarios with invariant verification
+— the production scenario gate (ROADMAP item 5).
+
+Every subsystem is proven in isolation; nothing before this exercised
+the COMBINATION a deployment sees: concurrent PUT / GET / degraded-GET /
+heal / list / parallel-multipart / lifecycle-expiry / versioned-delete
+clients driving the real S3 handlers while drive faults, process faults
+and network faults fire underneath. Three composable planes:
+
+- **workload** — `scenario_plan()` derives per-client op streams purely
+  from the seed: op kinds, keys, payload sizes and multipart shapes are
+  a deterministic function of (seed, client). Clients execute their
+  stream concurrently over signed HTTP against a real `S3Server`.
+- **faults** — the same plan composes (a) seeded `FaultSchedule` drive
+  faults (latency / error / hang / bitrot) armed on a subset of drives,
+  (b) process faults: encode-worker kill -9 (the pool must fall back
+  byte-identically and respawn) and, via `crash_restart_put`, a whole-
+  server SIGKILL mid-PUT with restart recovery verification, and
+  (c) network faults: a storage-REST peer blackout (the peer's RPC
+  plane stops for a blip and comes back; the rest-layer retry plus
+  probe re-admission must ride it out).
+- **invariants** — a library of named checks run continuously during
+  the soak and strictly at drain: no data loss at quorum, MRF drains
+  dry, every shared buffer/shm pool settles to in_use == 0, zero
+  lock-order cycles (when the lockgraph checker is armed), no orphaned
+  worker processes, admission conservation (grants + rejections ==
+  arrivals), and byte-flow ledger reconciliation (put writes ==
+  (k+m)/k x payload within framing tolerance; heal read/healed within
+  [k/m, k] — the dense-RS bounds of arXiv 1412.3022) that must hold
+  even when ops fail mid-stream.
+
+Determinism contract: same seed => same plan => same composed fault
+sequence (drive schedules + ordered process/network events) and same
+client op streams. Thread interleaving stays the OS's; the REPLAY unit
+is the plan, embedded verbatim in every result artifact (docs/SOAK.md).
+
+`pytest -m soak` is the tier-2 gate built on this engine
+(tests/test_chaos_soak.py); tests/test_scenarios.py holds the tier-1
+determinism/invariant proofs.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+import urllib.parse
+
+MIB = 1 << 20
+
+# Workload op classes (the scenario grammar's vocabulary).
+OP_PUT = "put"
+OP_GET = "get"
+OP_GET_DEGRADED = "get-degraded"
+OP_HEAL = "heal"
+OP_LIST = "list"
+OP_MULTIPART = "multipart"
+OP_LIFECYCLE = "lifecycle"
+OP_VERSIONED = "versioned-delete"
+
+ALL_OPS = (OP_PUT, OP_GET, OP_GET_DEGRADED, OP_HEAL, OP_LIST,
+           OP_MULTIPART, OP_LIFECYCLE, OP_VERSIONED)
+
+DEFAULT_WEIGHTS = {
+    OP_PUT: 4, OP_GET: 3, OP_GET_DEGRADED: 1, OP_HEAL: 1, OP_LIST: 1,
+    OP_MULTIPART: 1, OP_LIFECYCLE: 1, OP_VERSIONED: 1,
+}
+
+# Buckets the harness provisions: plain, versioned, lifecycle-expiry.
+BUCKET = "soak"
+BUCKET_VER = "soak-ver"
+BUCKET_EXP = "soak-exp"
+
+ACCESS, SECRET = "soakadmin", "soakadmin-secret-key"
+
+# Per-op stall bound: deadline + straggler grace + generous compute
+# slack on a loaded CI host (the hung-drive tolerance bound, never the
+# fault duration — injected hangs cap at MAX_HANG_S=120) — same
+# contract as the original chaos soak. The slack absorbs CPU
+# starvation on oversubscribed 1-core CI hosts, which is weather, not
+# a wedge; a real deadlock still blows through it by an order of
+# magnitude.
+STALL_SLACK_S = 20.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class ScenarioSpec:
+    """One scenario's full configuration. Everything that shapes the
+    run is HERE (and therefore in the plan/artifact) — reconstructing
+    the spec from a failure artifact reproduces the scenario."""
+
+    def __init__(self, seed: int | None = None, clients: int | None = None,
+                 ops_per_client: int | None = None, disks: int = 8,
+                 parity: int | None = None,
+                 payload_sizes: tuple = (64 << 10, 256 << 10, MIB),
+                 op_weights: dict | None = None,
+                 fault_drives: int = 2,
+                 worker_kills: int = 1,
+                 peer_blackouts: int = 0,
+                 remote_disks: int = 0,
+                 blip_s: float = 1.0,
+                 admission_slots: int = 0,
+                 lock_check: bool = True,
+                 op_deadline_s: float = 2.0,
+                 straggler_grace_s: float = 0.2):
+        # Env-tunable so operators replay a failing seed without
+        # editing tests (docs/SOAK.md seed-replay workflow).
+        self.seed = seed if seed is not None else _env_int(
+            "MTPU_SOAK_SEED", 1337)
+        self.clients = clients if clients is not None else _env_int(
+            "MTPU_SOAK_CLIENTS", 8)
+        self.ops_per_client = (ops_per_client if ops_per_client is not None
+                               else _env_int("MTPU_SOAK_OPS", 10))
+        self.disks = disks
+        self.parity = parity if parity is not None else disks // 2
+        self.payload_sizes = tuple(payload_sizes)
+        self.op_weights = dict(op_weights or DEFAULT_WEIGHTS)
+        self.fault_drives = min(fault_drives, self.parity)
+        self.worker_kills = worker_kills
+        self.peer_blackouts = peer_blackouts
+        self.remote_disks = remote_disks
+        self.blip_s = blip_s
+        # 0 = leave the env-derived admission config alone; > 0 pins
+        # tight write/read governors so the soak actually queues and
+        # 503s under pressure (rejections are LEGAL outcomes the
+        # conservation invariant accounts for).
+        self.admission_slots = admission_slots
+        self.lock_check = lock_check
+        # Hung-drive tolerance pins for the run: the per-op stall bound
+        # derives from THESE (deadline + grace + compute slack), never
+        # from the fault durations.
+        self.op_deadline_s = op_deadline_s
+        self.straggler_grace_s = straggler_grace_s
+
+    def to_dict(self) -> dict:
+        return {k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in vars(self).items()}
+
+
+# ---------------------------------------------------------------------------
+# plan: pure function of the spec (the determinism unit)
+
+
+def client_stream(spec: ScenarioSpec, client: int) -> list[dict]:
+    """Client `client`'s deterministic op stream. Op kinds/keys/sizes
+    derive only from (seed, client); runtime choices that depend on
+    earlier SUCCESSES (which committed object a GET re-reads) use the
+    stream's own `pick` ordinal against the client's committed list, so
+    two runs with identical outcomes choose identically."""
+    rng = random.Random(spec.seed * 7919 + client)
+    kinds = sorted(spec.op_weights)
+    weights = [spec.op_weights[k] for k in kinds]
+    ops: list[dict] = []
+    for n in range(spec.ops_per_client):
+        kind = rng.choices(kinds, weights=weights)[0]
+        op: dict = {"op": kind, "n": n}
+        if kind in (OP_PUT, OP_MULTIPART, OP_LIFECYCLE, OP_VERSIONED):
+            op["size"] = rng.choice(spec.payload_sizes)
+            op["pseed"] = rng.randrange(1 << 30)
+        if kind == OP_PUT:
+            op["key"] = f"c{client}/o{n:03d}"
+        elif kind == OP_MULTIPART:
+            op["key"] = f"c{client}/mp{n:03d}"
+            op["parts"] = rng.choice((2, 3))
+        elif kind == OP_LIFECYCLE:
+            op["key"] = f"exp/c{client}/e{n:03d}"
+        elif kind == OP_VERSIONED:
+            op["key"] = f"c{client}/v{n:03d}"
+            # overwrite -> marker -> versioned delete of v1 (each step
+            # independently allowed to fail under faults).
+            op["steps"] = rng.choice((
+                ("put", "put", "marker"),
+                ("put", "put", "delete-oldest"),
+                ("put", "marker"),
+            ))
+        elif kind in (OP_GET, OP_GET_DEGRADED, OP_HEAL):
+            op["pick"] = rng.randrange(1 << 16)
+        elif kind == OP_LIST:
+            op["prefix"] = f"c{client}/"
+        ops.append(op)
+    return ops
+
+
+def build_fault_plan(spec: ScenarioSpec, endpoints: list[str]) -> dict:
+    """The composed fault plan, a pure function of (spec, disk
+    endpoints): drive schedules for the first `fault_drives` odd-
+    indexed endpoints plus the ordered process/network event list,
+    keyed by GLOBAL completed-op count. Same seed => same plan."""
+    rng = random.Random(spec.seed ^ 0xFA0175)
+    drive_schedules = []
+    victims = endpoints[1::2][: spec.fault_drives]
+    for i, ep in enumerate(victims):
+        drive_schedules.append((ep, {
+            "seed": spec.seed * 31 + i,
+            "specs": [
+                {"kind": "latency", "probability": 0.12,
+                 "latency_s": 0.02},
+                {"kind": "latency", "probability": 0.04,
+                 "latency_s": 0.25},
+                {"kind": "error", "probability": 0.04,
+                 "error": "ErrDiskNotFound"},
+                {"kind": "bitrot", "probability": 0.01,
+                 "ops": ["stream_read"]},
+            ],
+        }))
+    total_ops = spec.clients * spec.ops_per_client
+    events = []
+    for _ in range(spec.worker_kills):
+        events.append({"at_op": rng.randrange(1, max(2, total_ops // 2)),
+                       "kind": "worker_kill"})
+    for _ in range(spec.peer_blackouts):
+        events.append({"at_op": rng.randrange(1, max(2, total_ops)),
+                       "kind": "peer_blackout", "blip_s": spec.blip_s})
+    events.sort(key=lambda e: (e["at_op"], e["kind"]))
+    return {"drive_schedules": drive_schedules, "events": events}
+
+
+def scenario_plan(spec: ScenarioSpec) -> dict:
+    """The full deterministic plan: spec + per-client op streams +
+    composed fault plan. This is what `same seed => same fault
+    sequence` means; the plan embeds verbatim in every artifact."""
+    endpoints = [f"soak-d{i}" for i in range(spec.disks)]
+    return {
+        "spec": spec.to_dict(),
+        "endpoints": endpoints,
+        "clients": [client_stream(spec, c) for c in range(spec.clients)],
+        "faults": build_fault_plan(spec, endpoints),
+    }
+
+
+# ---------------------------------------------------------------------------
+# harness: the real stack under test
+
+
+class ScenarioHarness:
+    """Boots the stack a scenario drives: LocalStorage (optionally part
+    storage-REST remote) -> FaultDisk -> health-checked MetricsDisk ->
+    ErasureSets/Pools -> signed S3Server, plus scanner and governors
+    pinned for the run. Restores every process-global it touches."""
+
+    def __init__(self, root: str, spec: ScenarioSpec):
+        from ..storage.diskcheck import robust_overrides
+
+        self.root = root
+        self.spec = spec
+        self.srv = None
+        self.storage_server = None
+        self._saved_env = {
+            k: os.environ.get(k)
+            for k in ("MTPU_INLINE_THRESHOLD",)
+        }
+        # Inline shards ride inside xl.meta (metadata bytes), which
+        # would fold payload into the wmeta ledger channel and break
+        # the put-write reconciliation invariant; stage everything.
+        os.environ["MTPU_INLINE_THRESHOLD"] = "0"
+        # Tight hung-drive tolerance for the run (the old chaos soak's
+        # envelope): faults must resolve at the TOLERANCE bound, not
+        # whenever the injected hang feels like ending.
+        self._robust = robust_overrides(
+            op_deadline_s=spec.op_deadline_s,
+            long_op_deadline_s=spec.op_deadline_s,
+            straggler_grace_s=spec.straggler_grace_s,
+            hedge_delay_s=0.05, probe_interval_s=0.1,
+            breaker_threshold=3,
+        )
+        self._robust.__enter__()
+        try:
+            self._boot(spec, root)
+        except BaseException:
+            # A half-booted harness must not leak its process-global
+            # overrides (robust deadlines, inline threshold, a
+            # started server) into the rest of the session.
+            self.close()
+            raise
+
+    def _boot(self, spec: ScenarioSpec, root: str) -> None:
+        from ..api import S3Server
+        from ..background.scanner import DataScanner
+        from ..bucket import BucketMetadataSys
+        from ..iam import IAMSys
+        from ..object.pools import ErasureServerPools
+        from ..object.sets import ErasureSets
+        from ..observability import ioflow
+        from ..observability.metrics import Metrics
+        from ..pipeline import admission
+        from ..storage.diskcheck import DiskHealth, MetricsDisk
+        from ..storage.local import LocalStorage
+        from .injector import FaultDisk
+
+        self.endpoints = [f"soak-d{i}" for i in range(spec.disks)]
+        self.raw_disks = [
+            LocalStorage(os.path.join(root, ep), endpoint=ep)
+            for ep in self.endpoints
+        ]
+        self.storage_server = None
+        self._remote_count = min(spec.remote_disks, spec.parity)
+        inner: list = list(self.raw_disks)
+        if self._remote_count:
+            inner = self._wire_remote(inner)
+        self.fault_disks = [FaultDisk(d) for d in inner]
+        self.disks = [
+            MetricsDisk(fd, health=DiskHealth(ep))
+            for fd, ep in zip(self.fault_disks, self.endpoints)
+        ]
+        self.metrics = Metrics()
+        sets = ErasureSets(
+            self.disks, spec.disks, default_parity=spec.parity,
+            deployment_id="50a45047-5047-5047-5047-504750475047",
+            pool_index=0,
+        )
+        sets.init_format()
+        self.sets = sets
+        self.ol = ErasureServerPools([sets])
+        self.iam = IAMSys(ACCESS, SECRET)
+        self.bm = BucketMetadataSys(self.ol)
+        self.scanner = DataScanner(self.ol, self.bm, metrics=self.metrics)
+        self.srv = S3Server(self.ol, self.iam, self.bm,
+                            metrics=self.metrics).start()
+        # Pin the admission planes when the spec asks for pressure; the
+        # governors are process-global, so always swap in FRESH ones —
+        # the conservation invariant then counts only this scenario.
+        # Queue deadlines stay WELL under the per-op stall bound
+        # (deadline + grace + STALL_SLACK_S): an admission wait that
+        # rides its full deadline plus the op's own execution must
+        # still not read as a stall — queueing is intended behavior,
+        # the stall bound hunts wedges.
+        cfg = None
+        if spec.admission_slots:
+            cfg = admission.AdmissionConfig(
+                slots=spec.admission_slots,
+                per_client_cap=spec.admission_slots,
+                max_queue=4 * spec.admission_slots, deadline_s=5.0,
+            )
+        self.governor = admission.reconfigure(cfg)
+        self.read_governor = admission.reconfigure_read(
+            admission.AdmissionConfig(
+                slots=spec.admission_slots * 2,
+                per_client_cap=spec.admission_slots * 2,
+                max_queue=8 * spec.admission_slots, deadline_s=5.0,
+            ) if spec.admission_slots else None
+        )
+        ioflow.reset()
+        self._provision()
+
+    def _wire_remote(self, disks: list) -> list:
+        """Serve the LAST `remote_disks` drives through a real
+        storage-REST plane (loopback), so peer-blackout events sever a
+        live RPC path, not a mock."""
+        from ..distributed.storage_rest import (
+            RemoteStorage,
+            StorageRESTServer,
+        )
+
+        n = self._remote_count
+        self._remote_raw = disks[-n:]
+        self.storage_server = StorageRESTServer(
+            self._remote_raw, SECRET, "127.0.0.1", 0
+        ).start()
+        self._storage_port = self.storage_server.rpc.port
+        node = f"127.0.0.1:{self._storage_port}"
+        out = list(disks[:-n])
+        for d in self._remote_raw:
+            out.append(RemoteStorage(node, d.endpoint(), SECRET,
+                                     timeout=10.0))
+        return out
+
+    def blackout_peer(self, blip_s: float) -> None:
+        """Stop the storage-REST plane, wait the blip, bring it back on
+        the SAME port (re-admission is the clients' probe + the rest
+        retry's job)."""
+        from ..distributed.storage_rest import StorageRESTServer
+
+        srv = self.storage_server
+        if srv is None:
+            return
+        srv.stop()
+        time.sleep(blip_s)
+        self.storage_server = StorageRESTServer(
+            self._remote_raw, SECRET, "127.0.0.1", self._storage_port
+        ).start()
+
+    def _provision(self) -> None:
+        for b in (BUCKET, BUCKET_VER, BUCKET_EXP):
+            st, _, _ = self.request("PUT", f"/{b}")
+            assert st == 200, f"make_bucket {b}: {st}"
+        st, _, _ = self.request(
+            "PUT", f"/{BUCKET_VER}", query=[("versioning", "")],
+            body=(b"<VersioningConfiguration><Status>Enabled</Status>"
+                  b"</VersioningConfiguration>"),
+        )
+        assert st == 200, f"versioning: {st}"
+        # Already-due Date rule on the exp/ prefix: every lifecycle-op
+        # object expires at the drain scan cycle.
+        lc = (b'<LifecycleConfiguration><Rule><ID>soak-exp</ID>'
+              b'<Status>Enabled</Status><Filter><Prefix>exp/</Prefix>'
+              b'</Filter><Expiration><Date>2001-01-01T00:00:00Z</Date>'
+              b'</Expiration></Rule></LifecycleConfiguration>')
+        st, _, _ = self.request("PUT", f"/{BUCKET_EXP}",
+                                query=[("lifecycle", "")], body=lc)
+        assert st == 200, f"lifecycle: {st}"
+
+    # -- signed HTTP client -------------------------------------------------
+
+    def request(self, method: str, path: str, query=None, body=b"",
+                headers=None, timeout: float = 120.0):
+        from ..api.sign import sign_v4_request
+
+        query = query or []
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        h = sign_v4_request(SECRET, ACCESS, method, self.srv.endpoint,
+                            path, query, dict(headers or {}), body)
+        conn = http.client.HTTPConnection(self.srv.endpoint,
+                                          timeout=timeout)
+        try:
+            conn.request(method, url, body=body, headers=h)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # -- fault backdoors (below the S3 surface, above nothing) --------------
+
+    def kill_data_shard(self, bucket: str, obj: str) -> str | None:
+        """Remove ONE data-shard part file of a committed object via
+        the raw disks (below the fault layer) — the deterministic
+        degraded-GET trigger. Returns the endpoint hit, or None when
+        no killable local shard exists."""
+        for d in self.raw_disks[: len(self.raw_disks)
+                                - self._remote_count]:
+            try:
+                fi = d.read_version(bucket, obj)
+            except Exception:  # noqa: BLE001  # except-ok: disks without a copy of this object are simply not kill candidates
+                continue
+            if not fi.data_dir or fi.erasure.index - 1 >= \
+                    fi.erasure.data_blocks:
+                continue
+            part = os.path.join(self.root, d.endpoint(), bucket, obj,
+                                fi.data_dir, "part.1")
+            try:
+                os.remove(part)
+            except OSError:
+                continue
+            return d.endpoint()
+        return None
+
+    # -- drain + teardown ---------------------------------------------------
+
+    def drain_mrf(self, deadline_s: float = 45.0) -> int:
+        """Heal the MRF backlog dry (bounded): entries that fail heal
+        re-queue with their original timestamp and retry until the
+        deadline; not-found entries are DROPPED as satisfied — the
+        production MRF drain's convention (a version the quorum deleted
+        vanishes from the straggler too; there is nothing left to
+        repair). Returns entries left (0 == dry)."""
+        from ..utils.errors import (
+            ErrFileNotFound,
+            ErrFileVersionNotFound,
+            ErrObjectNotFound,
+            ErrVersionNotFound,
+        )
+
+        deadline = time.monotonic() + deadline_s
+        left = 0
+        while time.monotonic() < deadline:
+            entries = []
+            for pool in self.ol.pools:
+                for es in pool.sets:
+                    entries.extend(
+                        (es, b, o, v, t)
+                        for b, o, v, t in es.drain_mrf(with_times=True)
+                    )
+            if not entries:
+                return 0
+            left = len(entries)
+            for es, b, o, v, t in entries:
+                try:
+                    self.ol.heal_object(b, o, v, remove_dangling=True)
+                except (ErrFileNotFound, ErrFileVersionNotFound,
+                        ErrObjectNotFound, ErrVersionNotFound):
+                    continue  # gone everywhere: the heal is satisfied
+                except Exception:  # noqa: BLE001  # except-ok: failed heals RE-QUEUE with their original timestamp and retry until the drain deadline
+                    es.queue_mrf(b, o, v, enqueued_at=t)
+            time.sleep(0.05)
+        return left
+
+    def wait_readmit(self, deadline_s: float = 12.0) -> list[str]:
+        """Wait for latched drive breakers to re-admit; returns the
+        endpoints still faulty at the deadline."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            faulty = [d.health.endpoint for d in self.disks
+                      if d.health.is_faulty()]
+            if not faulty:
+                return []
+            time.sleep(0.05)
+        return [d.health.endpoint for d in self.disks
+                if d.health.is_faulty()]
+
+    def close(self) -> None:
+        """Unwind everything __init__/_boot touched. Safe on a
+        half-booted harness (boot failure calls this too)."""
+        from ..pipeline import admission
+
+        try:
+            if self.srv is not None:
+                self.srv.stop()
+        finally:
+            if self.storage_server is not None:
+                self.storage_server.stop()
+            admission.reconfigure(None)
+            admission.reconfigure_read(None)
+            self._robust.__exit__(None, None, None)
+            for k, v in self._saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# workload execution
+
+
+class _Oracle:
+    """What the scenario PROVED committed: the no-loss invariant's
+    ground truth. Per-client keyspaces keep it race-free (clients are
+    sequential within themselves)."""
+
+    def __init__(self):
+        self.objects: dict[tuple, bytes] = {}   # (bucket,key) -> body
+        self.versions: dict[tuple, list] = {}   # (bucket,key) -> [(vid, body)]
+        self.markers: set = set()               # (bucket,key) with marker
+        self.expiring: dict[tuple, bytes] = {}  # lifecycle-doomed objects
+        self.degraded: set = set()              # shard-killed, heal pending
+        # Payload of versions DELETED mid-run: their commit-fanout
+        # shortfall (if any) is legitimately never healed, so the
+        # full-redundancy reconciliation discounts it.
+        self.deleted_payload = 0
+        self._mu = threading.Lock()
+
+    def commit(self, bucket: str, key: str, body: bytes) -> None:
+        with self._mu:
+            self.objects[(bucket, key)] = body
+
+    def committed_keys(self, client: int) -> list:
+        pre = f"c{client}/"
+        with self._mu:
+            return sorted(k for (b, k) in self.objects
+                          if b == BUCKET and k.startswith(pre))
+
+
+def _payload(seed: int, size: int) -> bytes:
+    return random.Random(seed).randbytes(size)
+
+
+class _Composer:
+    """Fires the plan's process/network events as the global completed-
+    op counter crosses their trigger points."""
+
+    def __init__(self, harness: ScenarioHarness, events: list[dict],
+                 log: list):
+        self._h = harness
+        self._pending = sorted(events, key=lambda e: e["at_op"])
+        self._log = log
+        self._ops = 0
+        self._mu = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    def op_done(self) -> None:
+        with self._mu:
+            self._ops += 1
+            due, keep = [], []
+            for e in self._pending:
+                (due if e["at_op"] <= self._ops else keep).append(e)
+            self._pending = keep
+            at = self._ops
+        for e in due:
+            self._fire(e, at)
+
+    def _fire(self, event: dict, at: int) -> None:
+        entry = dict(event, fired_at_op=at)
+        if event["kind"] == "worker_kill":
+            entry["pid"] = self._kill_worker()
+        elif event["kind"] == "peer_blackout":
+            t = threading.Thread(
+                target=self._h.blackout_peer,
+                args=(event.get("blip_s", 1.0),),
+                name="soak-blackout", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._log.append(entry)
+
+    def _kill_worker(self) -> int | None:
+        from ..pipeline import workers
+
+        pool = workers.get_pool()
+        if pool is None:
+            return None  # 1-core / sandboxed host: pool inert by design
+        pids = pool.live_pids()
+        if not pids:
+            return None
+        os.kill(pids[0], signal.SIGKILL)
+        return pids[0]
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        for t in self._threads:
+            t.join(timeout_s)
+
+
+def _run_client(h: ScenarioHarness, oracle: _Oracle, client: int,
+                stream: list[dict], composer: _Composer,
+                counts: dict, violations: list, stall_bound_s: float):
+    """Execute one client's op stream. Failures under faults are LEGAL
+    (recorded, not raised); stalls past the tolerance bound and wrong
+    bytes are violations."""
+    for op in stream:
+        t0 = time.monotonic()
+        try:
+            ok = _run_op(h, oracle, client, op)
+        except Exception as exc:  # noqa: BLE001 - op outcome, not crash
+            ok = False
+            counts.setdefault("errors", []).append(
+                f"c{client}/{op['op']}#{op['n']}: "
+                f"{type(exc).__name__}: {exc}")
+        took = time.monotonic() - t0
+        if took > stall_bound_s:
+            violations.append(
+                f"stall: c{client} {op['op']}#{op['n']} took "
+                f"{took:.1f}s > {stall_bound_s:.1f}s bound")
+        with oracle._mu:
+            c = counts.setdefault(op["op"], {"ok": 0, "failed": 0})
+            c["ok" if ok else "failed"] += 1
+        composer.op_done()
+
+
+def _run_op(h: ScenarioHarness, oracle: _Oracle, client: int,
+            op: dict) -> bool:
+    kind = op["op"]
+    if kind == OP_PUT:
+        body = _payload(op["pseed"], op["size"])
+        st, _, _ = h.request("PUT", f"/{BUCKET}/{op['key']}", body=body)
+        if st == 200:
+            oracle.commit(BUCKET, op["key"], body)
+        return st == 200
+    if kind == OP_GET:
+        keys = oracle.committed_keys(client)
+        if not keys:
+            return True  # nothing to read yet: vacuous
+        key = keys[op["pick"] % len(keys)]
+        st, _, got = h.request("GET", f"/{BUCKET}/{key}")
+        if st != 200:
+            return False
+        with oracle._mu:
+            want = oracle.objects[(BUCKET, key)]
+        if got != want:
+            raise AssertionError(f"GET {key}: bytes differ")
+        return True
+    if kind == OP_GET_DEGRADED:
+        keys = [k for k in oracle.committed_keys(client)
+                if (BUCKET, k) not in oracle.degraded]
+        if not keys:
+            return True
+        key = keys[op["pick"] % len(keys)]
+        if h.kill_data_shard(BUCKET, key) is None:
+            return True  # all copies remote/inline: nothing to kill
+        with oracle._mu:
+            oracle.degraded.add((BUCKET, key))
+        st, _, got = h.request("GET", f"/{BUCKET}/{key}")
+        if st != 200:
+            return False
+        with oracle._mu:
+            want = oracle.objects[(BUCKET, key)]
+        if got != want:
+            raise AssertionError(f"degraded GET {key}: bytes differ")
+        return True
+    if kind == OP_HEAL:
+        keys = oracle.committed_keys(client)
+        if not keys:
+            return True
+        key = keys[op["pick"] % len(keys)]
+        h.ol.heal_object(BUCKET, key)
+        return True
+    if kind == OP_LIST:
+        st, _, raw = h.request(
+            "GET", f"/{BUCKET}",
+            query=[("list-type", "2"), ("prefix", op["prefix"]),
+                   ("max-keys", "1000")],
+        )
+        if st != 200:
+            return False
+        listed = set(_xml_keys(raw))
+        missing = [k for k in oracle.committed_keys(client)
+                   if k not in listed]
+        if missing:
+            raise AssertionError(
+                f"list {op['prefix']}: committed keys missing: "
+                f"{missing[:4]}")
+        return True
+    if kind == OP_MULTIPART:
+        return _run_multipart(h, oracle, op)
+    if kind == OP_LIFECYCLE:
+        body = _payload(op["pseed"], op["size"])
+        st, _, _ = h.request("PUT", f"/{BUCKET_EXP}/{op['key']}",
+                             body=body)
+        if st == 200:
+            with oracle._mu:
+                oracle.expiring[(BUCKET_EXP, op["key"])] = body
+        return st == 200
+    if kind == OP_VERSIONED:
+        return _run_versioned(h, oracle, op)
+    raise ValueError(f"unknown op {kind}")
+
+
+def _xml_keys(raw: bytes) -> list[str]:
+    import re
+
+    return [m.decode() for m in re.findall(rb"<Key>([^<]+)</Key>", raw)]
+
+
+def _run_multipart(h: ScenarioHarness, oracle: _Oracle, op: dict) -> bool:
+    """Client-side parallel multipart: initiate, upload the parts
+    CONCURRENTLY, complete with the collected etags."""
+    import re
+
+    key = op["key"]
+    body = _payload(op["pseed"], op["size"])
+    nparts = op["parts"]
+    st, _, raw = h.request("POST", f"/{BUCKET}/{key}",
+                           query=[("uploads", "")])
+    if st != 200:
+        return False
+    m = re.search(rb"<UploadId>([^<]+)</UploadId>", raw)
+    if not m:
+        return False
+    upload_id = m.group(1).decode()
+    psize = max(1, len(body) // nparts)
+    view = memoryview(body)
+    etags: list = [None] * nparts
+    errs: list = []
+
+    def upload(i: int) -> None:
+        lo = i * psize
+        hi = len(body) if i == nparts - 1 else (i + 1) * psize
+        st_i, hdr, _ = h.request(
+            "PUT", f"/{BUCKET}/{key}",
+            query=[("partNumber", str(i + 1)), ("uploadId", upload_id)],
+            body=bytes(view[lo:hi]),  # copy-ok: meta — HTTP body framing of a test-harness part, not the serving hot path
+        )
+        if st_i != 200:
+            errs.append(st_i)
+            return
+        etags[i] = hdr.get("ETag", "").strip('"')
+
+    threads = [threading.Thread(target=upload, args=(i,))
+               for i in range(nparts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    if errs or any(e is None for e in etags):
+        h.request("DELETE", f"/{BUCKET}/{key}",
+                  query=[("uploadId", upload_id)])
+        return False
+    parts_xml = "".join(
+        f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags)
+    )
+    st, _, _ = h.request(
+        "POST", f"/{BUCKET}/{key}", query=[("uploadId", upload_id)],
+        body=(f"<CompleteMultipartUpload>{parts_xml}"
+              f"</CompleteMultipartUpload>").encode(),
+    )
+    if st != 200:
+        return False
+    oracle.commit(BUCKET, key, body)
+    return True
+
+
+def _run_versioned(h: ScenarioHarness, oracle: _Oracle, op: dict) -> bool:
+    """Versioned overwrite / delete-marker / versioned-delete cycle on
+    the versioned bucket; oracle records only what committed."""
+    key = op["key"]
+    committed: list = []
+    ok = True
+    for i, step in enumerate(op["steps"]):
+        if step == "put":
+            body = _payload(op["pseed"] + i, op["size"])
+            st, hdr, _ = h.request("PUT", f"/{BUCKET_VER}/{key}",
+                                   body=body)
+            if st == 200:
+                committed.append((hdr.get("x-amz-version-id", ""), body))
+            else:
+                ok = False
+        elif step == "marker":
+            st, _, _ = h.request("DELETE", f"/{BUCKET_VER}/{key}")
+            if st in (200, 204):
+                with oracle._mu:
+                    oracle.markers.add((BUCKET_VER, key))
+            else:
+                ok = False
+        elif step == "delete-oldest" and committed:
+            vid, vbody = committed[0]
+            if vid:
+                st, _, _ = h.request("DELETE", f"/{BUCKET_VER}/{key}",
+                                     query=[("versionId", vid)])
+                if st in (200, 204):
+                    committed.pop(0)
+                    with oracle._mu:
+                        oracle.deleted_payload += len(vbody)
+                else:
+                    ok = False
+    if committed:
+        with oracle._mu:
+            oracle.versions[(BUCKET_VER, key)] = committed
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# invariants
+
+
+def inv_no_loss(h: ScenarioHarness, oracle: _Oracle) -> list[str]:
+    """Every op that REPORTED success reads back byte-identical —
+    plain objects, multipart objects, and surviving versions; delete
+    markers hide their key; expired objects are gone."""
+    def fetch(path, query=None):
+        # A 200 status line followed by a severed body (quorum lost
+        # AFTER the header went out) is still a loss — report it as
+        # one, not as a checker crash.
+        try:
+            return h.request("GET", path, query=query)
+        except (OSError, http.client.HTTPException) as exc:
+            return -1, {}, f"{type(exc).__name__}: {exc}".encode()
+
+    out = []
+    for (bucket, key), want in sorted(oracle.objects.items()):
+        st, _, got = fetch(f"/{bucket}/{key}")
+        if st != 200:
+            out.append(f"no-loss: GET {bucket}/{key} -> {st} "
+                       f"({got[:80]!r})" if st == -1 else
+                       f"no-loss: GET {bucket}/{key} -> {st}")
+        elif got != want:
+            out.append(f"no-loss: {bucket}/{key} bytes differ "
+                       f"({len(got)} vs {len(want)})")
+    for (bucket, key), versions in sorted(oracle.versions.items()):
+        for vid, want in versions:
+            if not vid:
+                continue
+            st, _, got = fetch(f"/{bucket}/{key}",
+                               query=[("versionId", vid)])
+            if st != 200:
+                out.append(f"no-loss: GET {bucket}/{key}?versionId="
+                           f"{vid} -> {st}")
+            elif got != want:
+                out.append(f"no-loss: version {bucket}/{key}@{vid} "
+                           f"bytes differ")
+    for (bucket, key) in sorted(oracle.markers):
+        st, _, _ = fetch(f"/{bucket}/{key}")
+        if st != 404:
+            out.append(f"marker: GET {bucket}/{key} -> {st}, want 404")
+    return out
+
+
+def inv_expiry(h: ScenarioHarness, oracle: _Oracle) -> list[str]:
+    """Lifecycle-expired objects are GONE and their shard part files
+    freed on every disk (expiry must reclaim bytes, not just hide
+    keys)."""
+    out = []
+    for (bucket, key) in sorted(oracle.expiring):
+        st, _, _ = h.request("GET", f"/{bucket}/{key}")
+        if st != 404:
+            out.append(f"expiry: GET {bucket}/{key} -> {st}, want 404")
+        for d in h.raw_disks:
+            obj_dir = os.path.join(h.root, d.endpoint(), bucket, key)
+            if not os.path.isdir(obj_dir):
+                continue
+            parts = [f for dp, _, fs in os.walk(obj_dir)
+                     for f in fs if f.startswith("part.")]
+            if parts:
+                out.append(f"expiry: {d.endpoint()}/{bucket}/{key} "
+                           f"still holds {len(parts)} part file(s)")
+    return out
+
+
+def inv_mrf_dry(h: ScenarioHarness, _oracle) -> list[str]:
+    out = []
+    for pool in h.ol.pools:
+        for es in pool.sets:
+            stats = es.mrf_stats()
+            if stats["pending"]:
+                out.append(f"mrf: set {es.set_index} backlog "
+                           f"{stats['pending']} not drained "
+                           f"(oldest {stats['oldest_age_s']}s)")
+    return out
+
+
+def inv_pools_settled(_h, _oracle) -> list[str]:
+    """Every shared buffer pool — in-process strips AND shm strip/ring
+    pools — back to in_use == 0: the executor drop hooks returned every
+    abandoned buffer across all the faulted/aborted streams."""
+    from ..pipeline.buffers import _shared
+
+    out = []
+    for key, pool in sorted(_shared.items(), key=lambda kv: str(kv[0])):
+        stats = pool.stats()
+        if stats["in_use"]:
+            out.append(f"pool {key}: in_use {stats['in_use']} != 0 "
+                       f"({stats})")
+    return out
+
+
+def inv_lock_cycles(_h, _oracle) -> list[str]:
+    """Zero lock acquisition-order cycles while the runtime lockgraph
+    checker was armed (skips silently when tools/ is absent — a
+    pip-installed deployment)."""
+    try:
+        from tools.analysis import lockgraph
+    except ImportError:
+        return []
+    if not lockgraph.enabled():
+        return []
+    report = lockgraph.report()
+    return [f"lock-cycle: {c}" for c in report["cycles"]]
+
+
+def inv_no_orphan_workers(_h, _oracle) -> list[str]:
+    """Every live encode-worker child of THIS process is accounted for
+    in the pool registry: a kill -9'd worker must be respawned or
+    reaped, never abandoned."""
+    from ..pipeline import workers
+
+    # Snapshot /proc BEFORE the registry: a respawn landing between
+    # the two reads then shows up registered-but-not-scanned (benign)
+    # instead of scanned-but-not-yet-registered (a false orphan).
+    children = _worker_children()
+    pool = workers.get_pool()
+    registered = set(pool.live_pids()) if pool is not None else set()
+    out = []
+    for pid in children:
+        if pid in registered:
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().split()[2]
+        except OSError:
+            continue  # raced exit: reaped
+        if state != "Z":
+            out.append(f"orphan worker pid {pid} (state {state})")
+    return out
+
+
+def _worker_children() -> list[int]:
+    """PIDs of this process's children running the worker CLI."""
+    me = os.getpid()
+    out = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                fields = f.read().split()
+            if int(fields[3]) != me:
+                continue
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmd = f.read()
+        except (OSError, IndexError, ValueError):
+            continue
+        if b"minio_tpu.pipeline.workers" in cmd:
+            out.append(int(entry))
+    return out
+
+
+def inv_admission_conserved(h: ScenarioHarness, _oracle) -> list[str]:
+    """Admission conservation on BOTH governors: every arrival was
+    granted or rejected — grants + rejections - late-grant-returns ==
+    arrivals (pipeline/admission.py documents the identity)."""
+    out = []
+    for name, gov in (("put", h.governor), ("get", h.read_governor)):
+        s = gov.snapshot()
+        lhs = (s["admitted_total"] + s["rejected_queue_full"]
+               + s["rejected_deadline"] - s["late_grant_returns"])
+        if lhs != s["arrivals_total"]:
+            out.append(
+                f"admission[{name}]: admitted {s['admitted_total']} + "
+                f"rejected {s['rejected_queue_full']}+"
+                f"{s['rejected_deadline']} - late "
+                f"{s['late_grant_returns']} = {lhs} != arrivals "
+                f"{s['arrivals_total']}")
+        if s["inflight"] or s["waiting"]:
+            out.append(f"admission[{name}]: not drained "
+                       f"(inflight {s['inflight']}, waiting "
+                       f"{s['waiting']})")
+    return out
+
+
+# Bitrot framing adds 32 bytes per shard chunk; aborted mid-stream PUTs
+# stage extra bytes that tmp cleanup removes from disk but not from the
+# (monotonic) ledger. Tolerances absorb framing; failures only push the
+# written side UP, so the lower bound is strict.
+_RECON_TOL = 0.02
+
+
+def inv_ioflow_reconciles(h: ScenarioHarness, _oracle,
+                          counts: dict | None = None) -> list[str]:
+    """Byte-flow ledger reconciliation that must hold EVEN when ops
+    fail mid-stream:
+
+    - conservation floor: a committed put/multipart stream wrote at
+      least write_quorum/k x payload (a quorum commit may detach up to
+      m - 1 faulted shard writers; fewer would not have committed);
+    - full redundancy at drain: put + multipart + heal writes cover
+      (k+m)/k x payload — whatever the commit fan-out missed, the MRF
+      drain healed, and every byte of both is in the ledger;
+    - the clean-path equality: with ZERO failed ops and ZERO drive-
+      fault fires, put writes == (k+m)/k x payload within framing
+      tolerance (the arXiv 1412.3022 dense-RS baseline);
+    - heal read/healed within the dense-RS bounds [k/m, k];
+    - degraded-GET reads >= the payload logically served from them.
+    """
+    from ..observability import ioflow
+
+    snap = ioflow.snapshot()
+    ops = ioflow.op_totals(snap)
+    out = []
+    k = h.spec.disks - h.spec.parity
+    m = h.spec.parity
+    factor = (k + m) / k
+    write_quorum = k + (1 if k == m else 0)
+    quorum_factor = write_quorum / k
+    payload = 0
+    payload_writes = 0
+    clean = not getattr(h, "fault_fired", 0)
+    for op_class in ("put", "multipart"):
+        logical = snap["logical"].get(op_class, 0)
+        written = ops.get(op_class, {}).get("write", 0)
+        payload += logical
+        payload_writes += written
+        if not logical:
+            continue
+        floor = quorum_factor * logical * (1 - _RECON_TOL)
+        if written < floor:
+            out.append(
+                f"ioflow: {op_class} writes {written} < write_quorum/k "
+                f"x logical {logical} (floor {floor:.0f}) — committed "
+                f"bytes vanished from the ledger")
+        failed = (counts or {}).get(op_class, {}).get("failed", 0)
+        if clean and not failed:
+            lo = factor * logical * (1 - _RECON_TOL)
+            hi = factor * logical * (1 + _RECON_TOL)
+            if not lo <= written <= hi:
+                out.append(
+                    f"ioflow: {op_class} writes {written} != (k+m)/k x "
+                    f"logical {logical} (want [{lo:.0f}, {hi:.0f}]) "
+                    f"on the clean path")
+    heal = ops.get("heal", {})
+    durable = payload - getattr(_oracle, "deleted_payload", 0)
+    if durable > 0 and payload_writes + heal.get("write", 0) < \
+            factor * durable * (1 - _RECON_TOL):
+        out.append(
+            f"ioflow: payload writes {payload_writes} + heal writes "
+            f"{heal.get('write', 0)} < (k+m)/k x durable payload "
+            f"{durable} — drain did not restore full redundancy in "
+            f"the ledger")
+    if heal.get("write", 0):
+        ratio = heal.get("read", 0) / heal["write"]
+        # Dense RS can never rebuild cheaper than k survivor reads for
+        # m rebuilt shards — the lower bound holds under ANY chaos
+        # (only a regenerating-code engine may legitimately go below).
+        lo = (k / m) * (1 - _RECON_TOL)
+        if ratio < lo:
+            out.append(f"ioflow: heal read/healed {ratio:.2f} below "
+                       f"the dense-RS floor {lo:.2f}")
+        # The k upper bound is a CLEAN-path property: hedged reads and
+        # heal attempts that fault out mid-read (reads ledgered, no
+        # writes) push the ratio above k legitimately under chaos.
+        if clean and ratio > k * (1 + _RECON_TOL):
+            out.append(f"ioflow: heal read/healed {ratio:.2f} > k={k} "
+                       f"on the clean path")
+    deg = ops.get("get-degraded", {})
+    logical_deg = snap["logical"].get("get-degraded", 0)
+    if logical_deg and not deg.get("read", 0):
+        # A mid-stream promotion retags only the REMAINING bytes (the
+        # pre-failure reads stay op=get), so read >= logical does not
+        # hold here — but reconstruction always reads at least one
+        # extra shard AFTER the promotion, so zero degraded reads
+        # against nonzero degraded payload means the retag leaked.
+        out.append(f"ioflow: {logical_deg} payload bytes served "
+                   f"degraded with ZERO reads ledgered as "
+                   f"get-degraded — the mid-stream retag leaked")
+    return out
+
+
+# Ordered registry: the drain-time gate runs every one, IN THIS ORDER —
+# mrf_dry asserts the drain state BEFORE the no-loss verification reads
+# (which may legitimately queue fresh heal hints if they find residual
+# degradation; the runner drains and reports those separately).
+INVARIANTS = {
+    "mrf_dry": inv_mrf_dry,
+    "no_loss": inv_no_loss,
+    "expiry": inv_expiry,
+    "pools_settled": inv_pools_settled,
+    "lock_cycles": inv_lock_cycles,
+    "no_orphan_workers": inv_no_orphan_workers,
+    "admission_conserved": inv_admission_conserved,
+    "ioflow_reconciles": inv_ioflow_reconciles,
+}
+
+_CONTINUOUS = ("lock_cycles", "no_orphan_workers")
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+class ScenarioResult:
+    """The failure artifact (docs/SOAK.md "reading a failure
+    artifact"): plan + outcome counts + fault log + per-invariant
+    violations. JSON-able and self-contained — the plan inside it
+    replays the scenario."""
+
+    def __init__(self, plan: dict):
+        self.plan = plan
+        self.counts: dict = {}
+        self.fault_log: list = []
+        self.violations: dict[str, list[str]] = {}
+        self.wall_s = 0.0
+        self.bytes_moved = 0
+        self.drained_ok = True
+        # Heal entries the no-loss verification reads themselves
+        # queued (residual degradation found and repaired post-gate):
+        # visible in the artifact, not a gate failure by itself.
+        self.verify_requeued = 0
+        # Drive-fault injections that actually fired (vs armed).
+        self.drive_faults_fired = 0
+
+    @property
+    def passed(self) -> bool:
+        return self.drained_ok and not any(self.violations.values())
+
+    @property
+    def throughput_gbps(self) -> float:
+        return (self.bytes_moved / self.wall_s / 1e9
+                if self.wall_s else 0.0)
+
+    def to_dict(self) -> dict:
+        return {
+            "passed": self.passed,
+            "plan": self.plan,
+            "counts": self.counts,
+            "fault_log": self.fault_log,
+            "violations": {k: v for k, v in self.violations.items()
+                           if v},
+            "wall_s": round(self.wall_s, 3),
+            "bytes_moved": self.bytes_moved,
+            "throughput_gbps": round(self.throughput_gbps, 4),
+            "verify_requeued": self.verify_requeued,
+            "drive_faults_fired": self.drive_faults_fired,
+        }
+
+
+def run_scenario(spec: ScenarioSpec, root: str) -> ScenarioResult:
+    """Execute one full scenario: boot the harness, arm the plan's
+    faults, run every client stream concurrently with the continuous
+    checker, then drain (disarm -> re-admit -> MRF dry -> lifecycle
+    scan -> MRF dry) and run the full invariant gate."""
+    from ..storage.diskcheck import ROBUST
+
+    plan = scenario_plan(spec)
+    result = ScenarioResult(plan)
+    lockgraph = None
+    if spec.lock_check:
+        try:
+            from tools.analysis import lockgraph as _lg
+
+            if not _lg.enabled():
+                _lg.reset()
+                _lg.enable()
+                lockgraph = _lg
+        except ImportError:
+            pass  # pip-installed deployment without tools/: documented skip
+    h = None
+    oracle = _Oracle()
+    try:
+        h = ScenarioHarness(root, spec)
+        stall_bound_s = (ROBUST.long_op_deadline_s
+                         + ROBUST.straggler_grace_s + STALL_SLACK_S)
+        scheds = []
+        for ep, sched in plan["faults"]["drive_schedules"]:
+            fd = h.fault_disks[h.endpoints.index(ep)]
+            scheds.append(fd.arm(sched))
+        composer = _Composer(h, plan["faults"]["events"],
+                             result.fault_log)
+        violations: list[str] = []
+        stop = threading.Event()
+
+        def continuous():
+            while not stop.wait(0.5):
+                for name in _CONTINUOUS:
+                    for v in INVARIANTS[name](h, oracle):
+                        # Dedup on the STORED form: a violation that
+                        # persists all soak must not append one line
+                        # per 0.5s tick to the artifact.
+                        entry = f"[mid-run] {v}"
+                        if entry not in violations:
+                            violations.append(entry)
+
+        checker = threading.Thread(target=continuous,
+                                   name="soak-invariants", daemon=True)
+        checker.start()
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(
+                target=_run_client,
+                args=(h, oracle, c, plan["clients"][c], composer,
+                      result.counts, violations, stall_bound_s),
+                name=f"soak-c{c}",
+            )
+            for c in range(spec.clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600.0)
+            if t.is_alive():
+                violations.append(f"client {t.name} wedged past 600s")
+                result.drained_ok = False
+        result.wall_s = time.monotonic() - t0
+        stop.set()
+        checker.join(5.0)
+        composer.join()
+
+        # ---- drain ----
+        h.fault_fired = sum(s.fired for s in scheds)
+        result.drive_faults_fired = h.fault_fired
+        for s in scheds:
+            s.disarm()
+        still_faulty = h.wait_readmit()
+        if still_faulty:
+            violations.append(
+                f"drives never re-admitted after disarm: {still_faulty}")
+        left = h.drain_mrf()
+        if left:
+            result.drained_ok = False
+        # Lifecycle expiry + scanner heal sampling, then heal whatever
+        # the scan queued.
+        h.scanner.scan_cycle()
+        left = h.drain_mrf()
+        if left:
+            result.drained_ok = False
+
+        # ---- the gate ----
+        result.violations["run"] = violations
+        for name, fn in INVARIANTS.items():
+            try:
+                if fn is inv_ioflow_reconciles:
+                    result.violations[name] = fn(h, oracle,
+                                                 result.counts)
+                else:
+                    result.violations[name] = fn(h, oracle)
+            except Exception as exc:  # noqa: BLE001 - checker crash IS a failure
+                result.violations[name] = [
+                    f"invariant checker crashed: "
+                    f"{type(exc).__name__}: {exc}"]
+        result.bytes_moved = sum(
+            len(b) for b in oracle.objects.values()
+        ) + sum(len(b) for b in oracle.expiring.values())
+        # The verification reads above may have FOUND residual
+        # degradation and queued heal hints: repair it now and report
+        # the count — the gate already judged the drain state.
+        result.verify_requeued = sum(
+            es.mrf_stats()["pending"]
+            for pool in h.ol.pools for es in pool.sets
+        )
+        if result.verify_requeued:
+            h.drain_mrf(deadline_s=15.0)
+    finally:
+        if h is not None:
+            h.close()
+        if lockgraph is not None:
+            lockgraph.disable()
+            report = lockgraph.report()
+            lockgraph.reset()
+            if report["cycles"]:
+                result.violations.setdefault("lock_cycles", []).extend(
+                    f"lock-cycle (final): {c}" for c in report["cycles"]
+                )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# whole-server crash scenario: SIGKILL mid-PUT + restart recovery
+
+
+def host_memcpy_gbps(size_mib: int = 32, reps: int = 3) -> float:
+    """Best-of-N host memcpy rate — the soak throughput floor's
+    normalizer (same convention as bench.py: value/memcpy cancels the
+    host weather, so one floor number holds across CI hosts)."""
+    import numpy as np
+
+    src = np.random.default_rng(0).integers(
+        0, 256, size_mib * MIB, dtype=np.uint8
+    )
+    dst = np.empty_like(src)
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        best = max(best, size_mib * MIB / dt / 1e9)
+    return best
+
+
+def _count_tmp_entries(root: str, endpoints: list[str]) -> int:
+    from ..storage.local import SYSTEM_META_BUCKET
+
+    n = 0
+    for ep in endpoints:
+        base = os.path.join(root, ep, SYSTEM_META_BUCKET, "tmp")
+        if os.path.isdir(base):
+            n += len(os.listdir(base))
+    return n
+
+
+def crash_restart_put(root: str, seed: int = 7, payload_mib: int = 6,
+                      disks: int = 8, parity: int = 4) -> dict:
+    """The kill -9 recovery scenario: a real server subprocess dies
+    mid-PUT (half the body on the wire), then a restart over the same
+    drives must (a) purge the orphaned tmp staging, (b) show NO partial
+    object — the pre-crash version reads back byte-identical — and
+    (c) heal back to full redundancy with byte-identical content.
+    Returns the evidence artifact."""
+    import subprocess
+
+    from ..api.sign import sign_v4_request
+    from ..object.pools import ErasureServerPools
+    from ..object.sets import ErasureSets
+    from ..storage.local import LocalStorage
+
+    endpoints = [f"crash-d{i}" for i in range(disks)]
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MTPU_INLINE_THRESHOLD"] = "0"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.faults.scenarios", "serve",
+         root, str(disks), str(parity)] + endpoints,
+        stdout=subprocess.PIPE, env=env, text=True,
+    )
+    artifact: dict = {"seed": seed}
+    try:
+        line = proc.stdout.readline()
+        boot = json.loads(line)
+        endpoint = boot["endpoint"]
+
+        def req(method, path, body=b"", query=None):
+            q = query or []
+            headers = sign_v4_request(SECRET, ACCESS, method, endpoint,
+                                      path, q, {}, body)
+            conn = http.client.HTTPConnection(endpoint, timeout=60)
+            try:
+                qs = urllib.parse.urlencode(q)
+                conn.request(method,
+                             urllib.parse.quote(path)
+                             + (f"?{qs}" if qs else ""),
+                             body=body, headers=headers)
+                r = conn.getresponse()
+                return r.status, r.read()
+            finally:
+                conn.close()
+
+        assert req("PUT", "/crash")[0] == 200
+        committed = _payload(seed, payload_mib * MIB)
+        st, _ = req("PUT", "/crash/victim", body=committed)
+        assert st == 200, f"baseline PUT: {st}"
+
+        # The overwrite that dies on the wire: send headers + half the
+        # body, give the pipeline a beat to stage tmp shards, SIGKILL.
+        overwrite = _payload(seed + 1, payload_mib * MIB)
+        headers = sign_v4_request(SECRET, ACCESS, "PUT", endpoint,
+                                  "/crash/victim", [], {}, overwrite)
+        conn = http.client.HTTPConnection(endpoint, timeout=60)
+        conn.putrequest("PUT", "/crash/victim")
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        if not any(k.lower() == "content-length" for k in headers):
+            conn.putheader("Content-Length", str(len(overwrite)))
+        conn.endheaders()
+        conn.send(overwrite[: len(overwrite) // 2])
+        time.sleep(0.4)  # let shard writers stage under tmp
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        conn.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    artifact["tmp_entries_after_crash"] = _count_tmp_entries(
+        root, endpoints)
+
+    # ---- restart over the same drives: the REAL recovery path ----
+    raw = [LocalStorage(os.path.join(root, ep), endpoint=ep)
+           for ep in endpoints]
+    sets = ErasureSets(raw, disks, default_parity=parity, pool_index=0)
+    sets.load_format()  # boot-time recovery: purges stale tmp
+    ol = ErasureServerPools([sets])
+    artifact["tmp_entries_after_restart"] = _count_tmp_entries(
+        root, endpoints)
+
+    import io as _io
+
+    sink = _io.BytesIO()
+    ol.get_object("crash", "victim", sink)
+    artifact["pre_crash_version_intact"] = sink.getvalue() == committed
+    # No partial overwrite anywhere: every disk's visible version must
+    # carry the committed object's size.
+    partials = []
+    for d in raw:
+        try:
+            fi = d.read_version("crash", "victim")
+        except Exception:  # noqa: BLE001  # except-ok: a disk the commit fan-out missed is exactly what the heal step below repairs
+            continue
+        if fi.size != len(committed):
+            partials.append(d.endpoint())
+    artifact["partial_visible_on"] = partials
+
+    # Heal to full redundancy, then byte-identical re-read.
+    ol.heal_object("crash", "victim")
+    for pool in ol.pools:
+        for es in pool.sets:
+            for b, o, v in es.drain_mrf():
+                ol.heal_object(b, o, v, remove_dangling=True)
+    sink = _io.BytesIO()
+    ol.get_object("crash", "victim", sink)
+    artifact["healed_byte_identical"] = sink.getvalue() == committed
+    artifact["recovered"] = (
+        artifact["tmp_entries_after_restart"] == 0
+        and artifact["pre_crash_version_intact"]
+        and not partials
+        and artifact["healed_byte_identical"]
+    )
+    return artifact
+
+
+def _serve_cli() -> None:
+    """`python -m minio_tpu.faults.scenarios serve <root> <disks>
+    <parity> <ep...>`: boot a real signed S3 server over the given
+    drive roots (loading an existing format if present — the restart
+    half of the crash scenario), print {"endpoint": ...} and serve
+    until killed."""
+    from ..api import S3Server
+    from ..bucket import BucketMetadataSys
+    from ..iam import IAMSys
+    from ..object.pools import ErasureServerPools
+    from ..object.sets import ErasureSets
+    from ..storage.local import LocalStorage
+    from ..utils.errors import ErrUnformattedDisk
+
+    root, n, parity = sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+    endpoints = sys.argv[5:] or [f"crash-d{i}" for i in range(n)]
+    disks = [LocalStorage(os.path.join(root, ep), endpoint=ep)
+             for ep in endpoints]
+    sets = ErasureSets(disks, n, default_parity=parity, pool_index=0)
+    try:
+        sets.load_format()
+    except ErrUnformattedDisk:
+        sets.init_format()
+    ol = ErasureServerPools([sets])
+    srv = S3Server(ol, IAMSys(ACCESS, SECRET),
+                   BucketMetadataSys(ol)).start()
+    print(json.dumps({"endpoint": srv.endpoint}), flush=True)
+    while True:  # killed by the parent (that's the scenario)
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        _serve_cli()
+    else:
+        sys.stderr.write(
+            "usage: python -m minio_tpu.faults.scenarios serve "
+            "<root> <disks> <parity> [endpoints...]\n")
+        sys.exit(2)

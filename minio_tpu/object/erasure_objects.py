@@ -1110,12 +1110,17 @@ class ErasureObjects(MultipartMixin):
             if avail_by_shard[s] is None and disks_by_shard[s] is not None
         ]
 
-        erasure = self._object_erasure(data_blocks, parity)
         tmp_id = new_uuid()
         inline = bool(ref_fi.data)
         healed_inline: dict[int, dict[int, bytes]] = {s: {} for s in stale_shards}
 
         if not ref_fi.deleted:
+            # Codec only for DATA heals: a delete-marker version carries
+            # no erasure geometry (data=parity=0) — building one would
+            # raise and leave the marker permanently un-replicable on
+            # the disks its write fan-out missed (found by the PR15
+            # chaos soak's MRF-dry invariant).
+            erasure = self._object_erasure(data_blocks, parity)
             for part in ref_fi.parts:
                 till = erasure.shard_file_offset(0, part.size, part.size)
                 readers: list = [None] * len(disks_by_shard)

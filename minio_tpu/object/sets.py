@@ -134,6 +134,26 @@ class ErasureSets:
             raise ErrUnformattedDisk("no formatted disks")
         self.deployment_id = max(ids.items(), key=lambda kv: kv[1])[0]
         self.distribution_algo = max(algos.items(), key=lambda kv: kv[1])[0]
+        self.cleanup_stale_tmp()
+
+    def cleanup_stale_tmp(self) -> int:
+        """Crash recovery on restart-over-existing-data: purge staged
+        tmp writes on every local disk (a kill -9 mid-PUT leaves its
+        tmp shards behind; nothing can own them once the process that
+        staged them is gone). Remote disks clean their own tmp when
+        THEIR node boots — each node owns its local crash debris."""
+        purged = 0
+        for disk in self.disks:
+            if disk is None:
+                continue
+            purge = getattr(disk, "purge_stale_tmp", None)
+            if purge is None:
+                continue
+            try:
+                purged += purge()
+            except Exception:  # noqa: BLE001 - best-effort boot sweep
+                continue
+        return purged
 
     @property
     def deployment_id_bytes(self) -> bytes:

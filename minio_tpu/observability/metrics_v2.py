@@ -183,6 +183,12 @@ from ..pipeline.workers import WORKER_DESCRIPTORS  # noqa: E402
 DESCRIPTORS += ADMISSION_DESCRIPTORS
 DESCRIPTORS += WORKER_DESCRIPTORS
 
+# Node-to-node RPC plane (distributed/rest.py): transient-failure
+# retry accounting for the idempotent read/probe methods.
+from ..distributed.rest import RPC_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += RPC_DESCRIPTORS
+
 
 def mrf_scoreboard(ol) -> dict:
     """One traversal of the heal/MRF scoreboard (ISSUE 14), consumed by

@@ -215,6 +215,16 @@ class AdmissionGovernor:
         self.queued_total = 0               # guarded-by: _cv
         self.rejected_queue_full = 0        # guarded-by: _cv
         self.rejected_deadline = 0          # guarded-by: _cv
+        # Conservation accounting (the chaos-soak invariant): every
+        # acquire() arrival ends granted or rejected, so
+        #   arrivals == admitted + rejected_queue_full
+        #             + rejected_deadline - late_grant_returns
+        # where late_grant_returns counts the deadline-loser race (the
+        # grant landed while the waiter was timing out; the slot is
+        # handed straight back, but both admitted and rejected were
+        # incremented for that one arrival).
+        self.arrivals_total = 0             # guarded-by: _cv
+        self.late_grant_returns = 0         # guarded-by: _cv
 
     # -- budgets -----------------------------------------------------------
 
@@ -295,6 +305,7 @@ class AdmissionGovernor:
             client = current_client()
         deadline = time.monotonic() + self.cfg.deadline_s
         with self._cv:
+            self.arrivals_total += 1
             if (self._waiting == 0 and self._inflight < self.cfg.slots
                     and self._client_has_room(client)):
                 self._grant_to(client)
@@ -346,6 +357,7 @@ class AdmissionGovernor:
         if w.granted:
             # Lost the race: the grant landed while we were timing out.
             # Hand the slot straight back so it is not leaked.
+            self.late_grant_returns += 1
             self._release_locked(w.client)
 
     def release(self, client: str | None = None) -> None:
@@ -404,6 +416,8 @@ class AdmissionGovernor:
                 "queued_total": self.queued_total,
                 "rejected_queue_full": self.rejected_queue_full,
                 "rejected_deadline": self.rejected_deadline,
+                "arrivals_total": self.arrivals_total,
+                "late_grant_returns": self.late_grant_returns,
                 "per_client_inflight": {
                     c: b.inflight for c, b in self._budgets.items()
                     if b.inflight

@@ -140,12 +140,15 @@ class Server:
         # Robustness telemetry (hedged reads, detached stragglers) and
         # dsync unlock-failure counts flow through the same hooks.
         from .distributed import dsync as _dsync
+        from .distributed import rest as _rest
         from .erasure import streaming as _streaming
         from .utils import fanout as _fanout
 
         _streaming.set_metrics(self.metrics)
         _dsync.set_metrics(self.metrics)
         _fanout.set_metrics(self.metrics)
+        # RPC transient-retry accounting (mtpu_rpc_retries_total).
+        _rest.set_metrics(self.metrics)
         # Concurrency plane: the encode/read admission governors and
         # the GIL-free worker pool mirror admitted/queued/rejected and
         # worker-health series onto the same registry (mtpu_admission_*

@@ -292,6 +292,30 @@ class LocalStorage(StorageAPI):
         except OSError as exc:
             raise ErrVolumeNotEmpty(volume) from exc
 
+    def purge_stale_tmp(self) -> int:
+        """Boot-time crash recovery (ref formatErasureCleanupTmp,
+        cmd/format-erasure.go): drop every staged write under
+        <root>/.mtpu.sys/tmp. Every entry there is a PUT/heal staging
+        dir whose owner died before its rename-commit — by the time a
+        boot path calls this, no writer can still own one. Multipart
+        uploads stage under .mtpu.sys/multipart and are NOT touched
+        (they resume across restarts). Returns entries purged."""
+        base = os.path.join(self._vol_path(SYSTEM_META_BUCKET), "tmp")
+        if not os.path.isdir(base):
+            return 0
+        purged = 0
+        for name in os.listdir(base):
+            full = os.path.join(base, name)
+            try:
+                if os.path.isdir(full):
+                    shutil.rmtree(full)
+                else:
+                    os.remove(full)
+                purged += 1
+            except OSError:
+                continue  # raced cleanup / permissions: leave for next boot
+        return purged
+
     # --- listing ---
 
     def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:

@@ -48,6 +48,12 @@ def pytest_configure(config):
         "mesh: ObjectLayer mesh-serving proofs on an 8-device "
         "host-platform subprocess (tier-1)",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak: the tier-2 production scenario gate "
+        "(minio_tpu/faults/scenarios.py engine; run with -m soak — "
+        "see docs/SOAK.md)",
+    )
 
 
 def pytest_runtest_setup(item):

@@ -339,3 +339,109 @@ def test_fast_refresh_keeps_short_expiry_lock_alive(tmp_path):
         m2.unlock()
     finally:
         srv.stop()
+
+
+# ---------- transient-failure RPC retry (idempotent reads only) ----------
+
+def _blip_restart(srv_holder, disks, port, delay_s):
+    """Restart a stopped storage plane on the same port after delay_s
+    (the blip's trailing edge), from a background thread."""
+    import threading
+    import time as _time
+
+    def back():
+        _time.sleep(delay_s)
+        srv_holder.append(
+            StorageRESTServer(disks, SECRET, "127.0.0.1", port).start()
+        )
+
+    t = threading.Thread(target=back, daemon=True)
+    t.start()
+    return t
+
+
+def test_idempotent_read_rides_out_a_blip(tmp_path, monkeypatch):
+    """A short storage-plane blip must not fail an in-flight read: the
+    one jittered-backoff retry lands after the plane is back, the call
+    succeeds, the retry is counted, and the peer is re-admitted
+    immediately (no probe-backoff wait)."""
+    from minio_tpu.distributed import rest
+
+    disk = LocalStorage(str(tmp_path / "rd"), endpoint="rd")
+    disk.make_vol("v")
+    disk.write_all("v", "x", b"survives the blip")
+    srv = StorageRESTServer([disk], SECRET).start()
+    port = srv.rpc.port
+    remote = RemoteStorage(f"127.0.0.1:{port}", "rd", SECRET,
+                           timeout=10.0)
+    assert remote.read_all("v", "x") == b"survives the blip"
+    # Deterministic ordering: the retry backoff strictly outlasts the
+    # blip, so the second attempt always finds the plane back up.
+    monkeypatch.setattr(rest, "RETRY_BACKOFF_S", (0.5, 0.6))
+    before = rest.RETRIES["total"]
+    srv.stop()
+    holder: list = []
+    t = _blip_restart(holder, [disk], port, 0.15)
+    try:
+        assert remote.read_all("v", "x") == b"survives the blip"
+        assert rest.RETRIES["total"] == before + 1
+        # Re-admitted on the spot: no 1s probe window needed.
+        assert remote.is_online()
+    finally:
+        t.join(5)
+        for s in holder:
+            s.stop()
+
+
+def test_write_is_never_retried(tmp_path):
+    """An ambiguous transport failure on a WRITE must surface, not
+    replay: the bytes may have landed before the reset."""
+    from minio_tpu.distributed import rest
+    from minio_tpu.utils.errors import ErrDiskNotFound
+
+    disk = LocalStorage(str(tmp_path / "rd"), endpoint="rd")
+    disk.make_vol("v")
+    srv = StorageRESTServer([disk], SECRET).start()
+    port = srv.rpc.port
+    remote = RemoteStorage(f"127.0.0.1:{port}", "rd", SECRET,
+                           timeout=5.0)
+    remote.write_all("v", "w", b"pre")
+    srv.stop()
+    before = rest.RETRIES["total"]
+    with pytest.raises(ErrDiskNotFound):
+        remote.write_all("v", "w", b"post")
+    assert rest.RETRIES["total"] == before  # no retry burned
+    # The same outage on a READ does consume its one retry.
+    with pytest.raises(ErrDiskNotFound):
+        remote.read_all("v", "w")
+    assert rest.RETRIES["total"] == before + 1
+
+
+def test_retry_respects_the_deadline_budget(monkeypatch):
+    """Deadline propagation: when the first failure already consumed
+    the call's budget, the retry is SKIPPED — a caller that asked for
+    `timeout` seconds never waits longer because a blip happened."""
+    from minio_tpu.distributed import rest
+
+    cli = RPCClient("127.0.0.1:1", "/mtpu/storage/v1", SECRET,
+                    timeout=0.04)  # below RETRY_MIN_BUDGET_S
+    before = rest.RETRIES["total"]
+    with pytest.raises(RPCError):
+        cli.call("ping", idempotent=True)
+    assert rest.RETRIES["total"] == before
+
+
+def test_rpc_retry_counter_mirrors_to_metrics():
+    from minio_tpu.distributed import rest
+    from minio_tpu.observability.metrics import Metrics
+
+    reg = Metrics()
+    rest.set_metrics(reg)
+    try:
+        cli = RPCClient("127.0.0.1:1", "/mtpu/storage/v1", SECRET,
+                        timeout=2.0)
+        with pytest.raises(RPCError):
+            cli.call("ping", idempotent=True)
+        assert "mtpu_rpc_retries_total 1" in reg.render_prometheus()
+    finally:
+        rest.set_metrics(None)

@@ -1,0 +1,378 @@
+"""Tier-1 proofs for the scenario engine (minio_tpu/faults/scenarios):
+plan determinism (same seed => same fault sequence and op streams),
+mini mixed-workload soaks through the real S3 handlers (clean path and
+under drive faults + admission pressure), invariant checkers that
+actually DETECT violations, the faults admin active-listing, and the
+versioned-overwrite + delete-marker + lifecycle-expiry-under-faults
+coverage. The full-size gate lives in tests/test_chaos_soak.py
+(`pytest -m soak`)."""
+
+import io
+import json
+import os
+import types
+
+import pytest
+
+from minio_tpu import faults
+from minio_tpu.faults import scenarios
+from minio_tpu.faults.scenarios import (
+    ALL_OPS,
+    BUCKET_EXP,
+    BUCKET_VER,
+    ScenarioHarness,
+    ScenarioSpec,
+    build_fault_plan,
+    client_stream,
+    inv_admission_conserved,
+    inv_expiry,
+    inv_no_loss,
+    run_scenario,
+    scenario_plan,
+)
+
+
+def _mini_spec(**kw) -> ScenarioSpec:
+    base = dict(seed=42, clients=3, ops_per_client=6, disks=4, parity=2,
+                payload_sizes=(16 << 10, 64 << 10), fault_drives=0,
+                worker_kills=0, lock_check=False)
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# determinism
+
+
+def test_plan_is_a_pure_function_of_the_seed():
+    """Same seed => identical plan (drive schedules, process events,
+    every client's op stream); different seed => different plan."""
+    a = scenario_plan(_mini_spec())
+    b = scenario_plan(_mini_spec())
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    c = scenario_plan(_mini_spec(seed=43))
+    assert json.dumps(a, sort_keys=True) != json.dumps(c, sort_keys=True)
+
+
+def test_fault_plan_composes_all_three_planes():
+    spec = _mini_spec(fault_drives=2, worker_kills=2, peer_blackouts=1,
+                      disks=8, parity=4)
+    plan = build_fault_plan(spec, [f"soak-d{i}" for i in range(8)])
+    assert len(plan["drive_schedules"]) == 2
+    kinds = [e["kind"] for e in plan["events"]]
+    assert kinds.count("worker_kill") == 2
+    assert kinds.count("peer_blackout") == 1
+    # Events are ordered by trigger op: the fault SEQUENCE is total.
+    ats = [e["at_op"] for e in plan["events"]]
+    assert ats == sorted(ats)
+
+
+def test_streams_cover_every_op_class_at_gate_scale():
+    """At the soak gate's default scale every op class appears — the
+    acceptance criterion's 'all op classes' is a property of the plan,
+    checkable without running anything."""
+    spec = ScenarioSpec(seed=1337, clients=8, ops_per_client=10)
+    ops = {o["op"] for c in range(spec.clients)
+           for o in client_stream(spec, c)}
+    assert ops == set(ALL_OPS)
+
+
+# ---------------------------------------------------------------------------
+# mini soaks (the engine end to end, tier-1 sized)
+
+
+def test_mini_soak_clean_path(tmp_path):
+    """No faults armed: every op succeeds, every invariant holds, and
+    the ioflow clean-path equality (put writes == (k+m)/k x payload)
+    is enforced by the gate itself."""
+    res = run_scenario(_mini_spec(seed=5, clients=2, ops_per_client=5,
+                                  payload_sizes=(32 << 10,)),
+                       str(tmp_path))
+    art = res.to_dict()
+    assert res.passed, json.dumps(art, indent=2)
+    assert art["drive_faults_fired"] == 0
+    failed = {op: c["failed"] for op, c in res.counts.items()
+              if isinstance(c, dict) and c.get("failed")}
+    assert not failed, failed
+
+
+def test_mini_soak_under_faults_and_pressure(tmp_path):
+    """Drive faults on one drive + a 2-slot admission squeeze: ops may
+    legally fail, but every invariant — no loss at quorum, MRF dry,
+    pools settled, admission conservation, ledger reconciliation —
+    holds at drain."""
+    res = run_scenario(
+        _mini_spec(fault_drives=1, admission_slots=2, worker_kills=1),
+        str(tmp_path),
+    )
+    assert res.passed, json.dumps(res.to_dict(), indent=2)
+    # The schedule really fired (deterministic for this seed: every op
+    # makes dozens of disk calls against p≈0.2 specs on the victim).
+    assert res.to_dict()["drive_faults_fired"] > 0
+
+
+def test_artifact_shape_and_replay_plan(tmp_path):
+    """The failure artifact is self-contained: JSON-able, and its
+    embedded plan equals a fresh build from the same spec (the
+    seed-replay workflow of docs/SOAK.md)."""
+    spec = _mini_spec(seed=9, clients=2, ops_per_client=4)
+    res = run_scenario(spec, str(tmp_path))
+    art = json.loads(json.dumps(res.to_dict()))
+    for key in ("passed", "plan", "counts", "fault_log", "violations",
+                "wall_s", "bytes_moved", "throughput_gbps",
+                "verify_requeued", "drive_faults_fired"):
+        assert key in art, key
+    fresh = scenario_plan(_mini_spec(seed=9, clients=2, ops_per_client=4))
+    assert json.dumps(art["plan"], sort_keys=True) == \
+        json.dumps(fresh, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# the invariants detect violations (not just pass on good runs)
+
+
+def test_no_loss_invariant_detects_quorum_loss(tmp_path):
+    """Destroy more shards than parity behind the engine's back: the
+    no-loss checker must flag the object, not shrug."""
+    spec = _mini_spec()
+    h = ScenarioHarness(str(tmp_path), spec)
+    try:
+        body = b"\xabQ" * 40_000
+        st, _, _ = h.request("PUT", "/soak/c0/victim", body=body)
+        assert st == 200
+        oracle = scenarios._Oracle()
+        oracle.commit("soak", "c0/victim", body)
+        assert inv_no_loss(h, oracle) == []
+        killed = 0
+        for d in h.raw_disks:
+            try:
+                fi = d.read_version("soak", "c0/victim")
+            except Exception:  # noqa: BLE001 - no copy here
+                continue
+            part = os.path.join(str(tmp_path), d.endpoint(), "soak",
+                                "c0/victim", fi.data_dir, "part.1")
+            if os.path.exists(part):
+                os.remove(part)
+                killed += 1
+        assert killed > spec.parity
+        violations = inv_no_loss(h, oracle)
+        assert violations and "c0/victim" in violations[0]
+    finally:
+        h.close()
+
+
+def test_expiry_invariant_detects_unfreed_shards(tmp_path):
+    """An 'expired' object whose part files survive must be flagged:
+    expiry has to reclaim bytes, not just hide keys."""
+    h = ScenarioHarness(str(tmp_path), _mini_spec())
+    try:
+        body = b"\x11" * 50_000
+        st, _, _ = h.request("PUT", f"/{BUCKET_EXP}/exp/c0/e0", body=body)
+        assert st == 200
+        oracle = scenarios._Oracle()
+        oracle.expiring[(BUCKET_EXP, "exp/c0/e0")] = body
+        violations = inv_expiry(h, oracle)
+        # Not expired yet: both the 200 GET and the on-disk part files
+        # must fire.
+        assert any("want 404" in v for v in violations)
+        assert any("part file" in v for v in violations)
+        h.scanner.scan_cycle()
+        assert inv_expiry(h, oracle) == []
+    finally:
+        h.close()
+
+
+def test_admission_conservation_identity_and_detection():
+    """The conservation identity holds on a real governor under grant /
+    queue-full-reject traffic, and a tampered counter is detected."""
+    from minio_tpu.pipeline.admission import (
+        AdmissionConfig,
+        AdmissionGovernor,
+    )
+    from minio_tpu.utils.errors import ErrOperationTimedOut
+
+    gov = AdmissionGovernor(AdmissionConfig(
+        slots=1, per_client_cap=1, max_queue=0, deadline_s=0.05))
+    gov.acquire("a")
+    with pytest.raises(ErrOperationTimedOut):
+        gov.acquire("b")  # queue-full fast reject
+    gov.release("a")
+    s = gov.snapshot()
+    assert s["arrivals_total"] == 2
+    assert (s["admitted_total"] + s["rejected_queue_full"]
+            + s["rejected_deadline"] - s["late_grant_returns"]) == 2
+    fake_h = types.SimpleNamespace(governor=gov, read_governor=gov)
+    assert inv_admission_conserved(fake_h, None) == []
+    gov.admitted_total += 1  # a leaked grant
+    violations = inv_admission_conserved(fake_h, None)
+    assert violations and "admission" in violations[0]
+
+
+# ---------------------------------------------------------------------------
+# faults admin: active listing with remaining-trigger counts
+
+
+def test_faults_admin_active_listing(tmp_path):
+    """GET /minio/admin/v3/faults?active=true lists currently-armed
+    schedules with per-spec fired and remaining-trigger counts — the
+    mid-run fault-plane verification."""
+    h = ScenarioHarness(str(tmp_path), _mini_spec())
+    try:
+        faults.arm("soak-d1", {"seed": 3, "specs": [
+            {"kind": "error", "calls": [4, 5, 6],
+             "error": "ErrDiskNotFound"},
+            {"kind": "latency", "probability": 0.5, "latency_s": 0.001},
+        ]})
+        st, _, raw = h.request("GET", "/minio/admin/v3/faults",
+                               query=[("active", "true")])
+        assert st == 200
+        armed = json.loads(raw)["armed"]
+        assert "soak-d1" in armed
+        specs = armed["soak-d1"]["specs"]
+        assert specs[0]["remaining"] == 3   # scripted: finite countdown
+        assert specs[1]["remaining"] is None  # probabilistic: unbounded
+        # Burn calls through the armed disk; remaining drains.
+        disk = h.raw_disks[1]
+        fd = faults.FaultDisk(disk)  # registry-driven by endpoint
+        for _ in range(10):
+            try:
+                fd.stat_vol("soak")
+            except Exception:  # noqa: BLE001 - injected, expected
+                pass
+        st, _, raw = h.request("GET", "/minio/admin/v3/faults",
+                               query=[("active", "true")])
+        specs = json.loads(raw)["armed"]["soak-d1"]["specs"]
+        assert specs[0]["remaining"] == 0
+        assert specs[0]["fired"] == 3
+        # Disarmed schedules drop from the active view but stay in the
+        # unfiltered one until replaced.
+        faults.disarm("soak-d1")
+        st, _, raw = h.request("GET", "/minio/admin/v3/faults",
+                               query=[("active", "true")])
+        assert json.loads(raw)["armed"] == {}
+    finally:
+        faults.disarm()
+        h.close()
+
+
+def test_heal_replicates_a_delete_marker(tmp_path):
+    """Regression (found by the soak's MRF-dry invariant): healing a
+    delete-marker version must replicate the marker to the disks its
+    write fan-out missed — not crash building a 0x0 erasure codec and
+    leave the marker permanently un-replicable."""
+    from minio_tpu.object.erasure_objects import ErasureObjects
+    from minio_tpu.object.types import ObjectOptions
+    from minio_tpu.storage.local import LocalStorage
+
+    disks = [LocalStorage(str(tmp_path / f"d{i}"), endpoint=f"d{i}")
+             for i in range(4)]
+    for d in disks:
+        d.make_vol(".minio.sys")
+    es = ErasureObjects(disks)
+    es.make_bucket("vb")
+    body = b"\x42" * 200_000
+    es.put_object("vb", "doc", io.BytesIO(body), len(body),
+                  ObjectOptions(versioned=True))
+    # One disk misses the marker write (offline during the delete).
+    es.disks[3] = None
+    oi = es.delete_object("vb", "doc", ObjectOptions(versioned=True))
+    assert oi.delete_marker and oi.version_id
+    es.disks[3] = disks[3]
+    with pytest.raises(Exception):
+        disks[3].read_version("vb", "doc", oi.version_id)
+    res = es.heal_object("vb", "doc", oi.version_id)
+    assert disks[3].endpoint() in res["healed"], res
+    fi = disks[3].read_version("vb", "doc", oi.version_id)
+    assert fi.deleted, "healed marker lost its tombstone bit"
+
+
+# ---------------------------------------------------------------------------
+# satellite: versioned overwrite + delete marker + lifecycle expiry
+# UNDER injected drive faults
+
+
+def test_versioned_lifecycle_under_drive_faults(tmp_path):
+    """Lifecycle was only ever proven on healthy disks. With a seeded
+    error/latency schedule armed on one drive: (a) no version loss at
+    quorum — every surviving version reads back byte-identical,
+    (b) the delete marker hides the key, (c) the noncurrent-expired
+    version is GONE and its shard part files are actually freed."""
+    from minio_tpu.object.types import ObjectOptions
+
+    spec = _mini_spec()
+    h = ScenarioHarness(str(tmp_path), spec)
+    sched = None
+    try:
+        day_ns = 86_400 * 10**9
+        now = __import__("time").time_ns()
+        v1 = b"\x01v1" * 30_000
+        v2 = b"\x02v2" * 30_000
+        # Backdated versions via the object layer (mod_time is not an
+        # S3-API surface), THROUGH the wrapped (faultable) disks.
+        oi1 = h.ol.put_object(
+            BUCKET_VER, "doc", io.BytesIO(v1), len(v1),
+            ObjectOptions(versioned=True, mod_time_ns=now - 3 * day_ns),
+        )
+        oi2 = h.ol.put_object(
+            BUCKET_VER, "doc", io.BytesIO(v2), len(v2),
+            ObjectOptions(versioned=True, mod_time_ns=now - 1 * day_ns),
+        )
+        # Noncurrent expiry after 1 day on the versioned bucket.
+        lc = (b'<LifecycleConfiguration><Rule><ID>nc</ID>'
+              b'<Status>Enabled</Status><Filter><Prefix></Prefix>'
+              b'</Filter><NoncurrentVersionExpiration>'
+              b'<NoncurrentDays>1</NoncurrentDays>'
+              b'</NoncurrentVersionExpiration></Rule>'
+              b'</LifecycleConfiguration>')
+        st, _, _ = h.request("PUT", f"/{BUCKET_VER}",
+                             query=[("lifecycle", "")], body=lc)
+        assert st == 200
+
+        # NOW arm the chaos: seeded error + latency on one drive.
+        fd = h.fault_disks[1]
+        sched = fd.arm({"seed": 77, "specs": [
+            {"kind": "latency", "probability": 0.1, "latency_s": 0.01},
+            {"kind": "error", "probability": 0.06,
+             "error": "ErrDiskNotFound"},
+        ]})
+
+        # Delete marker lands under faults (versioned DELETE).
+        st, _, _ = h.request("DELETE", f"/{BUCKET_VER}/doc")
+        assert st in (200, 204)
+        # No version loss at quorum BEFORE the sweep: both versions
+        # read back byte-identical through the fault schedule.
+        for vid, want in ((oi1.version_id, v1), (oi2.version_id, v2)):
+            st, _, got = h.request("GET", f"/{BUCKET_VER}/doc",
+                                   query=[("versionId", vid)])
+            assert st == 200 and got == want, f"version {vid} lost"
+        # Plain GET: the marker hides the key.
+        st, _, _ = h.request("GET", f"/{BUCKET_VER}/doc")
+        assert st == 404
+
+        # The sweep runs UNDER the same fault schedule. v1 became
+        # noncurrent 1 day ago (v2's mod time): expired. v2 became
+        # noncurrent when the marker landed (now): survives.
+        h.scanner.scan_cycle()
+
+        st, _, got = h.request("GET", f"/{BUCKET_VER}/doc",
+                               query=[("versionId", oi2.version_id)])
+        assert st == 200 and got == v2, "surviving version lost"
+        st, _, _ = h.request("GET", f"/{BUCKET_VER}/doc",
+                             query=[("versionId", oi1.version_id)])
+        assert st == 404, "expired version still readable"
+        # The expired version's shard files are actually freed: no
+        # disk holds more than one data dir for the key.
+        for d in h.raw_disks:
+            obj_dir = os.path.join(str(tmp_path), d.endpoint(),
+                                   BUCKET_VER, "doc")
+            if not os.path.isdir(obj_dir):
+                continue
+            data_dirs = [e for e in os.listdir(obj_dir)
+                         if os.path.isdir(os.path.join(obj_dir, e))]
+            assert len(data_dirs) <= 1, (
+                f"{d.endpoint()}: expired version's shards not freed: "
+                f"{data_dirs}")
+    finally:
+        if sched is not None:
+            sched.disarm()
+        h.close()

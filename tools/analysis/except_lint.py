@@ -34,6 +34,11 @@ SCOPES = (
     "minio_tpu/pipeline/",
     "minio_tpu/observability/spans.py",
     "minio_tpu/parallel/mesh_engine.py",
+    # Added with ISSUE 15: the fault/scenario plane — a scenario engine
+    # that silently drops an op failure reports a soak as green that
+    # was not, and the injector's own swallowed errors hide armed
+    # faults from the drill they were meant to drive.
+    "minio_tpu/faults/",
 )
 
 _BROAD = {"Exception", "BaseException"}

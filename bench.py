@@ -1398,6 +1398,108 @@ def bench_soak(root: str) -> dict:
     }
 
 
+def bench_codec_sweep() -> dict:
+    """Per-codec encode/decode/heal throughput through the registry's
+    matrices on the strongest host kernel (ISSUE 16): every registered
+    codec x the canonical geometries, each op under the min-of-3
+    memcpy-normalized repeatability protocol. All codecs ride the SAME
+    native any-matrix kernel, so the sweep isolates what the codec
+    itself costs: matrix derivation is excluded (derived once, like the
+    steady-state caches), the applications are what stream per byte.
+    The cauchy entry also records its XOR-schedule accounting (xor
+    count, CSE savings) per geometry — the numbers the bit-matrix
+    literature (GT13) predicts wins from on XOR-only hardware.
+
+    The schedule-interpreted numpy path and a worker-shm A/B need
+    cores to mean anything; on a 1-core container those entries say
+    {"skipped"} honestly rather than publishing a fake comparison."""
+    from minio_tpu.erasure import registry
+    from minio_tpu.ops import gf_native
+
+    geometries = ((2, 2), (8, 4), (12, 4))
+    shard = 1 << 20
+    batch = 4
+    native_ok = gf_native.available()
+    out: dict = {
+        "shard_bytes": shard,
+        "batch": batch,
+        "engine": "native" if native_ok else "numpy",
+        "codecs": {},
+    }
+    rng = np.random.default_rng(0xC0DEC)
+
+    def apply_rate(mat, blocks, entry):
+        """GB/s of input shard bytes through one matrix application."""
+        if native_ok:
+            fn = lambda: gf_native.apply_matrix_batch(mat, blocks)  # noqa: E731
+        else:
+            fn = lambda: entry.host_apply(mat, blocks)  # noqa: E731
+        fn()  # warm (kernel tables, schedule compilation)
+        t0 = time.perf_counter()
+        fn()
+        return blocks.nbytes / (time.perf_counter() - t0) / 1e9
+
+    for cid in registry.codec_ids():
+        entry = registry.get(cid)
+        per_geo = {}
+        for k, m in geometries:
+            blocks = rng.integers(0, 256, size=(batch, k, shard),
+                                  dtype=np.uint8)
+            n_lost = min(2, k, m)
+            lost = list(range(n_lost))
+            present = [i for i in range(k + m) if i not in lost][:k]
+            mats = {
+                "encode": entry.parity_matrix(k, m),
+                # decode: rebuild the lost data shards from k survivors.
+                "decode": entry.reconstruct_matrix(k, m, present, lost),
+                # heal: the lost data plus one parity shard, the shape
+                # a 2-down heal actually dispatches.
+                "heal": entry.reconstruct_matrix(k, m, present,
+                                                 lost + [k]),
+            }
+            geo = {}
+            for op, mat in mats.items():
+                geo[op] = _config_protocol(
+                    lambda i, mat=mat: apply_rate(mat, blocks, entry),
+                    "max",
+                )
+            if entry.schedule_stats is not None:
+                geo["schedule"] = entry.schedule_stats(mats["encode"])
+            per_geo[f"{k}+{m}"] = geo
+        out["codecs"][cid] = per_geo
+
+    single_core = (os.cpu_count() or 1) < 2
+    if single_core:
+        out["numpy_schedule_ab"] = {
+            "skipped": "single-core host: the schedule-interpreted "
+                       "numpy path is GIL-bound here; an A/B against "
+                       "native would measure the interpreter, not the "
+                       "XOR schedule"
+        }
+        out["worker_shm_ab"] = {
+            "skipped": "single-core host: the worker pool refuses to "
+                       "arm (children would compete with the driver "
+                       "for the one core)",
+            "owed": "multicore round: per-codec worker-shm encode A/B "
+                    "vs in-process native",
+        }
+    else:
+        probe = {}
+        for cid in registry.codec_ids():
+            probe[cid] = _config_protocol(
+                lambda i, cid=cid: registry.probe_geometry_gbps.__wrapped__(
+                    cid, 8, 4
+                ),
+                "max",
+            )
+        out["numpy_schedule_ab"] = probe
+        out["worker_shm_ab"] = {
+            "owed": "wire the pool-armed per-codec A/B when a "
+                    "multicore round runs"
+        }
+    return out
+
+
 def bench_analysis_gate() -> dict:
     """Wall-time of the tier-1 static-analysis gate (tools/analysis).
     The scan runs on every CI pass, so its cost rides along with the
@@ -1586,6 +1688,12 @@ def main() -> None:
         _cleanup(soak_root)
     except Exception as exc:  # noqa: BLE001 - diagnostics
         result["soak"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Codec registry sweep (ISSUE 16): encode/decode/heal per codec x
+    # geometry, plus the cauchy XOR-schedule accounting.
+    try:
+        result["codec_sweep"] = bench_codec_sweep()
+    except Exception as exc:  # noqa: BLE001 - diagnostics
+        result["codec_sweep"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis gate cost (tools/analysis): tracked so the tier-1
     # scan stays visibly cheap.
     try:

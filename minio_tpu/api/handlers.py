@@ -1223,6 +1223,25 @@ class S3ApiHandlers:
                     "InvalidArgument", f"bad storage class spec {spec!r}"
                 ) from exc
 
+    def _apply_codec(self, ctx, opts):
+        """x-mtpu-codec → forced erasure codec id (the top of the
+        erasure/registry.py selection precedence). Validated HERE so an
+        unknown id rejects the request before any byte streams; "auto"
+        explicitly re-enables the measured-probe selection even when
+        MTPU_CODEC forces a codec server-wide."""
+        cid = ctx.headers.get("x-mtpu-codec", "")
+        if not cid:
+            return
+        from ..erasure import registry
+
+        if cid != "auto" and cid not in registry.codec_ids():
+            raise S3Error(
+                "InvalidArgument",
+                f"unknown erasure codec {cid!r} "
+                f"(registered: {sorted(registry.codec_ids())} or auto)",
+            )
+        opts.codec = cid
+
     def put_object(self, ctx) -> Response:
         if not valid_object_name(ctx.object):
             raise S3Error("InvalidArgument", f"bad object name {ctx.object!r}")
@@ -1247,6 +1266,7 @@ class S3ApiHandlers:
             opts.user_defined[self.TAGS_META_KEY] = \
                 urllib.parse.urlencode(tags)
         self._apply_storage_class(ctx, opts)
+        self._apply_codec(ctx, opts)
         self._apply_object_lock(ctx, opts)
         try:
             self.quota.check(ctx.bucket, size)
@@ -1958,6 +1978,7 @@ class S3ApiHandlers:
             opts.user_defined[self.TAGS_META_KEY] = \
                 urllib.parse.urlencode(tags)
         self._apply_storage_class(ctx, opts)
+        self._apply_codec(ctx, opts)
         # Multipart objects get the same lock treatment as single PUTs
         # (ref NewMultipartUploadHandler lock-header wiring).
         self._apply_object_lock(ctx, opts)

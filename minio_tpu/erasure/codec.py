@@ -28,101 +28,48 @@ from ..utils.errors import (
     ErrShortData,
     ErrTooFewShards,
 )
+from . import registry
 
-# Below this shard size the fixed JAX dispatch cost dominates; stay on the
-# host bit-matmul path. Above it, ship batches to the accelerator.
-_DEVICE_SHARD_THRESHOLD = 4096
+# Back-compat alias — the threshold now lives with the selection policy.
+_DEVICE_SHARD_THRESHOLD = registry.DEVICE_SHARD_THRESHOLD
 
 
-def _select_engine(shard_len: int, total_shards: int | None = None) -> str:
+def _select_engine(shard_len: int, total_shards: int | None = None,
+                   codec: str = registry.DEFAULT_CODEC) -> str:
     """Pick the GF engine for one application:
     'native' | 'device' | 'mesh' | 'numpy'.
 
-    MTPU_ENCODE_ENGINE forces it (auto|device|mesh|native|numpy). The
-    'auto' policy is measurement-driven (round 3, single-core host +
-    tunneled v5e): the native GFNI/SSSE3 engine sustains 9-13 GB/s
-    host-fed, the MXU kernel 28+ GB/s device-resident but every
-    available TPU attachment moves host bytes at only 0.3-0.6 GB/s, so
-    for HOST-SOURCED streams (the PutObject path — data arrives over
-    HTTP into host memory) the native engine wins by >10x end to end.
-    Deployments with a co-located chip (PCIe H2D >> encode rate) should
-    set MTPU_ENCODE_ENGINE=device; the full async batched pipeline
-    (erasure/streaming.py) ships unchanged and is benched by bench.py.
-
-    The mesh engine (parallel/mesh_engine.py) serves when the caller
-    supplies the geometry (`total_shards` = k+m, which must divide over
-    the mesh's lane axis) AND a multi-device mesh exists:
-    MTPU_ENCODE_ENGINE=mesh forces it (including on virtual CPU meshes
-    — the CI path); 'auto' self-selects it only on an already-up
-    multi-device ACCELERATOR backend with no native SIMD engine, never
-    on CPU virtual devices (collective dispatch there costs latency
-    with no parallel hardware; see parallel/placement.mesh_fit).
-    Callers that cannot name the geometry (the one-shot host helpers)
-    never route to the mesh.
-
-    The decision is re-read per call (tests flip the env vars) but the
-    resolution itself is memoized: the object layer asks once per block
-    batch, and the env/mesh probes are the only parts that may change.
+    Thin shim over the codec registry's selector (erasure/registry.py),
+    which replaced the engine if-chain that used to live here: candidates
+    are gated by (capability, geometry, availability) and ranked by
+    measured throughput, with MTPU_ENCODE_ENGINE preserved as the forced
+    override. See registry.select_engine for the full policy.
     """
-    import os
-
-    from ..ops import gf_native
-
-    eng = os.environ.get("MTPU_ENCODE_ENGINE", "auto")
-    if eng == "mesh" or (eng == "auto" and total_shards):
-        from ..parallel import placement
-
-        mesh_fit = placement.mesh_fit(total_shards, explicit=eng == "mesh")
-    else:
-        mesh_fit = False
-    return _select_engine_memo(
-        eng,
-        shard_len >= _DEVICE_SHARD_THRESHOLD,
-        gf_native.available(),
-        mesh_fit,
-    )
+    return registry.select_engine(shard_len, total_shards, codec)
 
 
 @functools.lru_cache(maxsize=64)
-def _select_engine_memo(eng: str, device_sized: bool, native_ok: bool,
-                        mesh_fit: bool = False) -> str:
-    if eng == "numpy":
-        return "numpy"
-    if eng == "native":
-        return "native" if native_ok else "numpy"
-    if eng == "mesh":
-        if mesh_fit and device_sized:
-            return "mesh"
-        return "native" if native_ok else "numpy"
-    if eng == "device":
-        if device_sized:
-            return "device"
-        return "native" if native_ok else "numpy"
-    if native_ok:
-        return "native"
-    if mesh_fit and device_sized:
-        return "mesh"
-    if device_sized:
-        return "device"
-    return "numpy"
-
-
-@functools.lru_cache(maxsize=64)
-def cached_erasure(data_blocks: int, parity_blocks: int,
-                   block_size: int) -> "Erasure":
+def cached_erasure(data_blocks: int, parity_blocks: int, block_size: int,
+                   codec: str = registry.DEFAULT_CODEC) -> "Erasure":
     """Geometry-keyed Erasure cache: an erasure set re-derives the same
     coding/bit matrices on every PUT when it constructs a fresh Erasure
     per object (the c5 pool-batched-PUT setup cost). Erasure instances
     are stateless after __init__ apart from the lazily device-put parity
-    bit-matrix (a benign idempotent race), so sharing one per geometry
-    across PUT/GET/heal is safe."""
-    return Erasure(data_blocks, parity_blocks, block_size)
+    bit-matrix (a benign idempotent race), so sharing one per
+    (geometry, codec) across PUT/GET/heal is safe."""
+    return Erasure(data_blocks, parity_blocks, block_size, codec)
 
 
 class Erasure:
-    """Erasure coding engine for one (data, parity, block_size) geometry."""
+    """Erasure coding engine for one (data, parity, block_size, codec)
+    geometry. The codec id names a registry entry (erasure/registry.py)
+    whose matrix constructors supply the coding algebra; every engine
+    substrate applies those byte matrices through its existing
+    any-matrix kernel, so all substrates stay byte-identical per codec.
+    """
 
-    def __init__(self, data_blocks: int, parity_blocks: int, block_size: int):
+    def __init__(self, data_blocks: int, parity_blocks: int,
+                 block_size: int, codec: str = registry.DEFAULT_CODEC):
         # Parameter checks mirror NewErasure (cmd/erasure-coding.go:41-49).
         if data_blocks <= 0 or parity_blocks <= 0:
             raise ErrInvShardNum(
@@ -136,9 +83,13 @@ class Erasure:
         self.parity_blocks = parity_blocks
         self.block_size = block_size
         self.total_shards = data_blocks + parity_blocks
-        # Host-side byte matrices (lru-cached module-level).
-        self.matrix = gf.rs_matrix(data_blocks, parity_blocks)
-        self._parity_mat = gf.parity_matrix(data_blocks, parity_blocks)
+        self.codec_id = codec
+        self._entry = registry.get(codec)  # loud on unknown codec ids
+        # Host-side byte matrices (lru-cached per codec module).
+        self.matrix = self._entry.coding_matrix(data_blocks, parity_blocks)
+        self._parity_mat = self._entry.parity_matrix(
+            data_blocks, parity_blocks
+        )
         self._parity_bits_np = gf.bit_matrix_for(self._parity_mat)
         self._parity_bits_dev = None  # lazily device_put on first large encode
 
@@ -188,7 +139,8 @@ class Erasure:
         precomputed GF(2) expansions for the numpy/device paths."""
         from ..ops import gf_native
 
-        engine = _select_engine(shards.shape[-1])
+        engine = _select_engine(shards.shape[-1], codec=self.codec_id)
+        registry.note_dispatch(self.codec_id, engine)
         if engine == "native":
             if shards.ndim == 3:
                 return gf_native.apply_matrix_batch(mat_gf, shards)
@@ -198,12 +150,14 @@ class Erasure:
             if bits is None:
                 bits = bits_np if bits_np is not None else gf.bit_matrix_for(mat_gf)
             return np.asarray(rs.apply_gf_matrix(bits, shards))
-        bits = bits_np if bits_np is not None else gf.bit_matrix_for(mat_gf)
-        return rs.gf_matmul_shards_np(bits, shards)
+        # Host fallback: the codec's own numpy realization (dense GF(2)
+        # bit-matmul, or the Cauchy XOR schedule).
+        return self._entry.host_apply(mat_gf, shards)
 
     def _apply_parity(self, shards: np.ndarray) -> np.ndarray:
         on_device = (
-            _select_engine(shards.shape[-1]) == "device"
+            _select_engine(shards.shape[-1], codec=self.codec_id)
+            == "device"
         )
         return self._apply(
             self._parity_mat,
@@ -280,7 +234,9 @@ class Erasure:
         )
         if not staged_on_device:
             blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
-        engine = _select_engine(blocks.shape[-1], self.total_shards)
+        engine = _select_engine(blocks.shape[-1], self.total_shards,
+                                self.codec_id)
+        registry.note_dispatch(self.codec_id, engine)
         if staged_on_device and engine not in ("device", "mesh"):
             blocks = np.asarray(blocks)  # tiny-shard fallback: host engines
         if engine == "native":
@@ -291,7 +247,7 @@ class Erasure:
 
             return gf_native.apply_matrix_batch(self._parity_mat, blocks), None
         if engine == "numpy":
-            parity = rs.gf_matmul_shards_np(self._parity_bits_np, blocks)
+            parity = self._entry.host_apply(self._parity_mat, blocks)
             return parity, None
         if engine == "mesh":
             # Lane-sharded mesh dispatch: same fused parity+digest
@@ -299,11 +255,13 @@ class Erasure:
             # ('dp', 'lane') mesh instead of one chip.
             from ..parallel.mesh_engine import for_geometry as mesh_geometry
 
-            codec = mesh_geometry(self.data_blocks, self.parity_blocks)
+            codec = mesh_geometry(self.data_blocks, self.parity_blocks,
+                                  self.codec_id)
             return codec.encode_async(blocks, with_hashes)
         from .device_engine import for_geometry
 
-        codec = for_geometry(self.data_blocks, self.parity_blocks)
+        codec = for_geometry(self.data_blocks, self.parity_blocks,
+                             self.codec_id)
         return codec.encode_async(blocks, with_hashes)
 
     # --- reconstruct / decode (cmd/erasure-coding.go:95-118) ---
@@ -360,7 +318,7 @@ class Erasure:
             return shards
 
         try:
-            mat = gf.reconstruct_matrix(
+            mat = self._entry.reconstruct_matrix(
                 self.data_blocks, self.parity_blocks, present, missing
             )
         except ValueError as exc:
@@ -395,7 +353,7 @@ class Erasure:
             if len(shards[i]) != shard_len:
                 raise ErrShardSize("present shards differ in size")
         try:
-            mat = gf.reconstruct_matrix(
+            mat = self._entry.reconstruct_matrix(
                 self.data_blocks, self.parity_blocks, present, targets
             )
         except ValueError as exc:

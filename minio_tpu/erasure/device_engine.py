@@ -124,13 +124,17 @@ class DeviceCodec:
     matrices.
     """
 
-    def __init__(self, data_blocks: int, parity_blocks: int):
+    def __init__(self, data_blocks: int, parity_blocks: int,
+                 codec: str | None = None):
         from ..ops import gf
+        from . import registry
 
         self.k = data_blocks
         self.m = parity_blocks
+        self.codec_id = codec or registry.DEFAULT_CODEC
+        self._entry = registry.get(self.codec_id)
         self._parity_bits_np = gf.bit_matrix_for(
-            gf.parity_matrix(data_blocks, parity_blocks)
+            self._entry.parity_matrix(data_blocks, parity_blocks)
         )
         self._lock = threading.Lock()
         self._dev_mats: dict = {}  # key -> device-resident bit-matrix
@@ -228,8 +232,8 @@ class DeviceCodec:
         from ..ops import gf
 
         return gf.bit_matrix_for(
-            gf.reconstruct_matrix(self.k, self.m, list(present),
-                                  list(targets))
+            self._entry.reconstruct_matrix(self.k, self.m, list(present),
+                                           list(targets))
         )
 
     def reconstruct_async(self, src, present, targets,
@@ -272,8 +276,9 @@ class DeviceCodec:
 
 
 @functools.lru_cache(maxsize=64)
-def for_geometry(data_blocks: int, parity_blocks: int) -> DeviceCodec:
-    """The geometry-keyed codec cache: every PUT/heal of the same
-    erasure set shares one codec — one set of compiled functions, one
-    device-resident parity matrix."""
-    return DeviceCodec(data_blocks, parity_blocks)
+def for_geometry(data_blocks: int, parity_blocks: int,
+                 codec: str | None = None) -> DeviceCodec:
+    """The (geometry, codec)-keyed codec cache: every PUT/heal of the
+    same erasure set shares one codec — one set of compiled functions,
+    one device-resident parity matrix."""
+    return DeviceCodec(data_blocks, parity_blocks, codec)

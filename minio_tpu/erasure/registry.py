@@ -1,0 +1,435 @@
+"""Codec registry: the pluggable erasure-codec subsystem (ROADMAP item
+1). Every codec is a CodecEntry declaring
+
+- **identity** — a stable string id persisted per object in xl.meta
+  (storage/fileinfo.ErasureInfo.codec, wire key "cid") plus the wire
+  `algo` string, so decode/heal always reconstruct with the codec that
+  encoded;
+- **capability** — the matrix constructors (coding / parity /
+  reconstruct), the host-side numpy realization, and the engine
+  substrates the codec can serve on (native / device / mesh /
+  worker-shm / numpy);
+- **geometry** — a predicate over (k, m);
+- **measured throughput** — a tiny min-of-N encode probe per host
+  engine (device/mesh carry declared host-feed rate bounds: the r03
+  measurement showed every available TPU attachment feeds host bytes
+  at well under 1 GB/s, which bounds host-sourced service regardless
+  of MXU rate).
+
+Engine selection (`select_engine`) replaces the four-way if-chain that
+used to live in erasure/codec.py: candidates are gated by availability
+(native lib present, mesh fit, device-sized shards) intersected with
+the entry's substrates, then ranked by throughput — measured for host
+engines, the declared feed bound for device/mesh. `MTPU_ENCODE_ENGINE`
+remains the forced override with the legacy fallback ladder (a forced
+engine that is unavailable degrades to native, then numpy).
+
+Codec selection (`select_codec`) picks the codec id a PUT stamps into
+xl.meta: `MTPU_CODEC` forces one; `auto` keeps the dense incumbent
+unless a challenger's measured encode beats it by the hysteresis margin
+on that geometry (both ship the same native kernel today, so dense
+stays the default and golden vectors are untouched).
+
+This module must stay importable without jax: metrics_v2 imports
+CODEC_DESCRIPTORS at catalog build, and the worker-pool children
+resolve codec matrices through it in jax-free interpreters.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..ops import cauchy, gf
+
+# Stable per-object codec identities — PERSISTED in xl.meta; renaming
+# one orphans every object written under it.
+DENSE_GF8 = "dense-gf8"
+CAUCHY_XOR = "cauchy-xor"
+
+# Default codec: what an absent "cid" field in pre-registry metadata
+# means, and the auto-selection incumbent.
+DEFAULT_CODEC = DENSE_GF8
+
+# Below this shard size the fixed JAX dispatch cost dominates; stay on
+# the host engines. Above it, device/mesh candidates become available.
+DEVICE_SHARD_THRESHOLD = 4096
+
+# A challenger codec must beat the incumbent's measured encode by this
+# factor to win auto-selection — both current entries ride the same
+# native kernel, so the margin keeps the default stable against
+# measurement noise on a shared 1-core container.
+AUTO_HYSTERESIS = 1.25
+
+CODEC_DESCRIPTORS: list[tuple[str, str, str]] = [
+    ("mtpu_codec_selected_total", "counter",
+     "Codec selections at write time, labeled codec + geometry (k+m)"),
+    ("mtpu_codec_dispatch_total", "counter",
+     "Erasure batch dispatches, labeled codec + engine substrate"),
+    ("mtpu_codec_probe_gbps", "gauge",
+     "Measured codec probe throughput (GB/s), labeled codec + engine"),
+]
+
+_metrics = None  # guarded-by: _metrics_mu
+_metrics_mu = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    global _metrics
+    with _metrics_mu:
+        _metrics = registry
+
+
+def _reg():
+    with _metrics_mu:
+        return _metrics
+
+
+@dataclass(frozen=True)
+class CodecEntry:
+    """One registered codec: identity + capabilities + matrix algebra +
+    host realization + throughput model. Matrix constructors return the
+    same shapes as the ops/gf dense helpers ((k+m, k) full, (m, k)
+    parity, (targets, k) reconstruct) so every engine substrate consumes
+    any registered codec through the existing any-matrix kernels."""
+
+    codec_id: str
+    wire_algorithm: str
+    substrates: frozenset[str]
+    coding_matrix: Callable[[int, int], np.ndarray]
+    parity_matrix: Callable[[int, int], np.ndarray]
+    reconstruct_matrix: Callable[[int, int, list, list], np.ndarray]
+    # Host numpy realization: (byte matrix [R, K], shards [K, S]) ->
+    # [R, S]. The no-native fallback AND the byte oracle per codec.
+    host_apply: Callable[[np.ndarray, np.ndarray], np.ndarray]
+    # Declared host-feed throughput bounds (GB/s) for engines whose
+    # kernel rate is not the binding constraint on host-sourced streams.
+    feed_bounds: dict = field(default_factory=dict)
+    # Optional schedule accounting (XOR-schedule codecs) for bench/probe.
+    schedule_stats: Callable[[np.ndarray], dict] | None = None
+    max_shards: int = gf.MAX_SHARDS
+
+    def geometry_ok(self, data_blocks: int, parity_blocks: int) -> bool:
+        return (data_blocks > 0 and parity_blocks > 0
+                and data_blocks + parity_blocks <= self.max_shards)
+
+
+def _dense_host_apply(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    from ..ops import rs
+
+    return rs.gf_matmul_shards_np(gf.bit_matrix_for(mat), shards)
+
+
+def _cauchy_host_apply(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    if np.asarray(shards).ndim == 3:
+        return cauchy.apply_schedule_batch(mat, shards)
+    return cauchy.apply_schedule(mat, shards)
+
+
+def _dense_reconstruct(k: int, m: int, present, targets) -> np.ndarray:
+    return gf.reconstruct_matrix(k, m, list(present), list(targets))
+
+
+def _cauchy_reconstruct(k: int, m: int, present, targets) -> np.ndarray:
+    return cauchy.cauchy_reconstruct_matrix(
+        k, m, list(present), list(targets)
+    )
+
+
+_ALL_SUBSTRATES = frozenset(
+    {"native", "device", "mesh", "worker", "numpy"}
+)
+
+_REGISTRY: dict[str, CodecEntry] = {}
+
+
+def register(entry: CodecEntry) -> CodecEntry:
+    if entry.codec_id in _REGISTRY:
+        raise ValueError(f"codec {entry.codec_id!r} already registered")
+    _REGISTRY[entry.codec_id] = entry
+    return entry
+
+
+register(CodecEntry(
+    codec_id=DENSE_GF8,
+    # Matches storage/fileinfo.ERASURE_ALGORITHM — the algo string every
+    # pre-registry object carries.
+    wire_algorithm="rs-vandermonde",
+    substrates=_ALL_SUBSTRATES,
+    coding_matrix=gf.rs_matrix,
+    parity_matrix=gf.parity_matrix,
+    reconstruct_matrix=_dense_reconstruct,
+    host_apply=_dense_host_apply,
+    feed_bounds={"mesh": 0.60, "device": 0.50},
+))
+
+register(CodecEntry(
+    codec_id=CAUCHY_XOR,
+    wire_algorithm="rs-cauchy-xor",
+    substrates=_ALL_SUBSTRATES,
+    coding_matrix=cauchy.cauchy_matrix,
+    parity_matrix=cauchy.cauchy_parity_matrix,
+    reconstruct_matrix=_cauchy_reconstruct,
+    host_apply=_cauchy_host_apply,
+    feed_bounds={"mesh": 0.60, "device": 0.50},
+    schedule_stats=cauchy.schedule_stats,
+))
+
+
+def codec_ids() -> tuple[str, ...]:
+    return tuple(_REGISTRY)
+
+
+def get(codec_id: str) -> CodecEntry:
+    """Resolve a codec id — LOUD on unknown ids: an object stamped with
+    a codec this build does not know must never silently decode dense."""
+    entry = _REGISTRY.get(codec_id)
+    if entry is None:
+        raise KeyError(
+            f"unknown erasure codec {codec_id!r} "
+            f"(registered: {', '.join(_REGISTRY)})"
+        )
+    return entry
+
+
+def wire_algorithm_to_codec(algorithm: str) -> str | None:
+    """Codec id for a wire `algo` string, or None when no registered
+    codec claims it (the metadata layer fails loud on those)."""
+    for entry in _REGISTRY.values():
+        if entry.wire_algorithm == algorithm:
+            return entry.codec_id
+    return None
+
+
+def supports(codec_id: str, substrate: str) -> bool:
+    return substrate in get(codec_id).substrates
+
+
+# --- measured-throughput probes ---------------------------------------
+
+_PROBE_SHARD = 16384
+_PROBE_GEOMETRY = (4, 2)
+_PROBE_RUNS = 3
+
+
+def _measure(fn, nbytes: int, runs: int = _PROBE_RUNS) -> float:
+    """Best-of-N wall-clock GB/s for one probe callable (min time, the
+    same dispersion-resistant protocol bench.py uses)."""
+    fn()  # warm caches (matrix derivations, kernel tables)
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    if best <= 0:
+        return 0.0
+    return nbytes / best / 1e9
+
+
+@functools.lru_cache(maxsize=32)
+def probe_gbps(codec_id: str, engine: str) -> float:
+    """Measured encode throughput of one (codec, host engine) pair on a
+    tiny canonical geometry; lru-cached — the probe runs once per
+    process. Device/mesh rates are declared feed bounds, not probed (a
+    probe would drag jax into every selection path)."""
+    entry = get(codec_id)
+    if engine in entry.feed_bounds:
+        value = float(entry.feed_bounds[engine])
+        _note_probe(codec_id, engine, value)
+        return value
+    k, m = _PROBE_GEOMETRY
+    mat = entry.parity_matrix(k, m)
+    rng = np.random.default_rng(0x5EED)
+    blocks = rng.integers(0, 256, size=(2, k, _PROBE_SHARD),
+                          dtype=np.uint8)
+    nbytes = blocks.nbytes
+    if engine == "native":
+        from ..ops import gf_native
+
+        if not gf_native.available():
+            return 0.0
+        value = _measure(
+            lambda: gf_native.apply_matrix_batch(mat, blocks), nbytes
+        )
+    elif engine == "numpy":
+        shards = blocks.reshape(2 * k, _PROBE_SHARD)[:k]
+        value = _measure(
+            lambda: entry.host_apply(mat, shards), shards.nbytes
+        )
+    else:
+        return 0.0
+    _note_probe(codec_id, engine, value)
+    return value
+
+
+def _note_probe(codec_id: str, engine: str, gbps: float) -> None:
+    reg = _reg()
+    if reg is not None:
+        reg.set_gauge("mtpu_codec_probe_gbps", round(gbps, 3),
+                      codec=codec_id, engine=engine)
+
+
+@functools.lru_cache(maxsize=32)
+def probe_geometry_gbps(codec_id: str, data_blocks: int,
+                        parity_blocks: int) -> float:
+    """Measured encode throughput of one codec on one geometry through
+    its best available host engine — the number codec auto-selection
+    compares."""
+    entry = get(codec_id)
+    mat = entry.parity_matrix(data_blocks, parity_blocks)
+    rng = np.random.default_rng(0x5EED)
+    blocks = rng.integers(
+        0, 256, size=(2, data_blocks, _PROBE_SHARD), dtype=np.uint8
+    )
+    from ..ops import gf_native
+
+    if gf_native.available() and "native" in entry.substrates:
+        return _measure(
+            lambda: gf_native.apply_matrix_batch(mat, blocks),
+            blocks.nbytes,
+        )
+    shards = blocks[0]
+    return _measure(lambda: entry.host_apply(mat, shards), shards.nbytes)
+
+
+# --- engine selection --------------------------------------------------
+
+_FORCED_ENGINES = ("auto", "device", "mesh", "native", "numpy")
+
+
+def select_engine(shard_len: int, total_shards: int | None = None,
+                  codec_id: str = DEFAULT_CODEC) -> str:
+    """Pick the GF engine for one application:
+    'native' | 'device' | 'mesh' | 'numpy'.
+
+    MTPU_ENCODE_ENGINE forces it (auto|device|mesh|native|numpy); a
+    forced engine that is unavailable for this call degrades down the
+    host ladder (native, then numpy) exactly as the pre-registry policy
+    did. 'auto' ranks the available candidates by throughput: measured
+    probes for the host engines, the codec's declared host-feed bounds
+    for device/mesh (see module docstring for the r03 measurement that
+    justifies feed-bounded ranking on host-sourced streams).
+
+    The mesh candidate exists only when the caller names the geometry
+    (`total_shards`) and placement.mesh_fit accepts it — forced mesh
+    admits virtual CPU meshes (the CI path), auto only real multi-device
+    accelerator backends. The env/mesh probes are re-read per call
+    (tests flip them); the resolution itself is memoized.
+    """
+    import os
+
+    from ..ops import gf_native
+
+    eng = os.environ.get("MTPU_ENCODE_ENGINE", "auto")
+    if eng == "mesh" or (eng == "auto" and total_shards):
+        from ..parallel import placement
+
+        mesh_fit = placement.mesh_fit(total_shards, explicit=eng == "mesh")
+    else:
+        mesh_fit = False
+    return _resolve_engine(
+        eng,
+        shard_len >= DEVICE_SHARD_THRESHOLD,
+        gf_native.available(),
+        mesh_fit,
+        codec_id,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _resolve_engine(eng: str, device_sized: bool, native_ok: bool,
+                    mesh_fit: bool, codec_id: str) -> str:
+    entry = get(codec_id)
+    available = {
+        "native": native_ok and "native" in entry.substrates,
+        "mesh": (mesh_fit and device_sized
+                 and "mesh" in entry.substrates),
+        "device": device_sized and "device" in entry.substrates,
+        "numpy": "numpy" in entry.substrates,
+    }
+    if eng != "auto" and eng in _FORCED_ENGINES:
+        if available.get(eng):
+            return eng
+        return "native" if available["native"] else "numpy"
+    ranked = sorted(
+        (name for name, ok in available.items() if ok),
+        key=lambda name: _engine_rank(codec_id, name),
+        reverse=True,
+    )
+    return ranked[0] if ranked else "numpy"
+
+
+def _engine_rank(codec_id: str, engine: str) -> tuple:
+    """(throughput GB/s, stable tiebreak) — measured for host engines,
+    declared feed bound for device/mesh. The tiebreak pins the order
+    when two engines measure identically (mesh outranks device: it
+    subsumes the single-chip path when both fit)."""
+    tiebreak = {"native": 3, "mesh": 2, "device": 1, "numpy": 0}
+    return (probe_gbps(codec_id, engine), tiebreak[engine])
+
+
+# --- codec selection ---------------------------------------------------
+
+def select_codec(data_blocks: int, parity_blocks: int,
+                 forced: str = "") -> str:
+    """Codec id a write should stamp for this geometry. Precedence:
+    `forced` (per-request, e.g. the x-mtpu-codec header) > MTPU_CODEC
+    env (a codec id, or 'auto' — the documented default) > measured
+    auto-selection with the dense incumbent favored by AUTO_HYSTERESIS.
+    Unknown forced ids raise KeyError (the API layer maps it to
+    InvalidArgument); geometry misfits raise ValueError."""
+    import os
+
+    want = forced or os.environ.get("MTPU_CODEC", "auto")
+    if want and want != "auto":
+        entry = get(want)
+        if not entry.geometry_ok(data_blocks, parity_blocks):
+            raise ValueError(
+                f"codec {want!r} does not support geometry "
+                f"{data_blocks}+{parity_blocks}"
+            )
+        chosen = entry.codec_id
+    else:
+        chosen = _auto_codec(data_blocks, parity_blocks)
+    reg = _reg()
+    if reg is not None:
+        reg.inc("mtpu_codec_selected_total", codec=chosen,
+                geometry=f"{data_blocks}+{parity_blocks}")
+    return chosen
+
+
+@functools.lru_cache(maxsize=32)
+def _auto_codec(data_blocks: int, parity_blocks: int) -> str:
+    incumbent = DEFAULT_CODEC
+    if not get(incumbent).geometry_ok(data_blocks, parity_blocks):
+        for cid, entry in _REGISTRY.items():
+            if entry.geometry_ok(data_blocks, parity_blocks):
+                return cid
+        return incumbent
+    best, best_gbps = incumbent, probe_geometry_gbps(
+        incumbent, data_blocks, parity_blocks
+    )
+    floor = best_gbps * AUTO_HYSTERESIS
+    for cid, entry in _REGISTRY.items():
+        if cid == incumbent:
+            continue
+        if not entry.geometry_ok(data_blocks, parity_blocks):
+            continue
+        gbps = probe_geometry_gbps(cid, data_blocks, parity_blocks)
+        if gbps > floor and gbps > best_gbps:
+            best, best_gbps = cid, gbps
+    return best
+
+
+def note_dispatch(codec_id: str, engine: str) -> None:
+    """Per-batch dispatch accounting (codec x engine substrate) — wired
+    from the codec core's engine dispatch points."""
+    reg = _reg()
+    if reg is not None:
+        reg.inc("mtpu_codec_dispatch_total", codec=codec_id,
+                engine=engine)

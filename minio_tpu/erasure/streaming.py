@@ -299,6 +299,7 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     the serial drivers — stage threads there only add dispatch cost
     (the measured fanout policy in utils/fanout.py).
     """
+    from . import registry
     from .codec import _select_engine
 
     writer = ParallelWriter(writers, quorum)
@@ -306,7 +307,8 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
     want_digests = any(
         getattr(w, "device_hashable", False) for w in writers if w is not None
     )
-    engine = _select_engine(shard, erasure.total_shards)
+    engine = _select_engine(shard, erasure.total_shards,
+                            codec=erasure.codec_id)
     if engine == "native":
         # Host-native engine: the batched strip path (one GFNI encode +
         # one framing call per shard per batch).
@@ -314,7 +316,8 @@ def encode_stream(erasure: Erasure, src, writers: list, quorum: int,
             return _encode_stream_native(erasure, src, writer, batch_blocks)
         from ..pipeline import workers as _workers
 
-        wpool = _workers.armed()
+        wpool = (_workers.armed()
+                 if registry.supports(erasure.codec_id, "worker") else None)
         if wpool is not None:
             # Worker-pool path: the per-batch GF encode + strided
             # digests run in a child process over a shared-memory strip
@@ -507,8 +510,8 @@ def _encode_stream_batched_pipelined(erasure: Erasure, src,
         # codec pads and stages itself.
         from ..parallel.mesh_engine import for_geometry as _mesh_geometry
 
-        feed = _mesh_geometry(erasure.data_blocks,
-                              erasure.parity_blocks).host_feed()
+        feed = _mesh_geometry(erasure.data_blocks, erasure.parity_blocks,
+                              erasure.codec_id).host_feed()
     else:
         feed = None
 
@@ -897,7 +900,7 @@ def _encode_stream_native_workers(erasure: Erasure, src,
                 encode_inprocess(item)
             else:
                 try:
-                    wpool.encode_batch(strip, nb)
+                    wpool.encode_batch(strip, nb, erasure.codec_id)
                     item[3] = strip.parity
                     item[5] = strip.digests
                 except (_workers.WorkerCrashed,
@@ -1345,12 +1348,16 @@ def decode_stream(erasure: Erasure, writer, readers: list, offset: int,
     # overlap, and on a mesh deployment degraded reconstruction — the
     # thing the collective dispatch accelerates — is what GET latency
     # economics turn on.
-    engine = _select_engine(erasure.shard_size(), erasure.total_shards)
+    engine = _select_engine(erasure.shard_size(), erasure.total_shards,
+                            codec=erasure.codec_id)
     wpool = None
     if engine == "native" and not _SINGLE_CORE:
-        from ..pipeline import workers as _workers
+        from . import registry as _registry
 
-        wpool = _workers.armed()
+        if _registry.supports(erasure.codec_id, "worker"):
+            from ..pipeline import workers as _workers
+
+            wpool = _workers.armed()
     try:
         if engine == "mesh":
             # Mesh serving path: degraded blocks reconstruct in fused
@@ -1447,7 +1454,8 @@ def _decode_stream_mesh(erasure: Erasure, writer, reader, geoms: list,
     from ..pipeline.buffers import copy_add
     from ..utils.errors import ErrShardSize, ErrTooFewShards
 
-    codec = mesh_geometry(erasure.data_blocks, erasure.parity_blocks)
+    codec = mesh_geometry(erasure.data_blocks, erasure.parity_blocks,
+                          erasure.codec_id)
     k = erasure.data_blocks
     shard = erasure.shard_size()
     bytes_written = 0
@@ -1599,7 +1607,8 @@ def _decode_stream_workers(erasure: Erasure, writer, reader, geoms: list,
         try:
             try:
                 wpool.recon_batch(strip, nb, present, targets,
-                                  digests=False, op="decode")
+                                  digests=False, op="decode",
+                                  codec=erasure.codec_id)
                 rebuilt = strip.recon_out(nb, len(targets))
             except (_workers.WorkerCrashed, _workers.WorkerUnavailable):
                 # The shm survivors are intact: recompute this batch
@@ -1764,7 +1773,8 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
             copy_add("heal.shard_copy", len(chunk))
             writers[t].write(chunk)
 
-    engine = _select_engine(erasure.shard_size(), erasure.total_shards)
+    engine = _select_engine(erasure.shard_size(), erasure.total_shards,
+                            codec=erasure.codec_id)
     try:
         if engine in ("device", "mesh") and total_blocks:
             # Same fused reconstruct+digest driver for both accelerator
@@ -1775,15 +1785,19 @@ def heal_stream(erasure: Erasure, writers: list, readers: list,
                 from .device_engine import for_geometry
 
             codec = for_geometry(erasure.data_blocks,
-                                 erasure.parity_blocks)
+                                 erasure.parity_blocks,
+                                 erasure.codec_id)
             return _heal_stream_fused(erasure, writers, reader, targets,
                                       total_blocks, codec)
 
         if (engine == "native" and not _SINGLE_CORE and total_blocks > 2
                 and len(targets) <= erasure.parity_blocks):
+            from . import registry as _registry
             from ..pipeline import workers as _workers
 
-            wpool = _workers.armed()
+            wpool = (_workers.armed()
+                     if _registry.supports(erasure.codec_id, "worker")
+                     else None)
             if wpool is not None:
                 # Worker heal driver (ISSUE 11): per-failure-pattern
                 # batch reconstruct + re-digest in a child interpreter
@@ -1979,7 +1993,8 @@ def _heal_stream_workers(erasure: Erasure, writers: list, reader,
             digs = None
             try:
                 wpool.recon_batch(strip, nb, present, targets_t,
-                                  digests=want_digests, op="heal")
+                                  digests=want_digests, op="heal",
+                                  codec=erasure.codec_id)
                 rebuilt = strip.recon_out(nb, len(targets_t))
                 if want_digests:
                     digs = strip.recon_digests(nb, len(targets_t))

@@ -76,6 +76,24 @@ BUCKET = "soak"
 BUCKET_VER = "soak-ver"
 BUCKET_EXP = "soak-exp"
 
+
+def _soak_codecs() -> tuple:
+    """Registered codec ids, registration order (stable). Every
+    PUT-like op draws one deterministically, so a single soak bucket
+    interleaves objects written under every codec and the drain
+    invariants are verified ACROSS codec boundaries (ISSUE 16), not
+    once per homogeneous bucket."""
+    from ..erasure import registry
+
+    return registry.codec_ids()
+
+
+def _codec_headers(op: dict) -> dict | None:
+    """x-mtpu-codec header for the op's planned codec (None for plans
+    recorded before codecs existed — replay compatibility)."""
+    cid = op.get("codec")
+    return {"x-mtpu-codec": cid} if cid else None
+
 ACCESS, SECRET = "soakadmin", "soakadmin-secret-key"
 
 # Per-op stall bound: deadline + straggler grace + generous compute
@@ -168,6 +186,7 @@ def client_stream(spec: ScenarioSpec, client: int) -> list[dict]:
         if kind in (OP_PUT, OP_MULTIPART, OP_LIFECYCLE, OP_VERSIONED):
             op["size"] = rng.choice(spec.payload_sizes)
             op["pseed"] = rng.randrange(1 << 30)
+            op["codec"] = rng.choice(_soak_codecs())
         if kind == OP_PUT:
             op["key"] = f"c{client}/o{n:03d}"
         elif kind == OP_MULTIPART:
@@ -652,7 +671,8 @@ def _run_op(h: ScenarioHarness, oracle: _Oracle, client: int,
     kind = op["op"]
     if kind == OP_PUT:
         body = _payload(op["pseed"], op["size"])
-        st, _, _ = h.request("PUT", f"/{BUCKET}/{op['key']}", body=body)
+        st, _, _ = h.request("PUT", f"/{BUCKET}/{op['key']}", body=body,
+                             headers=_codec_headers(op))
         if st == 200:
             oracle.commit(BUCKET, op["key"], body)
         return st == 200
@@ -715,7 +735,7 @@ def _run_op(h: ScenarioHarness, oracle: _Oracle, client: int,
     if kind == OP_LIFECYCLE:
         body = _payload(op["pseed"], op["size"])
         st, _, _ = h.request("PUT", f"/{BUCKET_EXP}/{op['key']}",
-                             body=body)
+                             body=body, headers=_codec_headers(op))
         if st == 200:
             with oracle._mu:
                 oracle.expiring[(BUCKET_EXP, op["key"])] = body
@@ -740,7 +760,8 @@ def _run_multipart(h: ScenarioHarness, oracle: _Oracle, op: dict) -> bool:
     body = _payload(op["pseed"], op["size"])
     nparts = op["parts"]
     st, _, raw = h.request("POST", f"/{BUCKET}/{key}",
-                           query=[("uploads", "")])
+                           query=[("uploads", "")],
+                           headers=_codec_headers(op))
     if st != 200:
         return False
     m = re.search(rb"<UploadId>([^<]+)</UploadId>", raw)
@@ -800,7 +821,8 @@ def _run_versioned(h: ScenarioHarness, oracle: _Oracle, op: dict) -> bool:
         if step == "put":
             body = _payload(op["pseed"] + i, op["size"])
             st, hdr, _ = h.request("PUT", f"/{BUCKET_VER}/{key}",
-                                   body=body)
+                                   body=body,
+                                   headers=_codec_headers(op))
             if st == 200:
                 committed.append((hdr.get("x-amz-version-id", ""), body))
             else:

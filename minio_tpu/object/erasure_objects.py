@@ -23,6 +23,7 @@ from ..erasure.bitrot import (
     StreamingBitrotReader,
     StreamingBitrotWriter,
 )
+from ..erasure import registry as _codec_registry
 from ..erasure.codec import Erasure
 from ..erasure.streaming import decode_stream, encode_stream, heal_stream
 from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
@@ -262,14 +263,16 @@ class ErasureObjects(MultipartMixin):
         except TimeoutError as exc:
             raise ErrOperationTimedOut(f"{bucket}/{object_}") from exc
 
-    def _object_erasure(self, k: int, m: int) -> Erasure:
-        # Geometry-keyed shared instance: PUT/GET/heal of one erasure
-        # set reuse the same codec (matrices, device engine caches)
-        # instead of re-deriving them per object — the per-PUT setup
-        # cost the pool-batched path measured.
+    def _object_erasure(self, k: int, m: int, codec: str = "") -> Erasure:
+        # (geometry, codec)-keyed shared instance: PUT/GET/heal of one
+        # erasure set reuse the same coder (matrices, device engine
+        # caches) instead of re-deriving them per object — the per-PUT
+        # setup cost the pool-batched path measured. "" = dense default
+        # (pre-registry metadata that never stamped a codec id).
         from ..erasure.codec import cached_erasure
 
-        return cached_erasure(k, m, BLOCK_SIZE_V2)
+        return cached_erasure(k, m, BLOCK_SIZE_V2,
+                              codec or _codec_registry.DEFAULT_CODEC)
 
     def _tmp_path(self, tmp_id: str) -> str:
         return f"tmp/{tmp_id}"
@@ -411,7 +414,12 @@ class ErasureObjects(MultipartMixin):
         data_blocks = n - parity
         write_quorum = data_blocks + (1 if data_blocks == parity else 0)
 
-        erasure = self._object_erasure(data_blocks, parity)
+        # Codec identity is fixed at PUT time and persisted in xl.meta:
+        # forced header > MTPU_CODEC env > measured probe > dense.
+        codec_id = _codec_registry.select_codec(data_blocks, parity,
+                                                forced=opts.codec)
+        wire_algo = _codec_registry.get(codec_id).wire_algorithm
+        erasure = self._object_erasure(data_blocks, parity, codec_id)
         distribution = hash_order(f"{bucket}/{object_}", n)
         disks_by_shard = shuffle_disks(self.disks, distribution)
 
@@ -524,12 +532,14 @@ class ErasureObjects(MultipartMixin):
                 size=size,
                 metadata=dict(metadata),
                 erasure=ErasureInfo(
+                    algorithm=wire_algo,
                     data_blocks=data_blocks,
                     parity_blocks=parity,
                     block_size=BLOCK_SIZE_V2,
                     index=i + 1,
                     distribution=list(distribution),
                     checksums=[ChecksumInfo(1, BitrotAlgorithm.HIGHWAYHASH256S.value)],
+                    codec=codec_id,
                 ),
             )
             fi.add_part(1, size, size)
@@ -589,8 +599,10 @@ class ErasureObjects(MultipartMixin):
             volume=bucket, name=object_, version_id=version_id,
             mod_time_ns=mod_time_ns, size=size, metadata=metadata,
             erasure=ErasureInfo(
+                algorithm=wire_algo,
                 data_blocks=data_blocks, parity_blocks=parity,
                 block_size=BLOCK_SIZE_V2, distribution=list(distribution),
+                codec=codec_id,
             ),
         )
         fi.num_versions = 1
@@ -828,7 +840,8 @@ class ErasureObjects(MultipartMixin):
             raise ErrInvalidArgument("invalid range")
 
         erasure = self._object_erasure(
-            fi.erasure.data_blocks, fi.erasure.parity_blocks
+            fi.erasure.data_blocks, fi.erasure.parity_blocks,
+            fi.erasure.codec
         )
         disks_by_shard, metas_by_shard = shuffle_disks_and_parts_metadata(
             self.disks, fis, fi
@@ -1120,7 +1133,11 @@ class ErasureObjects(MultipartMixin):
             # raise and leave the marker permanently un-replicable on
             # the disks its write fan-out missed (found by the PR15
             # chaos soak's MRF-dry invariant).
-            erasure = self._object_erasure(data_blocks, parity)
+            # The heal MUST rebuild with the codec the object was
+            # written under — fresh parity from a different matrix
+            # would verify against nothing.
+            erasure = self._object_erasure(data_blocks, parity,
+                                           ref_fi.erasure.codec)
             for part in ref_fi.parts:
                 till = erasure.shard_file_offset(0, part.size, part.size)
                 readers: list = [None] * len(disks_by_shard)

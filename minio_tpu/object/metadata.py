@@ -62,6 +62,10 @@ def _meta_hash(fi: FileInfo) -> str:
     for part in fi.parts:
         h.update(f"part.{part.number}".encode())
     h.update(str(fi.erasure.distribution).encode())
+    # Codec identity is quorum-relevant: disks disagreeing on the codec
+    # must never be merged into one readable version (their parity bytes
+    # come from different matrices).
+    h.update(fi.erasure.codec.encode())
     h.update(str(len(fi.data)).encode())
     return h.hexdigest()
 

@@ -14,6 +14,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..erasure import registry as _codec_registry
 from ..erasure.bitrot import BitrotAlgorithm, StreamingBitrotWriter
 from ..observability import carry as obs_carry
 from ..observability import ioflow
@@ -207,6 +208,11 @@ class MultipartMixin:
         upload_id = new_uuid()
         upload_path = f"{_upload_root(bucket, object_)}/{upload_id}"
 
+        # The codec is fixed at initiate time and journaled with the
+        # upload geometry: every part write and the final complete
+        # encode/stamp under the SAME codec id.
+        codec_id = _codec_registry.select_codec(data_blocks, parity,
+                                                forced=opts.codec)
         fi = FileInfo(
             volume=SYSTEM_META_BUCKET,
             name=upload_path,
@@ -216,10 +222,13 @@ class MultipartMixin:
                 "x-mtpu-internal-object": f"{bucket}/{object_}",
             },
             erasure=ErasureInfo(
+                algorithm=_codec_registry.get(codec_id).wire_algorithm,
                 data_blocks=data_blocks,
                 parity_blocks=parity,
-                block_size=self._object_erasure(data_blocks, parity).block_size,
+                block_size=self._object_erasure(
+                    data_blocks, parity, codec_id).block_size,
                 distribution=hash_order(f"{bucket}/{object_}", n),
+                codec=codec_id,
             ),
         )
         errs: list = [None] * n
@@ -284,7 +293,7 @@ class MultipartMixin:
         fi, fis, upload_path = self._upload_fi(bucket, object_, upload_id)
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         write_quorum = k + (1 if k == m else 0)
-        erasure = self._object_erasure(k, m)
+        erasure = self._object_erasure(k, m, fi.erasure.codec)
         disks_by_shard = shuffle_disks(self.disks, fi.erasure.distribution)
 
         tee = TeeMD5Reader(reader, size=size)
@@ -579,6 +588,7 @@ class MultipartMixin:
                 data_dir=data_dir, mod_time_ns=mod_time_ns, size=total_size,
                 metadata=dict(metadata),
                 erasure=ErasureInfo(
+                    algorithm=fi.erasure.algorithm,
                     data_blocks=k, parity_blocks=m,
                     block_size=fi.erasure.block_size, index=shard_i + 1,
                     distribution=list(fi.erasure.distribution),
@@ -586,6 +596,7 @@ class MultipartMixin:
                         ChecksumInfo(p.number, BitrotAlgorithm.HIGHWAYHASH256S.value)
                         for p in final_parts
                     ],
+                    codec=fi.erasure.codec,
                 ),
             )
             for p in final_parts:
@@ -621,7 +632,9 @@ class MultipartMixin:
         out = FileInfo(
             volume=bucket, name=object_, version_id=version_id,
             mod_time_ns=mod_time_ns, size=total_size, metadata=metadata,
-            erasure=ErasureInfo(data_blocks=k, parity_blocks=m),
+            erasure=ErasureInfo(algorithm=fi.erasure.algorithm,
+                                data_blocks=k, parity_blocks=m,
+                                codec=fi.erasure.codec),
         )
         return ObjectInfo.from_file_info(out, bucket, object_, opts.versioned)
 

@@ -42,6 +42,10 @@ class ObjectOptions:
     # new bytes under an old ETag (the reference instead holds the lock
     # from GetObjectNInfo through the reader's lifetime).
     expected_etag: str = ""
+    # Forced erasure codec id from the x-mtpu-codec header ("" = let
+    # registry.select_codec choose; see erasure/registry.py precedence:
+    # forced > MTPU_CODEC env > measured probe > dense default).
+    codec: str = ""
 
 
 @dataclass

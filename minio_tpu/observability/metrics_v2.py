@@ -189,6 +189,13 @@ from ..distributed.rest import RPC_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += RPC_DESCRIPTORS
 
+# Erasure-codec registry (erasure/registry.py, jax-free import):
+# per-(codec, geometry) selection counts, per-(codec, engine) dispatch
+# counts and measured probe throughputs for the pluggable codec plane.
+from ..erasure.registry import CODEC_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += CODEC_DESCRIPTORS
+
 
 def mrf_scoreboard(ol) -> dict:
     """One traversal of the heal/MRF scoreboard (ISSUE 14), consumed by

@@ -1,0 +1,233 @@
+"""Cauchy Reed-Solomon: systematic coding matrices built from a Cauchy
+matrix, plus a byte-level XOR-schedule realization with a common-
+subexpression-elimination pass (the bit-matrix scheduling idea of
+arXiv 2108.02692, lifted from per-bit XORs to whole xtime byte planes).
+
+Matrix construction: over GF(2^8) (poly 0x11D, the same field as
+ops/gf.py) pick disjoint evaluation points X = {k..k+m-1} for the parity
+rows and Y = {0..k-1} for the data columns; the Cauchy matrix
+C[i][j] = 1/(x_i XOR y_j) has every square submatrix nonsingular, so the
+systematic stack [I; C] is MDS for any k+m <= 256. Unlike the
+Vandermonde construction (ops/gf.rs_matrix) no k x k inversion is needed
+to systematize — the identity rows are free.
+
+XOR-schedule realization: multiplying a shard by a constant c is linear
+over GF(2), so with P[j][b] = xtime^b(shard_j) (the eight "doubling
+planes" of input shard j),
+
+    out_i = XOR over {(j, b) : bit b of M[i][j] set} of P[j][b]
+
+— pure byte-wide XORs after eight vectorized xtime passes per input.
+The schedule is the term list per output row; the CSE pass greedily
+extracts XOR pairs shared by >= 2 rows into temporaries (one pair per
+round, most frequent first), shrinking the XOR count the way 2108.02692
+shrinks bit-matrix schedules. Schedules are lru-cached per matrix; the
+stats (terms before/after CSE) feed the registry probe and bench's
+codec_sweep section.
+
+This is the HOST fallback realization and the oracle for the Cauchy
+codec; the native/device/mesh engines consume the same byte matrix
+through their existing any-matrix kernels (ops/gf_native.py, the GF(2)
+bit expansion), so all substrates stay byte-identical by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf
+
+
+@functools.lru_cache(maxsize=None)
+def cauchy_parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The (m, k) Cauchy parity block: C[i][j] = 1/((k+i) XOR j)."""
+    if data_shards + parity_shards > gf.MAX_SHARDS:
+        raise ValueError(
+            f"data+parity={data_shards + parity_shards} exceeds "
+            f"{gf.MAX_SHARDS}"
+        )
+    out = np.zeros((parity_shards, data_shards), dtype=np.uint8)
+    for i in range(parity_shards):
+        for j in range(data_shards):
+            out[i, j] = gf.gf_inv((data_shards + i) ^ j)
+    out.setflags(write=False)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def cauchy_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Systematic (k+m, k) coding matrix [I; C] — the Cauchy analogue of
+    gf.rs_matrix; data shards pass through unchanged."""
+    eye = np.eye(data_shards, dtype=np.uint8)
+    out = np.concatenate(
+        [eye, cauchy_parity_matrix(data_shards, parity_shards)]
+    )
+    out.setflags(write=False)
+    return out
+
+
+def cauchy_reconstruct_matrix(
+    data_shards: int,
+    parity_shards: int,
+    present: list[int],
+    targets: list[int],
+) -> np.ndarray:
+    """(len(targets), k) byte matrix regenerating `targets` from the
+    first k `present` shards — same contract as gf.reconstruct_matrix,
+    derived from the Cauchy coding matrix."""
+    return _cauchy_recon_cached(
+        data_shards, parity_shards, tuple(present), tuple(targets)
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _cauchy_recon_cached(data_shards: int, parity_shards: int,
+                         present: tuple, targets: tuple) -> np.ndarray:
+    full = cauchy_matrix(data_shards, parity_shards)
+    return gf.reconstruct_matrix_from(full, data_shards, present, targets)
+
+
+# --- XOR schedule -----------------------------------------------------
+
+def _xtime(v: np.ndarray) -> np.ndarray:
+    """Multiply a uint8 array by x (0x02) in GF(2^8): shift, then reduce
+    by the field polynomial where the top bit carried out."""
+    return (v << 1) ^ (np.uint8(0x1D) * ((v >> 7) & np.uint8(1)))
+
+
+def build_schedule(mat: np.ndarray):
+    """Compile a byte matrix [R, K] into (ops, rows):
+
+    - symbols 0..8K-1 name the input planes, symbol j*8+b = xtime^b of
+      input shard j (plane (j, b) exists only if some row uses it);
+    - `ops` is a list of (new_sym, a, b) temporaries, new = a XOR b,
+      emitted by the greedy CSE pass (evaluation order matters: later
+      temps may reference earlier ones);
+    - `rows` is a tuple per output row of the symbols to XOR together.
+    """
+    mat = np.asarray(mat, dtype=np.uint8)
+    r, k = mat.shape
+    rows = []
+    for i in range(r):
+        terms = set()
+        for j in range(k):
+            c = int(mat[i, j])
+            for b in range(8):
+                if (c >> b) & 1:
+                    terms.add(j * 8 + b)
+        rows.append(terms)
+    next_sym = 8 * k
+    ops: list[tuple[int, int, int]] = []
+    # Greedy pairwise CSE: hoist the XOR pair shared by the most rows,
+    # repeat until no pair appears twice. Each round shrinks the total
+    # term count by (freq - 1), so the loop is bounded by the initial
+    # term count; the explicit cap is a safety net, not a tuning knob.
+    for _ in range(64 * k):
+        counts: dict[tuple[int, int], int] = {}
+        for terms in rows:
+            ts = sorted(terms)
+            for a_i in range(len(ts)):
+                for b_i in range(a_i + 1, len(ts)):
+                    pair = (ts[a_i], ts[b_i])
+                    counts[pair] = counts.get(pair, 0) + 1
+        best, best_n = None, 1
+        for pair, n in counts.items():
+            if n > best_n or (n == best_n and best is not None
+                              and pair < best):
+                best, best_n = pair, n
+        if best is None or best_n < 2:
+            break
+        a, b = best
+        ops.append((next_sym, a, b))
+        for terms in rows:
+            if a in terms and b in terms:
+                terms.discard(a)
+                terms.discard(b)
+                terms.add(next_sym)
+        next_sym += 1
+    return ops, tuple(tuple(sorted(t)) for t in rows)
+
+
+@functools.lru_cache(maxsize=256)
+def _schedule_cached(shape: tuple, buf: bytes):
+    return build_schedule(np.frombuffer(buf, dtype=np.uint8).reshape(shape))
+
+
+def schedule_for(mat: np.ndarray):
+    """Cached front-end to build_schedule, keyed by matrix content (the
+    same keying discipline as gf.bit_matrix_for)."""
+    # copy-ok: meta (coding-matrix bytes form the cache key)
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    return _schedule_cached(mat.shape, mat.tobytes())  # copy-ok: meta
+
+
+def schedule_stats(mat: np.ndarray) -> dict:
+    """XOR-count accounting for one matrix's schedule: raw term count
+    (no CSE), scheduled XORs (row joins + temporaries), and the saving —
+    the numbers the codec probe and bench report."""
+    mat = np.asarray(mat, dtype=np.uint8)
+    raw = 0
+    for i in range(mat.shape[0]):
+        for j in range(mat.shape[1]):
+            raw += bin(int(mat[i, j])).count("1")
+    ops, rows = schedule_for(mat)
+    xors = len(ops) + sum(max(len(t) - 1, 0) for t in rows)
+    raw_xors = max(raw - mat.shape[0], 0)
+    return {
+        "raw_terms": raw,
+        "cse_temps": len(ops),
+        "scheduled_xors": xors,
+        "raw_xors": raw_xors,
+        "saved_xors": raw_xors - xors,
+    }
+
+
+def apply_schedule(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Apply byte matrix [R, K] to shards [K, S] -> [R, S] via the XOR
+    schedule — the numpy realization of this codec (oracle + no-native
+    fallback; bit-exact with gf.gf_matmul_shards_ref)."""
+    mat = np.asarray(mat, dtype=np.uint8)
+    shards = np.asarray(shards, dtype=np.uint8)
+    r, k = mat.shape
+    assert shards.shape[0] == k, (mat.shape, shards.shape)
+    s = shards.shape[-1]
+    ops, rows = schedule_for(mat)
+    planes: dict[int, np.ndarray] = {}
+    needed = {sym for row in rows for sym in row}
+    needed.update(a for _, a, b in ops for a in (a, b))
+    # Doubling planes, built incrementally: plane (j, b) only if used.
+    for j in range(k):
+        prev = shards[j]
+        for b in range(8):
+            sym = j * 8 + b
+            if b:
+                prev = _xtime(prev)
+            if sym in needed:
+                planes[sym] = prev
+    for sym, a, b in ops:
+        planes[sym] = planes[a] ^ planes[b]
+    out = np.zeros((r, s), dtype=np.uint8)
+    for i, row in enumerate(rows):
+        if not row:
+            continue
+        acc = planes[row[0]]
+        for sym in row[1:]:
+            acc = acc ^ planes[sym]
+        out[i] = acc
+    return out
+
+
+def apply_schedule_batch(mat: np.ndarray, blocks: np.ndarray,
+                         out: np.ndarray | None = None) -> np.ndarray:
+    """Batched XOR-schedule apply: [R, K] x [B, K, S] -> [B, R, S], with
+    the same optional out= contract as gf_native.apply_matrix_batch."""
+    blocks = np.asarray(blocks, dtype=np.uint8)
+    b, k, s = blocks.shape
+    r = np.asarray(mat).shape[0]
+    if out is None:
+        out = np.empty((b, r, s), dtype=np.uint8)
+    for i in range(b):
+        out[i] = apply_schedule(mat, blocks[i])
+    return out

@@ -203,7 +203,20 @@ def _reconstruct_matrix_cached(
     present: tuple,
     targets: tuple,
 ) -> np.ndarray:
-    """Byte matrix mapping k chosen present shards to the target shards.
+    full = rs_matrix(data_shards, parity_shards)
+    return reconstruct_matrix_from(full, data_shards, present, targets)
+
+
+def reconstruct_matrix_from(
+    full: np.ndarray,
+    data_shards: int,
+    present: tuple | list,
+    targets: tuple | list,
+) -> np.ndarray:
+    """Byte matrix mapping k chosen present shards to the target shards,
+    for ANY systematic (k+m, k) coding matrix `full` — the shared math
+    behind every registered codec's reconstruct path (dense Vandermonde
+    here, Cauchy in ops/cauchy.py).
 
     `present` must list >= k available shard indices (data first is not
     required); the first k are used, mirroring klauspost's reconstruct()
@@ -217,7 +230,6 @@ def _reconstruct_matrix_cached(
     if len(present) < k:
         raise ValueError("need at least dataShards present shards")
     rows = list(present[:k])
-    full = rs_matrix(data_shards, parity_shards)
     sub = full[rows]  # [k, k]
     inv = gf_mat_inv(sub)  # present -> original data
     out = np.zeros((len(targets), k), dtype=np.uint8)

@@ -101,8 +101,12 @@ def _u8(a: np.ndarray):
     return a.ctypes.data_as(_U8P)
 
 
-def apply_matrix(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
-    """mat uint8 [R, K] GF bytes, shards uint8 [K, S] -> [R, S]."""
+def apply_matrix(mat: np.ndarray, shards: np.ndarray,
+                 out: np.ndarray | None = None) -> np.ndarray:
+    """mat uint8 [R, K] GF bytes, shards uint8 [K, S] -> [R, S]. `out`
+    (contiguous [R, S]) lets callers land results in place — the same
+    shared-memory contract as apply_matrix_batch, so single-strip
+    worker ops write straight into their shm segment."""
     lib = _lib()
     if lib is None:
         raise RuntimeError("native GF engine unavailable")
@@ -115,7 +119,10 @@ def apply_matrix(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
     r, k = mat.shape
     s = shards.shape[-1]
     assert shards.shape == (k, s), (mat.shape, shards.shape)
-    out = np.empty((r, s), dtype=np.uint8)
+    if out is None:
+        out = np.empty((r, s), dtype=np.uint8)
+    else:
+        assert out.shape == (r, s) and out.flags.c_contiguous, out.shape
     if engine_kind() == 2:
         qw = _affine_qwords(mat.tobytes(), r, k)  # copy-ok: meta
         lib.gf_apply_affine(qw.ctypes.data_as(_U64P), r, k, _u8(shards),

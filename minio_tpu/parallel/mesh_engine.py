@@ -61,17 +61,21 @@ class MeshCodec:
     compiled programs and device-resident matrices across requests.
     """
 
-    def __init__(self, data_blocks: int, parity_blocks: int, mesh):
+    def __init__(self, data_blocks: int, parity_blocks: int, mesh,
+                 codec: str | None = None):
         import math
 
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..erasure import registry
         from ..ops import gf
 
         self.k = data_blocks
         self.m = parity_blocks
         self.n = data_blocks + parity_blocks
+        self.codec_id = codec or registry.DEFAULT_CODEC
+        self._entry = registry.get(self.codec_id)
         self.mesh = mesh
         self.dp = mesh.shape["dp"]
         self.lanes = mesh.shape["lane"]
@@ -88,7 +92,7 @@ class MeshCodec:
                 f"k+m={self.n} must divide over lane dim {self.lanes}"
             )
         self._parity_bits_np = gf.bit_matrix_for(
-            gf.parity_matrix(data_blocks, parity_blocks)
+            self._entry.parity_matrix(data_blocks, parity_blocks)
         )
         self.data_spec = NamedSharding(mesh, P("dp", None, None))
         self.stripe_spec = NamedSharding(mesh, P("dp", "lane", None))
@@ -254,10 +258,20 @@ class MeshCodec:
     # --- reconstruct (degraded GET / heal) ---
 
     def _recon_bits(self, present: tuple, targets: tuple) -> np.ndarray:
-        from .sharded import _recon_bits_np
+        from ..erasure import registry
 
-        return _recon_bits_np(self.k, self.m, tuple(present),
-                              tuple(targets))
+        if self.codec_id == registry.DEFAULT_CODEC:
+            # Dense keeps the shared lru of the SPMD proving ground.
+            from .sharded import _recon_bits_np
+
+            return _recon_bits_np(self.k, self.m, tuple(present),
+                                  tuple(targets))
+        from ..ops import gf
+
+        return gf.bit_matrix_for(
+            self._entry.reconstruct_matrix(self.k, self.m, list(present),
+                                           list(targets))
+        )
 
     def reconstruct_async(self, src, present, targets,
                           with_hashes: bool = False):
@@ -360,7 +374,7 @@ class MeshCodec:
 
 @functools.lru_cache(maxsize=32)
 def _codec_for(data_blocks: int, parity_blocks: int, dp: int,
-               lanes: int) -> MeshCodec:
+               lanes: int, codec: str | None = None) -> MeshCodec:
     mesh = placement.get_mesh(data_blocks + parity_blocks)
     if mesh is None or mesh.shape["dp"] != dp or mesh.shape["lane"] != lanes:
         # Shape env changed between selection and codec build (tests
@@ -368,18 +382,19 @@ def _codec_for(data_blocks: int, parity_blocks: int, dp: int,
         from .sharded import make_mesh
 
         mesh = make_mesh(dp * lanes, lanes=lanes)
-    return MeshCodec(data_blocks, parity_blocks, mesh)
+    return MeshCodec(data_blocks, parity_blocks, mesh, codec)
 
 
-def for_geometry(data_blocks: int, parity_blocks: int) -> MeshCodec:
-    """The geometry-keyed mesh codec cache. Raises RuntimeError when no
-    mesh shape fits — callers reach here only after _select_engine
-    validated the fit, so this is a programming-error guard, not a
-    runtime fallback path."""
+def for_geometry(data_blocks: int, parity_blocks: int,
+                 codec: str | None = None) -> MeshCodec:
+    """The (geometry, codec)-keyed mesh codec cache. Raises RuntimeError
+    when no mesh shape fits — callers reach here only after the registry
+    selector validated the fit, so this is a programming-error guard,
+    not a runtime fallback path."""
     shape = placement.select_shape(data_blocks + parity_blocks)
     if shape is None:
         raise RuntimeError(
             f"no mesh shape fits k+m={data_blocks + parity_blocks} on "
             f"{placement.device_count(initialize=True)} device(s)"
         )
-    return _codec_for(data_blocks, parity_blocks, *shape)
+    return _codec_for(data_blocks, parity_blocks, *shape, codec)

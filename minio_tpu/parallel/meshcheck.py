@@ -156,11 +156,19 @@ def _drive_shape(workdir: str, dp: int, lanes: int,
         f"{s1['mesh_batches_total']} batches, steady-state retraces "
         f"{steady_retraces}")
 
-    # --- 2) healthy GetObject, byte-verified.
+    # --- 2) healthy GetObject, byte-verified. Also pin the codec id
+    # the PUT stamped into xl.meta (MTPU_CODEC drives non-default runs):
+    # the degraded GET and heal below prove THAT codec's mesh path.
+    fi0 = disks[0].read_version(bucket, obj, "", False)
+    stamped_codec = fi0.erasure.codec
+    forced_codec = os.environ.get("MTPU_CODEC", "")
+    if forced_codec and forced_codec != "auto":
+        assert stamped_codec == forced_codec, (stamped_codec, forced_codec)
     sink = _Sink()
     es.get_object(bucket, obj, sink)
     assert sink.getvalue() == payload, "healthy GET mismatch"
-    say(f"GetObject ok — {len(payload)} bytes byte-verified")
+    say(f"GetObject ok — {len(payload)} bytes byte-verified "
+        f"(codec {stamped_codec})")
 
     pristine = _collect_part_files(roots, bucket, obj)
     assert len(pristine) == 16, sorted(pristine)
@@ -214,6 +222,7 @@ def _drive_shape(workdir: str, dp: int, lanes: int,
     stats = mesh_metrics.stats_snapshot()
     return {
         "shape": {"dp": dp, "lanes": lanes},
+        "codec": stamped_codec,
         "payload_bytes": len(payload),
         "put_dispatches": s1["mesh_dispatches_total"],
         "put_batches": s1["mesh_batches_total"],

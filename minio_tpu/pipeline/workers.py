@@ -339,22 +339,26 @@ def _attach_segment(name: str, batch: int, k: int, m: int, shard: int):
 
 
 def _child_encode(mats: dict, name: str, batch: int, nb: int,
-                  k: int, m: int, shard: int) -> None:
+                  k: int, m: int, shard: int,
+                  codec: str | None = None) -> None:
     """One batch: GF parity into the segment's parity region, frame
     digests for all k+m shards into its digest region. Must stay
     byte-identical to the in-process path: same parity matrix
-    derivation (ops/gf.parity_matrix), same native kernels."""
+    derivation (erasure/registry entry for the codec id), same native
+    kernels — the codec only changes the byte matrix, never the
+    kernel, which is what keeps this shm path codec-agnostic."""
     from ..erasure.bitrot import hash_strided_digests
     from ..ops import gf_native
 
     shm, data, parity, digests = _attach_segment(name, batch, k, m, shard)
     try:
-        mat = mats.get((k, m))
+        mat = mats.get((codec, k, m))
         if mat is None:
-            from ..ops import gf
+            from ..erasure import registry
 
-            mat = gf.parity_matrix(k, m)
-            mats[(k, m)] = mat
+            entry = registry.get(codec or registry.DEFAULT_CODEC)
+            mat = entry.parity_matrix(k, m)
+            mats[(codec, k, m)] = mat
         gf_native.apply_matrix_batch(
             mat, data[:nb].reshape(nb, k, shard), out=parity[:nb]
         )
@@ -381,21 +385,23 @@ def _child_encode(mats: dict, name: str, batch: int, nb: int,
 
 def _child_recon(name: str, batch: int, nb: int, k: int, m: int,
                  shard: int, present: tuple, targets: tuple,
-                 with_digests: bool) -> None:
+                 with_digests: bool, codec: str | None = None) -> None:
     """One decode/heal batch: rebuild `targets` shards from the k
     survivor rows in the segment's data region into the (flat-viewed)
     parity region, plus their frame digests for heal. Byte-identical to
     the in-process path by construction: the SAME cached reconstruction
-    matrix (ops/gf.reconstruct_matrix) applied by the SAME native
-    kernel (gf_native.apply_matrix_batch)."""
+    matrix (the codec's registry entry, lru-backed) applied by the SAME
+    native kernel (gf_native.apply_matrix_batch)."""
+    from ..erasure import registry
     from ..erasure.bitrot import hash_strided_digests
-    from ..ops import gf, gf_native
+    from ..ops import gf_native
 
     shm, data, parity, digests = _attach_segment(name, batch, k, m, shard)
     out = dig = None
     try:
         t = len(targets)
-        mat = gf.reconstruct_matrix(k, m, list(present), list(targets))
+        entry = registry.get(codec or registry.DEFAULT_CODEC)
+        mat = entry.reconstruct_matrix(k, m, list(present), list(targets))
         out = parity.reshape(-1)[: nb * t * shard].reshape(nb, t, shard)
         gf_native.apply_matrix_batch(
             mat, data[:nb].reshape(nb, k, shard), out=out
@@ -675,32 +681,36 @@ class WorkerPool:
     # -- dispatch ----------------------------------------------------------
 
     def encode_batch(self, strip: ShmStrip, nb: int,
+                     codec: str | None = None,
                      _test_crash: bool = False) -> None:
         """Run one batch's GF encode + strided digests in a worker.
         On return, strip.parity[:nb] and strip.digests[:, :nb] hold
-        the results. Raises WorkerCrashed / WorkerUnavailable; the shm
-        data region is untouched either way, so callers recompute
-        in-process from the same bytes."""
+        the results. `codec` is the registry codec id determining the
+        parity matrix (None = dense default). Raises WorkerCrashed /
+        WorkerUnavailable; the shm data region is untouched either way,
+        so callers recompute in-process from the same bytes."""
         self._dispatch(
             "encode",
             ("enc", strip.name, strip.batch, nb,
-             strip.k, strip.m, strip.shard),
+             strip.k, strip.m, strip.shard, codec),
             _test_crash=_test_crash,
         )
 
     def recon_batch(self, strip: ShmStrip, nb: int, present: tuple,
                     targets: tuple, digests: bool, op: str = "decode",
+                    codec: str | None = None,
                     _test_crash: bool = False) -> None:
         """Rebuild `targets` shards from the k survivor rows in
         strip.recon_src(nb) (rows in `present` order). On return,
         strip.recon_out(nb, len(targets)) holds the rebuilt shards and
         — when `digests` — strip.recon_digests(nb, len(targets)) their
         frame digests. `op` labels the telemetry: "decode" (degraded
-        GET) or "heal"."""
+        GET) or "heal"; `codec` the registry codec id (None = dense)."""
         self._dispatch(
             op,
             ("rec", strip.name, strip.batch, nb, strip.k, strip.m,
-             strip.shard, tuple(present), tuple(targets), bool(digests)),
+             strip.shard, tuple(present), tuple(targets), bool(digests),
+             codec),
             _test_crash=_test_crash,
         )
 

@@ -163,6 +163,11 @@ class Server:
         _admission.set_metrics(self.metrics)
         _workers.set_metrics(self.metrics)
         _workers.armed()
+        # Codec registry: selection/dispatch counters and probe gauges
+        # (mtpu_codec_*) for the pluggable erasure-codec plane.
+        from .erasure import registry as _codec_registry
+
+        _codec_registry.set_metrics(self.metrics)
         # Request-span tracing plane (ISSUE 12): per-kind latency
         # histograms (mtpu_span_seconds) and slow-request capture
         # counts flow through the same registry; pub/sub buses count

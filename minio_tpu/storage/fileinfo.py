@@ -53,7 +53,15 @@ class ChecksumInfo:
 @dataclass
 class ErasureInfo:
     """Erasure geometry + this disk's shard index (cmd/erasure-metadata.go
-    ErasureInfo)."""
+    ErasureInfo).
+
+    `codec` is the registry codec id (erasure/registry.py) that produced
+    this object's parity bytes — per-object codec identity. "" means the
+    field was absent on disk (pre-registry metadata): from_dict resolves
+    that to the dense default IF the wire algorithm is the legacy
+    rs-vandermonde, and fails loud otherwise, so a registry-written
+    non-dense object can never silently misdecode through old-shaped
+    metadata."""
 
     algorithm: str = ERASURE_ALGORITHM
     data_blocks: int = 0
@@ -62,6 +70,7 @@ class ErasureInfo:
     index: int = 0  # 1-based position of this disk in `distribution`
     distribution: list[int] = field(default_factory=list)
     checksums: list[ChecksumInfo] = field(default_factory=list)
+    codec: str = ""  # registry codec id; "" = absent-on-disk (dense)
 
     def shard_size(self) -> int:
         from ..utils import ceil_frac
@@ -93,6 +102,7 @@ class ErasureInfo:
     def equals(self, other: "ErasureInfo") -> bool:
         return (
             self.algorithm == other.algorithm
+            and self.codec == other.codec
             and self.data_blocks == other.data_blocks
             and self.parity_blocks == other.parity_blocks
             and self.block_size == other.block_size
@@ -100,7 +110,7 @@ class ErasureInfo:
         )
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "algo": self.algorithm,
             "k": self.data_blocks,
             "m": self.parity_blocks,
@@ -109,17 +119,50 @@ class ErasureInfo:
             "dist": list(self.distribution),
             "cs": [c.to_dict() for c in self.checksums],
         }
+        # "cid" is only written when the codec is known — legacy-shaped
+        # metadata (and the upgrade path's rewrite of it) stays
+        # byte-stable until an object is actually rewritten.
+        if self.codec:
+            d["cid"] = self.codec
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ErasureInfo":
+        from ..erasure import registry
+
+        algorithm = d["algo"]
+        codec = d.get("cid", "")
+        if codec:
+            if codec not in registry.codec_ids():
+                raise ValueError(
+                    f"xl.meta names unknown erasure codec {codec!r} "
+                    f"(registered: {sorted(registry.codec_ids())}); "
+                    "refusing to decode with the wrong matrices"
+                )
+            wire = registry.get(codec).wire_algorithm
+            if algorithm != wire:
+                raise ValueError(
+                    f"xl.meta codec {codec!r} / algorithm {algorithm!r} "
+                    f"mismatch (expected {wire!r})"
+                )
+        elif algorithm == ERASURE_ALGORITHM:
+            # Pre-registry metadata: every object ever written before
+            # the codec field existed is dense Vandermonde RS.
+            codec = registry.DEFAULT_CODEC
+        else:
+            raise ValueError(
+                f"xl.meta has no codec id and a non-legacy erasure "
+                f"algorithm {algorithm!r}; refusing to guess"
+            )
         return cls(
-            algorithm=d["algo"],
+            algorithm=algorithm,
             data_blocks=d["k"],
             parity_blocks=d["m"],
             block_size=d["bs"],
             index=d["idx"],
             distribution=list(d["dist"]),
             checksums=[ChecksumInfo.from_dict(c) for c in d["cs"]],
+            codec=codec,
         )
 
 

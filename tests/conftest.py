@@ -76,7 +76,8 @@ def mesh_subprocess():
     repo_root = os.path.dirname(tests_dir)
 
     def run(shape: str, payload_mib: int = 8,
-            timeout_s: float = 300.0) -> str:
+            timeout_s: float = 300.0,
+            extra_env: dict | None = None) -> str:
         env = dict(os.environ)
         env.update({
             "JAX_PLATFORMS": "cpu",
@@ -85,6 +86,9 @@ def mesh_subprocess():
             "MTPU_MESH_SHAPE": shape,
             "MTPU_MESH_CHILD_TIMEOUT_S": str(timeout_s),
         })
+        # e.g. MTPU_CODEC to drive the whole proof under a non-default
+        # erasure codec (test_cauchy_codec's mesh substrate proof).
+        env.update(extra_env or {})
         try:
             r = subprocess.run(
                 [sys.executable, os.path.join(tests_dir, "_mesh_child.py"),

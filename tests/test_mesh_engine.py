@@ -258,17 +258,29 @@ def test_mesh_heal_stream_restores_framing(monkeypatch):
 @pytest.mark.parametrize("shape", ["2x4"])
 def test_mesh_serving_object_layer(mesh_subprocess, shape):
     """One subprocess proof in tier-1, on the richest shape (dp>1 AND
-    multi-lane). The full shape sweep — 1x8, 2x4, 4x2, each with the
-    same ObjectLayer byte-verification — runs in
-    __graft_entry__.dryrun_multichip (the MULTICHIP evidence artifact);
-    lane-maximal sharding is additionally covered in-process above."""
-    out = mesh_subprocess(shape, payload_mib=4)
+    multi-lane), forced to the NON-DEFAULT cauchy codec end to end — so
+    the one child proves both the mesh serving path (PutObject ->
+    degraded GetObject -> HealObject through ObjectLayer) and the codec
+    registry's mesh substrate (the codec id stamped at PUT drives the
+    mesh reconstruction, and the in-child native-ref comparison shows
+    mesh-cauchy bytes == native-cauchy bytes). Dense mesh math is
+    byte-proven in-process above against the host oracle; the full
+    dense shape sweep — 1x8, 2x4, 4x2, same ObjectLayer verification —
+    runs in __graft_entry__.dryrun_multichip (the MULTICHIP evidence
+    artifact). One subprocess total: a second child for the default
+    codec would re-pay the jax init + mesh compile (~70 s) the tier-1
+    budget does not have."""
+    from minio_tpu.erasure import registry
+
+    out = mesh_subprocess(shape, payload_mib=4,
+                          extra_env={"MTPU_CODEC": registry.CAUCHY_XOR})
     line = next(
         ln for ln in out.splitlines() if ln.startswith("MESH_EVIDENCE ")
     )
     ev = json.loads(line[len("MESH_EVIDENCE "):])
     dp, _, lanes = shape.partition("x")
     assert ev["shape"] == {"dp": int(dp), "lanes": int(lanes)}
+    assert ev["codec"] == registry.CAUCHY_XOR
     assert ev["dispatches_per_batch"] == 1.0
     assert ev["steady_state_retraces"] == 0
     assert ev["degraded_get_dispatches"] > 0

@@ -77,6 +77,20 @@ def test_streams_cover_every_op_class_at_gate_scale():
     assert ops == set(ALL_OPS)
 
 
+def test_streams_mix_codecs_at_gate_scale():
+    """Every PUT-like op carries a planned codec id and, at gate scale,
+    every registered codec appears — the soak bucket interleaves codec
+    identities, so the drain invariants run across codec boundaries
+    (ISSUE 16), not on a homogeneous bucket."""
+    from minio_tpu.erasure import registry
+
+    spec = ScenarioSpec(seed=1337, clients=8, ops_per_client=10)
+    put_like = [o for c in range(spec.clients)
+                for o in client_stream(spec, c) if "size" in o]
+    assert all(o.get("codec") in registry.codec_ids() for o in put_like)
+    assert {o["codec"] for o in put_like} == set(registry.codec_ids())
+
+
 # ---------------------------------------------------------------------------
 # mini soaks (the engine end to end, tier-1 sized)
 

@@ -41,6 +41,9 @@ HOT_PATHS = {
     # stray materialization there skews the throughput-floor numbers
     # the gate enforces.
     "minio_tpu/faults/scenarios.py",
+    # Added with ISSUE 16: codec selection/probing sits on every PUT's
+    # setup path (ops/cauchy.py rides the existing ops/ prefix).
+    "minio_tpu/erasure/registry.py",
 }
 HOT_PREFIXES = ("minio_tpu/ops/",)
 

@@ -476,6 +476,75 @@ def bench_config6_closed_loop(root: str, ns=(8, 32, 64),
     return out
 
 
+def bench_config7_loadgen(root: str, clients: int = 64,
+                          ops_per_client: int = 4) -> dict:
+    """Config 7: the closed-loop load-generation harness at gate scale
+    (ISSUE 17) — >= 64 zipfian clients over the signed HTTP plane with
+    every fault plane armed (bounded hang included), reporting the soak
+    gate's own numbers: memcpy-normalized aggregate throughput, per-op-
+    class client p50/p99 off the latency board, span-plane p99
+    attribution, the hang-fault fire count the detach proof ran
+    against, plus the heal-storm paced-drain figures (degraded-vs-
+    baseline p99 ratio, final ledger heal ratio, pacer counters).
+    Skips cleanly on 1-core hosts: 64 closed-loop issuers on one core
+    measure the scheduler, not the store."""
+    if (os.cpu_count() or 1) < 2:
+        return {"skipped": "single-core host: 64 closed-loop clients "
+                           "would measure the scheduler, not the store"}
+    from minio_tpu.faults.scenarios import (
+        ScenarioSpec,
+        host_memcpy_gbps,
+        run_heal_storm,
+        run_scenario,
+    )
+
+    spec = ScenarioSpec(
+        seed=1337, clients=clients, ops_per_client=ops_per_client,
+        disks=8, parity=4,
+        payload_sizes=(16 << 10, 64 << 10, 256 << 10),
+        fault_drives=2, worker_kills=1, peer_blackouts=1,
+        remote_disks=2, blip_s=1.0, admission_slots=2, lock_check=False,
+    )
+    res = run_scenario(spec, os.path.join(root, "loadgen"))
+    art = res.to_dict()
+    memcpy = host_memcpy_gbps()
+    hang_fired = sum(s["fired"] for st in art["fault_status"]
+                     for s in st["specs"] if s["kind"] == "hang")
+    out: dict = {
+        "passed": res.passed,
+        "clients": spec.clients,
+        "ops_per_client": spec.ops_per_client,
+        "bytes_moved": res.bytes_moved,
+        "wall_s": round(res.wall_s, 3),
+        "aggregate_gbps": round(res.throughput_gbps, 5),
+        "value_per_memcpy": round(res.throughput_gbps / memcpy, 7),
+        "host_memcpy_gbps": round(memcpy, 2),
+        "hang_faults_fired": hang_fired,
+        "latency": art["latency"],
+        "span_p99": art["span_p99"],
+        "violations": {k: v for k, v in res.violations.items() if v},
+    }
+    # Heal storm under zipfian foreground: the adaptive pacer's
+    # headline numbers, recorded alongside the load-gen run they bound.
+    storm_spec = ScenarioSpec(
+        seed=1337, clients=8, ops_per_client=4, disks=8, parity=4,
+        hot_keys=0, fault_drives=0, worker_kills=0,
+        payload_sizes=(64 << 10,),
+    )
+    storm = run_heal_storm(storm_spec, os.path.join(root, "storm"),
+                           storm_objects=24, fg_clients=6, fg_ops=25,
+                           payload=64 << 10)
+    out["heal_storm"] = {
+        "passed": storm["passed"],
+        "p99_ratio": storm["p99_ratio"],
+        "p99_mult": storm["p99_mult"],
+        "heal_ratio_final": storm["heal_ratio"]["final"],
+        "mrf_left": storm["mrf_left"],
+        "pacer": storm["pacer"],
+    }
+    return out
+
+
 def bench_multipart_parallel(root: str, total_mib: int = 48) -> dict:
     """Single-object ingest two ways: serial PUT (one MD5 stream — the
     measured ~0.66 GB/s wall) vs the parallel multipart driver
@@ -1601,6 +1670,16 @@ def main() -> None:
         configs["c6_many_client_closed_loop"] = {
             "error": f"{type(exc).__name__}: {exc}"
         }
+    # Config 7: closed-loop load generation at soak-gate scale with
+    # every fault plane armed, plus the paced heal storm (ISSUE 17).
+    try:
+        c7_root = os.path.join(root, "c7-loadgen")
+        try:
+            configs["c7_loadgen"] = bench_config7_loadgen(c7_root)
+        finally:
+            _cleanup(c7_root)
+    except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort
+        configs["c7_loadgen"] = {"error": f"{type(exc).__name__}: {exc}"}
     try:
         stages = bench_put_stages(root)
     except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort

@@ -105,12 +105,31 @@ class MRFHealer:
         self._interval_s = max(1e-3, interval_s)
 
         def loop():
-            while not self._stop.wait(interval_s):
+            while not self._stop.wait(self._pace_delay(interval_s)):
                 self.drain_once()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
         return self
+
+    @staticmethod
+    def _pace_delay(interval_s: float) -> float:
+        """Stretch the drain interval while the heal pacer reports
+        foreground pressure (ISSUE 17): the per-heal pace slot already
+        yields inside a pass, but skipping the NEXT pass entirely is
+        cheaper than starting one that will spend its time yielding.
+        Bounded at 4x so the backlog always keeps draining."""
+        from . import healpace
+
+        p = healpace.installed()
+        if p is None or not p.cfg.enabled:
+            return interval_s
+        try:
+            if p.pressured():
+                return min(4.0 * interval_s, interval_s + 2.0)
+        except Exception:  # noqa: BLE001 - pacing must never kill drain
+            pass
+        return interval_s
 
     def stop(self):
         self._stop.set()

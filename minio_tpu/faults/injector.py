@@ -57,7 +57,8 @@ class FaultSpec:
 
     def __init__(self, kind: str, ops=None, calls=None,
                  probability: float = 0.0, latency_s: float = 0.0,
-                 error: Exception | type | str | None = None):
+                 error: Exception | type | str | None = None,
+                 hold_s: float = 0.0):
         if kind not in self.KINDS:
             raise ValueError(f"unknown fault kind {kind!r}")
         self.kind = kind
@@ -66,6 +67,13 @@ class FaultSpec:
         self.probability = float(probability)
         self.latency_s = float(latency_s)
         self.error = error
+        # hang only: a BOUNDED stall — the op blocks hold_s then
+        # proceeds normally (an NFS blip / firmware pause), vs the
+        # default hold_s=0 "wedged until disarm" hang that errors at
+        # MAX_HANG_S. Bounded hangs are what a soak plan arms: they
+        # exercise the deadline/detach/hedge path without pinning a
+        # client thread for the full safety cap.
+        self.hold_s = float(hold_s)
         # Times this spec actually fired (schedule-lock guarded by the
         # owning FaultSchedule's _match).
         self.fired = 0
@@ -79,6 +87,7 @@ class FaultSpec:
             probability=d.get("probability", 0.0),
             latency_s=d.get("latency_s", 0.0),
             error=d.get("error"),
+            hold_s=d.get("hold_s", 0.0),
         )
 
     def to_dict(self) -> dict:
@@ -88,6 +97,7 @@ class FaultSpec:
             "calls": sorted(self.calls) if self.calls else None,
             "probability": self.probability,
             "latency_s": self.latency_s,
+            "hold_s": self.hold_s,
             "error": (self.error if isinstance(self.error, str)
                       else getattr(self.error, "__name__",
                                    None if self.error is None
@@ -170,8 +180,14 @@ class FaultSchedule:
             self._released.wait(timeout=spec.latency_s)
             return None
         if spec.kind == "hang":
-            self._released.wait(timeout=MAX_HANG_S)
+            hold = spec.hold_s or MAX_HANG_S
+            self._released.wait(timeout=min(hold, MAX_HANG_S))
             if not self.active:
+                return None
+            if spec.hold_s:
+                # Bounded stall elapsed: the op proceeds normally —
+                # whether the CALLER already gave up at its deadline is
+                # exactly what the detach/hedge path decides.
                 return None
             raise ErrDiskNotFound(f"injected hang on {op} hit MAX_HANG_S")
         return "bitrot"

@@ -69,6 +69,7 @@ from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
 from ..utils.fanout import StragglerCompensator
 from ..utils.fanout import decode_slot as _decode_slot
 from ..utils.fanout import encode_slot as _encode_slot
+from ..utils.fanout import heal_slot as _heal_slot
 
 # Commit/delete stragglers detached by _quorum_fanout keep occupying
 # their _obj_pool worker until the hung call returns; compensate the
@@ -1048,7 +1049,10 @@ class ErasureObjects(MultipartMixin):
         # scanner sampling, fresh-disk sweep — passes here, so the tag
         # is set once and the ledger's heal read/write ratio (bytes read
         # per byte healed) is complete by construction.
-        with _ioflow.tag("heal", bucket=bucket), \
+        # Pace slot BEFORE the object lock: a heal yielding to
+        # foreground pressure must not do so while holding the write
+        # lock a foreground PUT of the same object needs.
+        with _ioflow.tag("heal", bucket=bucket), _heal_slot(), \
                 self._locked_write(bucket, object_):
             return self._heal_object(bucket, object_, version_id,
                                      remove_dangling)

@@ -196,6 +196,13 @@ from ..erasure.registry import CODEC_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += CODEC_DESCRIPTORS
 
+# Adaptive heal pacing (background/healpace.py, jax-free import):
+# background-class token budget, pressure yields and deadline grants
+# for heal I/O competing with foreground traffic (ISSUE 17).
+from ..background.healpace import HEALPACE_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += HEALPACE_DESCRIPTORS
+
 
 def mrf_scoreboard(ol) -> dict:
     """One traversal of the heal/MRF scoreboard (ISSUE 14), consumed by
@@ -271,6 +278,7 @@ class MetricsCollector:
         self._collect_iam(m)
         self._collect_mrf(m)
         self._collect_ioflow(m)
+        self._collect_healpace(m)
         self._collect_node(m)
 
     # Remote-disk stats are RPCs; bound how often a scrape pays them so
@@ -450,6 +458,26 @@ class MetricsCollector:
             [({"bucket": e["bucket"]}, e["bytes"])
              for e in ioflow.hot_buckets()],
         )
+
+    def _collect_healpace(self, m):
+        """Heal pacer mirror (ISSUE 17). installed() never constructs:
+        deployments without heal traffic keep a clean exposition."""
+        from ..background import healpace
+
+        p = healpace.installed()
+        if p is None:
+            return
+        snap = p.snapshot()
+        m.set_gauge("heal_pace_tokens", snap["tokens"])
+        m.set_gauge("heal_pace_inflight", snap["inflight"])
+        m.set_gauge("heal_pace_disk_p99_seconds",
+                    snap["disk_p99_ms"] / 1000.0)
+        m.set_counter("heal_pace_grants_total", snap["grants_total"])
+        m.set_counter("heal_pace_deadline_grants_total",
+                      snap["deadline_grants_total"])
+        m.set_counter("heal_pace_yields_total", snap["yields_total"])
+        m.set_counter("heal_pace_throttle_seconds_total",
+                      snap["throttle_seconds_total"])
 
     def _collect_node(self, m):
         m.set_gauge("node_uptime_seconds", time.time() - self.started)

@@ -405,6 +405,14 @@ class AdmissionGovernor:
 
     # -- introspection -----------------------------------------------------
 
+    def backlog(self) -> int:
+        """Current queue depth — the heal pacer's foreground-pressure
+        probe. Lock-free: a momentarily stale depth only shifts WHEN a
+        heal yields, never correctness."""
+        # guardedby-ok: racy telemetry read of an int the CPython VM
+        # loads atomically; staleness is bounded by one grant cycle
+        return self._waiting
+
     def snapshot(self) -> dict:
         with self._cv:
             return {

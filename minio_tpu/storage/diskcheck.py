@@ -71,6 +71,15 @@ _PASSTHROUGH = frozenset({
 
 _ID_CHECK_INTERVAL_S = 5.0
 
+
+def _pace_note(elapsed_s: float) -> None:
+    """Feed a timed disk-op latency to the heal pacer's foreground
+    pressure window (ISSUE 17). Lazy import keeps storage import-light;
+    the pacer itself filters background-class ops via the ioflow tag."""
+    from ..background import healpace
+
+    healpace.note_disk_op(elapsed_s)
+
 # Byte accounting happens ONLY at the syscall layer of the node that
 # owns the disk (storage/local.py, storage/directio.py); the op tag
 # crosses the storage-REST wire in a header (distributed/rest.py), so
@@ -346,6 +355,7 @@ class MetricsDisk:
                         "disk", f"{op}:{self._disk.endpoint()}",
                         int((time.perf_counter() - t0) * 1e9),
                     )
+                _pace_note(time.perf_counter() - t0)
             if guarded:
                 self._posthoc_breaker(op, time.perf_counter() - t0)
             return out
@@ -450,6 +460,9 @@ class MetricsDisk:
                     self._metrics.inc("disk_faulty_total", disk=ep)
             if latched:
                 self._start_probe()
+            # An abandoned op cost its caller the FULL deadline — that
+            # is the latency the pacer's pressure window must see.
+            _pace_note(deadline_s)
             raise ErrDiskOpTimeout(
                 f"{op} on {ep} exceeded {deadline_s}s deadline"
             ) from None
@@ -470,6 +483,7 @@ class MetricsDisk:
             self._metrics.observe(
                 "disk_op_seconds", time.perf_counter() - t0, op=op
             )
+        _pace_note(time.perf_counter() - t0)
         return out
 
     # --- re-admission probe (ref the monitor's reconnect loop, scoped

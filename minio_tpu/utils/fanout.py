@@ -79,6 +79,17 @@ def decode_slot():
     return read_governor().slot()
 
 
+def heal_slot():
+    """The background-class twin (ISSUE 17): every object heal's
+    read+re-encode section takes a token from the heal pacer's small
+    background budget — yielding while foreground queue depth or disk
+    p99 is high, but always granted within the pace deadline so a
+    saturated foreground can slow the MRF drain, never wedge it."""
+    from ..background.healpace import pacer
+
+    return pacer().heal_slot()
+
+
 def is_local_sink(sink) -> bool:
     """A sink whose write() is a local syscall/memory op (raw or buffered
     file, fsync wrapper, BytesIO) — safe to run inline on 1 core."""

@@ -1,0 +1,66 @@
+"""Subprocess child for the mesh-engine soak variant (ISSUE 17): run
+the mini mixed-workload scenario TWICE on a forced 8-device CPU mesh
+with MTPU_ENCODE_ENGINE=mesh. Run 1 is the warm-up (jit traces are
+legal); run 2 sets MTPU_MESH_WARM=1 so the mesh_stats_clean drain
+invariant also rejects steady-state retraces — the jit cache must be
+shape-stable under the full op mix (PUT / degraded-GET / heal /
+multipart across every registered codec). Prints one MESH_SOAK json
+line for the parent to assert on.
+
+Runs standalone too:  python tests/_mesh_soak_child.py /tmp/root 4242
+"""
+
+import faulthandler
+import json
+import os
+import sys
+
+
+def main() -> None:
+    timeout_s = float(os.environ.get("MTPU_MESH_CHILD_TIMEOUT_S", "540"))
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(max(30.0, timeout_s - 20.0),
+                                      exit=True)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from minio_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(8)
+    os.environ["MTPU_ENCODE_ENGINE"] = "mesh"
+
+    root = sys.argv[1]
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 4242
+
+    from minio_tpu.faults import scenarios as sc
+    from minio_tpu.parallel.metrics import STATS
+
+    out = {"runs": []}
+    for i, warm in enumerate(("", "1")):
+        os.environ["MTPU_MESH_WARM"] = warm
+        # Payloads must EXCEED the 1 MiB erasure block size: only full
+        # blocks batch through encode_batch_async onto the mesh — the
+        # sub-block tail always takes the host path, so a small-object
+        # soak would "pass" without a single collective dispatch.
+        spec = sc.ScenarioSpec(
+            seed=seed + i, clients=2, ops_per_client=4, disks=8,
+            parity=4, payload_sizes=(2 << 20,),
+            fault_drives=0, worker_kills=0, lock_check=False,
+            hot_keys=0,
+        )
+        res = sc.run_scenario(spec, os.path.join(root, f"run{i}"))
+        art = res.to_dict()
+        out["runs"].append({
+            "warm": bool(warm),
+            "passed": art["passed"],
+            "violations": art["violations"],
+        })
+    out["stats"] = {k: STATS[k] for k in
+                    ("mesh_dispatches_total", "mesh_batches_total",
+                     "mesh_retraces_total")}
+    print("MESH_SOAK " + json.dumps(out, sort_keys=True))
+    faulthandler.cancel_dump_traceback_later()
+
+
+if __name__ == "__main__":
+    main()

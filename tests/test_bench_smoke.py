@@ -286,6 +286,46 @@ def test_c6_skips_on_one_core(tmp_path, monkeypatch):
     assert out == {"skipped": "single-core host: no fan-in concurrency"}
 
 
+def test_c7_loadgen_skips_honestly_on_one_core(tmp_path, monkeypatch):
+    """ISSUE 17: the load-gen section must publish {"skipped": ...} on
+    a 1-core host — 64 closed-loop threads there measure the scheduler,
+    and a fake number would poison every cross-round comparison."""
+    import os
+
+    import bench
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    out = bench.bench_config7_loadgen(str(tmp_path))
+    assert set(out) == {"skipped"}
+    assert "single-core" in out["skipped"]
+
+
+def test_c7_loadgen_reports_gate_numbers(tmp_path):
+    """Multicore only: a small c7 run must carry the soak gate's own
+    numbers — latency board, span p99 attribution, hang fire count,
+    and the heal-storm pacer block."""
+    import os
+
+    import bench
+
+    if (os.cpu_count() or 1) < 2:
+        import pytest
+
+        pytest.skip("single-core host: c7 skips by contract")
+    out = bench.bench_config7_loadgen(str(tmp_path), clients=64,
+                                      ops_per_client=2)
+    assert out["passed"], out.get("violations")
+    assert out["clients"] >= 64
+    assert out["hang_faults_fired"] > 0
+    assert out["latency"]["all"]["count"] >= 64
+    assert out["span_p99"].get("request")
+    storm = out["heal_storm"]
+    assert storm["passed"]
+    assert storm["mrf_left"] == 0
+    assert storm["p99_ratio"] <= storm["p99_mult"]
+    assert storm["pacer"]["grants_total"] >= 24
+
+
 def test_worker_pool_path_keeps_copy_floor(tmp_path, monkeypatch):
     """copies_per_input_byte must be UNCHANGED under the worker-pool
     path: the shm strip is filled by the same one-readinto-per-block

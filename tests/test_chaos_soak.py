@@ -3,15 +3,26 @@
 (minio_tpu/faults/scenarios.py — docs/SOAK.md has the grammar,
 invariant table, and seed-replay workflow).
 
-Three gates:
+Five gates:
 
-- **mixed soak** — >= 8 concurrent clients across every op class
-  (PUT/GET/degraded-GET/heal/list/parallel-multipart/lifecycle-expiry/
-  versioned-delete) against the real S3 handlers with all three fault
-  planes armed (seeded drive faults, worker kill -9, storage-REST peer
+- **mixed soak** — >= 64 closed-loop clients with zipfian key
+  popularity across every op class (PUT/GET/degraded-GET/heal/list/
+  parallel-multipart/lifecycle-expiry/versioned-delete) against the
+  real S3 handlers with all three fault planes armed (seeded drive
+  faults INCLUDING a bounded hang, worker kill -9, storage-REST peer
   blackout) plus an admission squeeze; every invariant must hold at
-  drain, the same seed must reproduce the identical fault sequence,
-  and throughput must clear a memcpy-normalized floor;
+  drain — including the per-op stall bound that proves the diskcheck
+  deadline -> straggler-detach -> hedged-read path at soak scale — the
+  same seed must reproduce the identical fault sequence, and
+  throughput must clear a memcpy-normalized floor;
+- **heal storm** — dead drive + full MRF backlog drained by the
+  adaptive heal pacer under zipfian foreground load: degraded p99
+  bounded by a multiple of the unfaulted baseline, backlog dry, ledger
+  heal ratio inside the dense-RS bounds throughout;
+- **mesh variant** — a subprocess gate (MTPU_ENCODE_ENGINE=mesh on a
+  forced 8-device CPU mesh) running the mini soak twice: the warmed
+  second run must be STATS-clean (dispatches == batches, zero
+  steady-state retraces);
 - **worker-kill proof** — a forced-multicore child where the kill -9
   lands on a REAL worker pid and the pool falls back/respawns clean;
 - **crash recovery** — server SIGKILL mid-PUT, then restart over the
@@ -42,15 +53,22 @@ MIB = 1 << 20
 
 def _gate_spec() -> ScenarioSpec:
     """The gate's canonical shape; seed/clients/ops stay env-tunable
-    for replay (MTPU_SOAK_SEED / _CLIENTS / _OPS)."""
+    for replay (MTPU_SOAK_SEED / _CLIENTS / _OPS). ISSUE 17 scale:
+    >= 64 closed-loop clients (thread-cheap issuers over the signed
+    HTTP plane) with zipfian hot-key GETs; payloads shrink vs the old
+    8-client gate so total bytes stay CI-sized while CONCURRENCY
+    grows 8x."""
     spec = ScenarioSpec(
+        clients=int(os.environ.get("MTPU_SOAK_CLIENTS", "64")),
+        ops_per_client=int(os.environ.get("MTPU_SOAK_OPS", "4")),
         disks=8, parity=4,
-        payload_sizes=(64 << 10, 256 << 10, MIB, 2 * MIB),
+        payload_sizes=(16 << 10, 64 << 10, 256 << 10),
         fault_drives=2, worker_kills=1, peer_blackouts=1,
         remote_disks=2, blip_s=1.0, admission_slots=2,
         lock_check=True,
     )
-    assert spec.clients >= 8, "the gate needs >= 8 concurrent clients"
+    assert spec.clients >= 64, "the gate needs >= 64 concurrent clients"
+    assert spec.hang_drives >= 1, "the gate needs the hang plane armed"
     return spec
 
 
@@ -65,10 +83,17 @@ def test_mixed_soak_gate(tmp_path):
         ops = {o["op"] for c in plan["clients"] for o in c}
         assert ops == set(ALL_OPS), f"op classes missing: "\
             f"{set(ALL_OPS) - ops}"
-    # All three fault planes armed.
+    # All three fault planes armed — including the bounded hang (no op
+    # filter, scripted on the shared call counter) and zipfian hot GETs.
     assert plan["faults"]["drive_schedules"], "no drive faults armed"
     kinds = {e["kind"] for e in plan["faults"]["events"]}
     assert {"worker_kill", "peer_blackout"} <= kinds
+    hangs = [s for _, sch in plan["faults"]["drive_schedules"]
+             for s in sch["specs"] if s["kind"] == "hang"]
+    assert hangs and all(s["hold_s"] > 0 for s in hangs), \
+        "the gate needs bounded hang faults in the default plan"
+    assert any("hot" in o for c in plan["clients"] for o in c), \
+        "no zipfian hot GETs planned at gate scale"
 
     res = run_scenario(spec, str(tmp_path))
     art = res.to_dict()
@@ -80,6 +105,16 @@ def test_mixed_soak_gate(tmp_path):
     assert art["drive_faults_fired"] > 0, "chaos never actually fired"
     # Network fault really fired.
     assert any(e["kind"] == "peer_blackout" for e in res.fault_log)
+    # The hang REALLY fired (fault_status carries per-spec counts) and
+    # the stall-bound invariant scanned a populated latency board — the
+    # detach/hedge proof ran against live hangs, not a clean run.
+    hang_fired = sum(s["fired"] for st in art["fault_status"]
+                     for s in st["specs"] if s["kind"] == "hang")
+    assert hang_fired > 0, (
+        f"hang spec never fired: {json.dumps(art['fault_status'])[:2000]}")
+    assert art["latency"]["all"]["count"] >= spec.clients, \
+        "latency board missed the client plane"
+    assert art["span_p99"].get("request"), "span p99 attribution empty"
 
     # Same seed => byte-identical fault sequence + op streams.
     replay = scenario_plan(_gate_spec())
@@ -143,3 +178,61 @@ def test_kill9_mid_put_restart_recovery(tmp_path):
     assert art["partial_visible_on"] == [], art
     assert art["healed_byte_identical"], art
     assert art["recovered"], art
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_heal_storm_paced_drain_gate(tmp_path):
+    """Dead drive + full-keyspace MRF storm drained by the adaptive
+    heal pacer WHILE zipfian foreground traffic runs (ISSUE 17):
+    degraded GET p99 bounded by MTPU_HEAL_P99_MULT x the unfaulted
+    baseline, backlog dry, ledger heal ratio inside the dense-RS
+    bounds throughout, victim restored byte-identical, and every heal
+    through the pace plane."""
+    from minio_tpu.faults.scenarios import run_heal_storm
+
+    spec = ScenarioSpec(disks=8, parity=4, clients=8, ops_per_client=4,
+                        hot_keys=0, fault_drives=0, worker_kills=0,
+                        payload_sizes=(64 << 10,))
+    art = run_heal_storm(spec, str(tmp_path), storm_objects=24,
+                         fg_clients=6, fg_ops=25, payload=64 << 10)
+    assert art["passed"], json.dumps(
+        {k: v for k, v in art.items() if k != "spec"}, indent=2)[:8000]
+    assert art["mrf_left"] == 0, "pacing wedged the MRF drain"
+    assert art["victim_restored"] == 24
+    assert art["pacer"]["grants_total"] >= 24
+    assert art["p99_ratio"] <= art["p99_mult"]
+    k, m = 4, 4
+    assert (k / m) * 0.98 <= art["heal_ratio"]["final"] <= k * 1.02
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_mesh_soak_variant_is_stats_clean(tmp_path):
+    """MTPU_ENCODE_ENGINE=mesh subprocess gate: the mini soak runs
+    twice on a forced 8-device CPU mesh; the warmed second run must be
+    STATS-clean — dispatches == batches over the scenario and zero
+    steady-state retraces (MTPU_MESH_WARM=1 arms the retrace check in
+    the mesh_stats_clean drain invariant)."""
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MTPU_MESH_WARM", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(tests_dir, "_mesh_soak_child.py"),
+         str(tmp_path), "4242"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(tests_dir),
+    )
+    assert r.returncode == 0, (
+        f"mesh soak child rc={r.returncode}\n--- stdout ---\n"
+        f"{r.stdout}\n--- stderr ---\n{r.stderr}"
+    )
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("MESH_SOAK ")][-1]
+    out = json.loads(line[len("MESH_SOAK "):])
+    for run in out["runs"]:
+        assert run["passed"], json.dumps(run, indent=2)[:8000]
+    assert out["stats"]["mesh_dispatches_total"] > 0, \
+        "the mesh engine never dispatched — the variant proved nothing"
+    assert (out["stats"]["mesh_dispatches_total"]
+            == out["stats"]["mesh_batches_total"]), out["stats"]

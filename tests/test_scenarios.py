@@ -10,6 +10,9 @@ coverage. The full-size gate lives in tests/test_chaos_soak.py
 import io
 import json
 import os
+import random
+import threading
+import time
 import types
 
 import pytest
@@ -134,8 +137,13 @@ def test_artifact_shape_and_replay_plan(tmp_path):
     art = json.loads(json.dumps(res.to_dict()))
     for key in ("passed", "plan", "counts", "fault_log", "violations",
                 "wall_s", "bytes_moved", "throughput_gbps",
-                "verify_requeued", "drive_faults_fired"):
+                "verify_requeued", "drive_faults_fired",
+                "fault_status", "latency", "span_p99"):
         assert key in art, key
+    # The load-gen telemetry is populated, not vestigial: per-op-class
+    # latency quantiles and span-plane p99 attribution.
+    assert art["latency"].get("all", {}).get("count", 0) > 0
+    assert "request" in art["span_p99"]
     fresh = scenario_plan(_mini_spec(seed=9, clients=2, ops_per_client=4))
     assert json.dumps(art["plan"], sort_keys=True) == \
         json.dumps(fresh, sort_keys=True)
@@ -390,3 +398,189 @@ def test_versioned_lifecycle_under_drive_faults(tmp_path):
         if sched is not None:
             sched.disarm()
         h.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: bounded hang faults, zipfian load generation, stall-bound /
+# mesh-STATS invariants, and the paced heal storm
+
+
+def test_default_plan_arms_bounded_hang_without_op_filter():
+    """The default soak plan carries a hang-kind fault: bounded
+    (hold_s = 2 x op_deadline_s, an NFS-blip shape the detach/hedge
+    machinery must ride out) and armed on the shared call counter, not
+    an op filter — FaultSpec.matches() checks the ops filter FIRST, so
+    an op-filtered scripted hang could burn its call numbers on ops it
+    never fires for."""
+    spec = _mini_spec(fault_drives=2, disks=8, parity=4)
+    eps = [f"soak-d{i}" for i in range(8)]
+    plan = build_fault_plan(spec, eps)
+    hangs = [s for _, sch in plan["drive_schedules"]
+             for s in sch["specs"] if s["kind"] == "hang"]
+    assert len(hangs) == spec.hang_drives == 1
+    h = hangs[0]
+    assert h["hold_s"] == 2 * spec.op_deadline_s
+    assert not h.get("ops"), "hang must fire on the shared call counter"
+    assert h["calls"] == sorted(h["calls"]) and len(h["calls"]) == 2
+    # hang_drives=0 disarms the hang plane entirely.
+    plan0 = build_fault_plan(
+        _mini_spec(fault_drives=2, disks=8, parity=4, hang_drives=0), eps)
+    assert not any(s["kind"] == "hang" for _, sch in plan0["drive_schedules"]
+                   for s in sch["specs"])
+
+
+def test_zipf_draws_leave_legacy_streams_unchanged():
+    """Plan-compat proof: the zipfian hot-GET draws come from a DERIVED
+    rng, so disabling them (hot_keys=0) changes nothing but the `hot`
+    tags — every pre-existing plan field stays byte-identical and old
+    replay seeds keep reproducing their exact op streams."""
+    a = [dict(o) for o in scenarios.client_stream(_mini_spec(hot_keys=16), 0)]
+    b = [dict(o) for o in scenarios.client_stream(_mini_spec(hot_keys=0), 0)]
+    assert any("hot" in o for o in a) or True  # tags optional per seed
+    for o in a:
+        o.pop("hot", None)
+    assert a == b
+
+
+def test_zipf_rank_deterministic_and_skewed():
+    rng = random.Random(7)
+    seq = [scenarios._zipf_rank(rng, 16, 1.1) for _ in range(600)]
+    rng2 = random.Random(7)
+    assert seq == [scenarios._zipf_rank(rng2, 16, 1.1) for _ in range(600)]
+    counts = [seq.count(r) for r in range(16)]
+    assert counts[0] == max(counts), "rank 0 must be the hottest key"
+    assert counts[0] > 3 * max(1, counts[15]), "zipf tail not skewed"
+    assert all(0 <= r < 16 for r in seq)
+
+
+def test_bounded_hang_stalls_then_proceeds():
+    """hold_s bounds the stall: the op blocks for the hold, then
+    PROCEEDS normally — whether the caller already detached at its
+    deadline is the tolerance machinery's decision, not the fault's."""
+    from minio_tpu.faults.injector import FaultSchedule
+
+    sched = FaultSchedule([{"kind": "hang", "hold_s": 0.05, "calls": [1]}],
+                          seed=3)
+    t0 = time.monotonic()
+    assert sched.apply("stat_vol") is None
+    assert time.monotonic() - t0 >= 0.04, "bounded hang did not stall"
+    assert sched.fired == 1
+    t0 = time.monotonic()
+    assert sched.apply("stat_vol") is None  # call 2: clean and fast
+    assert time.monotonic() - t0 < 0.04
+    # Round-trips through the plan wire format.
+    d = sched.specs[0].to_dict()
+    assert d["hold_s"] == 0.05
+    from minio_tpu.faults.injector import FaultSpec
+
+    assert FaultSpec.from_dict(d).hold_s == 0.05
+
+
+def test_legacy_hang_wedges_until_disarm():
+    """hold_s=0 keeps the legacy wedge: the op blocks until disarm
+    (or MAX_HANG_S) — the shape diskcheck's posthoc breaker exists
+    for."""
+    from minio_tpu.faults.injector import FaultSchedule
+
+    sched = FaultSchedule([{"kind": "hang", "calls": [1]}], seed=3)
+    out = {}
+
+    def call():
+        out["r"] = sched.apply("read_file")
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.15)
+    assert t.is_alive(), "legacy hang must wedge until released"
+    sched.disarm()
+    t.join(5.0)
+    assert not t.is_alive() and out["r"] is None
+
+
+def test_stall_bound_invariant_detects_and_noops():
+    board = scenarios._LatencyBoard()
+    board.note("get", 0.5)
+    h = types.SimpleNamespace(latency=board, stall_bound_s=1.0)
+    assert scenarios.inv_stall_bounded(h, None) == []
+    board.note("multipart", 1.7)
+    violations = scenarios.inv_stall_bounded(h, None)
+    assert violations and "multipart" in violations[0]
+    # Harnesses that never attach a board (unit tests) are a no-op.
+    assert scenarios.inv_stall_bounded(types.SimpleNamespace(), None) == []
+
+
+def test_mesh_stats_invariant_detects_dispatch_batch_skew(monkeypatch):
+    from minio_tpu.parallel.metrics import STATS
+
+    base = dict(STATS)
+    h = types.SimpleNamespace(mesh_stats0=dict(STATS))
+    # Host-einsum engine: always a no-op.
+    monkeypatch.delenv("MTPU_ENCODE_ENGINE", raising=False)
+    assert scenarios.inv_mesh_stats_clean(h, None) == []
+    monkeypatch.setenv("MTPU_ENCODE_ENGINE", "mesh")
+    try:
+        assert scenarios.inv_mesh_stats_clean(h, None) == []
+        STATS["mesh_dispatches_total"] += 1
+        violations = scenarios.inv_mesh_stats_clean(h, None)
+        assert violations and "dispatches" in violations[0]
+        STATS["mesh_batches_total"] += 1
+        assert scenarios.inv_mesh_stats_clean(h, None) == []
+        # Retraces only count once warmed (the subprocess gate's second
+        # run sets MTPU_MESH_WARM=1).
+        STATS["mesh_retraces_total"] += 1
+        assert scenarios.inv_mesh_stats_clean(h, None) == []
+        monkeypatch.setenv("MTPU_MESH_WARM", "1")
+        violations = scenarios.inv_mesh_stats_clean(h, None)
+        assert violations and "retrace" in violations[0]
+    finally:
+        STATS.update(base)
+
+
+def test_latency_board_quantiles_and_over():
+    board = scenarios._LatencyBoard()
+    for i in range(100):
+        board.note("get", (i + 1) / 1000.0)
+    board.note("put", 2.0)
+    s = board.summary()
+    assert s["get"]["count"] == 100
+    assert s["get"]["p50_s"] <= s["get"]["p99_s"] <= s["get"]["max_s"]
+    assert s["all"]["count"] == 101 and s["all"]["max_s"] == 2.0
+    over = board.over(0.95)
+    assert over == [("put", 2.0)]
+
+
+def test_span_p99_extraction_from_histogram():
+    from minio_tpu.observability.metrics import Metrics
+
+    m = Metrics()
+    for _ in range(10):
+        m.observe("span_seconds", 0.003, kind="disk")
+    for _ in range(90):
+        m.observe("span_seconds", 0.7, kind="disk")
+    for _ in range(50):
+        m.observe("span_seconds", 0.002, kind="fanout")
+    p = scenarios._span_p99s(m)
+    assert 0.5 <= p["disk"] <= 1.0, p
+    assert p["fanout"] <= 0.005, p
+
+
+def test_mini_heal_storm_paces_drains_and_restores(tmp_path):
+    """Tier-1-sized heal storm: dead drive + MRF storm under zipfian
+    foreground load with the pacer armed — backlog dry, victim
+    restored byte-identical, ledger ratio inside the dense-RS bounds,
+    every heal through the pace plane."""
+    spec = _mini_spec(hot_keys=0)
+    art = scenarios.run_heal_storm(spec, str(tmp_path), storm_objects=6,
+                                   fg_clients=2, fg_ops=8,
+                                   payload=32 << 10)
+    assert art["passed"], json.dumps(
+        {k: v for k, v in art.items() if k != "spec"}, indent=2)
+    assert art["mrf_left"] == 0
+    assert art["victim_restored"] == 6
+    assert art["pacer"]["grants_total"] >= 6
+    k, m = spec.disks - spec.parity, spec.parity
+    assert art["heal_ratio"]["final"] >= (k / m) * 0.98
+    # Teardown left no process pacer behind.
+    from minio_tpu.background import healpace
+
+    assert healpace.installed() is None

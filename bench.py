@@ -545,6 +545,211 @@ def bench_config7_loadgen(root: str, clients: int = 64,
     return out
 
 
+def _c8_coalescing_proof(base: str, k_clients: int = 8,
+                         size: int = 4 * MIB) -> dict:
+    """The tier's LOGICAL coalescing counters at K=8 — core-count-
+    independent (counts, not wall time), so this proof runs even where
+    the A/B must skip: K concurrent GETs of a cold-cache sketch-hot key
+    must register exactly one decode leader, with the rest served off
+    the shared flight / block cache and the byte-flow ledger's
+    dir="read" (shard payload) bytes showing ONE decode's reads."""
+    import threading
+
+    from minio_tpu.object import readtier
+    from minio_tpu.observability import ioflow
+
+    readtier.reset()
+    ioflow.reset()
+    ol = _mk_pool_layout(base)
+    payload = np.random.default_rng(0xC8).integers(
+        0, 256, size, np.uint8).tobytes()
+    with ioflow.tag("put", bucket="bench"):
+        ol.put_object("bench", "hot/one", _ZeroCopyReader(payload), size)
+
+    def get():
+        with ioflow.tag("get", bucket="bench"):
+            ol.get_object("bench", "hot/one", _Null())
+
+    def shard_reads():
+        return sum(n for (_, _, dr), n in
+                   ioflow.snapshot()["bytes"].items() if dr == "read")
+
+    get()  # crosses the per-key hot threshold; leads + warms the cache
+    readtier.invalidate("bench", "hot/one")  # cache cold, sketch hot
+    r0 = shard_reads()
+    get()                                    # ONE decode, re-warms
+    one_decode = shard_reads() - r0
+    readtier.invalidate("bench", "hot/one")
+    before = readtier.snapshot()
+    r1 = shard_reads()
+    barrier = threading.Barrier(k_clients)
+
+    def client():
+        barrier.wait(30)
+        get()
+
+    threads = [threading.Thread(target=client) for _ in range(k_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = readtier.snapshot()
+    leaders = snap["misses_total"] - before["misses_total"]
+    served = (snap["hits_total"] - before["hits_total"]) \
+        + (snap["coalesced_total"] - before["coalesced_total"])
+    return {
+        "k": k_clients,
+        "leaders": leaders,
+        "served_without_decode": served,
+        "coalescing_factor": round(k_clients / max(1, leaders), 2),
+        "one_decode_read_bytes": one_decode,
+        "k_concurrent_read_bytes": shard_reads() - r1,
+    }
+
+
+def _c8_run(base: str, n_clients: int, ops_per_client: int, n_keys: int,
+            size: int, zipf_s: float,
+            tier_on: bool) -> tuple[float, float, float, dict | None]:
+    """One zipfian closed-loop GET round over a pre-seeded hot set at
+    steady state (two untimed warm passes, so both arms measure serving,
+    not first-touch): aggregate GB/s, p50/p99 ms, tier snapshot."""
+    import random
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.faults.scenarios import _zipf_rank
+    from minio_tpu.object import readtier
+    from minio_tpu.observability import ioflow
+    from minio_tpu.pipeline.admission import client_context
+
+    os.environ["MTPU_READTIER"] = "on" if tier_on else "off"
+    readtier.reset()
+    ioflow.reset()
+    ol = _mk_pool_layout(base)
+    payloads = []
+    for k in range(n_keys):
+        p = np.random.default_rng(1000 + k).integers(
+            0, 256, size, np.uint8).tobytes()
+        payloads.append(p)
+        with ioflow.tag("put", bucket="bench"):
+            ol.put_object("bench", f"hot/o{k:02d}", _ZeroCopyReader(p),
+                          size)
+
+    def get(k, writer):
+        with ioflow.tag("get", bucket="bench"):
+            ol.get_object("bench", f"hot/o{k:02d}", writer)
+
+    for _ in range(2):          # warm: the 2nd pass crosses the per-key
+        for k in range(n_keys):  # threshold and fills the block cache
+            get(k, _Null())
+    lat: list = []
+    lat_mu = threading.Lock()
+
+    def client(ci):
+        rng = random.Random(0xC8 * 2654435761 + ci)
+        local = []
+        with client_context(f"c8-client-{ci}"):
+            for _ in range(ops_per_client):
+                k = _zipf_rank(rng, n_keys, zipf_s)
+                t0 = time.perf_counter()
+                get(k, _Null())
+                local.append(time.perf_counter() - t0)
+        with lat_mu:
+            lat.extend(local)
+
+    with ThreadPoolExecutor(max_workers=n_clients) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(client, range(n_clients)))
+        dt = time.perf_counter() - t0
+    # Byte-correctness spot check through the same (possibly cached)
+    # read path the timed loop used.
+    for k in (0, n_keys - 1):
+        buf = io.BytesIO()
+        get(k, buf)
+        assert buf.getvalue() == payloads[k], f"c8: key o{k:02d} diverged"
+    moved = n_clients * ops_per_client * size
+    lat_ms = sorted(x * 1e3 for x in lat)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
+    return moved / dt / 1e9, p50, p99, readtier.snapshot()
+
+
+def bench_config8_hot_get(root: str, n_clients: int = 16,
+                          ops_per_client: int = 12, n_keys: int = 16,
+                          size: int = MIB, zipf_s: float = 1.1,
+                          runs: int = 3) -> dict:
+    """Config 8: hot-object serving tier A/B (ISSUE 19) — N zipfian
+    closed-loop GET clients over a small hot set, tier on vs off under
+    the min-of-N memcpy-normalized protocol, reporting aggregate GB/s,
+    per-op p50/p99, the tier's cache hit rate and coalescing factor.
+    The A/B skips honestly on 1-core hosts (N closed-loop threads there
+    measure the scheduler); the coalescing_proof block is logical
+    counters and records on every host."""
+    from minio_tpu.object import readtier
+    from minio_tpu.observability import ioflow
+
+    saved = os.environ.get("MTPU_READTIER")
+    out: dict = {
+        "clients": n_clients, "ops_per_client": ops_per_client,
+        "keys": n_keys, "size_bytes": size, "zipf_s": zipf_s,
+    }
+    try:
+        os.environ["MTPU_READTIER"] = "on"
+        proof_root = os.path.join(root, "c8-proof")
+        try:
+            out["coalescing_proof"] = _c8_coalescing_proof(proof_root)
+        finally:
+            _cleanup(proof_root)
+        if (os.cpu_count() or 1) < 2:
+            out["ab"] = {
+                "skipped": "single-core host: closed-loop zipfian GET "
+                           "clients measure the scheduler, not the "
+                           "tier; coalescing_proof above is "
+                           "core-count-independent"
+            }
+            return out
+        with _worker_pool_env("1"), _admission_env(n_clients * 4):
+            for arm, tier_on in (("tier_on", True), ("tier_off", False)):
+                stats: list = []
+
+                def one_run(i, arm=arm, tier_on=tier_on, stats=stats):
+                    sub = os.path.join(root, f"c8-{arm}-r{i}")
+                    try:
+                        g, p50, p99, snap = _c8_run(
+                            sub, n_clients, ops_per_client, n_keys,
+                            size, zipf_s, tier_on,
+                        )
+                        stats.append((g, p50, p99, snap))
+                        return g
+                    finally:
+                        _cleanup(sub)
+
+                entry = _config_protocol(one_run, "max", runs)
+                best = max(stats, key=lambda s: s[0])
+                entry["p50_ms"] = round(best[1], 2)
+                entry["p99_ms"] = round(best[2], 2)
+                if tier_on and best[3] is not None:
+                    snap = best[3]
+                    tier_gets = (snap["hits_total"] + snap["misses_total"]
+                                 + snap["coalesced_total"])
+                    entry["cache_hit_rate"] = round(
+                        snap["hits_total"] / max(1, tier_gets), 4)
+                    entry["coalescing_factor"] = round(
+                        tier_gets / max(1, snap["misses_total"]), 2)
+                    entry["tier"] = snap
+                out[arm] = entry
+        out["speedup_on_vs_off"] = round(
+            out["tier_on"]["value"] / out["tier_off"]["value"], 3)
+        return out
+    finally:
+        if saved is None:
+            os.environ.pop("MTPU_READTIER", None)
+        else:
+            os.environ["MTPU_READTIER"] = saved
+        readtier.reset()
+        ioflow.reset()
+
+
 def bench_multipart_parallel(root: str, total_mib: int = 48) -> dict:
     """Single-object ingest two ways: serial PUT (one MD5 stream — the
     measured ~0.66 GB/s wall) vs the parallel multipart driver
@@ -1680,6 +1885,17 @@ def main() -> None:
             _cleanup(c7_root)
     except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort
         configs["c7_loadgen"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Config 8: hot-object tier A/B — zipfian many-client GETs tier
+    # on/off, plus the core-count-independent coalescing proof
+    # (ISSUE 19).
+    try:
+        c8_root = os.path.join(root, "c8-hotget")
+        try:
+            configs["c8_hot_get"] = bench_config8_hot_get(c8_root)
+        finally:
+            _cleanup(c8_root)
+    except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort
+        configs["c8_hot_get"] = {"error": f"{type(exc).__name__}: {exc}"}
     try:
         stages = bench_put_stages(root)
     except Exception as exc:  # noqa: BLE001 - diagnostics are best-effort

@@ -1060,15 +1060,14 @@ class S3Server:
                 # must not lose.
                 ctx.deferred_trace = rt
                 inner = resp.body_stream
-                bucket = ctx.bucket or ""
 
                 def traced_stream(w, _inner=inner):
-                    # Fresh tag holder for the stream phase: the
-                    # decode/verify reads happen HERE, and a degraded
-                    # promotion must reclassify this phase's bytes.
-                    with client_context(client, bucket=bucket), \
-                            _ioflow.tag(opc, bucket=bucket), \
-                            _spans.resume(rt):
+                    # resume() reinstates everything defer() captured:
+                    # span ctx, the handler phase's ledger op-tag
+                    # holder (shared, so a degraded promotion during
+                    # the stream reclassifies from here on), and the
+                    # admission identity — even with tracing disabled.
+                    with _spans.resume(rt):
                         _inner(w)
 
                 resp.body_stream = traced_stream

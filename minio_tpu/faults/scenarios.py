@@ -1187,6 +1187,16 @@ def inv_admission_conserved(h: ScenarioHarness, _oracle) -> list[str]:
                 f"{s['rejected_deadline']} - late "
                 f"{s['late_grant_returns']} = {lhs} != arrivals "
                 f"{s['arrivals_total']}")
+        # A handler whose client already saw its response (or a severed
+        # socket) can still be a few instructions from its slot release
+        # — and MRF/on-read-heal service threads take slots of their
+        # own. Give in-release threads a beat; only a slot that NEVER
+        # returns is a leak.
+        deadline = time.monotonic() + 2.0
+        while (s["inflight"] or s["waiting"]) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+            s = gov.snapshot()
         if s["inflight"] or s["waiting"]:
             out.append(f"admission[{name}]: not drained "
                        f"(inflight {s['inflight']}, waiting "
@@ -1309,6 +1319,65 @@ def inv_stall_bounded(h: ScenarioHarness, _oracle) -> list[str]:
     ]
 
 
+def inv_hot_object_coherent(h: ScenarioHarness, _oracle) -> list[str]:
+    """Hot-object tier coherence at drain (ISSUE 19). For every shared
+    hot key: a tier-bypassed GET (MTPU_READTIER=off forces a fresh
+    erasure decode) establishes ground truth; that truth must be a
+    generation the run actually wrote (h.hot_gens when a mutating
+    scenario tracked overwrites, else the seeded body); and two
+    tier-path GETs — the first may lead a fresh decode, the second is
+    then servable straight off the decoded-block cache — must both
+    return the ground-truth bytes. A divergence is a stale or corrupt
+    cached block surviving the write-path invalidation. Also asserts
+    the single-flight registry drained: a leaked flight would wedge the
+    next follower behind a decode that no longer exists. No-op for
+    harnesses without a hot keyspace."""
+    hot = getattr(h, "hot_bodies", None)
+    if not hot:
+        return []
+    from ..object import readtier
+
+    out = []
+    gens = getattr(h, "hot_gens", None)
+    # knob-ok: save/restore — None must mean "was unset", not a default
+    saved = os.environ.get("MTPU_READTIER")
+    truths: dict[str, bytes] = {}
+    try:
+        os.environ["MTPU_READTIER"] = "off"
+        for key in sorted(hot):
+            st, _, got = h.request("GET", f"/{BUCKET}/{key}")
+            if st != 200:
+                out.append(f"hot-coherent: tier-bypassed GET {key} -> "
+                           f"{st}")
+                continue
+            truths[key] = got
+    finally:
+        if saved is None:
+            os.environ.pop("MTPU_READTIER", None)
+        else:
+            os.environ["MTPU_READTIER"] = saved
+    for key, truth in sorted(truths.items()):
+        allowed = gens.get(key, []) if gens else [hot[key]]
+        if truth not in allowed:
+            out.append(f"hot-coherent: {key} decodes to bytes no "
+                       f"generation of the run ever wrote")
+        for pass_ in ("first", "second"):
+            st, _, got = h.request("GET", f"/{BUCKET}/{key}")
+            if st != 200:
+                out.append(f"hot-coherent: tier GET {key} ({pass_}) "
+                           f"-> {st}")
+            elif got != truth:
+                out.append(
+                    f"hot-coherent: {key} ({pass_} tier pass) diverges "
+                    f"from the tier-bypassed decode — a stale or "
+                    f"corrupt cached block survived invalidation")
+    snap = readtier.snapshot()
+    if snap and snap["flights"]:
+        out.append(f"hot-coherent: {snap['flights']} single-flight "
+                   f"entr(ies) leaked past drain")
+    return out
+
+
 def inv_mesh_stats_clean(h: ScenarioHarness, _oracle) -> list[str]:
     """Mesh-engine STATS contract as a drain invariant (ISSUE 17): over
     the scenario, every mesh dispatch carried exactly one dp-group
@@ -1351,6 +1420,7 @@ INVARIANTS = {
     "no_orphan_workers": inv_no_orphan_workers,
     "admission_conserved": inv_admission_conserved,
     "ioflow_reconciles": inv_ioflow_reconciles,
+    "hot_object_coherent": inv_hot_object_coherent,
     "stall_bounded": inv_stall_bounded,
     "mesh_stats_clean": inv_mesh_stats_clean,
 }
@@ -1821,6 +1891,320 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
             h.close()
     artifact["reasons"] = reasons
     artifact["passed"] = not reasons
+    return artifact
+
+
+# ---------------------------------------------------------------------------
+# hot-object tier under mutation chaos (ISSUE 19)
+
+
+def run_hot_object(spec: ScenarioSpec, root: str, *,
+                   readers: int = 4, reader_ops: int = 24,
+                   overwrites: int = 8, ver_keys: int = 3,
+                   ver_cycles: int = 3, heal_kills: int = 2,
+                   crash_gets: int = 6) -> dict:
+    """Hot-key chaos scenario (ISSUE 19): zipfian readers hammer the
+    shared hot keyspace THROUGH the hot-object tier (hot-bytes
+    threshold pinned to 1, so every key is tier-hot from its first
+    served byte) while every mutation plane runs against the same
+    sketch-hot keys concurrently:
+
+    - **overwrite** — generation-tracked hot-key PUTs; a GET that
+      begins after an overwrite's 200 must never serve an older
+      generation (a stale cached block) — and no GET may ever serve
+      bytes that match NO generation (a corrupt one);
+    - **versioned-delete** — put/read-back/delete-oldest cycles on a
+      parallel hot keyspace in the versioned bucket, proving the tier's
+      (version-id, etag) keying plus delete-path invalidation;
+    - **heal + drive-fault** — shard kills healed mid-traffic, with a
+      mild error/latency schedule armed on one drive underneath.
+
+    Then the leader-crash proof: with stream reads erroring on parity+1
+    drives, K concurrent GETs of a cache-cold hot key share one doomed
+    decode — every one must fail CLEAN (non-200 or a severed
+    connection, never an intact 200 carrying a body), and the key reads
+    back byte-identical after disarm. The full drain-invariant gate
+    (hot_object_coherent included) closes the run."""
+    from ..object import readtier
+    from ..observability import ioflow
+
+    reasons: list[str] = []
+    artifact: dict = {"spec": spec.to_dict()}
+    saved_env = {k: os.environ.get(k)
+                 for k in ("MTPU_READTIER", "MTPU_READTIER_HOT_BYTES")}
+    os.environ["MTPU_READTIER"] = "on"
+    os.environ["MTPU_READTIER_HOT_BYTES"] = "1"
+    readtier.reset()
+    h = None
+    counts: dict = {"reads_ok": 0, "clean_failures": 0, "stale_hits": 0}
+    cmu = threading.Lock()
+    try:
+        h = ScenarioHarness(root, spec)
+        if not h.hot_bodies:
+            raise ValueError("run_hot_object needs spec.hot_keys > 0")
+        keys = sorted(h.hot_bodies)
+        # Generation history per hot key. Bodies are appended BEFORE
+        # their PUT goes out (a racing reader must always be able to
+        # match whatever the server serves it); committed[key] counts
+        # only 200-acknowledged generations — the staleness floor a
+        # reader snapshots at request start. Single overwriter thread,
+        # so per-key ordering is the append ordering.
+        h.hot_gens = {k: [h.hot_bodies[k]] for k in keys}
+        committed = {k: 1 for k in keys}
+        gmu = threading.Lock()
+
+        # Drive-fault plane under everything: the mild shape on one
+        # drive (same kinds the default soak plan arms).
+        sched = h.fault_disks[1].arm({
+            "seed": spec.seed * 53 + 1,
+            "specs": [
+                {"kind": "latency", "probability": 0.12,
+                 "latency_s": 0.02},
+                {"kind": "error", "probability": 0.04,
+                 "error": "ErrDiskNotFound"},
+            ],
+        })
+
+        def reader(r: int) -> None:
+            zrng = random.Random(spec.seed * 48611 + r)
+            for _ in range(reader_ops):
+                key = keys[_zipf_rank(zrng, len(keys), spec.zipf_s)]
+                with gmu:
+                    floor = committed[key]
+                try:
+                    st, _, got = h.request("GET", f"/{BUCKET}/{key}")
+                except (OSError, http.client.HTTPException):
+                    with cmu:
+                        counts["clean_failures"] += 1
+                    continue
+                if st != 200:
+                    with cmu:
+                        counts["clean_failures"] += 1
+                    continue
+                with gmu:
+                    allowed = list(h.hot_gens[key])
+                try:
+                    idx = allowed.index(got)
+                except ValueError:
+                    reasons.append(
+                        f"reader {r}: {key} served bytes matching NO "
+                        f"generation — corrupt cached block")
+                    continue
+                # Client-side bookkeeping lands an instant after the
+                # overwrite's 200, so a reader starting inside that
+                # window legitimately carries the previous floor; any
+                # reader starting after it must see >= floor-1.
+                if idx < floor - 1:
+                    with cmu:
+                        counts["stale_hits"] += 1
+                    reasons.append(
+                        f"reader {r}: {key} served generation {idx} "
+                        f"after generation {floor - 1} committed — "
+                        f"stale hit")
+                else:
+                    with cmu:
+                        counts["reads_ok"] += 1
+
+        def overwriter() -> None:
+            for n in range(overwrites):
+                # Mutate the hottest ranks: the overwrites must race
+                # cached blocks, not idle tail keys.
+                key = keys[n % min(4, len(keys))]
+                body = _payload(spec.seed * 263 + 7 * n + 1, 64 << 10)
+                with gmu:
+                    h.hot_gens[key].append(body)
+                st, _, _ = h.request("PUT", f"/{BUCKET}/{key}",
+                                     body=body)
+                if st == 200:
+                    with gmu:
+                        committed[key] = h.hot_gens[key].index(body) + 1
+                        h.hot_bodies[key] = body
+                time.sleep(0.02)
+
+        # Versioned plane: sequential per-key cycles on the versioned
+        # bucket; `live` tracks surviving (version-id, body) pairs for
+        # the no-loss gate. A non-200 anywhere taints the key (under
+        # faults a failed status cannot prove the server-side outcome),
+        # dropping it from verification instead of guessing.
+        ver_bodies: dict[str, list] = {}
+
+        def versioner() -> None:
+            for ki in range(ver_keys):
+                key = f"hotver/o{ki:02d}"
+                live: list = []
+                tainted = False
+                for cyc in range(ver_cycles):
+                    body = _payload(spec.seed * 521 + ki * 97 + cyc,
+                                    64 << 10)
+                    st, hdr, _ = h.request(
+                        "PUT", f"/{BUCKET_VER}/{key}", body=body)
+                    if st != 200:
+                        tainted = True
+                        break
+                    live.append((hdr.get("x-amz-version-id", ""), body))
+                    st, _, got = h.request("GET", f"/{BUCKET_VER}/{key}")
+                    if st == 200 and got != body:
+                        reasons.append(
+                            f"versioned: {key} read back an older "
+                            f"generation right after its overwrite "
+                            f"committed — stale hit")
+                    # Versioned-delete the oldest noncurrent version:
+                    # the delete-path invalidation plane (latest stays
+                    # latest, so reader expectations are monotonic).
+                    if len(live) >= 2 and live[0][0]:
+                        vid0 = live[0][0]
+                        st, _, _ = h.request(
+                            "DELETE", f"/{BUCKET_VER}/{key}",
+                            query=[("versionId", vid0)])
+                        if st in (200, 204):
+                            live.pop(0)
+                        else:
+                            tainted = True
+                            break
+                if not tainted:
+                    ver_bodies[key] = live
+
+        failed_heals: list[str] = []
+
+        def healer() -> None:
+            for i in range(heal_kills):
+                key = keys[(2 * i) % len(keys)]
+                if h.kill_data_shard(BUCKET, key) is None:
+                    continue
+                try:
+                    h.ol.heal_object(BUCKET, key)
+                except Exception:  # noqa: BLE001  # except-ok: heals failing under the armed fault schedule retry after disarm
+                    failed_heals.append(key)
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=reader, args=(r,),
+                                    name=f"hot-r{r}")
+                   for r in range(readers)]
+        threads += [threading.Thread(target=overwriter, name="hot-ow"),
+                    threading.Thread(target=versioner, name="hot-ver"),
+                    threading.Thread(target=healer, name="hot-heal")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300.0)
+            if t.is_alive():
+                reasons.append(f"{t.name} wedged past 300s")
+        sched.disarm()
+        h.fault_fired = sched.fired
+        still = h.wait_readmit()
+        if still:
+            reasons.append(f"drives never re-admitted after disarm: "
+                           f"{still}")
+        for key in failed_heals:
+            try:
+                h.ol.heal_object(BUCKET, key)
+            except Exception as exc:  # noqa: BLE001 - clean-path heal failure IS a finding
+                reasons.append(f"heal plane: {key} unhealable after "
+                               f"disarm: {type(exc).__name__}: {exc}")
+
+        # ---- leader-crash proof: a doomed shared decode fails clean.
+        crash_key = keys[0]
+        readtier.invalidate(BUCKET, crash_key)  # cold cache, hot sketch
+        crash_scheds = [
+            h.fault_disks[i].arm({
+                "seed": spec.seed * 101 + i,
+                "specs": [{"kind": "error", "probability": 1.0,
+                           "error": "ErrDiskNotFound",
+                           "ops": ["stream_read"]}],
+            })
+            for i in range(spec.parity + 1)
+        ]
+        tier0 = readtier.snapshot() or {}
+        outcomes: list[str] = []
+        omu = threading.Lock()
+
+        def crash_get() -> None:
+            try:
+                st, _, got = h.request("GET", f"/{BUCKET}/{crash_key}")
+            except (OSError, http.client.HTTPException):
+                with omu:
+                    outcomes.append("severed")
+                return
+            with omu:
+                if st != 200:
+                    outcomes.append(f"status-{st}")
+                else:
+                    # ANY intact 200 is a violation: with reads failing
+                    # below quorum there are no bytes to serve.
+                    outcomes.append("intact-200")
+
+        cthreads = [threading.Thread(target=crash_get,
+                                     name=f"hot-crash{i}")
+                    for i in range(crash_gets)]
+        for t in cthreads:
+            t.start()
+        for t in cthreads:
+            t.join(120.0)
+        for s in crash_scheds:
+            s.disarm()
+        tier1 = readtier.snapshot() or {}
+        artifact["crash_outcomes"] = sorted(outcomes)
+        bad = [o for o in outcomes if o == "intact-200"]
+        if bad:
+            reasons.append(
+                f"leader-crash: {len(bad)} GET(s) returned an intact "
+                f"200 body through a decode that could not have "
+                f"produced one")
+        if tier1.get("leader_crashes_total", 0) <= \
+                tier0.get("leader_crashes_total", 0):
+            reasons.append("leader-crash: no leader crash ledgered — "
+                           "the doomed GETs never reached a shared "
+                           "decode")
+        still = h.wait_readmit()
+        if still:
+            reasons.append(f"drives never re-admitted after the crash "
+                           f"phase: {still}")
+        # Recovery: the injected errors damaged nothing on disk.
+        st, _, got = h.request("GET", f"/{BUCKET}/{crash_key}")
+        if st != 200 or got not in h.hot_gens[crash_key]:
+            reasons.append(f"leader-crash: {crash_key} unreadable "
+                           f"after disarm ({st})")
+
+        # ---- drain + the full gate.
+        left = h.drain_mrf()
+        if left:
+            reasons.append(f"MRF backlog not dry: {left} left")
+        oracle = _Oracle()
+        for key, live in ver_bodies.items():
+            if live:
+                oracle.versions[(BUCKET_VER, key)] = live
+        violations: dict = {"run": reasons}
+        for name, fn in INVARIANTS.items():
+            try:
+                if fn is inv_ioflow_reconciles:
+                    violations[name] = fn(h, oracle, counts)
+                else:
+                    violations[name] = fn(h, oracle)
+            except Exception as exc:  # noqa: BLE001 - checker crash IS a failure
+                violations[name] = [
+                    f"invariant checker crashed: "
+                    f"{type(exc).__name__}: {exc}"]
+        tier = readtier.snapshot() or {}
+        if not (tier.get("hits_total", 0)
+                or tier.get("coalesced_total", 0)):
+            violations["run"].append(
+                "tier never served a byte: the hot keyspace stayed "
+                "cold with the hot-bytes threshold at 1")
+        artifact["counts"] = dict(counts)
+        artifact["tier"] = tier
+        artifact["served_bytes"] = dict(ioflow.snapshot()["served"])
+        artifact["violations"] = {k: v for k, v in violations.items()
+                                  if v}
+        artifact["passed"] = not any(violations.values())
+    finally:
+        if h is not None:
+            h.close()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        readtier.reset()
     return artifact
 
 

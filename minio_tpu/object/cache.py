@@ -4,6 +4,25 @@ equivalent of the reference's cacheObjects/diskCache
 cmd/disk-cache-backend.go: atime-based GC between low/high watermarks,
 ETag-validated hits, write-around semantics).
 
+Position in the read stack (the ISSUE 19 retire-or-integrate decision:
+KEPT, as the optional capacity tier of a two-tier read story). This
+layer is NOT dead weight — it is wired at server boot
+(minio_tpu/server.py build_cache_layer) behind the `cache` config
+subsystem and stays off until an operator names cache drives. When
+armed it fronts the erasure object layer for small (≤32 MiB),
+unversioned GETs off a local cache drive; everything it declines —
+versioned reads, large objects, excluded patterns, and ALL traffic
+when no cache drives are configured — falls through to erasure, where
+the hot-object tier (object/readtier.py) serves sketch-hot keys from
+decoded blocks in RAM with zero shard reads. The two compose without
+coordination: this cache's own miss-path population read runs through
+the erasure GET, so a stampede repopulating a cache drive coalesces on
+the hot tier's single-flight like any other hot traffic, and both
+tiers invalidate through the same write paths (this one in its
+ObjectLayer wrappers below, the hot tier at the erasure commit sites).
+They cache different shapes at different costs — whole objects on disk
+here, decoded blocks in memory there — so neither subsumes the other.
+
 Design deltas, by intent:
 - Cache entries are plain files `<dir>/<sha(bucket/object)>.{data,json}`
   (the reference nests per-entry dirs with its own cache.json metadata) —

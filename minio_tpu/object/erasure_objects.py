@@ -65,6 +65,7 @@ _obj_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="mtpu-obj")
 
 from ..observability import carry as _obs_carry
 from ..observability import ioflow as _ioflow
+from . import readtier as _readtier
 from ..utils.fanout import SINGLE_CORE as _SINGLE_CORE
 from ..utils.fanout import StragglerCompensator
 from ..utils.fanout import decode_slot as _decode_slot
@@ -384,6 +385,10 @@ class ErasureObjects(MultipartMixin):
         # Source-payload bytes of a COMMITTED put: the denominator of
         # the write-amplification series (aborted puts never count).
         _ioflow.logical(oi.size)
+        # Hot-tier hygiene: dead versions stop holding block-cache
+        # quota (correctness never depends on this — cache keys pin the
+        # version-id + etag read fresh per GET).
+        _readtier.invalidate(bucket, object_)
         return oi
 
     def _put_object(self, bucket: str, object_: str, reader, size: int,
@@ -623,8 +628,10 @@ class ErasureObjects(MultipartMixin):
         # concurrent put/heal can't interleave (ref updateObjectMeta under
         # the caller-held NSLock).
         with self._locked_write(bucket, object_):
-            return self._update_object_metadata(bucket, object_, version_id,
-                                                updates, replace_user_meta)
+            out = self._update_object_metadata(bucket, object_, version_id,
+                                               updates, replace_user_meta)
+            _readtier.invalidate(bucket, object_)
+            return out
 
     def _update_object_metadata(self, bucket: str, object_: str,
                                 version_id: str, updates: dict,
@@ -730,6 +737,9 @@ class ErasureObjects(MultipartMixin):
                                range(len(self.disks))))
             list(_obj_pool.map(_obs_carry(drop_parts),
                                range(len(self.disks))))
+        # The version's local shard data is gone: any decoded blocks
+        # the hot tier holds for it are dead weight now.
+        _readtier.invalidate(bucket, object_)
 
     def restore_object(self, bucket: str, object_: str, version_id: str,
                        reader, size: int, updates: dict) -> None:
@@ -844,63 +854,88 @@ class ErasureObjects(MultipartMixin):
             fi.erasure.data_blocks, fi.erasure.parity_blocks,
             fi.erasure.codec
         )
-        disks_by_shard, metas_by_shard = shuffle_disks_and_parts_metadata(
-            self.disks, fis, fi
-        )
 
         if length == 0 or not fi.parts:
             return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
 
-        # Part loop (ref getObjectWithFileInfo :277-353). The whole
-        # decode+verify section runs under a READ admission slot
-        # (ISSUE 11): GET clients flow through the same per-client
-        # caps / round-robin fairness / queue-depth 503s as PUT
-        # clients, against a separate slot pool so neither plane can
-        # starve the other.
-        part_index, part_offset = fi.to_object_part_index(offset)
-        remaining = length
-        heal_hint = None
-        with _decode_slot():
-            for p in range(part_index, len(fi.parts)):
-                if remaining <= 0:
-                    break
-                part = fi.parts[p]
-                part_length = min(part.size - part_offset, remaining)
-                till_offset = erasure.shard_file_offset(
-                    part_offset, part_length, part.size
+        # Hot-object tier (ISSUE 19): sketch-hot keys are served off
+        # the decoded-block cache or coalesced onto another request's
+        # in-flight decode. A None return is a binding guarantee that
+        # zero bytes were written — the legacy path below then streams
+        # the identical bytes (tier off / cold key / late join).
+        served = None
+        rt = _readtier.tier()
+        if rt is not None:
+            served = rt.serve(self, bucket, object_, fi, fis, erasure,
+                              writer, offset, length)
+        if served is not None:
+            heal_hint = served[1]
+        else:
+            # The whole decode+verify section runs under a READ
+            # admission slot (ISSUE 11): GET clients flow through the
+            # same per-client caps / round-robin fairness / queue-depth
+            # 503s as PUT clients, against a separate slot pool so
+            # neither plane can starve the other.
+            with _decode_slot():
+                heal_hint = self._decode_range(
+                    bucket, object_, fi, fis, erasure, writer, offset,
+                    length,
                 )
-                readers: list = [None] * len(disks_by_shard)
-                for i, disk in enumerate(disks_by_shard):
-                    meta = metas_by_shard[i]
-                    if disk is None or meta is None:
-                        continue
-                    readers[i] = self._shard_reader(
-                        disk, meta, bucket, object_, fi, part.number,
-                        till_offset, erasure.shard_size(),
-                    )
-                if any(r is None
-                       for r in readers[:erasure.data_blocks]):
-                    # A DATA shard is already known missing from the
-                    # metadata phase (offline/wiped disk): this GET
-                    # reconstructs from parity from byte zero, and the
-                    # read-time retag (a present reader failing
-                    # mid-stream) would never fire. A missing parity
-                    # shard alone degrades nothing — the data path
-                    # reads around it.
-                    _ioflow.retag_degraded()
-                _, hint = decode_stream(
-                    erasure, writer, readers, part_offset, part_length,
-                    part.size, telemetry="get",
-                )
-                if hint is not None and heal_hint is None:
-                    heal_hint = hint
-                remaining -= part_length
-                part_offset = 0
 
         if heal_hint is not None:
             # On-read heal trigger (ref cmd/erasure-object.go:319-338).
             self.queue_mrf(bucket, object_, fi.version_id)
         return ObjectInfo.from_file_info(fi, bucket, object_, opts.versioned)
+
+    def _decode_range(self, bucket: str, object_: str, fi, fis, erasure,
+                      writer, offset: int, length: int):
+        """One decode pipeline for object byte range [offset,
+        offset+length): the part loop (ref getObjectWithFileInfo
+        :277-353), slot-free — callers hold the read-admission slot
+        (the legacy GET path and the hot-tier's single-flight leader;
+        coalesced followers never get here). Returns the heal hint."""
+        disks_by_shard, metas_by_shard = shuffle_disks_and_parts_metadata(
+            self.disks, fis, fi
+        )
+        part_index, part_offset = fi.to_object_part_index(offset)
+        remaining = length
+        heal_hint = None
+        for p in range(part_index, len(fi.parts)):
+            if remaining <= 0:
+                break
+            part = fi.parts[p]
+            part_length = min(part.size - part_offset, remaining)
+            till_offset = erasure.shard_file_offset(
+                part_offset, part_length, part.size
+            )
+            readers: list = [None] * len(disks_by_shard)
+            for i, disk in enumerate(disks_by_shard):
+                meta = metas_by_shard[i]
+                if disk is None or meta is None:
+                    continue
+                readers[i] = self._shard_reader(
+                    disk, meta, bucket, object_, fi, part.number,
+                    till_offset, erasure.shard_size(),
+                )
+            if any(r is None
+                   for r in readers[:erasure.data_blocks]):
+                # A DATA shard is already known missing from the
+                # metadata phase (offline/wiped disk): this GET
+                # reconstructs from parity from byte zero, and the
+                # read-time retag (a present reader failing
+                # mid-stream) would never fire. A missing parity
+                # shard alone degrades nothing — the data path
+                # reads around it.
+                _ioflow.retag_degraded()
+            _, hint = decode_stream(
+                erasure, writer, readers, part_offset, part_length,
+                part.size, telemetry="get",
+            )
+            if hint is not None and heal_hint is None:
+                heal_hint = hint
+            remaining -= part_length
+            part_offset = 0
+        return heal_hint
 
     def _shard_reader(self, disk, meta: FileInfo, bucket: str, object_: str,
                       fi: FileInfo, part_number: int, till_offset: int,
@@ -931,9 +966,12 @@ class ErasureObjects(MultipartMixin):
                       opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
         if opts.no_lock:
-            return self._delete_object(bucket, object_, opts)
-        with self._locked_write(bucket, object_):
-            return self._delete_object(bucket, object_, opts)
+            oi = self._delete_object(bucket, object_, opts)
+        else:
+            with self._locked_write(bucket, object_):
+                oi = self._delete_object(bucket, object_, opts)
+        _readtier.invalidate(bucket, object_)
+        return oi
 
     def _delete_object(self, bucket: str, object_: str,
                        opts: ObjectOptions) -> ObjectInfo:
@@ -1054,8 +1092,10 @@ class ErasureObjects(MultipartMixin):
         # lock a foreground PUT of the same object needs.
         with _ioflow.tag("heal", bucket=bucket), _heal_slot(), \
                 self._locked_write(bucket, object_):
-            return self._heal_object(bucket, object_, version_id,
-                                     remove_dangling)
+            out = self._heal_object(bucket, object_, version_id,
+                                    remove_dangling)
+            _readtier.invalidate(bucket, object_)
+            return out
 
     def _heal_object(self, bucket: str, object_: str, version_id: str,
                      remove_dangling: bool) -> dict:

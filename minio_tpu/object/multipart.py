@@ -628,6 +628,12 @@ class MultipartMixin:
             # queue MRF so the missing shards are rebuilt (ref
             # addPartial, cmd/erasure-multipart.go).
             self.queue_mrf(bucket, object_, version_id)
+        # Hot-tier hygiene: the multipart commit just replaced the
+        # object's latest version (see _put_object_inner for the same
+        # hook on the single-shot path).
+        from . import readtier as _readtier
+
+        _readtier.invalidate(bucket, object_)
 
         out = FileInfo(
             volume=bucket, name=object_, version_id=version_id,

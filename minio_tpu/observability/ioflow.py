@@ -82,6 +82,11 @@ IOFLOW_DESCRIPTORS: list[tuple[str, str, str]] = [
     ("hot_bucket_bytes_total", "counter",
      "Approximate data-plane bytes by bucket (space-saving top-K "
      "sketch; `overcount` bounds the error)"),
+    ("ioflow_served_bytes_total", "counter",
+     "GET payload bytes served by the hot-object tier, by class "
+     "(hit = decoded-block cache, coalesced = follower slicing a "
+     "shared in-flight decode); bytes absent from the series were "
+     "served by a private decode pipeline"),
 ]
 
 # The op classes (ISSUE 14). Anything the dispatch map doesn't name
@@ -154,12 +159,13 @@ def retag_degraded() -> None:
 # per-thread counters
 
 class _Counters:
-    __slots__ = ("bytes", "logical", "hot")
+    __slots__ = ("bytes", "logical", "hot", "served")
 
     def __init__(self):
         self.bytes: dict[tuple, int] = {}   # (drive, op, dir) -> n
         self.logical: dict[str, int] = {}   # op -> n
         self.hot: dict[str, int] = {}       # bucket -> pending bytes
+        self.served: dict[str, int] = {}    # class -> n (readtier)
 
 
 _tls = threading.local()
@@ -211,6 +217,18 @@ def logical(n: int) -> None:
     op = t.op if t is not None else "untagged"
     c = _counters()
     c.logical[op] = c.logical.get(op, 0) + n
+
+
+def served(kind: str, n: int) -> None:
+    """Payload bytes the hot-object read tier served without a private
+    decode: `kind` is "hit" (decoded-block cache) or "coalesced"
+    (follower slicing a shared in-flight decode). The difference
+    between `logical` GET bytes and this series is what erasure decode
+    actually produced per request."""
+    if not _armed or n <= 0:
+        return
+    c = _counters()
+    c.served[kind] = c.served.get(kind, 0) + n
 
 
 def _flush_hot(c: _Counters) -> None:
@@ -382,6 +400,7 @@ def snapshot() -> dict:
         blocks = list(_all.values())
     bytes_total: dict[tuple, int] = {}
     logical_total: dict[str, int] = {}
+    served_total: dict[str, int] = {}
     for c in blocks:
         # Racy reads of single-writer dicts: list() the items under the
         # GIL; a concurrent insert is simply not yet visible.
@@ -389,7 +408,10 @@ def snapshot() -> dict:
             bytes_total[key] = bytes_total.get(key, 0) + n
         for op, n in list(c.logical.items()):
             logical_total[op] = logical_total.get(op, 0) + n
-    return {"bytes": bytes_total, "logical": logical_total}
+        for kind, n in list(c.served.items()):
+            served_total[kind] = served_total.get(kind, 0) + n
+    return {"bytes": bytes_total, "logical": logical_total,
+            "served": served_total}
 
 
 def op_totals(snap: dict | None = None) -> dict:
@@ -437,6 +459,7 @@ def report(scan_objects: int = 0) -> dict:
         "bytes": nested,
         "opTotals": op_totals(snap),
         "logicalBytes": snap["logical"],
+        "servedBytes": snap["served"],
         "efficiency": efficiency(snap, scan_objects=scan_objects),
         "hotBuckets": hot_buckets(),
     }
@@ -451,5 +474,6 @@ def reset() -> None:
             c.bytes = {}
             c.logical = {}
             c.hot = {}
+            c.served = {}
     with _hot_mu:
         _hot = None

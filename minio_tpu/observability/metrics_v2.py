@@ -203,6 +203,14 @@ from ..background.healpace import HEALPACE_DESCRIPTORS  # noqa: E402
 
 DESCRIPTORS += HEALPACE_DESCRIPTORS
 
+# Hot-object serving tier (object/readtier.py, jax-free import):
+# decoded-block cache hits/evictions/bytes held and single-flight
+# coalescing counters for the read tier that lets repeat traffic skip
+# erasure entirely (ISSUE 19).
+from ..object.readtier import READTIER_DESCRIPTORS  # noqa: E402
+
+DESCRIPTORS += READTIER_DESCRIPTORS
+
 
 def mrf_scoreboard(ol) -> dict:
     """One traversal of the heal/MRF scoreboard (ISSUE 14), consumed by
@@ -279,6 +287,7 @@ class MetricsCollector:
         self._collect_mrf(m)
         self._collect_ioflow(m)
         self._collect_healpace(m)
+        self._collect_readtier(m)
         self._collect_node(m)
 
     # Remote-disk stats are RPCs; bound how often a scrape pays them so
@@ -458,6 +467,8 @@ class MetricsCollector:
             [({"bucket": e["bucket"]}, e["bytes"])
              for e in ioflow.hot_buckets()],
         )
+        for kind, n in snap["served"].items():
+            m.set_counter("ioflow_served_bytes_total", n, kind=kind)
 
     def _collect_healpace(self, m):
         """Heal pacer mirror (ISSUE 17). installed() never constructs:
@@ -478,6 +489,23 @@ class MetricsCollector:
         m.set_counter("heal_pace_yields_total", snap["yields_total"])
         m.set_counter("heal_pace_throttle_seconds_total",
                       snap["throttle_seconds_total"])
+
+    def _collect_readtier(self, m):
+        """Hot-object tier mirror (ISSUE 19). snapshot() never
+        constructs the tier: deployments that never armed it keep a
+        clean exposition."""
+        from ..object import readtier
+
+        snap = readtier.snapshot()
+        if snap is None:
+            return
+        m.set_counter("readtier_hits_total", snap["hits_total"])
+        m.set_counter("readtier_misses_total", snap["misses_total"])
+        m.set_counter("readtier_coalesced_total", snap["coalesced_total"])
+        m.set_counter("readtier_evictions_total", snap["evictions_total"])
+        m.set_counter("readtier_leader_crashes_total",
+                      snap["leader_crashes_total"])
+        m.set_gauge("readtier_bytes_held", snap["bytes_held"])
 
     def _collect_node(self, m):
         m.set_gauge("node_uptime_seconds", time.time() - self.started)

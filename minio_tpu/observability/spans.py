@@ -470,17 +470,37 @@ class request_trace:
     span then covers the whole request, dispatch through last byte."""
 
     __slots__ = ("_label", "_meta", "_tok_t", "_tok_p", "_ctx", "_tid",
-                 "deferred")
+                 "deferred", "_io_holder", "_identity")
 
     def __init__(self, label: str, **meta):
         self._label = label
         self._meta = meta
         self._ctx = None
         self.deferred = False
+        self._io_holder = None
+        self._identity = None
 
     def defer(self) -> None:
-        """Skip finish at scope exit; `resume` finishes instead."""
+        """Skip finish at scope exit; `resume` finishes instead.
+
+        Beyond the span ctx, this captures the handler phase's byte-flow
+        ledger holder and admission identity (client, bucket): the body
+        stream runs on the writer's thread AFTER the handler scope — and
+        its contexts — exit, and the decode/verify bytes it moves (or,
+        with the hot-object tier, the coalesced follower bytes it
+        slices) must land in the ledger under this request's op tag and
+        in the governor under this caller, not as untagged/anonymous.
+        PR9 re-entered the identity only; the op tag rode along solely
+        because the API layer rebuilt it by hand around the stream —
+        capture BOTH here so resume() is self-sufficient even where no
+        hand-built wrapper exists (tracing disabled included)."""
         self.deferred = True
+        # Lazy imports: spans must stay cheap to import and cycle-free.
+        from . import ioflow as _ioflow
+        from ..pipeline.admission import identity as _adm_identity
+
+        self._io_holder = _ioflow.capture()
+        self._identity = _adm_identity()
 
     def __enter__(self) -> TraceCtx | None:
         if not enabled() or _trace_var.get() is not None:
@@ -516,17 +536,38 @@ class request_trace:
 
 class resume:
     """Re-enter a deferred request_trace for the response-stream phase
-    and finish it when the stream completes (or dies)."""
+    and finish it when the stream completes (or dies).
 
-    __slots__ = ("_rt", "_tok_t", "_tok_p", "_tid")
+    Re-entry covers all three planes defer() captured: the span ctx
+    (when tracing recorded one), the byte-flow ledger op-tag holder,
+    and the admission (client, bucket) identity. The latter two install
+    even when the span ctx is None — a disabled trace plane must never
+    cost the ledger its op classification or the governor its caller."""
+
+    __slots__ = ("_rt", "_tok_t", "_tok_p", "_tid", "_io_ctx", "_adm_ctx")
 
     def __init__(self, rt: request_trace):
         self._rt = rt
         self._tok_t = None
+        self._io_ctx = None
+        self._adm_ctx = None
 
     def __enter__(self):
-        ctx = self._rt._ctx
-        if ctx is None or not self._rt.deferred:
+        rt = self._rt
+        if not rt.deferred:
+            return None
+        from . import ioflow as _ioflow
+
+        self._io_ctx = _ioflow.activate(rt._io_holder)  # None-safe
+        self._io_ctx.__enter__()
+        if rt._identity is not None:
+            from ..pipeline.admission import client_context
+
+            self._adm_ctx = client_context(rt._identity[0],
+                                           bucket=rt._identity[1])
+            self._adm_ctx.__enter__()
+        ctx = rt._ctx
+        if ctx is None:
             return None
         self._tok_t = _trace_var.set(ctx)
         self._tok_p = _parent_var.set(ctx.root_id)
@@ -535,21 +576,25 @@ class resume:
         return ctx
 
     def __exit__(self, exc_type, exc, tb):
-        if self._tok_t is None:
+        if self._io_ctx is None:  # not deferred: full no-op
             return False
-        ctx = self._rt._ctx
-        if exc_type is not None and not ctx.error:
-            ctx.error = exc_type.__name__
-        _active.pop(self._tid, None)
-        _parent_var.reset(self._tok_p)
-        _trace_var.reset(self._tok_t)
+        if self._tok_t is not None:
+            ctx = self._rt._ctx
+            if exc_type is not None and not ctx.error:
+                ctx.error = exc_type.__name__
+            _active.pop(self._tid, None)
+            _parent_var.reset(self._tok_p)
+            _trace_var.reset(self._tok_t)
+            try:
+                _finish(ctx)
+            # except-ok: tracing must never fail a request — a broken
+            # exemplar capture drops one trace, never a response
+            except Exception:  # noqa: BLE001
+                pass
         self._rt.deferred = False
-        try:
-            _finish(ctx)
-        # except-ok: tracing must never fail a request — a broken
-        # exemplar capture drops one trace, never a response
-        except Exception:  # noqa: BLE001
-            pass
+        if self._adm_ctx is not None:
+            self._adm_ctx.__exit__(exc_type, exc, tb)
+        self._io_ctx.__exit__(exc_type, exc, tb)
         return False
 
 

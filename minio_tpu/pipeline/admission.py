@@ -71,6 +71,13 @@ def current_bucket() -> str:
     return _bucket_var.get()
 
 
+def identity() -> tuple[str, str]:
+    """The raw (client, bucket) pair for this context — the carrier a
+    deferred response stream captures at defer() time and reinstates
+    (via client_context) when the body streams on another thread."""
+    return _client_var.get(), _bucket_var.get()
+
+
 @contextmanager
 def client_context(client: str, bucket: str | None = None):
     """Tag every admission decision in this context with `client` (the
@@ -159,6 +166,10 @@ ADMISSION_DESCRIPTORS: list[tuple[str, str, str]] = [
      "Encode streams waiting for admission"),
     ("admission_clients_waiting", "gauge",
      "Distinct clients with queued encode streams"),
+    ("admission_coalesced_bypass_total", "counter",
+     "GET streams served without consuming a decode slot (hot-tier "
+     "cache hits and single-flight followers riding another "
+     "request's admitted decode)"),
 ]
 
 _metrics = None  # guarded-by: _metrics_mu
@@ -225,6 +236,10 @@ class AdmissionGovernor:
         # incremented for that one arrival).
         self.arrivals_total = 0             # guarded-by: _cv
         self.late_grant_returns = 0         # guarded-by: _cv
+        # Streams served WITHOUT a slot (hot-tier hits / coalesced
+        # followers): deliberately outside the conservation identity —
+        # these never arrive at the governor at all.
+        self.coalesced_bypass_total = 0     # guarded-by: _cv
 
     # -- budgets -----------------------------------------------------------
 
@@ -379,6 +394,17 @@ class AdmissionGovernor:
             self._budgets.pop(client, None)
         self._grant_waiters()
 
+    def note_coalesced(self) -> None:
+        """Record one stream served without consuming a slot (the
+        hot-object tier's cache hits and single-flight followers), so
+        the slot-pressure dashboards can see demand the pool never had
+        to absorb."""
+        with self._cv:
+            self.coalesced_bypass_total += 1
+        reg = _reg()
+        if reg is not None:
+            reg.inc("admission_coalesced_bypass_total", **self._labels())
+
     def saturated(self) -> bool:
         """True when a fresh acquire would reject IMMEDIATELY (queue
         already full). The pre-status probe for streaming responses:
@@ -426,6 +452,7 @@ class AdmissionGovernor:
                 "rejected_deadline": self.rejected_deadline,
                 "arrivals_total": self.arrivals_total,
                 "late_grant_returns": self.late_grant_returns,
+                "coalesced_bypass_total": self.coalesced_bypass_total,
                 "per_client_inflight": {
                     c: b.inflight for c, b in self._budgets.items()
                     if b.inflight

@@ -326,6 +326,67 @@ def test_c7_loadgen_reports_gate_numbers(tmp_path):
     assert storm["pacer"]["grants_total"] >= 24
 
 
+def test_c8_hot_get_records_coalescing_proof_and_skips_ab_on_one_core(
+        tmp_path, monkeypatch):
+    """ISSUE 19: on a 1-core host the c8 A/B must publish {"skipped"}
+    honestly, while the coalescing proof — logical counters, not wall
+    time — still records: K=8 concurrent GETs of a cold-cache hot key
+    register ONE leader decode and a factor > 4, with the ledger's
+    shard-read bytes equal to one decode's."""
+    import os
+
+    import bench
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    out = bench.bench_config8_hot_get(str(tmp_path))
+    assert set(out["ab"]) == {"skipped"}
+    assert "single-core" in out["ab"]["skipped"]
+    proof = out["coalescing_proof"]
+    assert proof["k"] == 8
+    assert proof["leaders"] == 1
+    assert proof["served_without_decode"] == 7
+    assert proof["coalescing_factor"] > 4
+    assert proof["one_decode_read_bytes"] > 0
+    assert proof["k_concurrent_read_bytes"] == \
+        proof["one_decode_read_bytes"]
+    # The knob is restored: the bench must not leak tier state into the
+    # process that ran it.
+    from minio_tpu.object import readtier
+
+    assert readtier.snapshot() is None
+
+
+def test_c8_hot_get_ab_shape(tmp_path):
+    """Multicore only: both arms carry the repeatability protocol plus
+    latency percentiles; the on-arm adds hit rate, coalescing factor,
+    and the tier snapshot."""
+    import os
+
+    import bench
+
+    if (os.cpu_count() or 1) < 2:
+        import pytest
+
+        pytest.skip("single-core host: the c8 A/B skips by contract")
+    out = bench.bench_config8_hot_get(
+        str(tmp_path), n_clients=4, ops_per_client=3, n_keys=4,
+        runs=1,
+    )
+    for arm in ("tier_on", "tier_off"):
+        entry = out[arm]
+        for field in ("value", "runs", "dispersion", "host_memcpy_gbps",
+                      "value_per_memcpy", "p50_ms", "p99_ms"):
+            assert field in entry, (arm, field, entry)
+        assert entry["value"] > 0
+        assert 0 < entry["p50_ms"] <= entry["p99_ms"]
+    on = out["tier_on"]
+    assert on["cache_hit_rate"] > 0
+    assert on["coalescing_factor"] >= 1
+    assert on["tier"]["hits_total"] > 0
+    assert out["speedup_on_vs_off"] > 0
+    assert out["coalescing_proof"]["leaders"] == 1
+
+
 def test_worker_pool_path_keeps_copy_floor(tmp_path, monkeypatch):
     """copies_per_input_byte must be UNCHANGED under the worker-pool
     path: the shm strip is filled by the same one-readinto-per-block

@@ -85,7 +85,7 @@ def test_knob_disarms_ledger(monkeypatch):
     with ioflow.tag("put", bucket="b"):
         ioflow.account("d0", "write", 100)
         ioflow.logical(100)
-    assert ioflow.snapshot() == {"bytes": {}, "logical": {}}
+    assert ioflow.snapshot() == {"bytes": {}, "logical": {}, "served": {}}
     monkeypatch.setenv("MTPU_IOFLOW", "1")
     with ioflow.tag("put", bucket="b"):
         ioflow.account("d0", "write", 1)

@@ -1,0 +1,393 @@
+"""Hot-object serving tier (ISSUE 19): admission off the hot-bucket
+sketch, the decoded-block cache's zero-shard-read warm hits (proved on
+the byte-flow ledger), range slicing, write-path invalidation, the
+single-flight coalescing factor at K=8, leader-crash semantics
+(unstarted followers fall back, mid-stream followers fail clean), the
+off-knob's byte-inertness — and THE end-to-end proof: a forced-
+multicore child where 8 concurrent signed GETs cost exactly one
+decode's shard reads and a warm hit costs zero."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from test_object_layer import make_pools
+
+from minio_tpu.object import readtier
+from minio_tpu.object.erasure_objects import BLOCK_SIZE_V2, ErasureObjects
+from minio_tpu.observability import ioflow
+from minio_tpu.pipeline.admission import read_governor
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BUCKET = "hotb"
+SIZE = 3 * (1 << 20) + 777  # 4 blocks at the 1 MiB erasure grid
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    """Every test starts with a cold tier AND a cold ledger (the tier
+    admits off the ledger's hot-bucket sketch), and leaves no knob or
+    global behind for the next test."""
+    saved = {k: os.environ.get(k) for k in (
+        "MTPU_READTIER", "MTPU_READTIER_QUOTA", "MTPU_READTIER_HOT_BYTES",
+        "MTPU_READTIER_WINDOW", "MTPU_IOFLOW",
+    )}
+    readtier.reset()
+    ioflow.reset()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    readtier.reset()
+    ioflow.reset()
+
+
+def _mk(tmp_path, size=SIZE):
+    """Pools + one seeded object; the ledger is then reset so the FIRST
+    GET is provably cold (empty bucket sketch -> legacy path)."""
+    z, _ = make_pools(tmp_path, n_disks=4)
+    z.make_bucket(BUCKET)
+    data = np.random.default_rng(1).integers(
+        0, 256, size, np.uint8).tobytes()
+    with ioflow.tag("put", bucket=BUCKET):
+        z.put_object(BUCKET, "obj", io.BytesIO(data), len(data))
+    readtier.reset()
+    ioflow.reset()
+    return z, data
+
+
+def _get(z, off=0, ln=-1):
+    with ioflow.tag("get", bucket=BUCKET):
+        return z.get_object_bytes(BUCKET, "obj", off, ln)
+
+
+def _shard_reads(snap=None) -> int:
+    """dir="read" covers shard/payload bytes only — quorum metadata
+    stays "rmeta", so a zero delta here IS the zero-shard-read proof."""
+    snap = snap or ioflow.snapshot()
+    return sum(n for (_, _, dr), n in snap["bytes"].items()
+               if dr == "read")
+
+
+# ---------------------------------------------------------------------------
+# admission + the cache ladder: cold -> leader -> warm hit
+
+
+def test_cold_get_takes_legacy_path(tmp_path):
+    z, data = _mk(tmp_path)
+    assert _get(z) == data
+    snap = readtier.snapshot()
+    assert snap is not None  # the tier armed (knob on) ...
+    # ... but admitted nothing: the hot-bucket sketch was empty when
+    # serve() ran, so the bytes flowed the unmodified legacy path.
+    assert snap["misses_total"] == 0
+    assert snap["hits_total"] == 0
+    assert snap["blocks"] == 0
+    assert _shard_reads() > 0
+
+
+def test_leader_warms_then_hit_costs_zero_shard_reads(tmp_path):
+    z, data = _mk(tmp_path)
+    assert _get(z) == data          # cold: feeds the bucket sketch
+    assert _get(z) == data          # hot now: leads a decode, caches
+    snap = readtier.snapshot()
+    assert snap["misses_total"] == 1
+    assert snap["blocks"] == 4      # ceil(SIZE / 1 MiB) whole blocks
+    assert snap["bytes_held"] == SIZE
+    before = _shard_reads()
+    assert _get(z) == data          # warm: served off decoded blocks
+    assert _shard_reads() - before == 0
+    snap = readtier.snapshot()
+    assert snap["hits_total"] == 1
+    served = ioflow.snapshot()["served"]
+    assert served.get("hit", 0) == SIZE
+
+
+def test_ranged_get_sliced_from_warm_blocks(tmp_path):
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)                # warm the cache
+    before = _shard_reads()
+    # A range crossing a block boundary: sliced off two cached blocks.
+    off, ln = BLOCK_SIZE_V2 - 100, 300
+    assert _get(z, off, ln) == data[off:off + ln]
+    assert _shard_reads() - before == 0
+    assert readtier.snapshot()["hits_total"] == 1
+
+
+def test_overwrite_invalidates_and_new_bytes_serve(tmp_path):
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)
+    assert readtier.snapshot()["blocks"] == 4
+    data2 = np.random.default_rng(2).integers(
+        0, 256, 2 * (1 << 20) + 5, np.uint8).tobytes()
+    with ioflow.tag("put", bucket=BUCKET):
+        z.put_object(BUCKET, "obj", io.BytesIO(data2), len(data2))
+    snap = readtier.snapshot()
+    assert snap["blocks"] == 0      # write-path invalidation ran
+    assert snap["bytes_held"] == 0
+    assert snap["evictions_total"] == 4
+    assert _get(z) == data2         # fresh etag -> new leader decode
+    assert _get(z) == data2         # ... and a hit under the NEW key
+    assert readtier.snapshot()["hits_total"] == 1
+
+
+def test_off_knob_is_byte_inert(tmp_path):
+    z, data = _mk(tmp_path)
+    os.environ["MTPU_READTIER"] = "off"
+    readtier.reset()
+    for _ in range(3):              # would be hot by the second GET
+        assert _get(z) == data
+    assert readtier.snapshot() is None   # never constructed
+    assert not ioflow.snapshot()["served"]
+
+
+def test_disarmed_ledger_keeps_tier_inert(tmp_path):
+    """Plane dependency: no ledger -> empty bucket sketch -> the tier
+    admits nothing (and must not crash trying)."""
+    z, data = _mk(tmp_path)
+    os.environ["MTPU_IOFLOW"] = "0"
+    ioflow.reset()
+    readtier.reset()
+    for _ in range(3):
+        assert _get(z) == data
+    snap = readtier.snapshot()
+    assert snap["misses_total"] == 0 and snap["hits_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# single-flight coalescing: K=8 concurrent GETs, ONE decode
+
+
+def test_k8_concurrent_gets_cost_one_decode(tmp_path):
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)                          # make the key tier-hot
+    # Measure what ONE leader decode costs on the ledger.
+    readtier.invalidate(BUCKET, "obj")
+    r0 = _shard_reads()
+    _get(z)
+    one_decode = _shard_reads() - r0
+    assert one_decode > 0
+
+    readtier.invalidate(BUCKET, "obj")        # cache cold, sketch hot
+    base = readtier.snapshot()
+    gov0 = read_governor().snapshot()["coalesced_bypass_total"]
+    r1 = _shard_reads()
+    barrier = threading.Barrier(8)
+    fails: list = []
+
+    def client():
+        try:
+            barrier.wait(10)
+            assert _get(z) == data
+        except Exception as exc:  # noqa: BLE001 - collected for the assert
+            fails.append(exc)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not fails, fails
+
+    # THE coalescing proof: 8 byte-identical responses, shard reads of
+    # exactly one decode.
+    assert _shard_reads() - r1 == one_decode
+    snap = readtier.snapshot()
+    leaders = snap["misses_total"] - base["misses_total"]
+    served = (snap["hits_total"] - base["hits_total"]) + \
+        (snap["coalesced_total"] - base["coalesced_total"])
+    assert leaders == 1
+    assert served == 7
+    assert 8 / leaders > 4          # the acceptance coalescing factor
+    assert snap["flights"] == 0     # nothing leaked
+    # Followers/hits took no decode slot: the governor counted them as
+    # coalesced bypasses instead.
+    assert read_governor().snapshot()["coalesced_bypass_total"] - gov0 == 7
+
+
+# ---------------------------------------------------------------------------
+# leader crash: unstarted followers fall back, mid-stream fails clean
+
+
+def test_leader_crash_unstarted_follower_falls_back(tmp_path):
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)
+    readtier.invalidate(BUCKET, "obj")
+    tier = readtier.tier()
+
+    started, release, follower_in = (threading.Event() for _ in range(3))
+    orig_decode = ErasureObjects._decode_range
+    orig_decide = tier._decide
+    calls = {"n": 0}
+
+    def decide(plan):
+        out = orig_decide(plan)
+        if out[0] == "follower":
+            follower_in.set()
+        return out
+
+    def crashing(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            started.set()
+            release.wait(10)
+            raise RuntimeError("injected decode crash")
+        return orig_decode(self, *a, **kw)
+
+    tier._decide = decide
+    ErasureObjects._decode_range = crashing
+    results: dict = {}
+    try:
+        def leader():
+            try:
+                _get(z)
+                results["leader"] = "returned"
+            except RuntimeError:
+                results["leader"] = "raised"
+
+        def follower():
+            results["follower"] = _get(z)
+
+        lt = threading.Thread(target=leader)
+        lt.start()
+        assert started.wait(10)
+        ft = threading.Thread(target=follower)
+        ft.start()
+        # Release the crash only once the follower has attached to the
+        # flight, so its fetch provably observes the leader's death.
+        assert follower_in.wait(10)
+        release.set()
+        lt.join(30), ft.join(30)
+    finally:
+        ErasureObjects._decode_range = orig_decode
+        tier._decide = orig_decide
+
+    assert results["leader"] == "raised"
+    # Zero bytes were written when the error arrived -> the follower
+    # fell back to its own legacy read and still got the full object.
+    assert results["follower"] == data
+    snap = readtier.snapshot()
+    assert snap["leader_crashes_total"] == 1
+    assert snap["follower_fallbacks_total"] == 1
+    assert snap["flights"] == 0
+
+
+def test_leader_crash_midstream_follower_fails_clean(tmp_path):
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)
+    readtier.invalidate(BUCKET, "obj")
+    tier = readtier.tier()
+
+    follower_in = threading.Event()
+    orig_decode = ErasureObjects._decode_range
+    orig_decide = tier._decide
+    calls = {"n": 0}
+
+    def decide(plan):
+        out = orig_decide(plan)
+        if out[0] == "follower":
+            follower_in.set()
+        return out
+
+    def crashing(self, bucket, object_, fi, fis, erasure, writer,
+                 offset, length):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # Produce EXACTLY block 0 (published + cached), then die
+            # with the stream mid-flight.
+            writer.write(data[:BLOCK_SIZE_V2])
+            follower_in.wait(10)
+            raise RuntimeError("mid-stream decode crash")
+        return orig_decode(self, bucket, object_, fi, fis, erasure,
+                           writer, offset, length)
+
+    tier._decide = decide
+    ErasureObjects._decode_range = crashing
+    results: dict = {}
+    try:
+        def leader():
+            try:
+                _get(z)
+                results["leader"] = "returned"
+            except RuntimeError:
+                results["leader"] = "raised"
+
+        def follower():
+            try:
+                results["follower"] = _get(z)
+            except Exception as exc:  # noqa: BLE001 - outcome under test
+                results["follower"] = exc
+
+        lt = threading.Thread(target=leader)
+        lt.start()
+        ft = threading.Thread(target=follower)
+        ft.start()
+        lt.join(30), ft.join(30)
+    finally:
+        ErasureObjects._decode_range = orig_decode
+        tier._decide = orig_decide
+
+    assert results["leader"] == "raised"
+    # The follower consumed block 0 off the shared stream (bytes were
+    # already written), so the leader's death must sever it — a clean
+    # raise, NEVER a short or padded 200 body. If it instead lost the
+    # follower race entirely (led its own decode after the crash), a
+    # full correct body is the one other legitimate outcome.
+    fol = results["follower"]
+    if isinstance(fol, bytes):
+        assert fol == data
+    else:
+        assert isinstance(fol, RuntimeError)
+    assert readtier.snapshot()["leader_crashes_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# quota GC
+
+
+def test_quota_gc_evicts_lru_blocks(tmp_path):
+    os.environ["MTPU_READTIER_QUOTA"] = str(3 << 20)  # < one object
+    z, data = _mk(tmp_path)
+    _get(z), _get(z)                # leader streams 4 blocks through
+    snap = readtier.snapshot()
+    assert snap["evictions_total"] > 0
+    assert snap["bytes_held"] <= 3 << 20
+    # Correctness is untouched: partial cache -> leader re-decodes.
+    assert _get(z) == data
+
+
+# ---------------------------------------------------------------------------
+# THE end-to-end proof: forced-multicore child, real S3 server
+
+
+def test_e2e_k8_coalescing_and_warm_hit_ledger_proof(tmp_path):
+    """Real server, real signed GETs, cpu_count pinned to 4 in the
+    child: 8 concurrent GETs of a cold-cache hot key cost exactly ONE
+    decode's dir="read" shard bytes, and a warm hit costs ZERO."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "_readtier_child.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, \
+        f"child failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["single_decode_read"] > 0
+    assert out["k8_read_delta"] == out["single_decode_read"]
+    assert out["warm_read_delta"] == 0
+    assert out["k8_statuses"] == [200] * 8
+    assert out["bodies_identical"]
+    tier = out["tier"]
+    assert tier["flights"] == 0
+    assert out["k8_leaders"] == 1
+    assert out["k8_served"] == 7
+    assert out["governor_coalesced_delta"] == 7

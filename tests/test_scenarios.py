@@ -564,6 +564,78 @@ def test_span_p99_extraction_from_histogram():
     assert p["fanout"] <= 0.005, p
 
 
+def test_mini_hot_object_scenario(tmp_path):
+    """Tier-1-sized hot-object chaos run (ISSUE 19): zipfian readers
+    through the hot tier while overwrite / versioned-delete / heal /
+    drive-fault planes mutate the same sketch-hot keys, then the
+    leader-crash proof and the full drain gate. Passing means: zero
+    stale hits, zero corrupt bytes, every doomed-decode GET failed
+    clean, the tier actually served (hits or coalesced > 0), and
+    hot_object_coherent held at drain."""
+    spec = _mini_spec(seed=11, hot_keys=6)
+    art = scenarios.run_hot_object(
+        spec, str(tmp_path), readers=3, reader_ops=8, overwrites=5,
+        ver_keys=2, ver_cycles=2, heal_kills=1, crash_gets=4,
+    )
+    assert art["passed"], json.dumps(
+        {k: v for k, v in art.items() if k != "spec"}, indent=2)
+    assert art["counts"]["stale_hits"] == 0
+    assert art["counts"]["reads_ok"] > 0
+    tier = art["tier"]
+    assert tier["hits_total"] + tier["coalesced_total"] > 0
+    assert tier["leader_crashes_total"] >= 1
+    # Every crash-phase GET failed clean: non-200 or severed, never an
+    # intact 200 (there were no bytes below quorum to build one from).
+    assert art["crash_outcomes"]
+    assert not any(o == "intact-200" for o in art["crash_outcomes"])
+    # The tier's served-byte ledger classification moved.
+    assert sum(art["served_bytes"].values()) > 0
+    # Teardown restored the knobs and dropped the pinned-threshold tier.
+    from minio_tpu.object import readtier
+
+    assert readtier._tier is None
+
+
+def test_hot_coherent_invariant_detects_poisoned_cache(tmp_path):
+    """The hot_object_coherent checker DETECTS divergence, not just
+    passes on good runs: poison a cached decoded block behind the
+    tier's back and the invariant must flag the key."""
+    from minio_tpu.object import readtier
+
+    saved = {k: os.environ.get(k)
+             for k in ("MTPU_READTIER", "MTPU_READTIER_HOT_BYTES")}
+    os.environ["MTPU_READTIER"] = "on"
+    os.environ["MTPU_READTIER_HOT_BYTES"] = "1"
+    readtier.reset()
+    h = ScenarioHarness(str(tmp_path), _mini_spec(hot_keys=2))
+    try:
+        key = sorted(h.hot_bodies)[0]
+        # First GET marks the key tier-hot and leads the caching
+        # decode; the invariant passes while the cache is honest.
+        st, _, got = h.request("GET", f"/{scenarios.BUCKET}/{key}")
+        assert st == 200 and got == h.hot_bodies[key]
+        assert scenarios.inv_hot_object_coherent(h, None) == []
+        t = readtier.tier()
+        with t._mu:
+            poisoned = 0
+            for ck, block in t._blocks.items():
+                if ck[0] == scenarios.BUCKET and ck[1] == key:
+                    block[0] ^= 0xFF
+                    poisoned += 1
+        assert poisoned, "the leading GET cached nothing"
+        violations = scenarios.inv_hot_object_coherent(h, None)
+        assert violations and any("diverges" in v for v in violations), \
+            violations
+    finally:
+        h.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        readtier.reset()
+
+
 def test_mini_heal_storm_paces_drains_and_restores(tmp_path):
     """Tier-1-sized heal storm: dead drive + MRF storm under zipfian
     foreground load with the pacer armed — backlog dry, victim

@@ -202,6 +202,61 @@ def test_engine_stats_deltas_ride_on_trees():
     assert tree["stats"]["hedged_reads"] == 2
 
 
+def test_defer_resume_reenters_ioflow_tag_and_admission_identity():
+    """Regression for the streaming-GET accounting hole (ISSUE 19):
+    the response body streams on the writer's thread AFTER the handler
+    scope — and its ioflow op tag + admission identity — exited. PR9's
+    resume() re-entered the identity only; defer() must capture BOTH so
+    the decode/verify (or hot-tier follower) bytes the stream moves
+    land in the ledger under THIS request's op class and in the
+    governor under THIS caller, not as untagged/anonymous."""
+    from minio_tpu.observability import ioflow
+    from minio_tpu.pipeline import admission
+
+    ioflow.reset()
+    try:
+        rt = spans.request_trace("get_object")
+        with admission.client_context("alice", bucket="hotb"):
+            with ioflow.tag("get", bucket="hotb"):
+                with rt:
+                    rt.defer()
+        # Handler scope closed: this thread is untagged/anonymous again.
+        assert admission.identity() == ("", "")
+        out = {}
+
+        def stream():
+            with spans.resume(rt):
+                ioflow.account("d0", "read", 1234)
+                out["ident"] = admission.identity()
+            out["after"] = admission.identity()
+
+        t = threading.Thread(target=stream)
+        t.start()
+        t.join()
+
+        b = ioflow.snapshot()["bytes"]
+        assert b.get(("d0", "get", "read")) == 1234
+        assert ("d0", "untagged", "read") not in b
+        assert out["ident"] == ("alice", "hotb")
+        assert out["after"] == ("", "")  # resume scoped, not leaked
+        assert rt.deferred is False      # the stream finished the trace
+    finally:
+        ioflow.reset()
+
+
+def test_defer_cancelled_by_handler_exception():
+    """A handler that dies pre-stream finishes its trace at scope exit;
+    resume() on it must be a full no-op (no ledger/identity install)."""
+    rt = spans.request_trace("get_object")
+    with pytest.raises(RuntimeError):
+        with rt:
+            rt.defer()
+            raise RuntimeError("framing error before the stream")
+    assert rt.deferred is False
+    with spans.resume(rt) as ctx:
+        assert ctx is None
+
+
 @pytest.mark.skipif(not gf_native.available(),
                     reason="worker pool needs the native engine")
 def test_e2e_span_tree_real_put_and_degraded_get():

@@ -44,6 +44,11 @@ HOT_PATHS = {
     # Added with ISSUE 16: codec selection/probing sits on every PUT's
     # setup path (ops/cauchy.py rides the existing ops/ prefix).
     "minio_tpu/erasure/registry.py",
+    # Added with ISSUE 19: the hot-object tier sits on the GET hot
+    # path; its ONE sanctioned retained copy (decoded blocks leaving
+    # the recycled reader ring) is budgeted as get.cache_hold — any
+    # other materialization there taxes every hot GET.
+    "minio_tpu/object/readtier.py",
 }
 HOT_PREFIXES = ("minio_tpu/ops/",)
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import io
 
+from ..observability import ioflow
 from ..storage.fileinfo import FileInfo
 from ..storage.interface import DiskInfo, FileInfoVersions, StorageAPI, VolInfo
 from ..utils import errors as oe
@@ -46,8 +47,8 @@ def _fi_pack(fi: FileInfo) -> dict:
 _IDEMPOTENT = frozenset({
     "ping", "disk_info", "get_disk_id", "list_vols", "stat_vol",
     "list_dir", "walk_dir", "read_version", "list_versions",
-    "read_file", "read_file_stream", "read_all", "check_parts",
-    "check_file", "verify_file", "stat_info_file",
+    "read_file", "read_file_stream", "read_repair_symbol", "read_all",
+    "check_parts", "check_file", "verify_file", "stat_info_file",
 })
 
 
@@ -64,7 +65,8 @@ class StorageRESTServer:
             "list_dir", "walk_dir", "delete_version", "delete_versions",
             "write_metadata", "update_metadata", "read_version",
             "rename_data", "list_versions", "read_file", "append_file",
-            "create_file", "read_file_stream", "rename_file", "check_parts",
+            "create_file", "read_file_stream", "read_repair_symbol",
+            "rename_file", "check_parts",
             "check_file", "delete", "verify_file", "write_all", "read_all",
             "stat_info_file",
         ):
@@ -245,6 +247,26 @@ class StorageRESTServer:
             close = getattr(stream, "close", None)
             if close:
                 close()
+        return {"n": len(data)}, io.BytesIO(data)
+
+    def _h_read_repair_symbol(self, args, body):
+        # β-slice read for the repair plane (erasure/repair.py): subs is
+        # a CSV of sub-shard indices, blocks a CSV of block:chunk_len
+        # pairs. Only the requested β bytes come back — the wire-cost
+        # contract that makes remote regenerating repair cheaper than
+        # shipping whole shards. Op attribution (heal) rides the
+        # forwarded _IOFLOW_OP_HDR like every other storage RPC.
+        data = self._disk(args).read_repair_symbol(
+            args["volume"], args["path"],
+            stride=int(args["stride"]),
+            digest_size=int(args["digest_size"]),
+            alpha=int(args["alpha"]),
+            subs=[int(s) for s in args["subs"].split(",")],
+            blocks=[
+                (int(b), int(c))
+                for b, c in (p.split(":") for p in args["blocks"].split(","))
+            ],
+        )
         return {"n": len(data)}, io.BytesIO(data)
 
     def _h_rename_file(self, args, body):
@@ -518,6 +540,25 @@ class RemoteStorage(StorageAPI):
             "offset": str(offset), "length": str(length),
         }, want_stream=True)
         return io.BytesIO(data)
+
+    def read_repair_symbol(self, volume: str, path: str, *, stride: int,
+                           digest_size: int, alpha: int, subs: list[int],
+                           blocks: list[tuple[int, int]]) -> bytes:
+        """One RPC per call: the whole β-slice request for this survivor
+        crosses the wire as a single round trip and only the β bytes come
+        back. The serving node ledgers its own disk read; this side
+        accounts the received bytes under the "rwire" direction so
+        repair_wire_bytes_per_byte_healed can prove wire ≈ d·β, not
+        d·shard."""
+        _, data = self._call("read_repair_symbol", {
+            "volume": volume, "path": path,
+            "stride": str(stride), "digest_size": str(digest_size),
+            "alpha": str(alpha),
+            "subs": ",".join(str(s) for s in subs),
+            "blocks": ",".join(f"{b}:{c}" for b, c in blocks),
+        }, want_stream=True)
+        ioflow.account(self.endpoint(), "rwire", len(data))
+        return data
 
     def create_file_writer(self, volume: str, path: str,
                            size: int = -1):

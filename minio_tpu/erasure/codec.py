@@ -85,6 +85,16 @@ class Erasure:
         self.total_shards = data_blocks + parity_blocks
         self.codec_id = codec
         self._entry = registry.get(codec)  # loud on unknown codec ids
+        if not self._entry.geometry_ok(data_blocks, parity_blocks):
+            raise ErrInvShardNum(
+                f"codec {codec!r} does not support geometry "
+                f"{data_blocks}+{parity_blocks}"
+            )
+        # Sub-packetization: shard lengths are rounded up to multiples
+        # of α and every matrix application reshapes [.., K, S] to
+        # [.., K·α, S/α] — byte-identical views, so expanded matrices
+        # ride the same any-matrix kernels (ops/regen.py layout note).
+        self.subshards = self._entry.alpha(data_blocks, parity_blocks)
         # Host-side byte matrices (lru-cached per codec module).
         self.matrix = self._entry.coding_matrix(data_blocks, parity_blocks)
         self._parity_mat = self._entry.parity_matrix(
@@ -95,9 +105,19 @@ class Erasure:
 
     # --- geometry (cmd/erasure-coding.go:120-149) ---
 
+    def _round_shard(self, size: int) -> int:
+        """Round a shard byte-length up to the codec's sub-packetization.
+        Zero-pad-and-truncate would NOT be safe instead: sub-packetized
+        parity bytes in a truncated tail depend on real data columns, so
+        the pad must exist on disk, exactly like split()'s block pad."""
+        a = self.subshards
+        return ceil_frac(size, a) * a if a > 1 else size
+
     def shard_size(self) -> int:
         """Actual shard size from the erasure blockSize."""
-        return ceil_frac(self.block_size, self.data_blocks)
+        return self._round_shard(
+            ceil_frac(self.block_size, self.data_blocks)
+        )
 
     def shard_file_size(self, total_length: int) -> int:
         """Final erasure size on each disk from the original object size."""
@@ -107,7 +127,9 @@ class Erasure:
             return -1
         num_shards = total_length // self.block_size
         last_block_size = total_length % self.block_size
-        last_shard_size = ceil_frac(last_block_size, self.data_blocks)
+        last_shard_size = self._round_shard(
+            ceil_frac(last_block_size, self.data_blocks)
+        )
         return num_shards * self.shard_size() + last_shard_size
 
     def shard_file_offset(self, start_offset: int, length: int, total_length: int) -> int:
@@ -131,28 +153,77 @@ class Erasure:
             self._parity_bits_dev = jax.device_put(self._parity_bits_np)
         return self._parity_bits_dev
 
+    def _subshard_view(self, shards: np.ndarray) -> np.ndarray:
+        """[.., K, S] -> [.., K·α, S/α] — a byte-identical reshape (the
+        α sub-shards of one shard are its contiguous S/α-byte slices),
+        matching the sub-shard indexing of the expanded matrices."""
+        a = self.subshards
+        s = shards.shape[-1]
+        if s % a:
+            raise ErrShardSize(
+                f"shard length {s} not a multiple of sub-packetization "
+                f"{a} for codec {self.codec_id!r}"
+            )
+        return shards.reshape(*shards.shape[:-2],
+                              shards.shape[-2] * a, s // a)
+
     def _apply(self, mat_gf: np.ndarray, shards: np.ndarray,
                bits_np: np.ndarray | None = None,
                dev_bitmat=None) -> np.ndarray:
         """Apply a GF(2^8) matrix (byte form `mat_gf` [R, K]) to [.., K, S]
         shards via the selected engine. `bits_np`/`dev_bitmat` supply
-        precomputed GF(2) expansions for the numpy/device paths."""
+        precomputed GF(2) expansions for the numpy/device paths. For
+        sub-packetized codecs the matrix addresses sub-shards: inputs
+        and outputs are reshaped around the kernel, whole-shard shapes
+        at the boundary either way."""
         from ..ops import gf_native
 
+        out_s = shards.shape[-1]
+        if self.subshards > 1:
+            shards = self._subshard_view(shards)
         engine = _select_engine(shards.shape[-1], codec=self.codec_id)
         registry.note_dispatch(self.codec_id, engine)
         if engine == "native":
             if shards.ndim == 3:
-                return gf_native.apply_matrix_batch(mat_gf, shards)
-            return gf_native.apply_matrix(mat_gf, shards)
-        if engine == "device":
+                out = gf_native.apply_matrix_batch(mat_gf, shards)
+            else:
+                out = gf_native.apply_matrix(mat_gf, shards)
+        elif engine == "device":
             bits = dev_bitmat
             if bits is None:
                 bits = bits_np if bits_np is not None else gf.bit_matrix_for(mat_gf)
-            return np.asarray(rs.apply_gf_matrix(bits, shards))
-        # Host fallback: the codec's own numpy realization (dense GF(2)
-        # bit-matmul, or the Cauchy XOR schedule).
-        return self._entry.host_apply(mat_gf, shards)
+            out = np.asarray(rs.apply_gf_matrix(bits, shards))
+        else:
+            # Host fallback: the codec's own numpy realization (dense
+            # GF(2) bit-matmul, or the Cauchy XOR schedule).
+            out = self._entry.host_apply(mat_gf, shards)
+        if self.subshards > 1:
+            out = out.reshape(*out.shape[:-2],
+                              out.shape[-2] // self.subshards, out_s)
+        return out
+
+    def parity_apply_batch_native(self, blocks: np.ndarray,
+                                  out: np.ndarray | None = None
+                                  ) -> np.ndarray:
+        """gf_native parity application for [B, K, S] blocks with the
+        codec's sub-shard reshape applied around the kernel — the one
+        entry point the streaming encode drivers use, so no native call
+        site can forget the α view."""
+        from ..ops import gf_native
+
+        a = self.subshards
+        if a == 1:
+            return gf_native.apply_matrix_batch(self._parity_mat, blocks,
+                                                out=out)
+        nb, _, s = blocks.shape
+        res = gf_native.apply_matrix_batch(
+            self._parity_mat,
+            self._subshard_view(blocks),
+            out=None if out is None else out.reshape(
+                nb, self.parity_blocks * a, s // a
+            ),
+        )
+        return res.reshape(nb, self.parity_blocks, s)
 
     def _apply_parity(self, shards: np.ndarray) -> np.ndarray:
         on_device = (
@@ -174,7 +245,9 @@ class Erasure:
         data = np.frombuffer(memoryview(data), dtype=np.uint8)
         if data.size == 0:
             raise ErrShortData("cannot split empty data")
-        per_shard = ceil_frac(data.size, self.data_blocks)
+        per_shard = self._round_shard(
+            ceil_frac(data.size, self.data_blocks)
+        )
         padded = np.zeros(self.total_shards * per_shard, dtype=np.uint8)
         padded[: data.size] = data
         return list(padded.reshape(self.total_shards, per_shard))
@@ -243,11 +316,17 @@ class Erasure:
             # Synchronous but fast (GFNI/SSSE3); the writers hash each
             # shard with the native AVX2 HighwayHash, so no fused-digest
             # dispatch is needed.
-            from ..ops import gf_native
-
-            return gf_native.apply_matrix_batch(self._parity_mat, blocks), None
+            return self.parity_apply_batch_native(blocks), None
         if engine == "numpy":
-            parity = self._entry.host_apply(self._parity_mat, blocks)
+            if self.subshards > 1:
+                s = blocks.shape[-1]
+                parity = self._entry.host_apply(
+                    self._parity_mat, self._subshard_view(blocks)
+                )
+                parity = parity.reshape(*parity.shape[:-2],
+                                        self.parity_blocks, s)
+            else:
+                parity = self._entry.host_apply(self._parity_mat, blocks)
             return parity, None
         if engine == "mesh":
             # Lane-sharded mesh dispatch: same fused parity+digest

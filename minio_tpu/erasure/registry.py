@@ -45,12 +45,16 @@ from typing import Callable
 
 import numpy as np
 
-from ..ops import cauchy, gf
+from ..ops import cauchy, gf, regen
 
 # Stable per-object codec identities — PERSISTED in xl.meta; renaming
 # one orphans every object written under it.
 DENSE_GF8 = "dense-gf8"
 CAUCHY_XOR = "cauchy-xor"
+# Regenerating codec (ops/regen.py): the roadmap's msr-pm id, served by
+# the coupled-layer/piggyback constructions (see that module's honest
+# naming note).
+MSR_PM = "msr-pm"
 
 # Default codec: what an absent "cid" field in pre-registry metadata
 # means, and the auto-selection incumbent.
@@ -113,10 +117,40 @@ class CodecEntry:
     # Optional schedule accounting (XOR-schedule codecs) for bench/probe.
     schedule_stats: Callable[[np.ndarray], dict] | None = None
     max_shards: int = gf.MAX_SHARDS
+    # Sub-packetization α(k, m): shard byte-lengths must be multiples of
+    # it and the matrix constructors address sub-shards (codecs whose
+    # matrices are expanded ×α). None == 1 == plain shard granularity.
+    subshards: Callable[[int, int], int] | None = None
+    # Bandwidth-optimal repair capability: (k, m, target) -> RepairPlan
+    # (ops/regen.RepairPlan) or None when the target has no β-plan.
+    repair_plan: Callable[[int, int, int], object] | None = None
+    # Declared mean bytes READ per byte healed for a 1-shard repair
+    # (dense RS reads k) — what heal-heavy auto-selection ranks by.
+    repair_read_fraction: Callable[[int, int], float] | None = None
+    # Extra geometry predicate beyond the max_shards envelope (codecs
+    # with construction constraints, e.g. sub-packetization caps).
+    geometry: Callable[[int, int], bool] | None = None
 
     def geometry_ok(self, data_blocks: int, parity_blocks: int) -> bool:
-        return (data_blocks > 0 and parity_blocks > 0
-                and data_blocks + parity_blocks <= self.max_shards)
+        if not (data_blocks > 0 and parity_blocks > 0
+                and data_blocks + parity_blocks <= self.max_shards):
+            return False
+        if self.geometry is not None:
+            return bool(self.geometry(data_blocks, parity_blocks))
+        return True
+
+    def alpha(self, data_blocks: int, parity_blocks: int) -> int:
+        if self.subshards is None:
+            return 1
+        return int(self.subshards(data_blocks, parity_blocks))
+
+    def declared_repair_fraction(self, data_blocks: int,
+                                 parity_blocks: int) -> float:
+        """Bytes read per byte healed for a single-shard repair — the
+        dense k-survivor cost unless the codec declares better."""
+        if self.repair_read_fraction is None:
+            return float(data_blocks)
+        return float(self.repair_read_fraction(data_blocks, parity_blocks))
 
 
 def _dense_host_apply(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
@@ -178,6 +212,25 @@ register(CodecEntry(
     host_apply=_cauchy_host_apply,
     feed_bounds={"mesh": 0.60, "device": 0.50},
     schedule_stats=cauchy.schedule_stats,
+))
+
+register(CodecEntry(
+    codec_id=MSR_PM,
+    wire_algorithm="rs-msr-pm",
+    # Host substrates only: the expanded sub-shard matrices ride the
+    # native any-matrix kernel (or the numpy bit-matmul oracle); the
+    # worker-pool children and device/mesh engines do not carry the
+    # sub-shard reshape, and repair-bandwidth heal needs host-side
+    # β-slice reads anyway.
+    substrates=frozenset({"native", "numpy"}),
+    coding_matrix=regen.coding_matrix,
+    parity_matrix=regen.parity_matrix,
+    reconstruct_matrix=regen.reconstruct_matrix,
+    host_apply=_dense_host_apply,
+    subshards=regen.subshards,
+    repair_plan=regen.repair_plan,
+    repair_read_fraction=regen.repair_read_fraction,
+    geometry=regen.geometry_ok,
 ))
 
 
@@ -244,8 +297,10 @@ def probe_gbps(codec_id: str, engine: str) -> float:
         return value
     k, m = _PROBE_GEOMETRY
     mat = entry.parity_matrix(k, m)
+    alpha = entry.alpha(k, m)
     rng = np.random.default_rng(0x5EED)
-    blocks = rng.integers(0, 256, size=(2, k, _PROBE_SHARD),
+    blocks = rng.integers(0, 256, size=(2, k * alpha,
+                                        _PROBE_SHARD // alpha),
                           dtype=np.uint8)
     nbytes = blocks.nbytes
     if engine == "native":
@@ -257,7 +312,7 @@ def probe_gbps(codec_id: str, engine: str) -> float:
             lambda: gf_native.apply_matrix_batch(mat, blocks), nbytes
         )
     elif engine == "numpy":
-        shards = blocks.reshape(2 * k, _PROBE_SHARD)[:k]
+        shards = blocks[0]
         value = _measure(
             lambda: entry.host_apply(mat, shards), shards.nbytes
         )
@@ -282,9 +337,12 @@ def probe_geometry_gbps(codec_id: str, data_blocks: int,
     compares."""
     entry = get(codec_id)
     mat = entry.parity_matrix(data_blocks, parity_blocks)
+    alpha = entry.alpha(data_blocks, parity_blocks)
     rng = np.random.default_rng(0x5EED)
     blocks = rng.integers(
-        0, 256, size=(2, data_blocks, _PROBE_SHARD), dtype=np.uint8
+        0, 256,
+        size=(2, data_blocks * alpha, _PROBE_SHARD // alpha),
+        dtype=np.uint8,
     )
     from ..ops import gf_native
 
@@ -375,12 +433,33 @@ def _engine_rank(codec_id: str, engine: str) -> tuple:
 
 # --- codec selection ---------------------------------------------------
 
+# Selection profiles: "throughput" (default) ranks auto-candidates by
+# measured encode rate; "heal-heavy" ranks by the entry's declared
+# repair-read fraction (bytes read per byte healed — exact, derived
+# from the codec's verified repair plans), encode rate as tiebreak.
+_CODEC_PROFILES = ("throughput", "heal-heavy")
+
+
+def _codec_profile() -> str:
+    import os
+
+    # MTPU_CODEC_PROFILE: "throughput" | "heal-heavy" (call-site
+    # default "throughput"); re-read per selection so operators can
+    # repoint a running server's storage class.
+    prof = os.environ.get("MTPU_CODEC_PROFILE", "throughput")
+    return prof if prof in _CODEC_PROFILES else "throughput"
+
+
 def select_codec(data_blocks: int, parity_blocks: int,
                  forced: str = "") -> str:
     """Codec id a write should stamp for this geometry. Precedence:
     `forced` (per-request, e.g. the x-mtpu-codec header) > MTPU_CODEC
-    env (a codec id, or 'auto' — the documented default) > measured
-    auto-selection with the dense incumbent favored by AUTO_HYSTERESIS.
+    env (a codec id, or 'auto' — the documented default) > auto-
+    selection with the dense incumbent favored by AUTO_HYSTERESIS.
+    Under MTPU_CODEC_PROFILE=heal-heavy the auto rank flips from
+    measured encode rate to declared repair bandwidth (a challenger
+    must cut bytes-read-per-byte-healed by the same hysteresis factor
+    to displace the incumbent — deterministic, so no flapping).
     Unknown forced ids raise KeyError (the API layer maps it to
     InvalidArgument); geometry misfits raise ValueError."""
     import os
@@ -395,7 +474,7 @@ def select_codec(data_blocks: int, parity_blocks: int,
             )
         chosen = entry.codec_id
     else:
-        chosen = _auto_codec(data_blocks, parity_blocks)
+        chosen = _auto_codec(data_blocks, parity_blocks, _codec_profile())
     reg = _reg()
     if reg is not None:
         reg.inc("mtpu_codec_selected_total", codec=chosen,
@@ -403,14 +482,17 @@ def select_codec(data_blocks: int, parity_blocks: int,
     return chosen
 
 
-@functools.lru_cache(maxsize=32)
-def _auto_codec(data_blocks: int, parity_blocks: int) -> str:
+@functools.lru_cache(maxsize=64)
+def _auto_codec(data_blocks: int, parity_blocks: int,
+                profile: str = "throughput") -> str:
     incumbent = DEFAULT_CODEC
     if not get(incumbent).geometry_ok(data_blocks, parity_blocks):
         for cid, entry in _REGISTRY.items():
             if entry.geometry_ok(data_blocks, parity_blocks):
                 return cid
         return incumbent
+    if profile == "heal-heavy":
+        return _auto_codec_heal_heavy(data_blocks, parity_blocks)
     best, best_gbps = incumbent, probe_geometry_gbps(
         incumbent, data_blocks, parity_blocks
     )
@@ -423,6 +505,35 @@ def _auto_codec(data_blocks: int, parity_blocks: int) -> str:
         gbps = probe_geometry_gbps(cid, data_blocks, parity_blocks)
         if gbps > floor and gbps > best_gbps:
             best, best_gbps = cid, gbps
+    return best
+
+
+def _auto_codec_heal_heavy(data_blocks: int, parity_blocks: int) -> str:
+    """Heal-heavy rank: a challenger displaces the incumbent only when
+    its declared repair-read fraction (from its verified repair plans)
+    beats the incumbent's by AUTO_HYSTERESIS — declared fractions are
+    deterministic per geometry, so the pick cannot flap with probe
+    noise. Measured encode rate breaks fraction ties."""
+    incumbent = DEFAULT_CODEC
+    best = incumbent
+    best_frac = get(incumbent).declared_repair_fraction(
+        data_blocks, parity_blocks
+    )
+    ceiling = best_frac / AUTO_HYSTERESIS
+    for cid, entry in _REGISTRY.items():
+        if cid == incumbent:
+            continue
+        if not entry.geometry_ok(data_blocks, parity_blocks):
+            continue
+        frac = entry.declared_repair_fraction(data_blocks, parity_blocks)
+        if frac >= ceiling:
+            continue
+        if frac < best_frac or (
+            frac == best_frac
+            and probe_geometry_gbps(cid, data_blocks, parity_blocks)
+            > probe_geometry_gbps(best, data_blocks, parity_blocks)
+        ):
+            best, best_frac = cid, frac
     return best
 
 

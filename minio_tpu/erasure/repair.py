@@ -1,0 +1,200 @@
+"""Distributed repair plane — repair-bandwidth-optimal heal of ONE
+stale shard under a regenerating codec (ops/regen.py via the codec
+registry).
+
+The dense heal path (streaming.heal_stream) reads k whole surviving
+shards to rebuild one: k bytes of disk read per byte healed. A
+regenerating codec's repair plan reads only β = α/m sub-shards from
+each of d = n−1 survivors, so the disk cost drops to (n−1)/m bytes per
+byte healed (4+4 → 1.75×, vs 4× dense) and — for remote survivors —
+only the β-slices cross the wire (storage-REST ``read_repair_symbol``),
+not whole shards.
+
+Mechanics: a survivor's shard file is a sequence of bitrot frames
+[digest || chunk] where chunk is the α-rounded per-block shard slice
+(codec.Erasure.shard_size(); the final block's chunk may be shorter).
+Sub-shard j of block b therefore lives at
+``b·(digest+shard) + digest + j·(chunk/α)``. The healer fans the plan's
+(helper → sub-shard set) reads across survivors, stacks the returned
+β-slices into the plan's symbol order, and applies the precomputed
+repair matrix (one [α, d·β] GF(2^8) matrix per target) — the same
+``gf_native.apply_matrix_batch`` any-matrix kernel the encode path
+uses, with the codec's numpy ``host_apply`` as the byte-identical
+in-process fallback.
+
+Repair reads skip bitrot verification by design: a β-slice cannot be
+checked without reading the whole framed chunk, which would erase the
+bandwidth win. The healed shard is re-framed with fresh digests by the
+caller's StreamingBitrotWriter, and any corruption in a survivor
+surfaces on that survivor's next verified read exactly as it would
+have before this plane existed. The dense fallback still verifies
+end-to-end.
+
+Anything this plane cannot serve — codec has no plan for the target,
+more than one stale shard, fewer than n−1 survivors, inline object,
+non-streaming bitrot framing, kill switch — raises RepairUnavailable
+and the caller falls back to the dense path, byte-identical output
+either way.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..observability import ioflow
+from . import registry
+
+# Cap on concurrent survivor reads per repair; the plan has at most
+# n−1 helpers so this only matters for very wide geometries.
+_MAX_FANOUT = 8
+
+# Per-window budget for gathered repair symbols (bytes of X). Windows
+# bound memory, not correctness — one RPC round trip per helper per
+# window.
+_WINDOW_BYTES = 4 << 20
+
+
+class RepairUnavailable(Exception):
+    """Regenerating repair cannot serve this heal; use the dense path."""
+
+
+def enabled() -> bool:
+    """Kill switch for the repair plane. MTPU_REPAIR=0 forces every
+    heal down the dense read-k-shards path (call-site default "1");
+    re-read per heal so a live flip takes effect without restart."""
+    return os.environ.get("MTPU_REPAIR", "1") == "1"
+
+
+@dataclass(frozen=True)
+class SymbolSource:
+    """Where one survivor's repair symbols live: a StorageAPI disk, the
+    shard-file coordinates, and the bitrot frame digest size (streaming
+    algorithms only — whole-file hashes have no frames to offset past)."""
+
+    disk: object
+    volume: str
+    path: str
+    digest_size: int
+
+
+def plan_for(erasure, target: int):
+    """The codec's repair plan for shard `target`, or None when the
+    codec declares none (dense codecs; piggyback parity targets)."""
+    entry = registry.get(erasure.codec_id)
+    if entry.repair_plan is None:
+        return None
+    return entry.repair_plan(erasure.data_blocks, erasure.parity_blocks,
+                             target)
+
+
+def repair_part(erasure, target: int, sources: list, writer,
+                part_size: int) -> int:
+    """Regenerate shard `target` of one part onto `writer` from the
+    plan's β-slices. `sources` maps shard index → SymbolSource (None at
+    `target`; every helper the plan names must be non-None). Returns
+    bytes written. Raises RepairUnavailable when the plan cannot serve
+    this part; the caller falls back to heal_stream."""
+    if not enabled():
+        raise RepairUnavailable("repair plane disabled (MTPU_REPAIR=0)")
+    plan = plan_for(erasure, target)
+    if plan is None:
+        raise RepairUnavailable(
+            f"codec {erasure.codec_id!r} has no repair plan for "
+            f"shard {target}"
+        )
+    for helper, _subs in plan.reads:
+        if sources[helper] is None:
+            raise RepairUnavailable(
+                f"survivor shard {helper} unavailable (plan needs all "
+                f"{len(plan.reads)} helpers)"
+            )
+    if part_size <= 0:
+        return 0
+
+    alpha = plan.alpha
+    shard = erasure.shard_size()
+    full_blocks = part_size // erasure.block_size
+    tail_chunk = erasure.shard_file_size(part_size) - full_blocks * shard
+
+    # Windows of uniform chunk length (the batched matrix application
+    # needs one sub-symbol length per dispatch): full blocks in
+    # _WINDOW_BYTES-bounded runs, then the shorter tail block alone.
+    windows: list[list[tuple[int, int]]] = []
+    if full_blocks:
+        per_block = plan.total_symbols * (shard // alpha)
+        step = max(1, _WINDOW_BYTES // max(1, per_block))
+        for lo in range(0, full_blocks, step):
+            hi = min(full_blocks, lo + step)
+            windows.append([(b, shard) for b in range(lo, hi)])
+    if tail_chunk:
+        windows.append([(full_blocks, tail_chunk)])
+
+    written = 0
+    holder = ioflow.capture()
+    with ThreadPoolExecutor(
+        max_workers=min(len(plan.reads), _MAX_FANOUT)
+    ) as pool:
+        for window in windows:
+            x = _gather(plan, sources, shard, window, pool, holder)
+            out = _apply(erasure, plan.matrix, x)
+            for i in range(len(window)):
+                chunk = out[i].tobytes()
+                writer.write(chunk)
+                written += len(chunk)
+    return written
+
+
+def _gather(plan, sources: list, shard: int,
+            window: list[tuple[int, int]], pool, holder) -> np.ndarray:
+    """Fan the window's β-slice reads across the plan's helpers and
+    stack them into [nb, total_symbols, sub_len] in plan symbol order.
+    Each helper is ONE read_repair_symbol call — one RPC round trip for
+    remote survivors, with the received bytes ledgered as heal `rwire`
+    by RemoteStorage."""
+    nb = len(window)
+    chunk_len = window[0][1]
+    alpha = plan.alpha
+    sub_len = chunk_len // alpha
+    x = np.empty((nb, plan.total_symbols, sub_len), dtype=np.uint8)
+    futs = []
+    col = 0
+    for helper, subs in plan.reads:
+        src = sources[helper]
+        futs.append((
+            pool.submit(
+                ioflow.bound(holder, src.disk.read_repair_symbol),
+                src.volume, src.path,
+                stride=src.digest_size + shard,
+                digest_size=src.digest_size,
+                alpha=alpha, subs=list(subs), blocks=window,
+            ),
+            col, len(subs),
+        ))
+        col += len(subs)
+    for fut, c0, nsub in futs:
+        data = fut.result()
+        if len(data) != nb * nsub * sub_len:
+            raise RepairUnavailable(
+                f"repair symbol read returned {len(data)} bytes, "
+                f"expected {nb * nsub * sub_len}"
+            )
+        x[:, c0:c0 + nsub, :] = np.frombuffer(
+            data, dtype=np.uint8
+        ).reshape(nb, nsub, sub_len)
+    return x
+
+
+def _apply(erasure, matrix: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """[α, total_syms] repair matrix × [nb, total_syms, sub_len]
+    symbols → [nb, α, sub_len] (the target's α sub-shards per block).
+    Native kernel when present, codec host_apply otherwise — both
+    byte-identical realizations of the same GF(2^8) matmul."""
+    from ..ops import gf_native
+
+    if gf_native.available():
+        return gf_native.apply_matrix_batch(matrix, x)
+    return registry.get(erasure.codec_id).host_apply(matrix, x)

@@ -631,8 +631,8 @@ def _encode_stream_native(erasure: Erasure, src, writer: ParallelWriter,
     while not filler.eof:
         nb, tail = filler.fill(buf)
         if nb:
-            parity = gf_native.apply_matrix_batch(
-                erasure._parity_mat, buf[:nb].reshape(nb, k, shard)
+            parity = erasure.parity_apply_batch_native(
+                buf[:nb].reshape(nb, k, shard)
             )
             writer.write_frame_batches(buf, parity, nb, k, m, shard)
             total += nb * erasure.block_size
@@ -741,8 +741,8 @@ def _encode_stream_native_pipelined(erasure: Erasure, src,
     def encode(item):
         buf, nb, tail = item[0], item[1], item[2]
         if nb:
-            item[3] = gf_native.apply_matrix_batch(
-                erasure._parity_mat, buf[:nb].reshape(nb, k, shard)
+            item[3] = erasure.parity_apply_batch_native(
+                buf[:nb].reshape(nb, k, shard)
             )
         item[4] = erasure.encode_data(tail) if tail is not None else None
         return item
@@ -884,8 +884,8 @@ def _encode_stream_native_workers(erasure: Erasure, src,
 
     def encode_inprocess(item):
         strip, nb = item[0], item[1]
-        item[3] = gf_native.apply_matrix_batch(
-            erasure._parity_mat, strip.data[:nb].reshape(nb, k, shard)
+        item[3] = erasure.parity_apply_batch_native(
+            strip.data[:nb].reshape(nb, k, shard)
         )
         item[5] = None  # frame-write hashes in-process
 

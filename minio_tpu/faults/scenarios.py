@@ -1378,6 +1378,47 @@ def inv_hot_object_coherent(h: ScenarioHarness, _oracle) -> list[str]:
     return out
 
 
+def inv_repair_bandwidth(h: ScenarioHarness, _oracle) -> list[str]:
+    """Heal byte economics at drain (ISSUE 20). Whatever mix of codecs
+    the run healed under, the ledger's heal disk-read ratio must land
+    in the union envelope [k/m, k]: the dense path reads k whole
+    shards per rebuilt shard (ratio k, or k/m when one pass rebuilds
+    all m), and the regenerating repair plane reads (n-1)/m — which
+    sits strictly inside that envelope for every m >= 2 geometry. A
+    ratio above k means some heal read MORE than the dense worst case
+    (a repair fan-out that fell back after reading, doubled reads);
+    below k/m means heal writes landed without their reads being
+    ledgered. Wire bytes (rwire, remote repair symbols) can never
+    exceed the disk reads that produced them. No-op when the run
+    healed nothing."""
+    from ..observability import ioflow
+
+    spec = getattr(h, "spec", None)
+    if spec is None:
+        return []
+    k = spec.disks - spec.parity
+    m = spec.parity
+    heal = ioflow.op_totals(ioflow.snapshot()).get("heal", {})
+    w = heal.get("write", 0)
+    if not w:
+        return []
+    out = []
+    r = heal.get("read", 0) / w
+    if r < (k / m) * (1 - _RECON_TOL):
+        out.append(f"repair-bandwidth: heal ratio {r:.2f} below k/m="
+                   f"{k / m:.2f} — heal writes without ledgered reads")
+    if r > k * (1 + _RECON_TOL):
+        out.append(f"repair-bandwidth: heal ratio {r:.2f} above the "
+                   f"dense-RS ceiling k={k} — a heal read more than "
+                   f"the read-k-shards worst case")
+    rw = heal.get("rwire", 0)
+    if rw > heal.get("read", 0) * (1 + _RECON_TOL):
+        out.append(f"repair-bandwidth: {rw} repair wire bytes exceed "
+                   f"{heal.get('read', 0)} heal disk reads — wire "
+                   f"symbols appeared from nowhere")
+    return out
+
+
 def inv_mesh_stats_clean(h: ScenarioHarness, _oracle) -> list[str]:
     """Mesh-engine STATS contract as a drain invariant (ISSUE 17): over
     the scenario, every mesh dispatch carried exactly one dp-group
@@ -1423,6 +1464,7 @@ INVARIANTS = {
     "hot_object_coherent": inv_hot_object_coherent,
     "stall_bounded": inv_stall_bounded,
     "mesh_stats_clean": inv_mesh_stats_clean,
+    "repair_bandwidth": inv_repair_bandwidth,
 }
 
 _CONTINUOUS = ("lock_cycles", "no_orphan_workers")
@@ -1689,7 +1731,8 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
                    storm_objects: int = 24, fg_clients: int = 4,
                    fg_ops: int = 30, payload: int = 64 << 10,
                    p99_mult: float | None = None,
-                   pace_tokens: int = 2) -> dict:
+                   pace_tokens: int = 2, codec: str = "",
+                   repair_ceiling: float | None = None) -> dict:
     """One drive dead (fresh-disk replacement: its objects wiped below
     the fault layer), the whole backlog queued into the MRF, and the
     paced healer drains it WHILE zipfian foreground traffic runs.
@@ -1706,6 +1749,14 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
       samples get in-flight slack (reads ledger before their write);
     - every storm object reads back byte-identical and the victim
       drive holds its shard again (the heal actually landed).
+
+    `codec` forces every storm PUT onto one codec id instead of
+    cycling the full registry — the regenerating-codec gate variant
+    (ISSUE 20) runs with codec="msr-pm" and `repair_ceiling`=4.5,
+    which additionally asserts the heal disk-read ratio stays at or
+    under the ceiling at EVERY ledger sample and at the final drain:
+    the repair plane's (n-1)/m economics must hold mid-storm, not
+    just on average.
     """
     import shutil
 
@@ -1729,7 +1780,8 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
     try:
         h = ScenarioHarness(root, spec)
         bodies: dict[str, bytes] = {}
-        codecs = _soak_codecs()
+        codecs = [codec] if codec else _soak_codecs()
+        artifact["codec"] = codec or "mixed"
         for i in range(storm_objects):
             key = f"storm/o{i:04d}"
             body = _payload(spec.seed * 92821 + i, payload)
@@ -1802,6 +1854,7 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
 
         def monitor() -> None:
             floor_broken = False
+            ceiling_broken = False
             while not mon_stop.wait(0.2):
                 heal = ioflow.op_totals(ioflow.snapshot()).get("heal", {})
                 w = heal.get("write", 0)
@@ -1814,6 +1867,14 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
                     reasons.append(
                         f"heal ratio {r:.2f} below dense-RS floor "
                         f"k/m={k / m:.2f} mid-drain")
+                if (repair_ceiling is not None and r > repair_ceiling
+                        and not ceiling_broken):
+                    ceiling_broken = True
+                    reasons.append(
+                        f"heal ratio {r:.2f} above the repair-plane "
+                        f"ceiling {repair_ceiling:.2f} mid-drain — a "
+                        f"heal read whole shards where β-slices "
+                        f"sufficed")
 
         mon = threading.Thread(target=monitor, name="storm-ratio-mon")
         mon.start()
@@ -1850,6 +1911,12 @@ def run_heal_storm(spec: ScenarioSpec, root: str, *,
             if final_ratio > k * (1 + _RECON_TOL):
                 reasons.append(f"final heal ratio {final_ratio:.2f} > "
                                f"k={k} dense-RS ceiling")
+            if (repair_ceiling is not None
+                    and final_ratio > repair_ceiling):
+                reasons.append(f"final heal ratio {final_ratio:.2f} > "
+                               f"repair-plane ceiling {repair_ceiling}")
+            artifact["heal_ratio"]["wire"] = round(
+                heal.get("rwire", 0) / heal["write"], 3)
 
         # ---- content + placement verification.
         for key in keys:

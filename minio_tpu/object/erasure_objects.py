@@ -25,6 +25,7 @@ from ..erasure.bitrot import (
 )
 from ..erasure import registry as _codec_registry
 from ..erasure.codec import Erasure
+from ..erasure import repair as _repair
 from ..erasure.streaming import decode_stream, encode_stream, heal_stream
 from ..storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, new_uuid
 from ..storage import local as _local_storage
@@ -959,6 +960,35 @@ class ErasureObjects(MultipartMixin):
         r.local = disk.is_local()
         return r
 
+    def _repair_sources(self, avail_by_shard: list, metas_by_shard: list,
+                        bucket: str, object_: str, fi, part_number: int):
+        """SymbolSource per surviving shard position for the repair
+        plane — the disk plus the shard file's bitrot frame geometry.
+        Survivors framed with a non-streaming bitrot algorithm have no
+        interleaved digests to offset past, so β-slice offsets would be
+        wrong: refuse and let the dense path (which reads through the
+        algorithm-aware StreamingBitrotReader) handle them."""
+        sources: list = [None] * len(avail_by_shard)
+        path = f"{object_}/{fi.data_dir}/part.{part_number}"
+        for s, disk in enumerate(avail_by_shard):
+            if disk is None:
+                continue
+            algo = BitrotAlgorithm.from_string(
+                metas_by_shard[s].erasure.get_checksum_info(
+                    part_number
+                ).algorithm
+            )
+            if not algo.streaming:
+                raise _repair.RepairUnavailable(
+                    f"survivor {s} uses non-streaming bitrot "
+                    f"{algo.value!r}"
+                )
+            sources[s] = _repair.SymbolSource(
+                disk=disk, volume=bucket, path=path,
+                digest_size=algo.digest_size,
+            )
+        return sources
+
     # ------------------------------------------------------------------
     # delete (ref cmd/erasure-object.go:901-1050 DeleteObject(s))
 
@@ -1182,16 +1212,26 @@ class ErasureObjects(MultipartMixin):
             # would verify against nothing.
             erasure = self._object_erasure(data_blocks, parity,
                                            ref_fi.erasure.codec)
+            # Regenerating repair plane (erasure/repair.py): serves a
+            # SINGLE stale shard when the codec declares a repair plan
+            # for it and every other shard survives (the plan needs all
+            # d = n−1 helpers). Each survivor then reads only its
+            # β-slice instead of the whole shard — (n−1)/m bytes of
+            # disk read per byte healed vs k dense. Anything else —
+            # two stale shards, a missing survivor, inline data, a
+            # plan-less codec, MTPU_REPAIR=0, or a mid-repair failure —
+            # falls back to the dense read-k-shards path below,
+            # byte-identical output either way.
+            use_repair = (
+                not inline
+                and len(stale_shards) == 1
+                and _repair.enabled()
+                and all(avail_by_shard[s] is not None
+                        for s in range(len(disks_by_shard))
+                        if s != stale_shards[0])
+                and _repair.plan_for(erasure, stale_shards[0]) is not None
+            )
             for part in ref_fi.parts:
-                till = erasure.shard_file_offset(0, part.size, part.size)
-                readers: list = [None] * len(disks_by_shard)
-                for s in range(len(disks_by_shard)):
-                    if avail_by_shard[s] is None:
-                        continue
-                    readers[s] = self._shard_reader(
-                        avail_by_shard[s], metas_by_shard[s], bucket, object_,
-                        ref_fi, part.number, till, erasure.shard_size(),
-                    )
                 from ..erasure.bitrot import bitrot_shard_file_size
 
                 phys_shard = bitrot_shard_file_size(
@@ -1199,31 +1239,72 @@ class ErasureObjects(MultipartMixin):
                     erasure.shard_size(),
                     BitrotAlgorithm.HIGHWAYHASH256S,
                 )
-                writers: list = [None] * len(disks_by_shard)
-                sinks: dict[int, object] = {}
-                try:
+
+                def _open_sinks():
+                    ws: list = [None] * len(disks_by_shard)
+                    sk: dict[int, object] = {}
                     for s in stale_shards:
                         if inline:
-                            sinks[s] = io.BytesIO()
+                            sk[s] = io.BytesIO()
                         else:
-                            sinks[s] = disks_by_shard[s].create_file_writer(
+                            sk[s] = disks_by_shard[s].create_file_writer(
                                 SYSTEM_META_BUCKET,
                                 f"{self._tmp_path(tmp_id)}/part.{part.number}",
                                 size=phys_shard,
                             )
-                        writers[s] = StreamingBitrotWriter(
-                            sinks[s], BitrotAlgorithm.HIGHWAYHASH256S
+                        ws[s] = StreamingBitrotWriter(
+                            sk[s], BitrotAlgorithm.HIGHWAYHASH256S
                         )
-                    heal_stream(erasure, writers, readers, part.size,
-                                telemetry="heal")
-                except Exception:
-                    # Writer creation OR the heal itself failed: close
-                    # whatever sinks exist (O_DIRECT fds must not wait
-                    # for GC) and drop the staged tmp shards.
-                    if not inline:
+                    return ws, sk
+
+                repaired = False
+                writers: list = []
+                sinks: dict[int, object] = {}
+                if use_repair and part.size > 0:
+                    target = stale_shards[0]
+                    try:
+                        sources = self._repair_sources(
+                            avail_by_shard, metas_by_shard, bucket,
+                            object_, ref_fi, part.number,
+                        )
+                        writers, sinks = _open_sinks()
+                        _repair.repair_part(
+                            erasure, target, sources, writers[target],
+                            part.size,
+                        )
+                        repaired = True
+                    except Exception:  # noqa: BLE001 - dense path heals
+                        # Partial repair output must not survive: the
+                        # dense retry re-creates (truncates) the same
+                        # tmp shard paths.
                         _close_sinks(sinks)
-                    self._cleanup_tmp(disks_by_shard, tmp_id)
-                    raise
+                        sinks = {}
+                if not repaired:
+                    till = erasure.shard_file_offset(
+                        0, part.size, part.size
+                    )
+                    readers: list = [None] * len(disks_by_shard)
+                    for s in range(len(disks_by_shard)):
+                        if avail_by_shard[s] is None:
+                            continue
+                        readers[s] = self._shard_reader(
+                            avail_by_shard[s], metas_by_shard[s], bucket,
+                            object_, ref_fi, part.number, till,
+                            erasure.shard_size(),
+                        )
+                    try:
+                        writers, sinks = _open_sinks()
+                        heal_stream(erasure, writers, readers, part.size,
+                                    telemetry="heal")
+                    except Exception:
+                        # Writer creation OR the heal itself failed:
+                        # close whatever sinks exist (O_DIRECT fds must
+                        # not wait for GC) and drop the staged tmp
+                        # shards.
+                        if not inline:
+                            _close_sinks(sinks)
+                        self._cleanup_tmp(disks_by_shard, tmp_id)
+                        raise
                 for s in stale_shards:
                     if inline:
                         healed_inline[s][part.number] = sinks[s].getvalue()

@@ -74,7 +74,11 @@ IOFLOW_DESCRIPTORS: list[tuple[str, str, str]] = [
      "source bytes of committed PUTs/parts)"),
     ("heal_bytes_read_per_byte_healed", "gauge",
      "Survivor bytes read per byte repaired (== k for dense RS "
-     "single-shard heal; the regenerating-codes baseline)"),
+     "single-shard heal; (n-1)/m for the msr-pm repair plane)"),
+    ("repair_wire_bytes_per_byte_healed", "gauge",
+     "Remote repair-symbol bytes received over storage-REST per byte "
+     "repaired (the repair plane ships beta-slices, not shards; 0 "
+     "when every survivor is local)"),
     ("degraded_get_read_amplification", "gauge",
      "Disk bytes read per byte served on degraded GETs"),
     ("scan_bytes_per_object", "gauge",
@@ -192,7 +196,11 @@ def _counters() -> _Counters:
 
 def account(drive: str, dir_: str, n: int) -> None:
     """Hot path: attribute `n` disk bytes on `drive` to the current
-    op-class. dir_ is one of read/write/rmeta/wmeta."""
+    op-class. dir_ is one of read/write/rmeta/wmeta, plus rwire for
+    repair-symbol bytes received over storage-REST (counted by the
+    CALLING node against the remote endpoint — the serving node's disk
+    read lands in its own ledger as plain `read`, so wire and disk
+    never double-count in one ledger)."""
     if not _armed or n <= 0:
         return
     t = _op_var.get()
@@ -441,6 +449,8 @@ def efficiency(snap: dict | None = None,
     return {
         "heal_bytes_read_per_byte_healed": ratio(
             heal.get("read", 0), heal.get("write", 0)),
+        "repair_wire_bytes_per_byte_healed": ratio(
+            heal.get("rwire", 0), heal.get("write", 0)),
         "degraded_get_read_amplification": ratio(
             deg.get("read", 0), logical_deg),
         "scan_bytes_per_object": ratio(

@@ -72,10 +72,34 @@ class ErasureInfo:
     checksums: list[ChecksumInfo] = field(default_factory=list)
     codec: str = ""  # registry codec id; "" = absent-on-disk (dense)
 
+    def _subshards(self) -> int:
+        """Codec sub-packetization α. Shard byte-lengths are rounded up
+        to multiples of it (erasure/codec.Erasure._round_shard) — the
+        storage layer's size accounting (check_parts/verify_file) MUST
+        agree with the codec layer or every sub-packetized object reads
+        as corrupt and heals forever. "" (pre-registry dense) is α=1."""
+        if not self.codec:
+            return 1
+        from ..erasure import registry
+
+        return registry.get(self.codec).alpha(
+            self.data_blocks, self.parity_blocks
+        )
+
+    def _round_shard(self, size: int) -> int:
+        a = self._subshards()
+        if a <= 1:
+            return size
+        from ..utils import ceil_frac
+
+        return ceil_frac(size, a) * a
+
     def shard_size(self) -> int:
         from ..utils import ceil_frac
 
-        return ceil_frac(self.block_size, self.data_blocks)
+        return self._round_shard(
+            ceil_frac(self.block_size, self.data_blocks)
+        )
 
     def shard_file_size(self, total_length: int) -> int:
         if total_length == 0:
@@ -86,7 +110,9 @@ class ErasureInfo:
         last = total_length % self.block_size
         from ..utils import ceil_frac
 
-        return num * self.shard_size() + ceil_frac(last, self.data_blocks)
+        return num * self.shard_size() + self._round_shard(
+            ceil_frac(last, self.data_blocks)
+        )
 
     def get_checksum_info(self, part_number: int) -> ChecksumInfo:
         for c in self.checksums:

@@ -143,6 +143,45 @@ class StorageAPI(abc.ABC):
     @abc.abstractmethod
     def read_file_stream(self, volume: str, path: str, offset: int, length: int): ...
 
+    def read_repair_symbol(self, volume: str, path: str, *, stride: int,
+                           digest_size: int, alpha: int, subs: list[int],
+                           blocks: list[tuple[int, int]]) -> bytes:
+        """Read repair-symbol (β-slice) bytes from a bitrot-framed shard
+        file: for each (block_index, chunk_len) in `blocks`, the chunk's
+        sub-shards named by `subs` (each chunk_len/alpha bytes), skipping
+        the per-block digest. Returns the slices concatenated block-major
+        in `subs` order — exactly len(blocks)·len(subs)·chunk/alpha
+        bytes, which is ALL this disk reads (and, for remote disks, all
+        that crosses the wire): the bandwidth contract of the repair
+        plane (erasure/repair.py).
+
+        `stride` is the full-block frame length (digest + whole-shard
+        chunk); `blocks` entries carry their own chunk_len because the
+        final block's chunk may be shorter. Repair reads deliberately
+        skip bitrot verification — a β-slice cannot be checked without
+        reading the whole framed chunk, which would defeat the plane;
+        the healed output is re-framed with fresh digests and the dense
+        fallback path still verifies end-to-end.
+
+        Base implementation: one read_file per slice (correct, and the
+        per-endpoint ledger accounting rides read_file). LocalStorage
+        overrides with a single-open pread loop; RemoteStorage ships ONE
+        RPC per call and accounts the β bytes as heal `rwire`."""
+        out = bytearray()
+        for block, chunk_len in blocks:
+            if chunk_len % alpha:
+                raise ValueError(
+                    f"repair chunk {chunk_len} not divisible by "
+                    f"alpha {alpha}"
+                )
+            sub_len = chunk_len // alpha
+            base = block * stride + digest_size
+            for sub in subs:
+                out += self.read_file(
+                    volume, path, base + sub * sub_len, sub_len
+                )
+        return bytes(out)
+
     @abc.abstractmethod
     def create_file_writer(self, volume: str, path: str,
                            size: int = -1):

@@ -577,6 +577,42 @@ class LocalStorage(StorageAPI):
         ioflow.account(self._endpoint, "read", len(buf))
         return buf
 
+    def read_repair_symbol(self, volume: str, path: str, *, stride: int,
+                           digest_size: int, alpha: int, subs: list[int],
+                           blocks: list[tuple[int, int]]) -> bytes:
+        """Single-open variant of the StorageAPI default: one file handle
+        and a seek per β-slice instead of an open per read_file call.
+        Error mapping and per-byte ledger accounting mirror read_file."""
+        self._require_online()
+        out = bytearray()
+        try:
+            with open(self._file_path(volume, path), "rb") as f:
+                for block, chunk_len in blocks:
+                    if chunk_len % alpha:
+                        raise ValueError(
+                            f"repair chunk {chunk_len} not divisible "
+                            f"by alpha {alpha}"
+                        )
+                    sub_len = chunk_len // alpha
+                    base = block * stride + digest_size
+                    for sub in subs:
+                        f.seek(base + sub * sub_len)
+                        buf = f.read(sub_len)
+                        if len(buf) != sub_len:
+                            raise ErrFileCorrupt(
+                                f"short repair read {volume}/{path}"
+                            )
+                        out += buf
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise ErrFileAccessDenied(f"{volume}/{path}") from None
+        ioflow.account(self._endpoint, "read", len(out))
+        from ..pipeline.buffers import copy_add
+
+        copy_add("repair.symbol_join", len(out))
+        return bytes(out)  # copy-ok: repair.symbol_join
+
     def append_file(self, volume: str, path: str, buf: bytes) -> None:
         self._require_online()
         if not os.path.isdir(self._vol_path(volume)):

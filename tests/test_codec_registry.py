@@ -190,7 +190,7 @@ def _erasure_dict(codec_id: str | None) -> dict:
 
 def test_cid_round_trips_and_absent_means_dense():
     # Registry-written metadata round-trips the codec id.
-    for cid in (registry.DENSE_GF8, registry.CAUCHY_XOR):
+    for cid in (registry.DENSE_GF8, registry.CAUCHY_XOR, registry.MSR_PM):
         d = _erasure_dict(cid)
         assert d["cid"] == cid
         back = ErasureInfo.from_dict(d)
@@ -216,6 +216,17 @@ def test_strict_from_dict_fails_loud():
     # Non-legacy algo with NO cid (a reader/rewriter dropped the
     # unknown field): refuse to guess.
     d = _erasure_dict(registry.CAUCHY_XOR)
+    del d["cid"]
+    with pytest.raises(ValueError, match="refusing to guess"):
+        ErasureInfo.from_dict(d)
+    # Same strictness for the regenerating codec: a cid/algo split or a
+    # dropped cid must never resolve to dense matrices over α-packed
+    # sub-shards.
+    d = _erasure_dict(registry.MSR_PM)
+    d["algo"] = ERASURE_ALGORITHM
+    with pytest.raises(ValueError, match="mismatch"):
+        ErasureInfo.from_dict(d)
+    d = _erasure_dict(registry.MSR_PM)
     del d["cid"]
     with pytest.raises(ValueError, match="refusing to guess"):
         ErasureInfo.from_dict(d)
@@ -263,6 +274,28 @@ def test_old_reader_cannot_silently_dense_decode_cauchy():
     assert ErasureInfo.from_dict(legacy).algorithm == ERASURE_ALGORITHM
 
 
+def test_old_reader_cannot_silently_dense_decode_msr(tmp_path):
+    """The msr-pm tripwire is double-walled: the wire algo is non-legacy
+    (same loud exits as cauchy), AND the shard files are α-packed —
+    shard_file_size under the dense reader's α=1 assumption would not
+    even match the bytes on disk for payloads the α-rounding padded."""
+    d = _erasure_dict(registry.MSR_PM)
+    old = _frozen_pre_registry_from_dict(d)
+    assert old.algorithm == "rs-msr-pm" and old.codec == ""
+    # Exit 1: old reader re-serializes, cid lost -> strict reader refuses.
+    with pytest.raises(ValueError, match="refusing to guess"):
+        ErasureInfo.from_dict(old.to_dict())
+    # Exit 2: algo resolves exactly, never to dense.
+    assert registry.wire_algorithm_to_codec("rs-msr-pm") == registry.MSR_PM
+    # The α wall: the same geometry disagrees on shard sizing between
+    # the stamped codec and the dense default, so even a reader that
+    # somehow bypassed the algo tripwire reads misaligned frames.
+    msr = ErasureInfo.from_dict(_erasure_dict(registry.MSR_PM))
+    dense = ErasureInfo.from_dict(_erasure_dict(registry.DENSE_GF8))
+    odd = (1 << 20) + 13  # tail chunk not a multiple of k*α
+    assert msr.shard_file_size(odd) != dense.shard_file_size(odd)
+
+
 def test_meta_hash_covers_codec():
     from minio_tpu.object.metadata import _meta_hash
 
@@ -275,8 +308,12 @@ def test_meta_hash_covers_codec():
         return f
 
     # Disks disagreeing on codec must never merge into one version.
-    assert _meta_hash(fi(registry.DENSE_GF8)) \
-        != _meta_hash(fi(registry.CAUCHY_XOR))
+    hashes = {
+        _meta_hash(fi(cid))
+        for cid in (registry.DENSE_GF8, registry.CAUCHY_XOR,
+                    registry.MSR_PM)
+    }
+    assert len(hashes) == 3
 
 
 # --- end-to-end: pre-registry on-disk metadata stays readable ---------
@@ -324,3 +361,34 @@ def test_pre_registry_object_decodes_heals_unchanged(tmp_path):
     res = z.heal_object("bkt", "old-world")
     assert res["healed"], res
     assert z.get_object_bytes("bkt", "old-world") == payload
+
+
+def test_mixed_codec_bucket_heals_per_object(tmp_path):
+    """One bucket, one object per registered codec, one dead disk: heal
+    must resolve EACH object's codec from its own xl.meta — matrices,
+    α-packed shard sizing, and (for msr-pm) the repair plan all differ
+    per object — and every GET must round-trip afterward."""
+    from minio_tpu.object.types import ObjectOptions
+
+    z, disks_all = make_pools(tmp_path, n_disks=6, parity=2)
+    disks = disks_all[0]
+    z.make_bucket("bkt")
+    rng = np.random.default_rng(11)
+    payloads = {}
+    for cid in registry.codec_ids():
+        payloads[cid] = rng.integers(
+            0, 256, (1 << 20) + 17 * len(cid), np.uint8).tobytes()
+        z.put_object("bkt", f"obj-{cid}", io.BytesIO(payloads[cid]),
+                     len(payloads[cid]), ObjectOptions(codec=cid))
+
+    # One disk loses everything it held for the bucket.
+    victim = disks[2]
+    import shutil
+    shutil.rmtree(os.path.join(victim.root, "bkt"), ignore_errors=True)
+
+    for cid in registry.codec_ids():
+        res = z.heal_object("bkt", f"obj-{cid}")
+        assert res["healed"], (cid, res)
+        fi = victim.read_version("bkt", f"obj-{cid}", "", False)
+        assert fi.erasure.codec == cid
+        assert z.get_object_bytes("bkt", f"obj-{cid}") == payloads[cid]

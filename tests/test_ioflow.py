@@ -219,6 +219,7 @@ def test_report_shape():
     assert rep["opTotals"]["put"]["write"] == 10
     assert set(rep["efficiency"]) == {
         "heal_bytes_read_per_byte_healed",
+        "repair_wire_bytes_per_byte_healed",
         "degraded_get_read_amplification",
         "scan_bytes_per_object",
     }

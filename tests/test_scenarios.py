@@ -656,3 +656,27 @@ def test_mini_heal_storm_paces_drains_and_restores(tmp_path):
     from minio_tpu.background import healpace
 
     assert healpace.installed() is None
+
+
+def test_mini_heal_storm_msr_repair_plane(tmp_path):
+    """Tier-1-sized ISSUE 20 gate: the mini storm forced onto the
+    regenerating codec (msr-pm at 2+2, clay arm, α=4) must drain with
+    the heal disk-read ratio at or under the 4.5 acceptance ceiling at
+    every sample — the repair plane reads (n-1)/m = 1.5 bytes per byte
+    healed where dense reads k = 2."""
+    spec = _mini_spec(hot_keys=0)
+    art = scenarios.run_heal_storm(spec, str(tmp_path), storm_objects=6,
+                                   fg_clients=2, fg_ops=8,
+                                   payload=32 << 10, codec="msr-pm",
+                                   repair_ceiling=4.5)
+    assert art["passed"], json.dumps(
+        {k: v for k, v in art.items() if k != "spec"}, indent=2)
+    assert art["codec"] == "msr-pm"
+    assert art["mrf_left"] == 0
+    assert art["victim_restored"] == 6
+    assert art["heal_ratio"]["final"] <= 4.5, art["heal_ratio"]
+    k, m = spec.disks - spec.parity, spec.parity
+    # Strictly under the dense k/1 = 2.0 economics: ~1.5 proves the
+    # β-slice reads happened rather than a silent dense fallback.
+    assert art["heal_ratio"]["final"] <= 1.6, art["heal_ratio"]
+    assert art["heal_ratio"]["final"] >= (k / m) * 0.98
